@@ -1,0 +1,120 @@
+"""Model configuration for the Llama decoder family.
+
+One config dataclass covers Llama-2/3, TinyLlama, Mistral and friends —
+they differ only in dimensions, GQA ratio, rope theta and vocab. The
+reference stack treats models as opaque strings passed to `vllm serve`
+(reference: helm/templates/deployment-vllm-multi.yaml:57-64); here model
+architecture is first-class so the engine can build/shard/jit it.
+"""
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str = "debug-llama"
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 22
+    num_heads: int = 32
+    num_kv_heads: int = 4
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 4096
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def num_params(self) -> int:
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        hd = self.head_dim_
+        per_layer = (
+            h * (self.num_heads * hd)            # q
+            + 2 * h * (self.num_kv_heads * hd)   # k, v
+            + (self.num_heads * hd) * h          # o
+            + 3 * h * i                          # gate, up, down
+            + 2 * h                              # norms
+        )
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return self.num_layers * per_layer + emb + h
+
+    @staticmethod
+    def from_hf_config(cfg: Dict[str, Any], name: str = "",
+                       dtype: Any = jnp.bfloat16) -> "ModelConfig":
+        """Map a HuggingFace LlamaConfig/MistralConfig dict onto ModelConfig."""
+        return ModelConfig(
+            name=name or cfg.get("_name_or_path", "hf-model"),
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            dtype=dtype,
+        )
+
+    @staticmethod
+    def from_json(path: str, dtype: Any = jnp.bfloat16) -> "ModelConfig":
+        with open(os.path.join(path, "config.json") if os.path.isdir(path) else path) as f:
+            return ModelConfig.from_hf_config(json.load(f), name=path, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Presets. Dimensions are the publicly documented architecture shapes.
+# ---------------------------------------------------------------------------
+
+PRESETS: Dict[str, ModelConfig] = {
+    # Tiny model for CPU tests — intentionally small, MXU-aligned dims.
+    "debug-tiny": ModelConfig(
+        name="debug-tiny", vocab_size=512, hidden_size=128,
+        intermediate_size=384, num_layers=2, num_heads=4, num_kv_heads=2,
+        max_position_embeddings=512,
+    ),
+    "tinyllama-1.1b": ModelConfig(
+        name="tinyllama-1.1b", vocab_size=32000, hidden_size=2048,
+        intermediate_size=5632, num_layers=22, num_heads=32, num_kv_heads=4,
+        max_position_embeddings=2048,
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+        rope_theta=500000.0, max_position_embeddings=8192,
+    ),
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        max_position_embeddings=32768,
+    ),
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name in PRESETS:
+        return PRESETS[name]
+    if os.path.exists(name):
+        return ModelConfig.from_json(name)
+    raise KeyError(
+        f"unknown model {name!r}; presets: {sorted(PRESETS)} or a path to an "
+        "HF checkpoint directory"
+    )

@@ -1,0 +1,99 @@
+"""Load HuggingFace Llama-family checkpoints into the stacked-params layout.
+
+Accepts either a state-dict-like mapping (name -> numpy/torch tensor) or a
+checkpoint directory (safetensors preferred, torch .bin fallback). Torch is
+used only as a host-side file reader — nothing torch touches the device.
+
+HF stores projections as [out, in]; we store [in, out] (x @ W), so every
+projection is transposed on load, and per-layer tensors are stacked along
+the leading layer axis to match models/llama.py's scan layout.
+"""
+
+import glob
+import json
+import os
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_LAYER_MAP = {
+    # our-name: (hf-suffix, transpose)
+    "attn_norm": ("input_layernorm.weight", False),
+    "q": ("self_attn.q_proj.weight", True),
+    "k": ("self_attn.k_proj.weight", True),
+    "v": ("self_attn.v_proj.weight", True),
+    "o": ("self_attn.o_proj.weight", True),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "gate": ("mlp.gate_proj.weight", True),
+    "up": ("mlp.up_proj.weight", True),
+    "down": ("mlp.down_proj.weight", True),
+}
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    # torch tensor (possibly bf16, which numpy can't represent) — go via fp32
+    return t.detach().to(dtype=__import__("torch").float32).cpu().numpy()
+
+
+def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
+    """Build the stacked-params pytree from an HF LlamaForCausalLM state dict."""
+    import jax.numpy as jnp
+
+    def get(name: str, bare: bool = False) -> np.ndarray:
+        return _to_numpy(_lookup(sd, name, bare=bare))
+
+    def cast(x: np.ndarray, transpose: bool) -> Any:
+        if transpose:
+            x = x.T
+        return jnp.asarray(x, dtype=cfg.dtype)
+
+    layers: Dict[str, Any] = {}
+    for ours, (suffix, transpose) in _LAYER_MAP.items():
+        stacked = np.stack(
+            [get(f"layers.{i}.{suffix}") for i in range(cfg.num_layers)])
+        if transpose:
+            stacked = np.swapaxes(stacked, -1, -2)
+        layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
+
+    params = {
+        "embed": cast(get("embed_tokens.weight"), False),
+        "layers": layers,
+        "final_norm": cast(get("norm.weight"), False),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = cast(get("lm_head.weight", bare=True), True)
+    return params
+
+
+def _lookup(sd: Mapping[str, Any], name: str, bare: bool = False) -> Any:
+    candidates = [name] if bare else []
+    candidates += [f"model.{name}", name]
+    for c in candidates:
+        if c in sd:
+            return sd[c]
+    raise KeyError(f"missing weight {name!r}")
+
+
+def load_checkpoint(cfg: ModelConfig, path: str) -> Dict:
+    """Load params from an HF checkpoint directory on disk."""
+    st_files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    sd: Dict[str, Any] = {}
+    if st_files:
+        from safetensors.numpy import load_file
+        for f in st_files:
+            sd.update(load_file(f))
+    else:
+        import torch
+        for f in sorted(glob.glob(os.path.join(path, "*.bin"))):
+            sd.update(torch.load(f, map_location="cpu", weights_only=True))
+    if not sd:
+        raise FileNotFoundError(f"no weights (*.safetensors|*.bin) in {path}")
+    logger.info("loaded %d tensors from %s", len(sd), path)
+    return params_from_state_dict(cfg, sd)

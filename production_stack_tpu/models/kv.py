@@ -1,0 +1,48 @@
+"""KV cache container shared by models/ and engine/.
+
+Slot-based, statically-shaped cache: each running sequence owns one batch
+slot of a preallocated [L, B, S, Hkv, D] buffer. Static shapes keep every
+decode step a single cached XLA executable; per-sequence lengths are data
+(positions/masks), not shapes.
+
+The reference stack's KV management is configuration around LMCache env
+vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178); the
+actual in-engine cache is external to it. Here the cache is a first-class
+functional object so tiering (engine/offload.py) can snapshot/restore slots.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S, Hkv, D]
+    v: jnp.ndarray  # [L, B, S, Hkv, D]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def make_cache(num_layers: int, num_slots: int, max_len: int,
+               num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (num_layers, num_slots, max_len, num_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
+                starts: jnp.ndarray) -> jnp.ndarray:
+    """Write new [B,T,Hkv,D] into cache_layer [B,S,Hkv,D] at per-row starts [B].
+
+    Contiguous dynamic-update-slice per batch row (vmapped) — lowers to an
+    in-place DUS on TPU when the buffer is donated.
+    """
+    def _one(c, x, s):
+        return jax.lax.dynamic_update_slice(c, x, (s, 0, 0))
+    return jax.vmap(_one)(cache_layer, new, starts)

@@ -1,0 +1,147 @@
+"""Llama-family decoder as pure JAX functions over a stacked-params pytree.
+
+Design (TPU-first, not a torch translation):
+- All L layers' weights are stacked along a leading layer axis and the
+  forward pass runs ``lax.scan`` over layers: one traced layer body, O(1)
+  compile time in depth, and a natural seam for pipeline parallelism.
+- Weights live in bf16 (MXU-native); norms/softmax/logits in fp32.
+- Two entry points: ``forward`` (incremental, serving; reads/writes the
+  slot KV cache) and ``forward_train`` (full-sequence, no cache; used by
+  the training step and numerics tests).
+- Sharding is NOT baked in here — parallel/sharding.py assigns
+  PartitionSpecs to this pytree by path (megatron-style column/row rules),
+  so the same model code runs single-chip or on any mesh.
+
+The reference repo contains no model code (models are strings passed to
+``vllm serve``, reference: helm/templates/deployment-vllm-multi.yaml:57-64);
+this module is the TPU-native engine's compute core.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.models.kv import KVCache, write_chunk
+from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
+from production_stack_tpu.ops.norms import rms_norm
+from production_stack_tpu.ops.rope import apply_rope, rope_table
+
+Params = Dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Random init (normal 0.02) in cfg.dtype, stacked-layer layout."""
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nh, nkv, hd, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_, cfg.num_layers
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(cfg.dtype)
+
+    params: Params = {
+        "embed": w(next(keys), (v, h)),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), cfg.dtype),
+            "q": w(next(keys), (L, h, nh * hd)),
+            "k": w(next(keys), (L, h, nkv * hd)),
+            "v": w(next(keys), (L, h, nkv * hd)),
+            "o": w(next(keys), (L, nh * hd, h)),
+            "mlp_norm": jnp.ones((L, h), cfg.dtype),
+            "gate": w(next(keys), (L, h, i)),
+            "up": w(next(keys), (L, h, i)),
+            "down": w(next(keys), (L, i, h)),
+        },
+        "final_norm": jnp.ones((h,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (h, v))
+    return params
+
+
+def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
+                positions: jnp.ndarray, starts: Optional[jnp.ndarray],
+                x: jnp.ndarray, lp: Params,
+                kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]]):
+    """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D]."""
+    B, T, _ = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    cos, sin = rope
+
+    hidden = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (hidden @ lp["q"]).reshape(B, T, nh, hd)
+    k = (hidden @ lp["k"]).reshape(B, T, nkv, hd)
+    v = (hidden @ lp["v"]).reshape(B, T, nkv, hd)
+    q = apply_rope(q, positions, cos, sin)
+    k = apply_rope(k, positions, cos, sin)
+
+    if kv is None:
+        attn = causal_attention(q, k, v, scale=hd ** -0.5)
+        new_kv = None
+    else:
+        k_cache = write_chunk(kv[0], k, starts)
+        v_cache = write_chunk(kv[1], v, starts)
+        attn = attention_with_cache(q, k_cache, v_cache, positions,
+                                    scale=hd ** -0.5)
+        new_kv = (k_cache, v_cache)
+    x = x + (attn.reshape(B, T, nh * hd) @ lp["o"])
+
+    hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    gated = jax.nn.silu(hidden @ lp["gate"]) * (hidden @ lp["up"])
+    x = x + gated @ lp["down"]
+    return x, new_kv
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+            positions: jnp.ndarray, cache: KVCache,
+            rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+            ) -> Tuple[jnp.ndarray, KVCache]:
+    """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
+
+    positions[b] must be contiguous starting at the sequence's current
+    length; the new K/V chunk is written at that offset in slot b.
+    """
+    if rope is None:
+        rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
+                          cfg.rope_theta)
+    starts = positions[:, 0]
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def scan_body(carry, xs):
+        lp, k_c, v_c = xs
+        out, new_kv = _layer_body(cfg, rope, positions, starts, carry, lp,
+                                  (k_c, v_c))
+        return out, new_kv
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _lm_head(params, cfg, x)
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+                  ) -> jnp.ndarray:
+    """Full-sequence causal forward without cache. tokens [B,T] -> logits fp32."""
+    if rope is None:
+        rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
+                          cfg.rope_theta)
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def scan_body(carry, lp):
+        out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None)
+        return out, None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _lm_head(params, cfg, x)
+
+
+def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    return jnp.einsum("bth,hv->btv", x, head,
+                      preferred_element_type=jnp.float32)

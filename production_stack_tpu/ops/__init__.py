@@ -1,0 +1,11 @@
+from production_stack_tpu.ops.norms import rms_norm
+from production_stack_tpu.ops.rope import apply_rope, rope_table
+from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_table",
+    "attention_with_cache",
+    "causal_attention",
+]

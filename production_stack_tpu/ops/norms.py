@@ -1,0 +1,20 @@
+"""Normalization ops.
+
+TPU notes: RMSNorm reduces in float32 regardless of activation dtype
+(bf16 accumulation loses ~3 decimal digits and visibly degrades long
+sequences), then casts back so the surrounding matmuls stay bf16 on the MXU.
+XLA fuses the whole thing into the neighboring matmul's epilogue/prologue;
+no Pallas kernel is needed for this op.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """y = x / rms(x) * weight, computed in fp32, returned in x.dtype."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)

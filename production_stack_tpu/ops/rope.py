@@ -1,0 +1,44 @@
+"""Rotary position embeddings (non-interleaved, Llama/NeoX layout).
+
+The sin/cos table is precomputed once on host as fp32 and closed over by
+the jitted step functions — under jit it becomes a baked-in constant in HBM
+and the per-step work is a fused elementwise multiply on the VPU. Positions
+are dynamic (per-sequence offsets in continuous batching), so the table is
+gathered by position ids rather than sliced statically.
+"""
+
+from functools import lru_cache
+
+import jax.numpy as jnp
+
+
+@lru_cache(maxsize=32)
+def rope_table(max_positions: int, head_dim: int, theta: float = 10000.0):
+    """Precompute (cos, sin), each [max_positions, head_dim // 2], fp32.
+
+    Cached per (max_positions, head_dim, theta). Positions >= max_positions
+    would be clamp-gathered under jit (silently wrong logits) — callers with
+    a cache longer than the model's max_position_embeddings must pass a
+    table sized to the cache length (the engine does; see engine/runner.py).
+    """
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(max_positions, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [P, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, cos: jnp.ndarray,
+               sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate x [..., T, H, D] by per-token positions [..., T].
+
+    Non-interleaved ("rotate half") convention: the head dim is split into
+    two contiguous halves, matching HF Llama's ``rotate_half``.
+    """
+    c = cos[positions].astype(jnp.float32)[..., None, :]  # [..., T, 1, D/2]
+    s = sin[positions].astype(jnp.float32)[..., None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

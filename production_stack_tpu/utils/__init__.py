@@ -1,0 +1,21 @@
+from production_stack_tpu.utils.log import init_logger
+from production_stack_tpu.utils.misc import (
+    SingletonMeta,
+    parse_comma_separated,
+    parse_static_aliases,
+    parse_static_model_types,
+    parse_static_urls,
+    set_ulimit,
+    validate_url,
+)
+
+__all__ = [
+    "init_logger",
+    "SingletonMeta",
+    "validate_url",
+    "set_ulimit",
+    "parse_comma_separated",
+    "parse_static_aliases",
+    "parse_static_model_types",
+    "parse_static_urls",
+]

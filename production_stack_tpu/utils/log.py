@@ -1,0 +1,49 @@
+"""Colored structured logging for the stack.
+
+Capability parity with the reference's router logger
+(reference: src/vllm_router/log.py) — per-level ANSI colors, one shared
+format, idempotent handler install — with env-var level control.
+"""
+
+import logging
+import os
+import sys
+
+_COLORS = {
+    logging.DEBUG: "\x1b[38;20m",
+    logging.INFO: "\x1b[36;20m",
+    logging.WARNING: "\x1b[33;20m",
+    logging.ERROR: "\x1b[31;20m",
+    logging.CRITICAL: "\x1b[31;1m",
+}
+_RESET = "\x1b[0m"
+_FMT = "[%(asctime)s] %(levelname)s %(name)s: %(message)s"
+
+
+class ColorFormatter(logging.Formatter):
+    def __init__(self, use_color: bool = True):
+        super().__init__(_FMT, datefmt="%H:%M:%S")
+        self.use_color = use_color
+
+    def format(self, record: logging.LogRecord) -> str:
+        msg = super().format(record)
+        if self.use_color:
+            color = _COLORS.get(record.levelno, "")
+            return f"{color}{msg}{_RESET}"
+        return msg
+
+
+def init_logger(name: str, level: str | int | None = None) -> logging.Logger:
+    """Create/fetch a logger with the stack's formatter attached once."""
+    logger = logging.getLogger(name)
+    if level is None:
+        level = os.environ.get("PSTPU_LOG_LEVEL", "INFO")
+    if isinstance(level, str):
+        level = getattr(logging, level.upper(), logging.INFO)
+    logger.setLevel(level)
+    if not any(isinstance(h.formatter, ColorFormatter) for h in logger.handlers):
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(ColorFormatter(use_color=sys.stderr.isatty()))
+        logger.addHandler(handler)
+        logger.propagate = False
+    return logger

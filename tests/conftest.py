@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+All tests run hardware-free: JAX is pinned to the CPU platform with 8
+virtual devices so sharding/collective code paths (tp/dp/sp meshes) are
+exercised exactly as they would be on an 8-chip TPU slice.
+
+Must run before jax is imported anywhere in the test process.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The environment may have a TPU-tunnel PJRT plugin ("axon") registered via
+# sitecustomize; its backend init dials a local relay and can block every
+# jax.devices() call (even CPU-pinned) if the tunnel is down. Tests must be
+# hardware-free, so drop the plugin's backend factory before any backend
+# initialization happens.
+try:
+    import jax
+
+    # sitecustomize may have imported jax already with JAX_PLATFORMS=axon
+    # baked in; override the live config, not just the env var.
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge
+
+    for _reg in ("_backend_factories", "backend_factories"):
+        _factories = getattr(xla_bridge, _reg, None)
+        if _factories is not None and "axon" in _factories:
+            _factories.pop("axon")
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

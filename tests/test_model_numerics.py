@@ -1,0 +1,94 @@
+"""Numerics parity: our JAX Llama vs HuggingFace transformers LlamaForCausalLM.
+
+Mirrors the role of the reference's unit tier (SURVEY.md §4.1) but for the
+in-repo engine the reference doesn't have: proves the TPU-native model is
+the same function as the canonical implementation.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models import ModelConfig, llama, make_cache
+from production_stack_tpu.models.hf_loader import params_from_state_dict
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_pair():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval().to(torch.float32)
+    cfg = ModelConfig(
+        name="tiny-hf", vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=3, num_heads=4, num_kv_heads=2, max_position_embeddings=128,
+        dtype=jnp.float32,
+    )
+    params = params_from_state_dict(cfg, hf_model.state_dict())
+    return cfg, params, hf_model
+
+
+def test_forward_train_matches_hf(tiny_pair):
+    cfg, params, hf_model = tiny_pair
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 24))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(toks)).logits.numpy()
+    ours = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
+    np.testing.assert_allclose(ours, ref, atol=1e-2, rtol=0)
+
+
+def test_incremental_decode_matches_hf(tiny_pair):
+    cfg, params, hf_model = tiny_pair
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, cfg.vocab_size, size=(1, 10))
+
+    cache = make_cache(cfg.num_layers, 1, 64, cfg.num_kv_heads, cfg.head_dim_,
+                       dtype=jnp.float32)
+    pos = jnp.arange(10)[None, :]
+    logits, cache = llama.forward(params, cfg, jnp.asarray(prompt), pos, cache)
+
+    seq = list(prompt[0])
+    for step in range(5):
+        nxt = int(np.argmax(np.asarray(logits)[0, -1]))
+        seq.append(nxt)
+        with torch.no_grad():
+            ref = hf_model(torch.tensor([seq])).logits[0, -1].numpy()
+        logits, cache = llama.forward(
+            params, cfg, jnp.asarray([[nxt]]),
+            jnp.asarray([[len(seq) - 1]]), cache)
+        np.testing.assert_allclose(
+            np.asarray(logits)[0, 0], ref, atol=1e-2, rtol=0)
+
+
+def test_gqa_grouping_consistent():
+    """GQA einsum path equals explicit KV-head repetition."""
+    cfg = ModelConfig(name="t", vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_layers=1, num_heads=4,
+                      num_kv_heads=1, dtype=jnp.float32,
+                      max_position_embeddings=64)
+    cfg_mha = ModelConfig(name="t", vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_layers=1, num_heads=4,
+                          num_kv_heads=4, dtype=jnp.float32,
+                          max_position_embeddings=64)
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key)
+    # replicate kv weights across the 4 heads -> MHA equivalent
+    params_mha = jax.tree.map(lambda x: x, params)
+    params_mha["layers"] = dict(params["layers"])
+    params_mha["layers"]["k"] = jnp.tile(params["layers"]["k"], (1, 1, 4))
+    params_mha["layers"]["v"] = jnp.tile(params["layers"]["v"], (1, 1, 4))
+    toks = jax.random.randint(key, (2, 8), 0, 64)
+    out_gqa = llama.forward_train(params, cfg, toks)
+    out_mha = llama.forward_train(params_mha, cfg_mha, toks)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               atol=1e-4, rtol=1e-4)
