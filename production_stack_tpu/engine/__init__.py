@@ -1,0 +1,13 @@
+"""TPU-native serving engine.
+
+The reference stack launches an external ``vllm serve`` container per
+replica (reference: helm/templates/deployment-vllm-multi.yaml:57-64) and
+never implements the engine itself. Here the engine is in-repo and
+TPU-first: a continuous-batching loop over two cached XLA executables
+(chunked prefill + batched decode), a statically-shaped slot KV cache,
+fused on-device sampling, and an aiohttp OpenAI-compatible server.
+"""
+
+from production_stack_tpu.engine.config import EngineConfig
+
+__all__ = ["EngineConfig"]

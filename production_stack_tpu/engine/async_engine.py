@@ -1,0 +1,108 @@
+"""AsyncLLMEngine: asyncio facade over the synchronous engine loop.
+
+The engine loop runs on one dedicated thread (JAX dispatch is blocking);
+results cross into the event loop via ``loop.call_soon_threadsafe`` onto
+per-request asyncio queues. When idle the loop parks on a condition
+variable so an idle engine burns no CPU.
+"""
+
+import asyncio
+import threading
+from typing import AsyncIterator, Dict, List, Optional, Tuple
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine, StepOutput
+from production_stack_tpu.engine.scheduler import SamplingOptions
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_SENTINEL: Tuple = ()
+
+
+class AsyncLLMEngine:
+    def __init__(self, cfg: EngineConfig, params=None, mesh=None):
+        self.engine = LLMEngine(cfg, params=params, mesh=mesh)
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._wake = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    def start(self, loop: Optional[asyncio.AbstractEventLoop] = None,
+              warmup: bool = True) -> None:
+        self._loop = loop or asyncio.get_event_loop()
+        if warmup:
+            self.engine.runner.warmup()
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="engine-loop")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while self._running:
+            if not self.engine.has_work:
+                with self._wake:
+                    if not self.engine.has_work and self._running:
+                        self._wake.wait(timeout=0.2)
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception:
+                logger.exception("engine step failed")
+                continue
+            if outputs and self._loop is not None:
+                self._loop.call_soon_threadsafe(self._dispatch, outputs)
+
+    def _dispatch(self, outputs: List[StepOutput]) -> None:
+        for out in outputs:
+            q = self._queues.get(out.seq_id)
+            if q is not None:
+                q.put_nowait(out)
+                if out.finished:
+                    self._queues.pop(out.seq_id, None)
+
+    # ------------------------------------------------------------------
+
+    async def submit(self, prompt_tokens: List[int],
+                     options: SamplingOptions,
+                     seq_id: Optional[str] = None) -> Tuple[str, asyncio.Queue]:
+        q: asyncio.Queue = asyncio.Queue()
+        seq_id = self.engine.add_request(prompt_tokens, options,
+                                        seq_id=seq_id)
+        self._queues[seq_id] = q
+        with self._wake:
+            self._wake.notify_all()
+        return seq_id, q
+
+    async def stream(self, prompt_tokens: List[int],
+                     options: SamplingOptions) -> AsyncIterator[StepOutput]:
+        seq_id, q = await self.submit(prompt_tokens, options)
+        try:
+            while True:
+                out = await q.get()
+                yield out
+                if out.finished:
+                    return
+        finally:
+            # client disconnected mid-stream: free the slot
+            if seq_id in self._queues:
+                self._queues.pop(seq_id, None)
+                self.engine.abort(seq_id)
+
+    @property
+    def tokenizer(self):
+        return self.engine.tokenizer
+
+    @property
+    def model_name(self) -> str:
+        return self.engine.model_cfg.name

@@ -1,0 +1,44 @@
+"""Engine configuration.
+
+Mirrors the knobs the reference exposes as `vllm serve` flags rendered by
+Helm (reference: helm/templates/deployment-vllm-multi.yaml:68-93 —
+--max-model-len, --dtype, --enable-chunked-prefill, --tensor-parallel-size,
+--enable-prefix-caching) as a typed config for the in-repo engine.
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = "debug-tiny"
+    tokenizer: Optional[str] = None          # defaults to model path
+    max_model_len: int = 2048                # max prompt+generation length
+    max_num_seqs: int = 8                    # concurrent batch slots
+    prefill_chunk: int = 512                 # chunked-prefill chunk size
+    # prefill lengths are bucketed to these sizes to bound XLA compiles
+    prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
+    dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"
+    tensor_parallel_size: int = 1
+    seed: int = 0
+    checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
+    enable_prefix_caching: bool = False
+    max_top_k: int = 64                      # static top-k bound for sampler
+
+    def __post_init__(self):
+        # chunks never exceed prefill_chunk (or the cache), so larger
+        # buckets would only waste warmup compiles and executable HBM
+        self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)
+        buckets = sorted(b for b in self.prefill_buckets
+                         if b <= self.prefill_chunk)
+        if not buckets or buckets[-1] < self.prefill_chunk:
+            buckets.append(self.prefill_chunk)
+        self.prefill_buckets = tuple(buckets)
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        return self.prefill_buckets[-1]

@@ -1,0 +1,227 @@
+"""LLMEngine: the synchronous continuous-batching core.
+
+One ``step()`` = one unit of device work (a prefill chunk or a fused
+decode over all running slots) plus host bookkeeping (sampling-param
+assembly, stop detection, metrics). The async server drives this loop on
+a dedicated thread (see server.py); batch composition changes never
+recompile because shapes are static.
+"""
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.metrics import EngineMetrics
+from production_stack_tpu.engine.runner import ModelRunner
+from production_stack_tpu.engine.sampler import SamplingParams
+from production_stack_tpu.engine.scheduler import (Scheduler, SamplingOptions,
+                                                   SeqStatus, Sequence)
+from production_stack_tpu.engine.tokenizer import (DetokenizeStream,
+                                                   load_tokenizer)
+from production_stack_tpu.models.config import get_config
+from production_stack_tpu.models.hf_loader import load_checkpoint
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class StepOutput:
+    seq_id: str
+    new_token: Optional[int]
+    text_delta: str
+    finished: bool
+    finish_reason: Optional[str]
+
+
+# finished sequences kept for post-hoc inspection (bounded; see _remember)
+_FINISHED_RETENTION = 1024
+
+
+class LLMEngine:
+    def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
+        self.cfg = engine_cfg
+        self.model_cfg = get_config(engine_cfg.model)
+        self.tokenizer = load_tokenizer(engine_cfg.model,
+                                        engine_cfg.tokenizer)
+        if params is None and engine_cfg.checkpoint:
+            params = load_checkpoint(self.model_cfg, engine_cfg.checkpoint)
+        self.runner = ModelRunner(self.model_cfg, engine_cfg, params=params,
+                                  mesh=mesh)
+        self.scheduler = Scheduler(engine_cfg.max_num_seqs,
+                                   engine_cfg.max_model_len,
+                                   engine_cfg.prefill_chunk)
+        self.metrics = EngineMetrics(self.model_cfg.name)
+        self.seqs: Dict[str, Sequence] = {}
+        self._finished_order: List[str] = []
+        self._id_counter = itertools.count()
+        # guards scheduler state across the engine-loop and server threads
+        self._lock = threading.RLock()
+        # per-slot host mirrors feeding the decode batch
+        B = engine_cfg.max_num_seqs
+        self._slot_token = np.zeros((B,), np.int32)
+        self._slot_pos = np.zeros((B,), np.int32)
+        self._slot_temp = np.full((B,), 1.0, np.float32)
+        self._slot_top_p = np.ones((B,), np.float32)
+        self._slot_top_k = np.zeros((B,), np.int32)
+
+    # ------------------------------------------------------------------
+
+    def add_request(self, prompt_tokens: List[int],
+                    options: Optional[SamplingOptions] = None,
+                    seq_id: Optional[str] = None) -> str:
+        seq_id = seq_id or f"seq-{next(self._id_counter)}"
+        seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
+                       options=options or SamplingOptions(),
+                       detok=DetokenizeStream(self.tokenizer))
+        with self._lock:
+            self.scheduler.add(seq)
+            self.seqs[seq_id] = seq
+        return seq_id
+
+    def abort(self, seq_id: str) -> bool:
+        with self._lock:
+            ok = self.scheduler.abort(seq_id)
+            if ok and seq_id in self.seqs:
+                self._remember(self.seqs[seq_id])
+            self._refresh_gauges()
+            return ok
+
+    # ------------------------------------------------------------------
+
+    def step(self) -> List[StepOutput]:
+        with self._lock:
+            work, decode_seqs = self.scheduler.schedule()
+            outputs: List[StepOutput] = []
+            if work is not None:
+                outputs.extend(self._do_prefill(work))
+            elif decode_seqs:
+                outputs.extend(self._do_decode(decode_seqs))
+            self._refresh_gauges()
+            return outputs
+
+    def _do_prefill(self, work) -> List[StepOutput]:
+        seq = work.seq
+        opt = seq.options
+        row = SamplingParams(
+            temperature=jnp.asarray([opt.temperature], jnp.float32),
+            top_p=jnp.asarray([opt.top_p], jnp.float32),
+            top_k=jnp.asarray([opt.top_k], jnp.int32))
+        token_dev = self.runner.prefill(work.chunk, work.start, seq.slot, row)
+        self.scheduler.on_prefill_done(work)
+        self.metrics.prompt_tokens.inc(len(work.chunk))
+        if not work.is_last:
+            return []
+        # prompt fully prefilled: the sampled id is the first output token
+        token = int(token_dev)
+        seq.first_token_time = time.monotonic()
+        self.metrics.ttft.observe(seq.first_token_time - seq.arrival_time)
+        return self._accept_token(seq, token)
+
+    def _do_decode(self, decode_seqs) -> List[StepOutput]:
+        sampling = SamplingParams(
+            temperature=jnp.asarray(self._slot_temp),
+            top_p=jnp.asarray(self._slot_top_p),
+            top_k=jnp.asarray(self._slot_top_k))
+        t0 = time.monotonic()
+        ids = np.asarray(self.runner.decode(self._slot_token, self._slot_pos,
+                                            sampling))
+        dt = time.monotonic() - t0
+        outputs: List[StepOutput] = []
+        for seq in decode_seqs:
+            self.metrics.per_token.observe(dt)
+            outputs.extend(self._accept_token(seq, int(ids[seq.slot])))
+        return outputs
+
+    def _accept_token(self, seq: Sequence, token: int) -> List[StepOutput]:
+        seq.output_tokens.append(token)
+        self.metrics.generation_tokens.inc()
+        delta = seq.detok.push(token)
+        seq.output_text += delta
+        reason = self._stop_reason(seq, token, delta)
+        if reason is not None and reason != "stop":
+            seq.output_text += seq.detok.flush()
+        text_delta = seq.output_text[seq.chars_emitted:]
+        seq.chars_emitted = len(seq.output_text)
+        if reason is not None:
+            self.scheduler.finish(seq, reason)
+            self._remember(seq)
+            self.metrics.e2e_latency.observe(
+                time.monotonic() - seq.arrival_time)
+            return [StepOutput(seq.seq_id, token, text_delta, True, reason)]
+        self._sync_slot(seq)
+        return [StepOutput(seq.seq_id, token, text_delta, False, None)]
+
+    def _stop_reason(self, seq: Sequence, token: int,
+                     delta: str) -> Optional[str]:
+        """Stop decision; on a stop-string match, truncates seq.output_text
+        so the stop string itself is never delivered (OpenAI semantics)."""
+        opt = seq.options
+        if token in opt.stop_token_ids:
+            return "stop"
+        if not opt.ignore_eos and token == self.tokenizer.eos_token_id:
+            return "stop"
+        if opt.stop and delta:
+            # a match can straddle the delta boundary: search a window of
+            # (longest stop - 1) chars before the delta
+            for s in opt.stop:
+                from_idx = max(0, len(seq.output_text) - len(delta) - len(s))
+                idx = seq.output_text.find(s, from_idx)
+                if idx != -1:
+                    seq.output_text = seq.output_text[:idx]
+                    return "stop"
+        if len(seq.output_tokens) >= opt.max_tokens:
+            return "length"
+        if seq.num_tokens >= self.cfg.max_model_len:
+            return "length"
+        return None
+
+    def _remember(self, seq: Sequence) -> None:
+        """Retain finished sequences for inspection, bounded in count."""
+        self._finished_order.append(seq.seq_id)
+        while len(self._finished_order) > _FINISHED_RETENTION:
+            old = self._finished_order.pop(0)
+            self.seqs.pop(old, None)
+
+    def _sync_slot(self, seq: Sequence) -> None:
+        """Mirror the sequence's next decode input into the slot arrays."""
+        slot, opt = seq.slot, seq.options
+        self._slot_token[slot] = seq.output_tokens[-1]
+        self._slot_pos[slot] = seq.next_position
+        self._slot_temp[slot] = opt.temperature
+        self._slot_top_p[slot] = opt.top_p
+        self._slot_top_k[slot] = opt.top_k
+
+    def render_metrics(self) -> bytes:
+        with self._lock:
+            self._refresh_gauges()
+        return self.metrics.render()
+
+    def _refresh_gauges(self) -> None:
+        self.metrics.num_running.set(self.scheduler.num_running)
+        self.metrics.num_waiting.set(self.scheduler.num_waiting)
+        usage = self.scheduler.kv_usage
+        self.metrics.kv_usage.set(usage)
+        self.metrics.hbm_kv_usage.set(usage)
+
+    # ------------------------------------------------------------------
+
+    def generate(self, prompt: str, options: Optional[SamplingOptions] = None,
+                 ) -> str:
+        """Blocking single-prompt convenience API (tests, CLI)."""
+        toks = self.tokenizer.encode(prompt)
+        seq_id = self.add_request(toks, options)
+        while True:
+            for out in self.step():
+                if out.seq_id == seq_id and out.finished:
+                    return self.seqs[seq_id].output_text
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work

@@ -1,0 +1,61 @@
+"""Engine Prometheus metrics.
+
+Gauge names keep the `vllm:` prefix the reference router scrapes
+(reference: src/vllm_router/stats/engine_stats.py:46-55 parses
+vllm:num_requests_running / vllm:num_requests_waiting /
+vllm:gpu_cache_usage_perc / vllm:gpu_prefix_cache_hit_rate) so either
+stack's router can balance on either engine. TPU-specific duplicates are
+exported under `tpu:` (HBM KV usage) for the Grafana dashboard.
+"""
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
+                               generate_latest)
+
+# Engine metrics get their own registry so multiple in-process engines
+# (tests) don't collide in the global default registry.
+
+
+class EngineMetrics:
+    def __init__(self, model: str):
+        self.registry = CollectorRegistry()
+        labels = {"model_name": model}
+
+        def gauge(name, doc):
+            g = Gauge(name, doc, list(labels), registry=self.registry)
+            return g.labels(**labels)
+
+        def counter(name, doc):
+            c = Counter(name, doc, list(labels), registry=self.registry)
+            return c.labels(**labels)
+
+        def histo(name, doc, buckets):
+            h = Histogram(name, doc, list(labels), buckets=buckets,
+                          registry=self.registry)
+            return h.labels(**labels)
+
+        self.num_running = gauge("vllm:num_requests_running",
+                                 "Sequences in the decode batch")
+        self.num_waiting = gauge("vllm:num_requests_waiting",
+                                 "Sequences queued or prefilling")
+        self.kv_usage = gauge("vllm:gpu_cache_usage_perc",
+                              "KV cache slot-token utilization (0-1)")
+        self.hbm_kv_usage = gauge("tpu:hbm_kv_usage_perc",
+                                  "KV cache HBM utilization (0-1)")
+        self.prefix_hit_rate = gauge("vllm:gpu_prefix_cache_hit_rate",
+                                     "Prefix cache hit rate (0-1)")
+        self.prompt_tokens = counter("vllm:prompt_tokens_total",
+                                     "Prefilled prompt tokens")
+        self.generation_tokens = counter("vllm:generation_tokens_total",
+                                         "Generated tokens")
+        self.ttft = histo(
+            "vllm:time_to_first_token_seconds", "Time to first token",
+            (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+        self.e2e_latency = histo(
+            "vllm:e2e_request_latency_seconds", "End-to-end request latency",
+            (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+        self.per_token = histo(
+            "vllm:time_per_output_token_seconds", "Inter-token latency",
+            (0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,147 @@
+"""ModelRunner: owns params + KV cache and the two cached XLA executables.
+
+TPU execution model:
+- ``decode``: ONE executable for the whole engine lifetime — batch is
+  always [max_num_seqs] (free slots run as padding rows), so every step
+  after warmup is a cache hit. Sampling is fused in; only int32 token ids
+  come back to host.
+- ``prefill``: one executable per length bucket (engine_cfg.prefill_buckets),
+  prompt chunks are right-padded to the bucket. Works on a single slot via
+  dynamic batch-axis slice so running sequences keep their state.
+- Both donate the KV cache => XLA updates it in place in HBM.
+
+The reference has no equivalent (engine external, SURVEY.md §1 L2); this
+is the TPU-native core the stack serves from.
+"""
+
+import time
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.sampler import SamplingParams, sample
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.models.kv import KVCache, make_cache
+from production_stack_tpu.models import llama
+from production_stack_tpu.ops.rope import rope_table
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class ModelRunner:
+    def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                 params=None, mesh=None):
+        self.model_cfg = model_cfg
+        self.engine_cfg = engine_cfg
+        self.mesh = mesh
+        # rope table must cover the cache length, not just the model's
+        # native max (see ops/rope.py clamping note)
+        self.rope = rope_table(engine_cfg.max_model_len, model_cfg.head_dim_,
+                               model_cfg.rope_theta)
+        if params is None:
+            t0 = time.time()
+            params = llama.init_params(model_cfg, jax.random.PRNGKey(
+                engine_cfg.seed))
+            logger.info("random-initialized %s (%.2fs)", model_cfg.name,
+                        time.time() - t0)
+        self.params = params
+        self.cache: KVCache = make_cache(
+            model_cfg.num_layers, engine_cfg.max_num_seqs,
+            engine_cfg.max_model_len, model_cfg.num_kv_heads,
+            model_cfg.head_dim_,
+            dtype=jnp.bfloat16 if engine_cfg.kv_dtype == "bfloat16"
+            else jnp.float32)
+        self._key = jax.random.PRNGKey(engine_cfg.seed ^ 0x5EED)
+
+        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
+
+    # ------------------------------------------------------------------
+    # jitted impls (pure)
+    # ------------------------------------------------------------------
+
+    def _decode_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
+                     positions: jnp.ndarray, sampling: SamplingParams,
+                     key: jax.Array):
+        """tokens/positions [B] -> sampled ids [B], cache'."""
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens[:, None], positions[:, None],
+            cache, rope=self.rope)
+        ids = sample(logits[:, 0, :], sampling, key)
+        return ids, cache
+
+    def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
+                      start: jnp.ndarray, length: jnp.ndarray,
+                      slot: jnp.ndarray, sampling: SamplingParams,
+                      key: jax.Array):
+        """tokens [Tb] (padded chunk) into `slot` at offset `start`.
+
+        Returns (sampled id for the chunk's last real token, cache').
+        """
+        L = self.model_cfg.num_layers
+        S = self.engine_cfg.max_model_len
+        Hkv, D = self.model_cfg.num_kv_heads, self.model_cfg.head_dim_
+        Tb = tokens.shape[0]
+
+        k_slot = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
+                                       (L, 1, S, Hkv, D))
+        v_slot = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
+                                       (L, 1, S, Hkv, D))
+        positions = (start + jnp.arange(Tb))[None, :]
+        logits, slot_cache = llama.forward(
+            params, self.model_cfg, tokens[None, :], positions,
+            KVCache(k_slot, v_slot), rope=self.rope)
+        new_k = jax.lax.dynamic_update_slice(cache.k, slot_cache.k,
+                                             (0, slot, 0, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(cache.v, slot_cache.v,
+                                             (0, slot, 0, 0, 0))
+        last = jax.lax.dynamic_slice(logits, (0, length - 1, 0),
+                                     (1, 1, logits.shape[-1]))[:, 0, :]
+        ids = sample(last, sampling, key)
+        return ids[0], KVCache(new_k, new_v)
+
+    # ------------------------------------------------------------------
+    # host API
+    # ------------------------------------------------------------------
+
+    def _next_key(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def decode(self, tokens, positions, sampling: SamplingParams):
+        """Batched decode step over all slots. Returns np-convertible ids [B]."""
+        ids, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(positions, jnp.int32), sampling, self._next_key())
+        return ids
+
+    def prefill(self, chunk_tokens, start: int, slot: int,
+                sampling_row: SamplingParams):
+        """Prefill one padded chunk into a slot. Returns sampled id (device)."""
+        bucket = self.engine_cfg.bucket_for(len(chunk_tokens))
+        length = len(chunk_tokens)
+        padded = list(chunk_tokens) + [0] * (bucket - length)
+        token_id, self.cache = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(padded, jnp.int32),
+            jnp.int32(start), jnp.int32(length), jnp.int32(slot),
+            sampling_row, self._next_key())
+        return token_id
+
+    def warmup(self) -> float:
+        """Compile decode + all prefill buckets. Returns seconds spent."""
+        t0 = time.time()
+        B = self.engine_cfg.max_num_seqs
+        sampling = SamplingParams.filled(B)
+        row = SamplingParams.filled(1)
+        self.decode([0] * B, [0] * B, sampling)
+        for bucket in self.engine_cfg.prefill_buckets:
+            self.prefill([0] * bucket, 0, 0, row)
+        jax.block_until_ready(self.cache.k)
+        dt = time.time() - t0
+        logger.info("warmup compiled decode + %d prefill buckets in %.1fs",
+                    len(self.engine_cfg.prefill_buckets), dt)
+        return dt

@@ -1,0 +1,69 @@
+"""On-device batched sampling (greedy / temperature / top-k / top-p).
+
+Fused into the decode executable so only sampled token ids (a few bytes
+per sequence) cross the host↔device boundary each step — never the
+[B, vocab] logits. All branching is data-dependent masking, not Python
+control flow, so one executable serves any mix of per-request sampling
+params. The single descending sort per step feeds both top-k and top-p.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-6
+_NEG_INF = -1e30
+
+
+class SamplingParams(NamedTuple):
+    """Per-sequence device-side sampling state, shape [B] each."""
+
+    temperature: jnp.ndarray  # fp32; 0 => greedy
+    top_p: jnp.ndarray        # fp32 in (0, 1]
+    top_k: jnp.ndarray        # int32; 0 => disabled
+
+    @staticmethod
+    def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0):
+        return SamplingParams(
+            temperature=jnp.full((batch,), temperature, jnp.float32),
+            top_p=jnp.full((batch,), top_p, jnp.float32),
+            top_k=jnp.full((batch,), top_k, jnp.int32),
+        )
+
+
+def sample(logits: jnp.ndarray, params: SamplingParams,
+           key: jax.Array) -> jnp.ndarray:
+    """logits fp32 [B,V] -> token ids int32 [B]."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(params.temperature, _EPS)[:, None]
+    scaled = logits / temp
+
+    # One sort serves top-k and top-p. [B,V] descending.
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    ranks = jnp.arange(V)[None, :]
+
+    # top-k threshold: value of the k-th largest (disabled => keep all)
+    k = jnp.where(params.top_k > 0, params.top_k, V).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_logits,
+                              jnp.clip(k[:, None] - 1, 0, V - 1), axis=-1)
+
+    # top-p: smallest prefix of the sorted distribution with mass >= p.
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep ranks whose cumulative mass *before* them is < p
+    keep_sorted = (cum - probs_sorted) < params.top_p[:, None]
+    # threshold = smallest kept logit value
+    p_thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+
+    threshold = jnp.maximum(kth, p_thresh)
+    masked = jnp.where(scaled >= threshold, scaled, _NEG_INF)
+
+    gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
+    sampled = jnp.argmax(masked + gumbel, axis=-1)
+
+    return jnp.where(params.temperature <= _EPS, greedy, sampled).astype(
+        jnp.int32)

@@ -1,0 +1,183 @@
+"""Continuous-batching scheduler: waiting queue -> slots -> decode batch.
+
+Policy (round-robin between admission and decode):
+- A waiting sequence is admitted when a slot is free; its prompt is
+  prefilled in chunks of ``prefill_chunk`` tokens (chunked prefill — the
+  reference exposes this as the `--enable-chunked-prefill` engine flag,
+  reference: helm/templates/deployment-vllm-multi.yaml:69-72).
+- When no prefill work is pending, all running slots advance one token in
+  a single fused decode step.
+- Finished sequences free their slot immediately; the next waiting
+  sequence takes it on the following iteration.
+
+The scheduler is pure host-side bookkeeping — device work happens in
+ModelRunner. Static batch shape (max_num_seqs) means admission never
+recompiles anything.
+"""
+
+import collections
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"
+    PREFILLING = "prefilling"
+    RUNNING = "running"
+    FINISHED = "finished"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    max_tokens: int = 128
+    stop: List[str] = field(default_factory=list)
+    stop_token_ids: List[int] = field(default_factory=list)
+    ignore_eos: bool = False
+    logprobs: bool = False
+
+
+@dataclass
+class Sequence:
+    seq_id: str
+    prompt_tokens: List[int]
+    options: SamplingOptions
+    status: SeqStatus = SeqStatus.WAITING
+    slot: int = -1
+    output_tokens: List[int] = field(default_factory=list)
+    num_prefilled: int = 0
+    arrival_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    # incremental detokenization state (owned by LLMEngine)
+    output_text: str = ""       # stable decoded text, stop-truncated
+    chars_emitted: int = 0      # prefix of output_text already delivered
+    detok: object = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+    @property
+    def next_position(self) -> int:
+        return self.num_tokens - 1
+
+
+@dataclass
+class PrefillWork:
+    seq: Sequence
+    chunk: List[int]
+    start: int
+    is_last: bool
+
+
+class Scheduler:
+    def __init__(self, max_num_seqs: int, max_model_len: int,
+                 prefill_chunk: int):
+        self.max_num_seqs = max_num_seqs
+        self.max_model_len = max_model_len
+        self.prefill_chunk = prefill_chunk
+        self.waiting: Deque[Sequence] = collections.deque()
+        self.running: Dict[int, Sequence] = {}        # slot -> seq
+        self.free_slots: List[int] = list(range(max_num_seqs - 1, -1, -1))
+        self._prefilling: Optional[Sequence] = None
+
+    # ------------------------------------------------------------------
+
+    def add(self, seq: Sequence) -> None:
+        if len(seq.prompt_tokens) >= self.max_model_len:
+            raise ValueError(
+                f"prompt length {len(seq.prompt_tokens)} exceeds "
+                f"max_model_len {self.max_model_len}")
+        self.waiting.append(seq)
+
+    def abort(self, seq_id: str) -> bool:
+        for seq in list(self.waiting):
+            if seq.seq_id == seq_id:
+                self.waiting.remove(seq)
+                seq.status = SeqStatus.FINISHED
+                seq.finish_reason = "abort"
+                return True
+        for slot, seq in list(self.running.items()):
+            if seq.seq_id == seq_id:
+                self._release(slot, seq, "abort")
+                return True
+        if self._prefilling is not None and self._prefilling.seq_id == seq_id:
+            seq = self._prefilling
+            self._release(seq.slot, seq, "abort")
+            self._prefilling = None
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+
+    def schedule(self) -> Tuple[Optional[PrefillWork], List[Sequence]]:
+        """Pick the next unit of device work.
+
+        Returns (prefill_work, decode_seqs): exactly one of them is
+        non-empty. Prefill has priority so admitted requests reach their
+        first token quickly (TTFT) — decode-only batches run otherwise.
+        """
+        work = self._next_prefill()
+        if work is not None:
+            return work, []
+        return None, list(self.running.values())
+
+    def _next_prefill(self) -> Optional[PrefillWork]:
+        seq = self._prefilling
+        if seq is None:
+            if not self.waiting or not self.free_slots:
+                return None
+            seq = self.waiting.popleft()
+            seq.slot = self.free_slots.pop()
+            seq.status = SeqStatus.PREFILLING
+            self._prefilling = seq
+        start = seq.num_prefilled
+        end = min(start + self.prefill_chunk, len(seq.prompt_tokens))
+        return PrefillWork(seq=seq, chunk=seq.prompt_tokens[start:end],
+                           start=start, is_last=end == len(seq.prompt_tokens))
+
+    def on_prefill_done(self, work: PrefillWork) -> None:
+        seq = work.seq
+        seq.num_prefilled += len(work.chunk)
+        if work.is_last:
+            seq.status = SeqStatus.RUNNING
+            self.running[seq.slot] = seq
+            self._prefilling = None
+
+    def finish(self, seq: Sequence, reason: str) -> None:
+        self._release(seq.slot, seq, reason)
+
+    def _release(self, slot: int, seq: Sequence, reason: str) -> None:
+        seq.status = SeqStatus.FINISHED
+        seq.finish_reason = reason
+        if slot >= 0:
+            self.running.pop(slot, None)
+            self.free_slots.append(slot)
+            seq.slot = -1
+
+    # ------------------------------------------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting) + (1 if self._prefilling else 0)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running or self._prefilling)
+
+    @property
+    def kv_usage(self) -> float:
+        """Fraction of KV slot-tokens in use (the TPU HBM KV gauge)."""
+        used = sum(s.num_tokens for s in self.running.values())
+        if self._prefilling:
+            used += self._prefilling.num_prefilled
+        return used / float(self.max_num_seqs * self.max_model_len)

@@ -1,0 +1,308 @@
+"""OpenAI-compatible HTTP server for the TPU engine (aiohttp).
+
+Surface parity with what the reference's router expects from each engine
+pod (reference: src/vllm_router/service_discovery.py:131-155 queries
+/v1/models; stats/engine_stats.py scrapes /metrics; helm probes hit
+/health): /v1/completions, /v1/chat/completions (streaming SSE and
+non-streaming), /v1/models, /health, /metrics, /version, /tokenize,
+/detokenize.
+
+Built on aiohttp (no FastAPI dependency): handlers parse with pydantic
+models from protocol.py and stream via chunked responses.
+"""
+
+import argparse
+import asyncio
+import json
+from contextlib import aclosing
+from typing import List, Optional
+
+from aiohttp import web
+from pydantic import ValidationError
+
+from production_stack_tpu import protocol as proto
+from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.scheduler import SamplingOptions
+from production_stack_tpu.utils import init_logger, set_ulimit
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+ENGINE_KEY = web.AppKey("engine", AsyncLLMEngine)
+
+
+def _error(status: int, message: str) -> web.Response:
+    body = proto.ErrorResponse(
+        error=proto.ErrorInfo(message=message, code=status))
+    return web.json_response(body.model_dump(), status=status)
+
+
+def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
+    stop = req.stop if isinstance(req.stop, list) else (
+        [req.stop] if req.stop else [])
+    return SamplingOptions(
+        temperature=req.temperature,
+        top_p=req.top_p,
+        top_k=req.top_k,
+        max_tokens=max_tokens if max_tokens is not None else 128,
+        stop=stop,
+        stop_token_ids=req.stop_token_ids or [],
+        ignore_eos=req.ignore_eos,
+    )
+
+
+async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache",
+                 "X-Accel-Buffering": "no"})
+    await resp.prepare(request)
+    try:
+        async for payload in gen:
+            await resp.write(f"data: {payload}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+    except (ConnectionResetError, ConnectionError):
+        # client went away mid-stream; generator cleanup aborts the request
+        await gen.aclose()
+    return resp
+
+
+# ---------------------------------------------------------------- handlers
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    engine = request.app[ENGINE_KEY]
+    try:
+        req = proto.ChatCompletionRequest(**await request.json())
+    except (ValidationError, json.JSONDecodeError) as e:
+        return _error(400, f"invalid request: {e}")
+    if req.n != 1:
+        return _error(400, "n>1 is not supported yet")
+
+    tok = engine.tokenizer
+    prompt = tok.apply_chat_template(
+        [m.model_dump() for m in req.messages])
+    prompt_ids = tok.encode(prompt)
+    if len(prompt_ids) >= engine.engine.cfg.max_model_len:
+        return _error(400, f"prompt has {len(prompt_ids)} tokens, which "
+                           f"exceeds max_model_len "
+                           f"{engine.engine.cfg.max_model_len}")
+    max_tokens = req.max_completion_tokens or req.max_tokens
+    options = _sampling_options(req, max_tokens)
+    rid = proto._gen_id("chatcmpl")
+
+    if req.stream:
+        async def gen():
+            first = proto.ChatCompletionChunk(
+                id=rid, model=req.model,
+                choices=[proto.ChatCompletionChunkChoice(
+                    delta=proto.DeltaMessage(role="assistant", content=""))])
+            yield first.model_dump_json()
+            # aclosing => a dropped consumer deterministically runs
+            # engine.stream's cleanup (slot abort), not at GC's leisure
+            async with aclosing(engine.stream(prompt_ids, options)) as it:
+                async for out in it:
+                    if out.text_delta or out.finished:
+                        chunk = proto.ChatCompletionChunk(
+                            id=rid, model=req.model,
+                            choices=[proto.ChatCompletionChunkChoice(
+                                delta=proto.DeltaMessage(
+                                    content=out.text_delta or None),
+                                finish_reason=out.finish_reason if out.finished
+                                else None)])
+                        yield chunk.model_dump_json()
+        return await _sse_stream(request, gen())
+
+    parts: List[str] = []
+    num_tokens = 0
+    finish_reason = None
+    async with aclosing(engine.stream(prompt_ids, options)) as it:
+        async for out in it:
+            parts.append(out.text_delta)
+            if out.new_token is not None:
+                num_tokens += 1
+            if out.finished:
+                finish_reason = out.finish_reason
+    text = "".join(parts)
+    resp = proto.ChatCompletionResponse(
+        id=rid, model=req.model,
+        choices=[proto.ChatCompletionChoice(
+            message=proto.ChatChoiceMessage(content=text),
+            finish_reason=finish_reason)],
+        usage=proto.UsageInfo(
+            prompt_tokens=len(prompt_ids),
+            completion_tokens=num_tokens,
+            total_tokens=len(prompt_ids) + num_tokens))
+    return web.json_response(resp.model_dump())
+
+
+async def completions(request: web.Request) -> web.StreamResponse:
+    engine = request.app[ENGINE_KEY]
+    try:
+        req = proto.CompletionRequest(**await request.json())
+    except (ValidationError, json.JSONDecodeError) as e:
+        return _error(400, f"invalid request: {e}")
+    if req.n != 1:
+        return _error(400, "n>1 is not supported yet")
+
+    tok = engine.tokenizer
+    prompt = req.prompt
+    if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+        prompt_ids = list(prompt)
+    elif isinstance(prompt, str):
+        prompt_ids = tok.encode(prompt)
+    elif isinstance(prompt, list) and len(prompt) == 1 and isinstance(
+            prompt[0], str):
+        prompt_ids = tok.encode(prompt[0])
+    else:
+        return _error(400, "batched prompts are not supported yet")
+    if len(prompt_ids) >= engine.engine.cfg.max_model_len:
+        return _error(400, f"prompt has {len(prompt_ids)} tokens, which "
+                           f"exceeds max_model_len "
+                           f"{engine.engine.cfg.max_model_len}")
+    options = _sampling_options(req, req.max_tokens)
+    rid = proto._gen_id("cmpl")
+
+    if req.stream:
+        async def gen():
+            async with aclosing(engine.stream(prompt_ids, options)) as it:
+                async for out in it:
+                    if out.text_delta or out.finished:
+                        chunk = proto.CompletionChunk(
+                            id=rid, model=req.model,
+                            choices=[proto.CompletionChunkChoice(
+                                text=out.text_delta,
+                                finish_reason=out.finish_reason if out.finished
+                                else None)])
+                        yield chunk.model_dump_json()
+        return await _sse_stream(request, gen())
+
+    parts: List[str] = []
+    num_tokens = 0
+    finish_reason = None
+    async with aclosing(engine.stream(prompt_ids, options)) as it:
+        async for out in it:
+            parts.append(out.text_delta)
+            if out.new_token is not None:
+                num_tokens += 1
+            if out.finished:
+                finish_reason = out.finish_reason
+    resp = proto.CompletionResponse(
+        id=rid, model=req.model,
+        choices=[proto.CompletionChoice(text="".join(parts),
+                                        finish_reason=finish_reason)],
+        usage=proto.UsageInfo(
+            prompt_tokens=len(prompt_ids), completion_tokens=num_tokens,
+            total_tokens=len(prompt_ids) + num_tokens))
+    return web.json_response(resp.model_dump())
+
+
+async def list_models(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    cards = proto.ModelList(data=[proto.ModelCard(id=engine.model_name)])
+    return web.json_response(cards.model_dump())
+
+
+async def health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    return web.Response(body=engine.engine.render_metrics(),
+                        content_type="text/plain")
+
+
+async def tokenize(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    body = await request.json()
+    ids = engine.tokenizer.encode(body.get("prompt", ""))
+    return web.json_response({"tokens": ids, "count": len(ids)})
+
+
+async def detokenize(request: web.Request) -> web.Response:
+    engine = request.app[ENGINE_KEY]
+    body = await request.json()
+    return web.json_response(
+        {"prompt": engine.tokenizer.decode(body.get("tokens", []))})
+
+
+# ---------------------------------------------------------------- app
+
+def build_app(engine: AsyncLLMEngine) -> web.Application:
+    app = web.Application(client_max_size=32 * 1024 * 1024)
+    app[ENGINE_KEY] = engine
+    app.router.add_post("/v1/chat/completions", chat_completions)
+    app.router.add_post("/v1/completions", completions)
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/tokenize", tokenize)
+    app.router.add_post("/detokenize", detokenize)
+
+    async def on_startup(app):
+        # warmup (if any) was done before the loop started
+        engine.start(asyncio.get_event_loop(), warmup=False)
+
+    async def on_cleanup(app):
+        engine.stop()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser("pstpu-engine",
+                                description="TPU-native OpenAI-compatible "
+                                            "serving engine")
+    p.add_argument("--model", default="debug-tiny")
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--checkpoint", default=None,
+                   help="HF checkpoint dir (random weights if omitted)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8100)
+    p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--prefill-chunk", type=int, default=512)
+    p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-warmup", action="store_true")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    set_ulimit()
+    cfg = EngineConfig(
+        model=args.model, tokenizer=args.tokenizer,
+        checkpoint=args.checkpoint, max_model_len=args.max_model_len,
+        max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
+        tensor_parallel_size=args.tensor_parallel_size, seed=args.seed)
+    engine = AsyncLLMEngine(cfg)
+    if not args.no_warmup:
+        engine.engine.runner.warmup()
+
+    async def _serve():
+        app = build_app(engine)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.port)
+        await site.start()
+        logger.info("engine serving %s on %s:%d", cfg.model, args.host,
+                    args.port)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Tokenizers: HF-backed for real checkpoints, byte-level for debug models.
+
+The byte tokenizer keeps every CI/e2e path hardware- and download-free
+(the reference achieves the same with facebook/opt-125m on CPU runners,
+reference: .github/workflows/functionality-helm-chart.yml; we go further
+and need no network at all).
+"""
+
+from typing import List, Optional, Sequence
+
+BOS_ID = 256
+EOS_ID = 257
+PAD_ID = 258
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0-255 are bytes, then BOS/EOS/PAD."""
+
+    vocab_size = 512
+    bos_token_id = BOS_ID
+    eos_token_id = EOS_ID
+    pad_token_id = PAD_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return [BOS_ID] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        parts = [f"<|{m.get('role', 'user')}|>\n{_content_text(m)}\n"
+                 for m in messages]
+        return "".join(parts) + "<|assistant|>\n"
+
+
+class HFTokenizer:
+    """Wraps a transformers tokenizer loaded from a checkpoint path."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(path)
+        self.vocab_size = len(self._tok)
+        self.bos_token_id = self._tok.bos_token_id
+        self.eos_token_id = self._tok.eos_token_id
+        self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: List[dict]) -> str:
+        if getattr(self._tok, "chat_template", None):
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True)
+        return ByteTokenizer.apply_chat_template(self, messages)  # type: ignore
+
+
+def _content_text(message: dict) -> str:
+    content = message.get("content", "")
+    if isinstance(content, list):  # OpenAI content-part arrays
+        return "".join(p.get("text", "") for p in content
+                       if isinstance(p, dict))
+    return str(content)
+
+
+def load_tokenizer(model_or_path: str, tokenizer_path: Optional[str] = None):
+    """HF tokenizer when a checkpoint dir exists; byte tokenizer otherwise."""
+    import os
+    path = tokenizer_path or model_or_path
+    if os.path.isdir(path):
+        try:
+            return HFTokenizer(path)
+        except Exception:
+            pass
+    return ByteTokenizer()
+
+
+class DetokenizeStream:
+    """Incremental detokenizer producing printable deltas per new token.
+
+    Buffers until the decoded string grows cleanly (handles multi-byte
+    UTF-8 and SentencePiece prefix-space merges) — the SSE stream sends
+    only stable text.
+    """
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):  # mid-codepoint; wait for more bytes
+            return ""
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+    def flush(self) -> str:
+        """Emit whatever is still buffered (e.g. a trailing partial
+        codepoint rendered as the replacement char) at end of stream."""
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta

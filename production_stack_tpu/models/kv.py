@@ -40,9 +40,29 @@ def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
                 starts: jnp.ndarray) -> jnp.ndarray:
     """Write new [B,T,Hkv,D] into cache_layer [B,S,Hkv,D] at per-row starts [B].
 
-    Contiguous dynamic-update-slice per batch row (vmapped) — lowers to an
-    in-place DUS on TPU when the buffer is donated.
+    T == 1 (decode): contiguous dynamic-update-slice per batch row — lowers
+    to an in-place DUS on TPU when the buffer is donated. The write offset
+    is always < S so DUS clamping never triggers.
+
+    T > 1 (prefill): per-row scatter with clipped indices. A prefill chunk
+    is right-padded to its length bucket, so start+T can exceed S near the
+    end of the cache; DUS would *clamp the start* and silently overwrite
+    valid earlier entries with padding K/V. Scatter clips only the padding
+    rows onto index S-1 (real prompt rows never reach S-1 because prompts
+    are capped below max_model_len), and that slot is rewritten with real
+    K/V by the decode step that reaches position S-1 before any query can
+    attend to it.
     """
-    def _one(c, x, s):
-        return jax.lax.dynamic_update_slice(c, x, (s, 0, 0))
-    return jax.vmap(_one)(cache_layer, new, starts)
+    if new.shape[1] == 1:
+        def _one(c, x, s):
+            return jax.lax.dynamic_update_slice(c, x, (s, 0, 0))
+        return jax.vmap(_one)(cache_layer, new, starts)
+
+    S = cache_layer.shape[1]
+    T = new.shape[1]
+
+    def _scatter(c, x, s):
+        idx = jnp.clip(s + jnp.arange(T), 0, S - 1)
+        return c.at[idx].set(x)
+
+    return jax.vmap(_scatter)(cache_layer, new, starts)

@@ -1,0 +1,169 @@
+"""OpenAI-compatible API protocol models (pydantic v2).
+
+Shared by the engine server and the router. Extra fields are tolerated
+everywhere (parity with the reference's extra-field-tolerant
+OpenAIBaseModel, reference: src/vllm_router/protocols.py) so newer client
+SDKs never break the stack.
+"""
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class OpenAIBase(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+
+def _gen_id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex[:24]}"
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+# ---------------------------------------------------------------- requests
+
+class CompletionRequest(OpenAIBase):
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]] = ""
+    max_tokens: Optional[int] = 16
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0                      # vLLM extension
+    n: int = 1
+    stream: bool = False
+    stop: Optional[Union[str, List[str]]] = None
+    stop_token_ids: Optional[List[int]] = None  # vLLM extension
+    ignore_eos: bool = False            # vLLM extension
+    echo: bool = False
+    seed: Optional[int] = None
+    user: Optional[str] = None
+
+
+class ChatMessage(OpenAIBase):
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = ""
+
+
+class ChatCompletionRequest(OpenAIBase):
+    model: str
+    messages: List[ChatMessage]
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+    n: int = 1
+    stream: bool = False
+    stop: Optional[Union[str, List[str]]] = None
+    stop_token_ids: Optional[List[int]] = None
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+    user: Optional[str] = None
+
+
+# ---------------------------------------------------------------- responses
+
+class UsageInfo(OpenAIBase):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class CompletionChoice(OpenAIBase):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[Any] = None
+
+
+class CompletionResponse(OpenAIBase):
+    id: str = Field(default_factory=lambda: _gen_id("cmpl"))
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: List[CompletionChoice] = Field(default_factory=list)
+    usage: UsageInfo = Field(default_factory=UsageInfo)
+
+
+class ChatChoiceMessage(OpenAIBase):
+    role: str = "assistant"
+    content: Optional[str] = None
+
+
+class ChatCompletionChoice(OpenAIBase):
+    index: int = 0
+    message: ChatChoiceMessage = Field(default_factory=ChatChoiceMessage)
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(OpenAIBase):
+    id: str = Field(default_factory=lambda: _gen_id("chatcmpl"))
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: List[ChatCompletionChoice] = Field(default_factory=list)
+    usage: UsageInfo = Field(default_factory=UsageInfo)
+
+
+class DeltaMessage(OpenAIBase):
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatCompletionChunkChoice(OpenAIBase):
+    index: int = 0
+    delta: DeltaMessage = Field(default_factory=DeltaMessage)
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(OpenAIBase):
+    id: str = ""
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: List[ChatCompletionChunkChoice] = Field(default_factory=list)
+
+
+class CompletionChunkChoice(OpenAIBase):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+
+
+class CompletionChunk(OpenAIBase):
+    id: str = ""
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: List[CompletionChunkChoice] = Field(default_factory=list)
+
+
+# ---------------------------------------------------------------- models API
+
+class ModelCard(OpenAIBase):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=_now)
+    owned_by: str = "production-stack-tpu"
+    root: Optional[str] = None
+    parent: Optional[str] = None
+
+
+class ModelList(OpenAIBase):
+    object: Literal["list"] = "list"
+    data: List[ModelCard] = Field(default_factory=list)
+
+
+class ErrorInfo(OpenAIBase):
+    message: str
+    type: str = "invalid_request_error"
+    code: Optional[int] = None
+
+
+class ErrorResponse(OpenAIBase):
+    error: ErrorInfo

@@ -1,0 +1,173 @@
+"""Engine core tests: continuous batching, stop conditions, determinism.
+
+Hardware-free (CPU, debug-tiny random weights). Mirrors the role of the
+reference's perftest tier (SURVEY.md §4.2) but against the real in-repo
+engine rather than a fake.
+"""
+
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingOptions
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128, max_num_seqs=4,
+                       prefill_chunk=32, prefill_buckets=(16, 32))
+    eng = LLMEngine(cfg)
+    eng.runner.warmup()
+    return eng
+
+
+def _run_all(eng, ids):
+    done = {}
+    steps = 0
+    while len(done) < len(ids):
+        for out in eng.step():
+            if out.finished:
+                done[out.seq_id] = out.finish_reason
+        steps += 1
+        assert steps < 2000, "engine did not converge"
+    return done
+
+
+def test_greedy_deterministic(engine):
+    opts = SamplingOptions(temperature=0.0, max_tokens=12)
+    out1 = engine.generate("determinism test", opts)
+    out2 = engine.generate("determinism test", opts)
+    assert out1 == out2
+
+
+def test_continuous_batching_many_requests(engine):
+    """More requests than slots: all must finish via slot recycling."""
+    ids = [engine.add_request(
+        engine.tokenizer.encode(f"request number {i}"),
+        SamplingOptions(temperature=0.0, max_tokens=6 + i % 5))
+        for i in range(10)]
+    done = _run_all(engine, ids)
+    assert set(done) == set(ids)
+    assert all(r == "length" for r in done.values())
+
+
+def test_batched_decode_matches_solo(engine):
+    """A greedy sequence must produce identical tokens whether it runs
+    alone or next to other sequences (slot isolation)."""
+    opts = SamplingOptions(temperature=0.0, max_tokens=10)
+    solo = engine.generate("isolation probe", opts)
+
+    ids = [engine.add_request(engine.tokenizer.encode("isolation probe"),
+                              SamplingOptions(temperature=0.0, max_tokens=10)),
+           engine.add_request(engine.tokenizer.encode("other traffic 1"),
+                              SamplingOptions(temperature=0.9, max_tokens=10)),
+           engine.add_request(engine.tokenizer.encode("other traffic 22"),
+                              SamplingOptions(temperature=0.7, max_tokens=10))]
+    _run_all(engine, ids)
+    batched = engine.tokenizer.decode(engine.seqs[ids[0]].output_tokens)
+    assert batched == solo
+
+
+def test_stop_token(engine):
+    """stop_token_ids terminates generation with reason 'stop'."""
+    probe = engine.add_request(engine.tokenizer.encode("stop test"),
+                               SamplingOptions(temperature=0.0, max_tokens=1))
+    _run_all(engine, [probe])
+    first_id = engine.seqs[probe].output_tokens[0]
+    sid = engine.add_request(
+        engine.tokenizer.encode("stop test"),
+        SamplingOptions(temperature=0.0, max_tokens=50,
+                        stop_token_ids=[first_id]))
+    done = _run_all(engine, [sid])
+    assert done[sid] == "stop"
+    assert len(engine.seqs[sid].output_tokens) == 1
+
+
+def test_long_prompt_chunked_prefill(engine):
+    """Prompt longer than prefill_chunk forces multi-chunk prefill."""
+    prompt = "x" * 100  # 101 tokens with BOS > chunk 32
+    out = engine.generate(prompt, SamplingOptions(temperature=0.0,
+                                                  max_tokens=4))
+    assert isinstance(out, str)
+
+
+def test_prompt_too_long_rejected(engine):
+    with pytest.raises(ValueError, match="exceeds"):
+        engine.add_request(list(range(300)), SamplingOptions())
+
+
+def test_abort(engine):
+    sid = engine.add_request(engine.tokenizer.encode("to be aborted"),
+                             SamplingOptions(max_tokens=100))
+    assert engine.abort(sid)
+    assert engine.seqs[sid].finish_reason == "abort"
+    assert not engine.scheduler.has_work
+
+
+def test_stop_string_truncation(engine):
+    """Stop strings are excluded from delivered text (OpenAI semantics)."""
+    # discover the first 8 greedy chars, then use a middle substring as stop
+    probe = engine.generate("truncation probe",
+                            SamplingOptions(temperature=0.0, max_tokens=8))
+    if len(probe) < 3:
+        pytest.skip("model output too short to derive a stop string")
+    stop = probe[1:3]
+    out = engine.generate("truncation probe",
+                          SamplingOptions(temperature=0.0, max_tokens=8,
+                                          stop=[stop]))
+    assert stop not in out
+    assert out == probe[:probe.index(stop)]
+
+
+def test_ignore_eos_still_honors_stop_tokens(engine):
+    probe = engine.add_request(engine.tokenizer.encode("ignore eos probe"),
+                               SamplingOptions(temperature=0.0, max_tokens=1))
+    _run_all(engine, [probe])
+    first_id = engine.seqs[probe].output_tokens[0]
+    sid = engine.add_request(
+        engine.tokenizer.encode("ignore eos probe"),
+        SamplingOptions(temperature=0.0, max_tokens=50, ignore_eos=True,
+                        stop_token_ids=[first_id]))
+    done = _run_all(engine, [sid])
+    assert done[sid] == "stop"
+
+
+def test_prefill_near_cache_end_no_corruption():
+    """A prompt whose last prefill chunk pads past max_model_len must not
+    corrupt earlier KV entries (scatter-clip write path)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+
+    cfg = EngineConfig(model="debug-tiny", max_model_len=100, max_num_seqs=1,
+                       prefill_chunk=32, prefill_buckets=(16, 32))
+    eng = LLMEngine(cfg)
+    # 97-token prompt: final chunk start=96, len=1, padded to 16 -> would
+    # clamp with DUS. Compare against a roomy-cache engine on the same
+    # prompt: greedy continuation must match.
+    prompt = list(range(1, 98))
+    sid = eng.add_request(prompt, SamplingOptions(temperature=0.0,
+                                                  max_tokens=3))
+    done = {}
+    while not done:
+        for o in eng.step():
+            if o.finished:
+                done[o.seq_id] = o
+    out_small = eng.seqs[sid].output_tokens
+
+    cfg2 = EngineConfig(model="debug-tiny", max_model_len=256, max_num_seqs=1,
+                        prefill_chunk=32, prefill_buckets=(16, 32))
+    eng2 = LLMEngine(cfg2)
+    sid2 = eng2.add_request(prompt, SamplingOptions(temperature=0.0,
+                                                    max_tokens=3))
+    done = {}
+    while not done:
+        for o in eng2.step():
+            if o.finished:
+                done[o.seq_id] = o
+    assert eng2.seqs[sid2].output_tokens == out_small
+
+
+def test_finished_seq_retention_bounded(engine):
+    from production_stack_tpu.engine import engine as engine_mod
+    assert len(engine.seqs) <= engine_mod._FINISHED_RETENTION + \
+        engine.cfg.max_num_seqs + len(engine.scheduler.waiting)

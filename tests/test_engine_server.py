@@ -1,0 +1,116 @@
+"""Engine HTTP server tests via aiohttp TestClient (in-process, CPU)."""
+
+import asyncio
+import json
+
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.server import build_app
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128, max_num_seqs=2,
+                       prefill_chunk=32, prefill_buckets=(16, 32))
+    eng = AsyncLLMEngine(cfg)
+    eng.engine.runner.warmup()
+    return eng
+
+
+def _with_client(engine, coro):
+    async def runner():
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            return await coro(client)
+    return asyncio.run(runner())
+
+
+def test_models_and_health(engine):
+    async def body(client):
+        r = await client.get("/v1/models")
+        assert r.status == 200
+        data = await r.json()
+        assert data["data"][0]["id"] == "debug-tiny"
+        r = await client.get("/health")
+        assert r.status == 200
+        r = await client.get("/version")
+        assert (await r.json())["version"]
+    _with_client(engine, body)
+
+
+def test_chat_completion(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 5, "temperature": 0.0})
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "chat.completion"
+        assert data["usage"]["completion_tokens"] == 5
+        assert data["choices"][0]["finish_reason"] == "length"
+    _with_client(engine, body)
+
+
+def test_chat_completion_stream(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 5, "stream": True})
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        raw = (await r.read()).decode()
+        events = [line[len("data: "):] for line in raw.splitlines()
+                  if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+    _with_client(engine, body)
+
+
+def test_completions_and_token_api(engine):
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "abc", "max_tokens": 4,
+            "temperature": 0.0})
+        assert r.status == 200
+        data = await r.json()
+        assert data["object"] == "text_completion"
+
+        r = await client.post("/tokenize", json={"prompt": "abc"})
+        toks = (await r.json())["tokens"]
+        r = await client.post("/detokenize", json={"tokens": toks})
+        assert (await r.json())["prompt"] == "abc"
+    _with_client(engine, body)
+
+
+def test_bad_requests(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={"model": "x"})
+        assert r.status == 400
+        assert "error" in await r.json()
+        r = await client.post("/v1/chat/completions", data=b"not json",
+                              headers={"Content-Type": "application/json"})
+        assert r.status == 400
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny", "n": 3,
+            "messages": [{"role": "user", "content": "x"}]})
+        assert r.status == 400
+    _with_client(engine, body)
+
+
+def test_metrics_exposition(engine):
+    async def body(client):
+        r = await client.get("/metrics")
+        text = (await r.read()).decode()
+        for name in ("vllm:num_requests_running", "vllm:num_requests_waiting",
+                     "vllm:gpu_cache_usage_perc", "tpu:hbm_kv_usage_perc",
+                     "vllm:time_to_first_token_seconds"):
+            assert name in text, f"missing metric {name}"
+    _with_client(engine, body)
