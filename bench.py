@@ -1,0 +1,112 @@
+"""Serving-engine benchmark: prints ONE JSON line with decode throughput.
+
+Measures end-to-end continuous-batching generation throughput (output
+tokens/sec) of the TPU-native engine on a TinyLlama-1.1B-geometry model
+(random weights — throughput is weight-value-independent), batch 8,
+128-token prompts, 128 generated tokens per request, greedy.
+
+vs_baseline: ratio against the value recorded in BENCH_REF.json for this
+(mode, platform) pair — first run of a pair records the baseline (ratio
+1.0); later rounds show the improvement factor. The reference repo
+publishes no absolute numbers (see BASELINE.md), so the trajectory is
+measured against ourselves.
+
+Usage: python bench.py [--small]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+REF_PATH = os.path.join(REPO, "BENCH_REF.json")
+
+
+def run_bench(small: bool) -> dict:
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    if small:
+        cfg = EngineConfig(model="debug-tiny", max_model_len=512,
+                           max_num_seqs=8, prefill_chunk=128)
+        prompt_len, gen_len, n_requests = 64, 32, 16
+    else:
+        cfg = EngineConfig(model="tinyllama-1.1b", max_model_len=1024,
+                           max_num_seqs=8, prefill_chunk=512)
+        prompt_len, gen_len, n_requests = 128, 128, 16
+
+    eng = LLMEngine(cfg)
+    compile_s = eng.runner.warmup()
+
+    opts = SamplingOptions(temperature=0.0, max_tokens=gen_len,
+                           ignore_eos=True)
+    rng_tokens = [[(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
+                  for i in range(n_requests)]
+
+    t0 = time.time()
+    ids = [eng.add_request(toks, opts) for toks in rng_tokens]
+    done = set()
+    while len(done) < len(ids):
+        for out in eng.step():
+            if out.finished:
+                done.add(out.seq_id)
+    wall = time.time() - t0
+
+    out_tokens = sum(len(eng.seqs[i].output_tokens) for i in ids)
+    in_tokens = sum(len(t) for t in rng_tokens)
+    return {
+        "output_tokens_per_s": out_tokens / wall,
+        "total_tokens_per_s": (out_tokens + in_tokens) / wall,
+        "wall_s": wall,
+        "compile_s": compile_s,
+        "out_tokens": out_tokens,
+        "model": cfg.model,
+        "batch_slots": cfg.max_num_seqs,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="tiny model (CPU-viable quick check)")
+    args = ap.parse_args()
+
+    import jax
+    platform = jax.devices()[0].platform
+    stats = run_bench(args.small)
+
+    value = round(stats["output_tokens_per_s"], 2)
+    # baselines keyed by (mode, platform) so runs never clobber each other
+    key = f"{'small' if args.small else 'full'}-{platform}"
+    refs = {}
+    if os.path.exists(REF_PATH):
+        try:
+            with open(REF_PATH) as f:
+                refs = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            refs = {}
+    ref = refs.get(key)
+    if ref is None:
+        refs[key] = ref = value
+        with open(REF_PATH, "w") as f:
+            json.dump(refs, f)
+
+    print(json.dumps({
+        "metric": "engine decode throughput (TinyLlama-1.1B geometry, "
+                  "batch 8, 128+128 tok, single chip)"
+        if not args.small else "engine decode throughput (debug-tiny)",
+        "value": value,
+        "unit": "out_tok/s",
+        "vs_baseline": round(value / ref, 3) if ref else 1.0,
+        "platform": platform,
+        "detail": {k: (round(v, 2) if isinstance(v, float) else v)
+                   for k, v in stats.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,13 @@ class LLMEngine:
                                         engine_cfg.tokenizer)
         if params is None and engine_cfg.checkpoint:
             params = load_checkpoint(self.model_cfg, engine_cfg.checkpoint)
+        if mesh is None and engine_cfg.tensor_parallel_size > 1:
+            from production_stack_tpu.parallel.mesh import (MeshConfig,
+                                                            build_mesh)
+            import jax
+            tp = engine_cfg.tensor_parallel_size
+            mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=tp),
+                              jax.devices()[:tp])
         self.runner = ModelRunner(self.model_cfg, engine_cfg, params=params,
                                   mesh=mesh)
         self.scheduler = Scheduler(engine_cfg.max_num_seqs,

@@ -55,6 +55,23 @@ class ModelRunner:
             model_cfg.head_dim_,
             dtype=jnp.bfloat16 if engine_cfg.kv_dtype == "bfloat16"
             else jnp.float32)
+        if mesh is not None:
+            # tensor-parallel serving: weights/cache sharded over the
+            # slice's chips; XLA derives all ICI collectives from here
+            from jax.sharding import NamedSharding
+            from production_stack_tpu.parallel.sharding import (
+                cache_pspec, param_shardings)
+            tp = mesh.shape.get("tp", 1)
+            if model_cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel_size {tp} must divide num_kv_heads "
+                    f"{model_cfg.num_kv_heads} (KV-head replication is not "
+                    f"implemented yet)")
+            self.params = jax.device_put(
+                self.params, param_shardings(mesh, self.params))
+            cache_sh = NamedSharding(mesh, cache_pspec())
+            self.cache = KVCache(jax.device_put(self.cache.k, cache_sh),
+                                 jax.device_put(self.cache.v, cache_sh))
         self._key = jax.random.PRNGKey(engine_cfg.seed ^ 0x5EED)
 
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))

@@ -63,8 +63,13 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 positions: jnp.ndarray, starts: Optional[jnp.ndarray],
                 x: jnp.ndarray, lp: Params,
-                kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]]):
-    """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D]."""
+                kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
+                attention_fn=None):
+    """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
+
+    attention_fn(q, k, v) overrides the no-cache attention — used to swap
+    in ring attention when the sequence dim is sharded (parallel/train.py).
+    """
     B, T, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     cos, sin = rope
@@ -77,7 +82,10 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
     k = apply_rope(k, positions, cos, sin)
 
     if kv is None:
-        attn = causal_attention(q, k, v, scale=hd ** -0.5)
+        if attention_fn is not None:
+            attn = attention_fn(q, k, v)
+        else:
+            attn = causal_attention(q, k, v, scale=hd ** -0.5)
         new_kv = None
     else:
         k_cache = write_chunk(kv[0], k, starts)
@@ -123,8 +131,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                   rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-                  ) -> jnp.ndarray:
-    """Full-sequence causal forward without cache. tokens [B,T] -> logits fp32."""
+                  attention_fn=None) -> jnp.ndarray:
+    """Full-sequence causal forward without cache. tokens [B,T] -> logits fp32.
+
+    attention_fn(q, k, v) -> out replaces dense causal attention when given
+    (e.g. ring attention over an 'sp'-sharded sequence).
+    """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
                           cfg.rope_theta)
@@ -133,7 +145,8 @@ def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     x = params["embed"][tokens].astype(cfg.dtype)
 
     def scan_body(carry, lp):
-        out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None)
+        out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None,
+                             attention_fn=attention_fn)
         return out, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])

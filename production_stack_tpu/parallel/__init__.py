@@ -1,0 +1,5 @@
+from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+from production_stack_tpu.parallel.sharding import (data_sharding,
+                                                    param_shardings)
+
+__all__ = ["MeshConfig", "build_mesh", "param_shardings", "data_sharding"]

@@ -1,0 +1,125 @@
+"""Ring attention: causal attention with the sequence sharded over ``sp``.
+
+Long-context support: each of the N devices on the sp axis holds one
+contiguous block of the sequence (queries and K/V). K/V blocks rotate
+around the ring via ``lax.ppermute`` (ICI neighbor hops — bandwidth-
+optimal, never all-to-all) while each device accumulates its queries'
+attention with a numerically-stable online softmax (flash-style running
+max/sum). After N-1 hops every query has seen every key it may attend to.
+
+Causality at block granularity: a device only *uses* a K/V block whose
+global positions aren't entirely in its future; within the diagonal block
+a per-element mask applies. Compute cost of skipped blocks is masked, not
+branched (static shapes; XLA requires it).
+
+Used under ``shard_map`` over the 'sp' axis — see ``ring_causal_attention``
+for the jit-level wrapper. The reference stack has no long-context
+machinery at all (SURVEY.md §5 "Long-context": nothing in-repo); this is
+new TPU-native capability.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, q_pos, k_pos, scale):
+    """Partial attention of local q against one K/V block.
+
+    q [B,Tq,Hkv,G,D]; k,v [B,Tk,Hkv,D]; positions [Tq]/[Tk] global.
+    Returns (unnormalized out [B,Tq,Hkv,G,D], row max m [B,Hkv,G,Tq],
+    row sum l [B,Hkv,G,Tq]) for online-softmax merging.
+    """
+    scores = jnp.einsum("btkgd,bskd->bkgts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    mask = k_pos[None, :] <= q_pos[:, None]          # [Tq,Tk] causal
+    scores = jnp.where(mask[None, None, None], scores, _NEG_INF)
+    m = scores.max(axis=-1)                          # [B,Hkv,G,Tq]
+    p = jnp.exp(scores - m[..., None])
+    # rows with no visible keys: m = -inf -> p would be exp(0)=1; zero them
+    valid = (m > _NEG_INF / 2)
+    p = jnp.where(valid[..., None], p, 0.0)
+    m = jnp.where(valid, m, _NEG_INF)
+    l = p.sum(axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v.dtype), v)
+    return out.astype(jnp.float32), m, l
+
+
+def _merge(out, m, l, blk_out, blk_m, blk_l):
+    """Online-softmax merge of one block's partial attention."""
+    new_m = jnp.maximum(m, blk_m)
+    alpha = jnp.exp(m - new_m)
+    beta = jnp.exp(blk_m - new_m)
+    l = l * alpha + blk_l * beta
+    out = out * _to_btkgd(alpha) + blk_out * _to_btkgd(beta)
+    return out, new_m, l
+
+
+def _to_btkgd(x):
+    """[B,Hkv,G,Tq] -> [B,Tq,Hkv,G,1] broadcast helper."""
+    return jnp.moveaxis(x, -1, 1)[..., None]
+
+
+def _ring_attention_local(q, k, v, scale, axis_name):
+    """Per-device body (inside shard_map). q [B,Tl,H,D]; k,v [B,Tl,Hkv,D]."""
+    B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    idx = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+    q_pos = idx * Tl + jnp.arange(Tl)
+    k_pos0 = idx * Tl + jnp.arange(Tl)
+
+    q5 = q.reshape(B, Tl, Hkv, G, D)
+    # local (diagonal) block first — no communication needed for it
+    out, m, l = _block_attend(q5, k, v, q_pos, k_pos0, scale)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, _):
+        k_blk, v_blk, k_pos, out, m, l = carry
+        # rotate first, then attend: exactly n-1 hops total, and the
+        # final iteration's K/V are consumed, not discarded
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_pos = lax.ppermute(k_pos, axis_name, perm)
+        blk = _block_attend(q5, k_blk, v_blk, q_pos, k_pos, scale)
+        out, m, l = _merge(out, m, l, *blk)
+        return (k_blk, v_blk, k_pos, out, m, l), None
+
+    (k_f, v_f, kp_f, out, m, l), _ = lax.scan(
+        body, (k, v, k_pos0, out, m, l), None, length=n - 1)
+    norm = jnp.where(l > 0, l, 1.0)
+    out = out / _to_btkgd(norm)
+    return out.reshape(B, Tl, H, D).astype(q.dtype)
+
+
+def ring_causal_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                          scale: Optional[float] = None):
+    """Causal GQA with sequence dim sharded over mesh axis ``axis_name``.
+
+    q [B,T,H,D]; k,v [B,T,Hkv,D] with T globally sharded over sp. Batch
+    stays dp-sharded and heads tp-sharded (ring collectives touch only the
+    sp axis, so dp/tp shards proceed independently). Output matches
+    ops.attention.causal_attention run on a single device.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    dp = "dp" if "dp" in mesh.shape else None
+    tp = "tp" if "tp" in mesh.shape else None
+    spec = P(dp, axis_name, tp, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, scale=scale,
+                          axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

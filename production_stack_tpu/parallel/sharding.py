@@ -1,0 +1,66 @@
+"""PartitionSpecs for the stacked-params Llama pytree (megatron-style).
+
+Column-parallel projections (q/k/v/gate/up) shard the output feature dim
+over ``tp``; row-parallel (o/down) shard the input feature dim, so each
+layer needs exactly one psum (inserted automatically by XLA from the
+sharding propagation) on the attention output and one on the MLP output —
+riding ICI within the slice.
+
+Embedding and lm_head shard the vocab dim; norms are replicated.
+The KV cache shards over heads (tp) and slots (dp).
+"""
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+_LAYER_SPECS: Dict[str, P] = {
+    # [L, in, out] column-parallel: shard out over tp
+    "q": P(None, None, "tp"),
+    "k": P(None, None, "tp"),
+    "v": P(None, None, "tp"),
+    "gate": P(None, None, "tp"),
+    "up": P(None, None, "tp"),
+    # [L, in, out] row-parallel: shard in over tp
+    "o": P(None, "tp", None),
+    "down": P(None, "tp", None),
+    # norms replicated
+    "attn_norm": P(None, None),
+    "mlp_norm": P(None, None),
+}
+
+
+def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching models/llama.py's params layout."""
+    specs: Dict[str, Any] = {
+        "embed": P("tp", None),
+        "layers": {name: _LAYER_SPECS[name] for name in params["layers"]},
+        "final_norm": P(None),
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def param_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                        param_pspecs(params),
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def data_sharding(mesh: Mesh, sequence_parallel: bool = False):
+    """Sharding for [B, T] token batches: batch over dp, optionally
+    sequence over sp (ring attention consumes the sp axis)."""
+    return NamedSharding(mesh, P("dp", "sp" if sequence_parallel else None))
+
+
+def cache_pspec() -> P:
+    """KV cache [L, B, S, Hkv, D]: slots over dp, kv heads over tp."""
+    return P(None, "dp", None, "tp", None)
+
+
+def shard_params(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Place an (unsharded) params pytree onto the mesh."""
+    return jax.device_put(params, param_shardings(mesh, params))

@@ -1,0 +1,119 @@
+"""Parallelism tests on the 8-device virtual CPU mesh.
+
+Exercises exactly the sharding/collective paths a v5e-8 slice would run:
+tp param sharding, dp/sp batch sharding, ring attention vs the reference
+dense attention, and the full sharded training step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models import ModelConfig, llama
+from production_stack_tpu.parallel.mesh import MeshConfig, build_mesh
+from production_stack_tpu.parallel.ring_attention import ring_causal_attention
+from production_stack_tpu.parallel.sharding import shard_params
+from production_stack_tpu.parallel.train import jit_train_step
+from production_stack_tpu.ops.attention import causal_attention
+
+
+CFG = ModelConfig(name="t", vocab_size=128, hidden_size=64,
+                  intermediate_size=128, num_layers=2, num_heads=8,
+                  num_kv_heads=4, max_position_embeddings=256,
+                  dtype=jnp.float32)
+
+
+def test_mesh_factoring():
+    assert MeshConfig.for_devices(8) == MeshConfig(dp=2, sp=2, tp=2)
+    assert MeshConfig.for_devices(8, tp=4) == MeshConfig(dp=1, sp=2, tp=4)
+    assert MeshConfig.for_devices(1) == MeshConfig(dp=1, sp=1, tp=1)
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=3, sp=1, tp=1))
+
+
+def test_tp_sharded_forward_matches_single_device():
+    mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=8))
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(CFG, key)
+    toks = jax.random.randint(key, (2, 16), 0, CFG.vocab_size)
+
+    expected = llama.forward_train(params, CFG, toks)
+    sharded = shard_params(mesh, params)
+    got = jax.jit(lambda p, t: llama.forward_train(p, CFG, t))(sharded, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_ring_attention_matches_dense():
+    mesh = build_mesh(MeshConfig(dp=1, sp=8, tp=1))
+    key = jax.random.PRNGKey(1)
+    B, T, H, Hkv, D = 2, 64, 4, 2, 16
+    q = jax.random.normal(key, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, T, Hkv, D))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, T, Hkv, D))
+
+    dense = causal_attention(q, k, v)
+    ring = ring_causal_attention(q, k, v, mesh)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sharded_train_step_runs_and_learns():
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    state, step_fn = jit_train_step(mesh, CFG, params)
+    toks = jax.random.randint(jax.random.PRNGKey(3), (4, 32), 0,
+                              CFG.vocab_size)
+    losses = []
+    for _ in range(5):
+        state, loss = step_fn(state, toks)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+
+
+def test_sp_train_step_matches_dp_loss():
+    """First-step loss must be identical whether the sequence is sharded
+    (ring attention) or not — same math, different layout."""
+    toks = jax.random.randint(jax.random.PRNGKey(3), (4, 64), 0,
+                              CFG.vocab_size)
+
+    # params are consumed by jit_train_step (donation/aliasing) — build
+    # a fresh pytree per mesh
+    mesh_dp = build_mesh(MeshConfig(dp=4, sp=1, tp=2))
+    state, step = jit_train_step(
+        mesh_dp, CFG, llama.init_params(CFG, jax.random.PRNGKey(0)))
+    _, loss_dp = step(state, toks)
+
+    mesh_sp = build_mesh(MeshConfig(dp=1, sp=4, tp=2))
+    state, step = jit_train_step(
+        mesh_sp, CFG, llama.init_params(CFG, jax.random.PRNGKey(0)))
+    _, loss_sp = step(state, toks)
+    assert abs(float(loss_dp) - float(loss_sp)) < 1e-4
+
+
+def test_tp_serving_engine_matches_unsharded():
+    """Greedy generation through the engine must be identical with and
+    without a tp=2 serving mesh (debug-tiny has 2 KV heads)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    opts = SamplingOptions(temperature=0.0, max_tokens=8)
+    base = EngineConfig(model="debug-tiny", max_model_len=128, max_num_seqs=2,
+                        prefill_chunk=32, prefill_buckets=(16, 32))
+    plain = LLMEngine(base).generate("tensor parallel probe", opts)
+
+    tp_cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                          max_num_seqs=2, prefill_chunk=32,
+                          prefill_buckets=(16, 32), tensor_parallel_size=2)
+    sharded = LLMEngine(tp_cfg).generate("tensor parallel probe", opts)
+    assert plain == sharded
+
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        LLMEngine(EngineConfig(model="debug-tiny", max_model_len=128,
+                               max_num_seqs=2, prefill_chunk=32,
+                               prefill_buckets=(16, 32),
+                               tensor_parallel_size=8))
