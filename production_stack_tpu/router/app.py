@@ -1,0 +1,238 @@
+"""Router application: endpoint surface + wiring + CLI.
+
+Endpoint parity with reference src/vllm_router/routers/main_router.py:
+42-160 — /v1/chat/completions, /v1/completions, /v1/embeddings,
+/v1/rerank, /v1/score (proxied); /v1/models (aggregated, deduped);
+/health (discovery + scraper + config watcher liveness + current dynamic
+config); /metrics; /version. Files and batches endpoints live in
+files_api.py / batches_api.py and are mounted here.
+
+Everything is one aiohttp Application; background activities (K8s watch,
+stats scraper, config watcher) are asyncio tasks started on app startup
+and cancelled on cleanup.
+"""
+
+import argparse
+import asyncio
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu import protocol as proto
+from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
+from production_stack_tpu.router.feature_gates import FeatureGates
+from production_stack_tpu.router.metrics import RouterMetrics
+from production_stack_tpu.router.proxy import route_general_request
+from production_stack_tpu.router.rewriter import make_rewriter
+from production_stack_tpu.router.routing import make_router
+from production_stack_tpu.router.service_discovery import (
+    K8sServiceDiscovery, StaticServiceDiscovery)
+from production_stack_tpu.router.stats import (EngineStatsScraper,
+                                               RequestStatsMonitor)
+from production_stack_tpu.utils import (init_logger, parse_comma_separated,
+                                        parse_static_aliases,
+                                        parse_static_urls, set_ulimit)
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+PROXIED_PATHS = ["/v1/chat/completions", "/v1/completions", "/v1/embeddings",
+                 "/v1/rerank", "/v1/score"]
+
+
+# ---------------------------------------------------------------- handlers
+
+def _make_proxy_handler(path: str):
+    async def handler(request: web.Request) -> web.StreamResponse:
+        return await route_general_request(request, path)
+    return handler
+
+
+async def list_models(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    cards = {}
+    for ep in state["discovery"].get_endpoints():
+        for name in [ep.model] + ep.model_aliases:
+            if name not in cards:
+                cards[name] = proto.ModelCard(id=name)
+    return web.json_response(
+        proto.ModelList(data=list(cards.values())).model_dump())
+
+
+async def health(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    problems = []
+    if not state["discovery"].get_endpoints():
+        problems.append("no routable engine endpoints")
+    if not state["discovery"].healthy():
+        problems.append("service discovery task dead")
+    if state.get("scraper") and not state["scraper"].healthy():
+        problems.append("engine stats scraper dead")
+    watcher = state.get("config_watcher")
+    if watcher and not watcher.healthy():
+        problems.append("dynamic config watcher dead")
+    body = {
+        "status": "ok" if not problems else "unhealthy",
+        "problems": problems,
+        "endpoints": len(state["discovery"].get_endpoints()),
+        "dynamic_config": watcher.current.to_json()
+        if watcher and watcher.current else None,
+    }
+    return web.json_response(body, status=200 if not problems else 503)
+
+
+async def version(request: web.Request) -> web.Response:
+    return web.json_response({"version": __version__})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    endpoints = state["discovery"].get_endpoints()
+    state["request_stats"].evict_except(ep.url for ep in endpoints)
+    state["metrics"].refresh(state["request_stats"].get(), len(endpoints))
+    return web.Response(body=state["metrics"].render(),
+                        content_type="text/plain")
+
+
+# ---------------------------------------------------------------- wiring
+
+def build_app(args: argparse.Namespace) -> web.Application:
+    app = web.Application(client_max_size=64 * 1024 * 1024)
+    state: dict = {
+        "request_timeout": args.request_timeout,
+        "metrics": RouterMetrics(),
+        "request_stats": RequestStatsMonitor(
+            horizon_s=args.request_stats_window),
+        "feature_gates": FeatureGates(args.feature_gates),
+        "rewriter": make_rewriter("noop"),
+    }
+    app["state"] = state
+
+    if args.service_discovery == "static":
+        state["discovery"] = StaticServiceDiscovery(
+            parse_static_urls(args.static_backends),
+            parse_comma_separated(args.static_models),
+            aliases=parse_static_aliases(args.static_model_aliases),
+        )
+    elif args.service_discovery == "k8s":
+        state["discovery"] = K8sServiceDiscovery(
+            namespace=args.k8s_namespace,
+            label_selector=args.k8s_label_selector,
+            engine_port=args.k8s_engine_port)
+    else:
+        raise ValueError(
+            f"unknown service discovery {args.service_discovery!r}")
+
+    if args.routing_logic == "prefix" and not state["feature_gates"].enabled(
+            "KVAwareRouting"):
+        raise ValueError("--routing-logic prefix requires the "
+                         "KVAwareRouting feature gate (BETA, on by "
+                         "default; it was explicitly disabled)")
+    state["router"] = make_router(args.routing_logic, args.session_key)
+    # indirect through state so dynamic-config discovery swaps are followed
+    state["scraper"] = EngineStatsScraper(
+        lambda: state["discovery"].get_endpoints(),
+        interval_s=args.engine_stats_interval)
+
+    if args.dynamic_config_json:
+        state["config_watcher"] = DynamicConfigWatcher(
+            state, args.dynamic_config_json,
+            interval_s=args.dynamic_config_interval)
+
+    for path in PROXIED_PATHS:
+        app.router.add_post(path, _make_proxy_handler(path))
+    app.router.add_get("/v1/models", list_models)
+    app.router.add_get("/health", health)
+    app.router.add_get("/version", version)
+    app.router.add_get("/metrics", metrics)
+
+    if args.enable_files_api or args.enable_batch_api:
+        from production_stack_tpu.router.files_api import mount_files_api
+        mount_files_api(app, args.file_storage_path)
+    if args.enable_batch_api:
+        from production_stack_tpu.router.batches_api import mount_batches_api
+        mount_batches_api(app, args.batch_db_path)
+
+    async def on_startup(app):
+        state["client"] = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0))
+        await state["discovery"].start()
+        await state["scraper"].start()
+        if "config_watcher" in state:
+            await state["config_watcher"].start()
+
+    async def on_cleanup(app):
+        if "config_watcher" in state:
+            await state["config_watcher"].close()
+        await state["scraper"].close()
+        await state["discovery"].close()
+        await state["client"].close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "pstpu-router",
+        description="OpenAI-compatible router over TPU engine replicas")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--service-discovery", choices=["static", "k8s"],
+                   default="static")
+    p.add_argument("--static-backends", default="",
+                   help="comma-separated engine URLs")
+    p.add_argument("--static-models", default="",
+                   help="comma-separated model names (same order)")
+    p.add_argument("--static-model-aliases", default="",
+                   help="alias:model,... pairs")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-label-selector", default="")
+    p.add_argument("--k8s-engine-port", type=int, default=8100)
+    p.add_argument("--routing-logic",
+                   choices=["roundrobin", "session", "least_loaded",
+                            "prefix"],
+                   default="roundrobin")
+    p.add_argument("--session-key", default="x-user-id")
+    p.add_argument("--engine-stats-interval", type=float, default=10.0)
+    p.add_argument("--request-stats-window", type=float, default=30.0)
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--dynamic-config-json", default=None)
+    p.add_argument("--dynamic-config-interval", type=float, default=10.0)
+    p.add_argument("--feature-gates", default=None,
+                   help="Name=true,Name2=false")
+    p.add_argument("--enable-files-api", action="store_true")
+    p.add_argument("--enable-batch-api", action="store_true")
+    p.add_argument("--file-storage-path", default="/tmp/pstpu_files")
+    p.add_argument("--batch-db-path", default="/tmp/pstpu_batches.db")
+    args = p.parse_args(argv)
+    if args.service_discovery == "static" and not args.static_backends:
+        p.error("--static-backends is required with static discovery")
+    if args.service_discovery == "k8s" and not args.k8s_label_selector:
+        p.error("--k8s-label-selector is required with k8s discovery")
+    return args
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    set_ulimit()
+    app = build_app(args)
+
+    async def _serve():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.port)
+        await site.start()
+        logger.info("router listening on %s:%d (%s discovery, %s routing)",
+                    args.host, args.port, args.service_discovery,
+                    args.routing_logic)
+        while True:
+            await asyncio.sleep(3600)
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

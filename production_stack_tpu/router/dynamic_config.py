@@ -1,0 +1,111 @@
+"""Hot-reload router configuration from a JSON file (operator contract).
+
+Capability parity with reference src/vllm_router/dynamic_config.py
+(DynamicRouterConfig :20-76 + 10s file-poll watcher :95-209): the C++
+operator reconciles a StaticRoute CR into a ConfigMap mounted at
+--dynamic-config-json; this watcher (an asyncio task) detects content
+changes and swaps service discovery / routing policy in place. The
+current config is surfaced in /health (parity with main_router.py:150-158).
+"""
+
+import asyncio
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from production_stack_tpu.router.routing import make_router
+from production_stack_tpu.router.service_discovery import (
+    StaticServiceDiscovery)
+from production_stack_tpu.utils import init_logger, parse_comma_separated
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class DynamicRouterConfig:
+    service_discovery: str = "static"
+    routing_logic: str = "roundrobin"
+    static_backends: List[str] = field(default_factory=list)
+    static_models: List[str] = field(default_factory=list)
+    session_key: str = "x-user-id"
+
+    @staticmethod
+    def from_json(data: dict) -> "DynamicRouterConfig":
+        def listify(v):
+            return parse_comma_separated(v) if isinstance(v, str) else (
+                v or [])
+        return DynamicRouterConfig(
+            service_discovery=data.get("service_discovery", "static"),
+            routing_logic=data.get("routing_logic", "roundrobin"),
+            static_backends=listify(data.get("static_backends")),
+            static_models=listify(data.get("static_models")),
+            session_key=data.get("session_key", "x-user-id"),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "service_discovery": self.service_discovery,
+            "routing_logic": self.routing_logic,
+            "static_backends": self.static_backends,
+            "static_models": self.static_models,
+            "session_key": self.session_key,
+        }
+
+
+class DynamicConfigWatcher:
+    def __init__(self, app_state: dict, path: str, interval_s: float = 10.0):
+        self.state = app_state
+        self.path = path
+        self.interval = interval_s
+        self._last_content: Optional[str] = None
+        self._task: Optional[asyncio.Task] = None
+        self.current: Optional[DynamicRouterConfig] = None
+
+    async def start(self) -> None:
+        await self._check_once()   # apply initial config before serving
+        self._task = asyncio.create_task(self._loop(), name="config-watch")
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    def healthy(self) -> bool:
+        return self._task is None or not self._task.done()
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self._check_once()
+            except Exception:
+                logger.exception("dynamic config reload failed")
+
+    async def _check_once(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path) as f:
+            content = f.read()
+        if content == self._last_content:
+            return
+        self._last_content = content
+        cfg = DynamicRouterConfig.from_json(json.loads(content))
+        await self._apply(cfg)
+
+    async def _apply(self, cfg: DynamicRouterConfig) -> None:
+        logger.info("applying dynamic config: %s", cfg.to_json())
+        if cfg.service_discovery == "static" and cfg.static_backends:
+            old = self.state.get("discovery")
+            new = StaticServiceDiscovery(cfg.static_backends,
+                                         cfg.static_models)
+            await new.start()
+            self.state["discovery"] = new
+            if old is not None:
+                await old.close()
+        self.state["router"] = make_router(cfg.routing_logic,
+                                           cfg.session_key)
+        self.current = cfg

@@ -1,0 +1,60 @@
+"""Router-side Prometheus gauges, refreshed from the stats plane on scrape.
+
+Gauge names match the reference's router metrics surface (reference:
+src/vllm_router/services/metrics_service/prometheus_gauge.py —
+vllm:current_qps, vllm:avg_latency, vllm:avg_itl, vllm:num_prefill_requests,
+vllm:num_decoding_requests, vllm:num_requests_running,
+vllm:healthy_pods_total) so existing Grafana dashboards keep working.
+"""
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+
+class RouterMetrics:
+    def __init__(self):
+        self.registry = CollectorRegistry()
+
+        def gauge(name, doc):
+            return Gauge(name, doc, ["server"], registry=self.registry)
+
+        self.current_qps = gauge("vllm:current_qps",
+                                 "Router-observed QPS per engine")
+        self.avg_latency = gauge("vllm:avg_latency",
+                                 "Mean e2e latency (window)")
+        self.avg_ttft = gauge("vllm:avg_ttft", "Mean TTFT (window)")
+        self.avg_itl = gauge("vllm:avg_itl", "Mean inter-token latency")
+        self.num_prefill = gauge("vllm:num_prefill_requests",
+                                 "Requests awaiting first byte")
+        self.num_decoding = gauge("vllm:num_decoding_requests",
+                                  "Requests streaming")
+        self.num_running = gauge("vllm:num_requests_running",
+                                 "In-flight requests via router")
+        self.healthy_pods = Gauge("vllm:healthy_pods_total",
+                                  "Routable engine endpoints",
+                                  registry=self.registry)
+        self._seen_servers = set()
+
+    def refresh(self, request_stats: dict, num_endpoints: int) -> None:
+        # drop label series for engines that left the fleet so /metrics
+        # never exports frozen stats for dead pods
+        for url in self._seen_servers - set(request_stats):
+            for g in (self.current_qps, self.avg_latency, self.avg_ttft,
+                      self.avg_itl, self.num_prefill, self.num_decoding,
+                      self.num_running):
+                try:
+                    g.remove(url)
+                except KeyError:
+                    pass
+        self._seen_servers = set(request_stats)
+        for url, st in request_stats.items():
+            self.current_qps.labels(server=url).set(st.qps)
+            self.avg_latency.labels(server=url).set(st.latency)
+            self.avg_ttft.labels(server=url).set(st.ttft)
+            self.avg_itl.labels(server=url).set(st.itl)
+            self.num_prefill.labels(server=url).set(st.in_prefill)
+            self.num_decoding.labels(server=url).set(st.in_decoding)
+            self.num_running.labels(server=url).set(st.in_flight)
+        self.healthy_pods.set(num_endpoints)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,109 @@
+"""The hot path: proxy an OpenAI request to a chosen engine, streaming.
+
+Capability parity with reference src/vllm_router/services/request_service/
+request.py:44-196 (body parse -> model filter -> route -> stream relay ->
+stats hooks -> response), re-designed on one shared aiohttp
+ClientSession: the relay forwards raw bytes chunk-by-chunk (no SSE
+re-parse on the hot loop) and fires first-byte/complete stats hooks.
+"""
+
+import json
+import time
+import uuid
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
+               "keep-alive", "te", "upgrade",
+               # aiohttp's client auto-decompresses, so encoding headers
+               # must not leak through in either direction
+               "accept-encoding", "content-encoding"}
+
+
+def _forward_headers(request: web.Request) -> dict:
+    return {k: v for k, v in request.headers.items()
+            if k.lower() not in HOP_HEADERS}
+
+
+async def route_general_request(request: web.Request,
+                                endpoint_path: str) -> web.StreamResponse:
+    """Proxy `request` to an engine chosen by the app's routing policy."""
+    app = request.app
+    state = app["state"]
+    t_route0 = time.monotonic()
+
+    raw = await request.read()
+    try:
+        body = json.loads(raw) if raw else {}
+    except json.JSONDecodeError:
+        return web.json_response(
+            {"error": {"message": "request body is not valid JSON",
+                       "type": "invalid_request_error"}}, status=400)
+    model = body.get("model")
+    if not model:
+        return web.json_response(
+            {"error": {"message": "missing 'model' field",
+                       "type": "invalid_request_error"}}, status=400)
+
+    # optional pluggable rewrite hook
+    rewriter = state.get("rewriter")
+    if rewriter is not None:
+        body, raw = rewriter.rewrite(endpoint_path, body, raw)
+
+    endpoints = [ep for ep in state["discovery"].get_endpoints()
+                 if ep.serves(model)]
+    if not endpoints:
+        return web.json_response(
+            {"error": {"message": f"no backend serves model {model!r}",
+                       "type": "invalid_request_error"}}, status=400)
+
+    request_stats = state["request_stats"].get()
+    url = state["router"].route(endpoints, request_stats,
+                                dict(request.headers), body)
+    request_id = request.headers.get("x-request-id", uuid.uuid4().hex)
+    logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path, model, url,
+                 1e3 * (time.monotonic() - t_route0))
+
+    monitor = state["request_stats"]
+    session: aiohttp.ClientSession = state["client"]
+    monitor.on_new_request(url, request_id)
+    resp: Optional[web.StreamResponse] = None
+    try:
+        async with session.post(
+                f"{url}{endpoint_path}", data=raw,
+                headers=_forward_headers(request),
+                timeout=aiohttp.ClientTimeout(total=state["request_timeout"]),
+        ) as backend:
+            resp = web.StreamResponse(status=backend.status)
+            for k, v in backend.headers.items():
+                if k.lower() not in HOP_HEADERS:
+                    resp.headers[k] = v
+            await resp.prepare(request)
+            first = True
+            async for chunk in backend.content.iter_any():
+                if first:
+                    monitor.on_first_token(url, request_id)
+                    first = False
+                monitor.on_token(url, request_id)
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+    except (aiohttp.ClientError, ConnectionError) as e:
+        logger.warning("backend %s failed: %s", url, e)
+        if resp is not None and resp.prepared:
+            # headers already sent — a 502 body can't be delivered; drop
+            # the connection so the client sees a truncated stream, not a
+            # corrupted second response on the same exchange
+            resp.force_close()
+            return resp
+        return web.json_response(
+            {"error": {"message": f"backend error: {e}",
+                       "type": "server_error"}}, status=502)
+    finally:
+        monitor.on_request_complete(url, request_id)

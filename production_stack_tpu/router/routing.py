@@ -1,0 +1,174 @@
+"""Routing policies: pick a backend for each request.
+
+Capability parity with reference src/vllm_router/routers/routing_logic.py
+(round-robin :45-76; session consistent-hash with lowest-QPS fallback
+:79-172), re-designed:
+
+- The consistent-hash ring is implemented here directly (md5 points,
+  vnode replicas, bisect lookup) instead of depending on uhashring;
+  same invariants: stable mapping, minimal remapping on join/leave.
+- An extra ``prefix`` policy routes by a hash of the request's prompt
+  prefix — KV-cache-affinity routing so multi-round conversations with
+  shared history land where their KV blocks live (the TPU stack's
+  answer to LMCache-aware routing).
+"""
+
+import bisect
+import hashlib
+import json
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Sequence
+
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class Router(ABC):
+    name = "abstract"
+
+    @abstractmethod
+    def route(self, endpoints: Sequence[EndpointInfo], request_stats: Dict,
+              headers: Dict[str, str], body: dict) -> str:
+        """Return the chosen backend URL. endpoints is non-empty."""
+
+
+class RoundRobinRouter(Router):
+    name = "roundrobin"
+
+    def __init__(self):
+        self._counter = 0
+
+    def route(self, endpoints, request_stats, headers, body) -> str:
+        ordered = sorted(endpoints, key=lambda e: e.url)
+        choice = ordered[self._counter % len(ordered)]
+        self._counter += 1
+        return choice.url
+
+
+class LeastLoadedRouter(Router):
+    """Lowest observed in-flight requests (falls back to QPS, then RR)."""
+
+    name = "least_loaded"
+
+    def __init__(self):
+        self._rr = RoundRobinRouter()
+
+    def route(self, endpoints, request_stats, headers, body) -> str:
+        def load(ep: EndpointInfo):
+            st = request_stats.get(ep.url)
+            if st is None:
+                return (0, 0.0)
+            return (st.in_flight, st.qps)
+        if not request_stats:
+            return self._rr.route(endpoints, request_stats, headers, body)
+        return min(endpoints, key=load).url
+
+
+class HashRing:
+    """Consistent hashing: md5 ring with virtual nodes."""
+
+    def __init__(self, vnodes: int = 128):
+        self.vnodes = vnodes
+        self._points: List[int] = []
+        self._owners: Dict[int, str] = {}
+        self._nodes: List[str] = []
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return int.from_bytes(hashlib.md5(key.encode()).digest()[:8], "big")
+
+    def rebuild(self, nodes: Sequence[str]) -> None:
+        nodes = sorted(set(nodes))
+        if nodes == self._nodes:
+            return
+        self._nodes = list(nodes)
+        self._points = []
+        self._owners = {}
+        for node in nodes:
+            for i in range(self.vnodes):
+                p = self._hash(f"{node}#{i}")
+                self._points.append(p)
+                self._owners[p] = node
+        self._points.sort()
+
+    def lookup(self, key: str) -> Optional[str]:
+        if not self._points:
+            return None
+        h = self._hash(key)
+        idx = bisect.bisect(self._points, h) % len(self._points)
+        return self._owners[self._points[idx]]
+
+
+class SessionRouter(Router):
+    """Sticky sessions via consistent hashing on a session header.
+
+    Requests without the session header fall back to least-loaded
+    (parity with reference routing_logic.py:94-115's QPS fallback).
+    """
+
+    name = "session"
+
+    def __init__(self, session_key: str = "x-user-id", vnodes: int = 128):
+        self.session_key = session_key
+        self._ring = HashRing(vnodes)
+        self._fallback = LeastLoadedRouter()
+
+    def route(self, endpoints, request_stats, headers, body) -> str:
+        self._ring.rebuild([e.url for e in endpoints])
+        session_id = headers.get(self.session_key)
+        if not session_id:
+            return self._fallback.route(endpoints, request_stats, headers,
+                                        body)
+        return self._ring.lookup(session_id)
+
+
+class PrefixAwareRouter(Router):
+    """KV-affinity: hash the first `prefix_chars` of the prompt/messages.
+
+    Conversations sharing a long system prompt + history map to the same
+    engine, whose KV tiers (HBM/host) already hold those blocks.
+    """
+
+    name = "prefix"
+
+    def __init__(self, prefix_chars: int = 1024, vnodes: int = 128):
+        self.prefix_chars = prefix_chars
+        self._ring = HashRing(vnodes)
+        self._fallback = LeastLoadedRouter()
+
+    @staticmethod
+    def _prompt_text(body: dict) -> str:
+        if "messages" in body:
+            try:
+                return json.dumps(body["messages"])
+            except (TypeError, ValueError):
+                return ""
+        prompt = body.get("prompt", "")
+        return prompt if isinstance(prompt, str) else json.dumps(prompt)
+
+    def route(self, endpoints, request_stats, headers, body) -> str:
+        self._ring.rebuild([e.url for e in endpoints])
+        text = self._prompt_text(body)[:self.prefix_chars]
+        if not text:
+            return self._fallback.route(endpoints, request_stats, headers,
+                                        body)
+        return self._ring.lookup(text)
+
+
+_ROUTERS = {
+    "roundrobin": RoundRobinRouter,
+    "least_loaded": LeastLoadedRouter,
+    "session": SessionRouter,
+    "prefix": PrefixAwareRouter,
+}
+
+
+def make_router(name: str, session_key: str = "x-user-id") -> Router:
+    if name not in _ROUTERS:
+        raise ValueError(f"unknown routing logic {name!r}; "
+                         f"options: {sorted(_ROUTERS)}")
+    if name == "session":
+        return SessionRouter(session_key=session_key)
+    return _ROUTERS[name]()

@@ -1,0 +1,241 @@
+"""Router stats plane: per-engine request stats + scraped engine stats.
+
+Capability parity with reference src/vllm_router/stats/ (request_stats.py
+sliding-window QPS/TTFT/latency monitor :20-282; engine_stats.py
+Prometheus scraper :27-186), re-designed: one dataclass per concern, the
+scraper is an asyncio task (not a thread), and histograms are simple
+ring-deques trimmed on read.
+"""
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional, Tuple
+
+import aiohttp
+from prometheus_client.parser import text_string_to_metric_families
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class _Window:
+    """Sliding window of (timestamp, value) pairs."""
+
+    def __init__(self, horizon_s: float):
+        self.horizon = horizon_s
+        self._items: Deque[Tuple[float, float]] = collections.deque()
+
+    def add(self, value: float, now: Optional[float] = None) -> None:
+        self._items.append((now or time.time(), value))
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.horizon
+        while self._items and self._items[0][0] < cutoff:
+            self._items.popleft()
+
+    def count(self, now: Optional[float] = None) -> int:
+        self._trim(now or time.time())
+        return len(self._items)
+
+    def mean(self, now: Optional[float] = None) -> float:
+        self._trim(now or time.time())
+        if not self._items:
+            return 0.0
+        return sum(v for _, v in self._items) / len(self._items)
+
+    def rate(self, now: Optional[float] = None) -> float:
+        now = now or time.time()
+        self._trim(now)
+        return len(self._items) / self.horizon
+
+
+@dataclass
+class RequestStats:
+    """Router-observed stats for one engine URL."""
+
+    qps: float = 0.0
+    ttft: float = 0.0              # mean seconds in window
+    latency: float = 0.0           # mean end-to-end seconds in window
+    itl: float = 0.0               # mean inter-token latency proxy
+    in_flight: int = 0             # currently proxied requests
+    in_prefill: int = 0            # accepted, no first byte yet
+    in_decoding: int = 0           # streaming
+    finished: int = 0              # total completed
+
+
+class RequestStatsMonitor:
+    """Lifecycle hooks called by the proxy; windows per engine URL."""
+
+    def __init__(self, horizon_s: float = 30.0):
+        self.horizon = horizon_s
+        self._arrivals: Dict[str, _Window] = {}
+        self._ttft: Dict[str, _Window] = {}
+        self._latency: Dict[str, _Window] = {}
+        self._itl: Dict[str, _Window] = {}
+        self._in_prefill: Dict[str, int] = collections.defaultdict(int)
+        self._in_decoding: Dict[str, int] = collections.defaultdict(int)
+        self._finished: Dict[str, int] = collections.defaultdict(int)
+        self._start: Dict[Tuple[str, str], float] = {}
+        self._first_byte: Dict[Tuple[str, str], float] = {}
+        self._tokens: Dict[Tuple[str, str], int] = {}
+
+    def _window(self, store: Dict[str, _Window], url: str) -> _Window:
+        if url not in store:
+            store[url] = _Window(self.horizon)
+        return store[url]
+
+    # lifecycle ---------------------------------------------------------
+
+    def on_new_request(self, url: str, request_id: str) -> None:
+        now = time.time()
+        self._window(self._arrivals, url).add(1.0, now)
+        self._start[(url, request_id)] = now
+        self._in_prefill[url] += 1
+
+    def on_first_token(self, url: str, request_id: str) -> None:
+        key = (url, request_id)
+        now = time.time()
+        start = self._start.get(key)
+        if start is not None and key not in self._first_byte:
+            self._first_byte[key] = now
+            self._window(self._ttft, url).add(now - start, now)
+            self._in_prefill[url] = max(0, self._in_prefill[url] - 1)
+            self._in_decoding[url] += 1
+
+    def on_token(self, url: str, request_id: str) -> None:
+        self._tokens[(url, request_id)] = self._tokens.get(
+            (url, request_id), 0) + 1
+
+    def on_request_complete(self, url: str, request_id: str) -> None:
+        key = (url, request_id)
+        now = time.time()
+        start = self._start.pop(key, None)
+        first = self._first_byte.pop(key, None)
+        ntok = self._tokens.pop(key, 0)
+        if first is None:
+            self._in_prefill[url] = max(0, self._in_prefill[url] - 1)
+        else:
+            self._in_decoding[url] = max(0, self._in_decoding[url] - 1)
+            if ntok > 1:
+                self._window(self._itl, url).add(
+                    (now - first) / max(1, ntok - 1), now)
+        if start is not None:
+            self._window(self._latency, url).add(now - start, now)
+        self._finished[url] += 1
+
+    def evict_except(self, live_urls) -> None:
+        """Drop windows/counters for engines no longer in discovery."""
+        live = set(live_urls)
+        for store in (self._arrivals, self._ttft, self._latency, self._itl,
+                      self._in_prefill, self._in_decoding, self._finished):
+            for url in [u for u in store if u not in live]:
+                del store[url]
+
+    # reads -------------------------------------------------------------
+
+    def get(self) -> Dict[str, RequestStats]:
+        now = time.time()
+        urls = set(self._arrivals) | set(self._in_prefill) | set(
+            self._in_decoding)
+        out = {}
+        for url in urls:
+            out[url] = RequestStats(
+                qps=self._window(self._arrivals, url).rate(now),
+                ttft=self._window(self._ttft, url).mean(now),
+                latency=self._window(self._latency, url).mean(now),
+                itl=self._window(self._itl, url).mean(now),
+                in_flight=self._in_prefill[url] + self._in_decoding[url],
+                in_prefill=self._in_prefill[url],
+                in_decoding=self._in_decoding[url],
+                finished=self._finished[url],
+            )
+        return out
+
+
+@dataclass
+class EngineStats:
+    """Parsed from an engine's /metrics exposition."""
+
+    num_running: float = 0.0
+    num_waiting: float = 0.0
+    kv_usage: float = 0.0          # vllm:gpu_cache_usage_perc | tpu:hbm_kv
+    prefix_hit_rate: float = 0.0
+    scraped_at: float = field(default_factory=time.time)
+
+
+_WANTED_GAUGES = ("vllm:num_requests_running", "vllm:num_requests_waiting",
+                  "vllm:gpu_cache_usage_perc", "tpu:hbm_kv_usage_perc",
+                  "vllm:gpu_prefix_cache_hit_rate")
+
+
+def parse_engine_metrics(text: str) -> EngineStats:
+    values: Dict[str, float] = {}
+    for family in text_string_to_metric_families(text):
+        if family.name in _WANTED_GAUGES:
+            for sample in family.samples:
+                values[family.name] = float(sample.value)
+    # vllm's gauge name wins when both KV-usage spellings are exposed
+    kv = values.get("vllm:gpu_cache_usage_perc",
+                    values.get("tpu:hbm_kv_usage_perc", 0.0))
+    return EngineStats(
+        num_running=values.get("vllm:num_requests_running", 0.0),
+        num_waiting=values.get("vllm:num_requests_waiting", 0.0),
+        kv_usage=kv,
+        prefix_hit_rate=values.get("vllm:gpu_prefix_cache_hit_rate", 0.0),
+    )
+
+
+class EngineStatsScraper:
+    """Polls every engine's /metrics on an interval (asyncio task)."""
+
+    def __init__(self, get_endpoints, interval_s: float = 10.0):
+        self._get_endpoints = get_endpoints
+        self.interval = interval_s
+        self._stats: Dict[str, EngineStats] = {}
+        self._task: Optional[asyncio.Task] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        self._task = asyncio.create_task(self._loop(), name="engine-scraper")
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._session:
+            await self._session.close()
+
+    def healthy(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def get(self) -> Dict[str, EngineStats]:
+        return dict(self._stats)
+
+    async def _loop(self) -> None:
+        while True:
+            await self._scrape_once()
+            await asyncio.sleep(self.interval)
+
+    async def _scrape_one(self, url: str) -> None:
+        try:
+            async with self._session.get(
+                    f"{url}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                if r.status == 200:
+                    self._stats[url] = parse_engine_metrics(await r.text())
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            self._stats.pop(url, None)   # stale engine drops out
+
+    async def _scrape_once(self) -> None:
+        urls = {ep.url for ep in self._get_endpoints()}
+        # concurrent: one slow/unreachable engine must not stall the rest
+        await asyncio.gather(*(self._scrape_one(u) for u in urls))
+        for gone in set(self._stats) - urls:
+            del self._stats[gone]

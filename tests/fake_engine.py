@@ -1,0 +1,120 @@
+"""Fake engine: OpenAI-streaming mock with a fake /metrics exposition.
+
+The keystone test asset (pattern from the reference's perftest tier,
+SURVEY.md §4.2: a mock engine enables router/stats/routing/benchmark work
+with no hardware). Serves /v1/chat/completions + /v1/completions with
+configurable tokens/s and TTFT, /v1/models, /health, and /metrics with
+settable vllm: gauge values.
+"""
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+
+class FakeEngine:
+    def __init__(self, model: str = "fake-model", ttft_s: float = 0.0,
+                 tokens_per_s: float = 0.0, num_tokens: int = 8):
+        self.model = model
+        self.ttft_s = ttft_s
+        self.tokens_per_s = tokens_per_s
+        self.num_tokens = num_tokens
+        self.gauges = {
+            "vllm:num_requests_running": 0.0,
+            "vllm:num_requests_waiting": 0.0,
+            "vllm:gpu_cache_usage_perc": 0.0,
+            "tpu:hbm_kv_usage_perc": 0.0,
+            "vllm:gpu_prefix_cache_hit_rate": 0.0,
+        }
+        self.requests_seen = []          # (path, user header, model)
+        self._in_flight = 0
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self.chat)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_get("/v1/models", self.models)
+        app.router.add_get("/health", self.health)
+        app.router.add_get("/metrics", self.metrics)
+        return app
+
+    async def _tick(self):
+        if self.tokens_per_s > 0:
+            await asyncio.sleep(1.0 / self.tokens_per_s)
+
+    async def chat(self, request: web.Request) -> web.StreamResponse:
+        body = await request.json()
+        self.requests_seen.append(
+            ("/v1/chat/completions", request.headers.get("x-user-id"),
+             body.get("model")))
+        self._in_flight += 1
+        self.gauges["vllm:num_requests_running"] = float(self._in_flight)
+        try:
+            n = min(body.get("max_tokens") or self.num_tokens,
+                    self.num_tokens)
+            if self.ttft_s:
+                await asyncio.sleep(self.ttft_s)
+            rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            if body.get("stream"):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"})
+                await resp.prepare(request)
+                for i in range(n):
+                    await self._tick()
+                    chunk = {"id": rid, "object": "chat.completion.chunk",
+                             "model": self.model,
+                             "choices": [{"index": 0,
+                                          "delta": {"content": f"tok{i} "},
+                                          "finish_reason": None}]}
+                    await resp.write(f"data: {json.dumps(chunk)}\n\n"
+                                     .encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            text = " ".join(f"tok{i}" for i in range(n))
+            return web.json_response({
+                "id": rid, "object": "chat.completion", "model": self.model,
+                "choices": [{"index": 0,
+                             "message": {"role": "assistant",
+                                         "content": text},
+                             "finish_reason": "length"}],
+                "usage": {"prompt_tokens": 3, "completion_tokens": n,
+                          "total_tokens": 3 + n}})
+        finally:
+            self._in_flight -= 1
+            self.gauges["vllm:num_requests_running"] = float(self._in_flight)
+
+    async def completions(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.requests_seen.append(
+            ("/v1/completions", request.headers.get("x-user-id"),
+             body.get("model")))
+        n = min(body.get("max_tokens") or self.num_tokens, self.num_tokens)
+        return web.json_response({
+            "id": f"cmpl-{uuid.uuid4().hex[:12]}",
+            "object": "text_completion", "model": self.model,
+            "choices": [{"index": 0,
+                         "text": " ".join(f"tok{i}" for i in range(n)),
+                         "finish_reason": "length"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": n,
+                      "total_tokens": 3 + n}})
+
+    async def models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"object": "list", "data": [{"id": self.model,
+                                         "object": "model"}]})
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        lines = []
+        for name, value in self.gauges.items():
+            lines.append(f"# TYPE {name.replace(':', '_')} gauge")
+            lines.append(f'{name}{{model_name="{self.model}"}} {value}')
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")

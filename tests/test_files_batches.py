@@ -1,0 +1,134 @@
+"""Files + Batch API tests: upload -> batch -> routed execution -> output.
+
+The reference's batch processor is a non-functional placeholder
+(SURVEY.md §2.1); these tests prove ours executes real requests through
+the routing policy against (fake) engines.
+"""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app, parse_args
+from tests.fake_engine import FakeEngine
+
+
+def _args(backends, models, tmp_path):
+    return parse_args([
+        "--service-discovery", "static",
+        "--static-backends", ",".join(backends),
+        "--static-models", ",".join(models),
+        "--enable-files-api", "--enable-batch-api",
+        "--file-storage-path", str(tmp_path / "files"),
+        "--batch-db-path", str(tmp_path / "batches.db"),
+    ])
+
+
+def test_files_crud(tmp_path):
+    async def body():
+        fake = FakeEngine(model="m")
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        app = build_app(_args([f"http://127.0.0.1:{server.port}"], ["m"],
+                              tmp_path))
+        async with TestClient(TestServer(app)) as client:
+            import aiohttp
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", b"hello world", filename="test.jsonl")
+            r = await client.post("/v1/files", data=form)
+            assert r.status == 200
+            info = await r.json()
+            fid = info["id"]
+            assert info["bytes"] == 11
+
+            r = await client.get(f"/v1/files/{fid}")
+            assert (await r.json())["filename"] == "test.jsonl"
+
+            r = await client.get(f"/v1/files/{fid}/content")
+            assert await r.read() == b"hello world"
+
+            r = await client.get("/v1/files")
+            assert len((await r.json())["data"]) == 1
+
+            r = await client.delete(f"/v1/files/{fid}")
+            assert (await r.json())["deleted"] is True
+            r = await client.get(f"/v1/files/{fid}")
+            assert r.status == 404
+        await server.close()
+    asyncio.run(body())
+
+
+def test_batch_lifecycle_executes_requests(tmp_path):
+    async def body():
+        fake = FakeEngine(model="m")
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        app = build_app(_args([f"http://127.0.0.1:{server.port}"], ["m"],
+                              tmp_path))
+        async with TestClient(TestServer(app)) as client:
+            import aiohttp
+            lines = [json.dumps({
+                "custom_id": f"req-{i}",
+                "method": "POST",
+                "url": "/v1/chat/completions",
+                "body": {"model": "m", "max_tokens": 3,
+                         "messages": [{"role": "user",
+                                       "content": f"line {i}"}]},
+            }) for i in range(3)]
+            form = aiohttp.FormData()
+            form.add_field("purpose", "batch")
+            form.add_field("file", "\n".join(lines).encode(),
+                           filename="in.jsonl")
+            r = await client.post("/v1/files", data=form)
+            fid = (await r.json())["id"]
+
+            r = await client.post("/v1/batches", json={
+                "input_file_id": fid,
+                "endpoint": "/v1/chat/completions"})
+            assert r.status == 200
+            batch = await r.json()
+            bid = batch["id"]
+            assert batch["status"] == "validating"
+
+            for _ in range(50):
+                r = await client.get(f"/v1/batches/{bid}")
+                batch = await r.json()
+                if batch["status"] == "completed":
+                    break
+                await asyncio.sleep(0.2)
+            assert batch["status"] == "completed", batch
+            assert batch["request_counts"]["completed"] == 3
+            assert len(fake.requests_seen) == 3
+
+            r = await client.get(
+                f"/v1/files/{batch['output_file_id']}/content")
+            out_lines = (await r.read()).decode().strip().splitlines()
+            assert len(out_lines) == 3
+            first = json.loads(out_lines[0])
+            assert first["custom_id"] == "req-0"
+            assert first["response"]["status_code"] == 200
+
+            r = await client.get("/v1/batches")
+            assert len((await r.json())["data"]) == 1
+        await server.close()
+    asyncio.run(body())
+
+
+def test_batch_missing_input_file(tmp_path):
+    async def body():
+        fake = FakeEngine(model="m")
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        app = build_app(_args([f"http://127.0.0.1:{server.port}"], ["m"],
+                              tmp_path))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/batches", json={
+                "input_file_id": "file-nope",
+                "endpoint": "/v1/chat/completions"})
+            assert r.status == 404
+            r = await client.post("/v1/batches", json={})
+            assert r.status == 400
+        await server.close()
+    asyncio.run(body())

@@ -1,0 +1,198 @@
+"""Router end-to-end tests against fake engines (the multi-node story
+without a cluster — reference pattern, SURVEY.md §4.2)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app, parse_args
+from tests.fake_engine import FakeEngine
+
+
+def _router_args(backends, models, extra=None):
+    argv = ["--service-discovery", "static",
+            "--static-backends", ",".join(backends),
+            "--static-models", ",".join(models),
+            "--engine-stats-interval", "0.2"]
+    return parse_args(argv + (extra or []))
+
+
+async def _start_fakes(*fakes):
+    servers = []
+    for fake in fakes:
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        servers.append(server)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+def test_router_chat_roundrobin_and_models():
+    async def body():
+        f1, f2 = FakeEngine(model="m-a"), FakeEngine(model="m-a")
+        servers, urls = await _start_fakes(f1, f2)
+        app = build_app(_router_args(urls, ["m-a", "m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/v1/models")
+            assert [c["id"] for c in (await r.json())["data"]] == ["m-a"]
+
+            for _ in range(4):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m-a",
+                    "messages": [{"role": "user", "content": "hi"}]})
+                assert r.status == 200
+                data = await r.json()
+                assert data["choices"][0]["message"]["content"]
+            # round-robin spread: both fakes saw traffic
+            assert len(f1.requests_seen) == 2
+            assert len(f2.requests_seen) == 2
+
+            r = await client.get("/health")
+            health = await r.json()
+            assert health["status"] == "ok"
+            assert health["endpoints"] == 2
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_streaming_relay():
+    async def body():
+        fake = FakeEngine(model="m-s", num_tokens=5)
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m-s"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-s", "stream": True,
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            events = [ln for ln in raw.splitlines() if ln.startswith("data: ")]
+            assert events[-1] == "data: [DONE]"
+            assert len(events) == 6  # 5 chunks + DONE
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_session_affinity():
+    async def body():
+        f1, f2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(f1, f2)
+        app = build_app(_router_args(urls, ["m", "m"],
+                                     ["--routing-logic", "session"]))
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(6):
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={"model": "m",
+                          "messages": [{"role": "user", "content": "x"}]},
+                    headers={"x-user-id": "alice"})
+                assert r.status == 200
+            # all six requests landed on ONE fake
+            seen = (len(f1.requests_seen), len(f2.requests_seen))
+            assert sorted(seen) == [0, 6], seen
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_model_filtering_and_errors():
+    async def body():
+        f1, f2 = FakeEngine(model="m-a"), FakeEngine(model="m-b")
+        servers, urls = await _start_fakes(f1, f2)
+        app = build_app(_router_args(urls, ["m-a", "m-b"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-b",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 200
+            assert len(f2.requests_seen) == 1 and not f1.requests_seen
+
+            r = await client.post("/v1/chat/completions", json={
+                "model": "missing",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 400
+            assert "no backend serves" in (await r.json())["error"]["message"]
+
+            r = await client.post("/v1/chat/completions", data=b"garbage")
+            assert r.status == 400
+
+            r = await client.post("/v1/chat/completions", json={})
+            assert r.status == 400
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_engine_stats_scrape_and_metrics():
+    async def body():
+        fake = FakeEngine(model="m")
+        fake.gauges["vllm:num_requests_waiting"] = 7.0
+        fake.gauges["vllm:gpu_cache_usage_perc"] = 0.42
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            await asyncio.sleep(0.5)   # let the scraper tick
+            state = app["state"]
+            stats = state["scraper"].get()
+            assert stats[urls[0]].num_waiting == 7.0
+            assert abs(stats[urls[0]].kv_usage - 0.42) < 1e-9
+
+            await client.post("/v1/chat/completions", json={
+                "model": "m", "messages": [{"role": "user", "content": "x"}]})
+            r = await client.get("/metrics")
+            text = (await r.read()).decode()
+            assert "vllm:current_qps" in text
+            assert "vllm:healthy_pods_total 1.0" in text
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_backend_down_returns_502():
+    async def body():
+        app = build_app(_router_args(["http://127.0.0.1:1"], ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m", "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 502
+    asyncio.run(body())
+
+
+def test_dynamic_config_hot_reload(tmp_path):
+    async def body():
+        f1, f2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(f1, f2)
+        cfg_path = tmp_path / "dyn.json"
+        cfg_path.write_text(json.dumps({
+            "service_discovery": "static",
+            "routing_logic": "roundrobin",
+            "static_backends": urls[:1],
+            "static_models": ["m"],
+        }))
+        app = build_app(_router_args(
+            urls[:1], ["m"],
+            ["--dynamic-config-json", str(cfg_path),
+             "--dynamic-config-interval", "0.2"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/health")
+            assert (await r.json())["endpoints"] == 1
+
+            # hot-swap to both backends + session routing
+            cfg_path.write_text(json.dumps({
+                "service_discovery": "static",
+                "routing_logic": "session",
+                "static_backends": urls,
+                "static_models": ["m", "m"],
+            }))
+            await asyncio.sleep(0.6)
+            r = await client.get("/health")
+            health = await r.json()
+            assert health["endpoints"] == 2
+            assert health["dynamic_config"]["routing_logic"] == "session"
+            assert type(app["state"]["router"]).__name__ == "SessionRouter"
+        for s in servers:
+            await s.close()
+    asyncio.run(body())

@@ -1,0 +1,106 @@
+"""Routing-policy unit tests (consistent hashing invariants, fallbacks).
+
+Mirrors the reference's test_session_router.py coverage (SURVEY.md §4.1):
+same session -> same endpoint; fallback without session header; minimal
+remapping on node join/leave.
+"""
+
+import collections
+
+from production_stack_tpu.router.routing import (HashRing,
+                                                 LeastLoadedRouter,
+                                                 PrefixAwareRouter,
+                                                 RoundRobinRouter,
+                                                 SessionRouter, make_router)
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.router.stats import RequestStats
+
+
+def _eps(n):
+    return [EndpointInfo(url=f"http://e{i}:8100", model="m") for i in
+            range(n)]
+
+
+def test_round_robin_uniform():
+    router = RoundRobinRouter()
+    eps = _eps(3)
+    counts = collections.Counter(
+        router.route(eps, {}, {}, {}) for _ in range(30))
+    assert all(c == 10 for c in counts.values())
+
+
+def test_session_stickiness():
+    router = SessionRouter()
+    eps = _eps(4)
+    for user in ("alice", "bob", "carol"):
+        urls = {router.route(eps, {}, {"x-user-id": user}, {})
+                for _ in range(10)}
+        assert len(urls) == 1, f"session {user} bounced between {urls}"
+
+
+def test_session_fallback_to_least_loaded():
+    router = SessionRouter()
+    eps = _eps(3)
+    stats = {
+        "http://e0:8100": RequestStats(qps=5.0, in_flight=7),
+        "http://e1:8100": RequestStats(qps=0.1, in_flight=0),
+        "http://e2:8100": RequestStats(qps=3.0, in_flight=2),
+    }
+    assert router.route(eps, stats, {}, {}) == "http://e1:8100"
+
+
+def test_minimal_remapping_on_leave():
+    """Removing one of 8 nodes remaps only that node's sessions."""
+    ring = HashRing()
+    nodes = [f"http://e{i}" for i in range(8)]
+    ring.rebuild(nodes)
+    before = {f"user{i}": ring.lookup(f"user{i}") for i in range(2000)}
+
+    survivors = nodes[:-1]
+    ring2 = HashRing()
+    ring2.rebuild(survivors)
+    moved = sum(
+        1 for u, owner in before.items()
+        if owner in survivors and ring2.lookup(u) != owner)
+    assert moved == 0, f"{moved} sessions on surviving nodes were remapped"
+    orphans = sum(1 for owner in before.values() if owner == nodes[-1])
+    assert 2000 / 8 * 0.5 < orphans < 2000 / 8 * 2.0
+
+
+def test_minimal_remapping_on_join():
+    ring = HashRing()
+    nodes = [f"http://e{i}" for i in range(4)]
+    ring.rebuild(nodes)
+    before = {f"user{i}": ring.lookup(f"user{i}") for i in range(2000)}
+    ring.rebuild(nodes + ["http://e4"])
+    moved = sum(1 for u, owner in before.items()
+                if ring.lookup(u) not in (owner, "http://e4"))
+    assert moved == 0
+
+
+def test_prefix_router_affinity():
+    router = PrefixAwareRouter()
+    eps = _eps(4)
+    # shared system prompt longer than the router's 1024-char hash window
+    body1 = {"messages": [{"role": "system", "content": "long shared " * 200},
+                          {"role": "user", "content": "round 1"}]}
+    body2 = {"messages": [{"role": "system", "content": "long shared " * 200},
+                          {"role": "user", "content": "round 1"},
+                          {"role": "assistant", "content": "reply"},
+                          {"role": "user", "content": "round 2"}]}
+    assert router.route(eps, {}, {}, body1) == router.route(eps, {}, {},
+                                                            body2)
+
+
+def test_least_loaded_prefers_idle():
+    router = LeastLoadedRouter()
+    eps = _eps(2)
+    stats = {"http://e0:8100": RequestStats(in_flight=3),
+             "http://e1:8100": RequestStats(in_flight=1)}
+    assert router.route(eps, stats, {}, {}) == "http://e1:8100"
+
+
+def test_make_router_unknown():
+    import pytest
+    with pytest.raises(ValueError, match="unknown routing"):
+        make_router("nope")
