@@ -1,0 +1,352 @@
+// libpskv: native KV-chunk store + cache-server transport for the TPU stack.
+//
+// This is the stack's equivalent of the reference's LMCache remote cache
+// server (reference: helm/templates/deployment-cache-server.yaml:20-24 runs
+// `lmcache_experimental_server`; the `lm://host:port` URL is formatted by
+// helm/templates/_helpers.tpl:166-168). Here the store and wire transport
+// are native C++ behind a C ABI consumed from Python via ctypes
+// (production_stack_tpu/kvcache/_native.py). Rationale: KV chunks are
+// megabytes of bfloat16 per chunk; eviction bookkeeping and socket relay
+// should not pay Python object overhead.
+//
+// Components:
+//   * byte-bounded LRU store (pskv_store_*): unordered_map + intrusive LRU
+//     list under one mutex; values are opaque byte blobs.
+//   - blocking TCP server (pskv_server_run): thread-per-connection relay of
+//     the TPKV binary protocol (see production_stack_tpu/kvcache/protocol.py
+//     for the canonical frame layout shared with the Python client).
+//
+// Thread-safety: every exported call is safe from any thread.
+
+#include <arpa/inet.h>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    std::string key;
+    std::string val;
+};
+
+class LruStore {
+  public:
+    explicit LruStore(uint64_t capacity) : capacity_(capacity) {}
+
+    int put(const std::string &key, const char *val, uint64_t vlen) {
+        std::lock_guard<std::mutex> g(mu_);
+        if (vlen > capacity_) return -1;  // can never fit
+        auto it = map_.find(key);
+        if (it != map_.end()) {
+            bytes_ -= it->second->val.size();
+            it->second->val.assign(val, vlen);
+            bytes_ += vlen;
+            lru_.splice(lru_.begin(), lru_, it->second);
+        } else {
+            lru_.push_front(Entry{key, std::string(val, vlen)});
+            map_[key] = lru_.begin();
+            bytes_ += vlen;
+        }
+        evict_locked();
+        return 0;
+    }
+
+    // Copies the value into buf (caller-sized). Returns the value length,
+    // -1 if missing, or -2 if buf is too small (buflen < value length —
+    // caller re-queries size and retries).
+    int64_t get(const std::string &key, char *buf, uint64_t buflen,
+                bool touch) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = map_.find(key);
+        if (it == map_.end()) { misses_++; return -1; }
+        const std::string &v = it->second->val;
+        if (buf == nullptr) return (int64_t)v.size();  // size query
+        if (v.size() > buflen) return -2;
+        memcpy(buf, v.data(), v.size());
+        if (touch) lru_.splice(lru_.begin(), lru_, it->second);
+        hits_++;
+        return (int64_t)v.size();
+    }
+
+    int exists(const std::string &key) {
+        std::lock_guard<std::mutex> g(mu_);
+        return map_.count(key) ? 1 : 0;
+    }
+
+    int del(const std::string &key) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = map_.find(key);
+        if (it == map_.end()) return 0;
+        bytes_ -= it->second->val.size();
+        lru_.erase(it->second);
+        map_.erase(it);
+        return 1;
+    }
+
+    void clear() {
+        std::lock_guard<std::mutex> g(mu_);
+        lru_.clear();
+        map_.clear();
+        bytes_ = 0;
+    }
+
+    uint64_t bytes() { std::lock_guard<std::mutex> g(mu_); return bytes_; }
+    uint64_t count() { std::lock_guard<std::mutex> g(mu_); return map_.size(); }
+    uint64_t hits() { std::lock_guard<std::mutex> g(mu_); return hits_; }
+    uint64_t misses() { std::lock_guard<std::mutex> g(mu_); return misses_; }
+    uint64_t evictions() {
+        std::lock_guard<std::mutex> g(mu_);
+        return evictions_;
+    }
+
+  private:
+    void evict_locked() {
+        while (bytes_ > capacity_ && !lru_.empty()) {
+            Entry &e = lru_.back();
+            bytes_ -= e.val.size();
+            map_.erase(e.key);
+            lru_.pop_back();
+            evictions_++;
+        }
+    }
+
+    std::mutex mu_;
+    uint64_t capacity_;
+    uint64_t bytes_ = 0;
+    uint64_t hits_ = 0, misses_ = 0, evictions_ = 0;
+    std::list<Entry> lru_;  // front = most recent
+    std::unordered_map<std::string, std::list<Entry>::iterator> map_;
+};
+
+// ---------------------------------------------------------------------------
+// TPKV wire protocol (must match production_stack_tpu/kvcache/protocol.py)
+//
+// request:  u32 magic 'TPKV' | u8 op | u16 key_len | u64 val_len
+//           | key bytes | val bytes          (all integers big-endian)
+// response: u8 status (0 ok, 1 missing, 2 error) | u64 val_len | val bytes
+// ops: 1 PUT, 2 GET, 3 EXISTS, 4 DEL, 5 STATS, 6 PING
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kMagic = 0x54504B56;  // "TPKV"
+constexpr uint64_t kMaxVal = 1ull << 32; // 4 GiB frame cap
+
+bool read_all(int fd, char *buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+        ssize_t r = recv(fd, buf + off, n - off, 0);
+        if (r <= 0) return false;
+        off += (size_t)r;
+    }
+    return true;
+}
+
+bool write_all(int fd, const char *buf, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+        ssize_t r = send(fd, buf + off, n - off, MSG_NOSIGNAL);
+        if (r <= 0) return false;
+        off += (size_t)r;
+    }
+    return true;
+}
+
+uint16_t load_u16(const char *p) {
+    uint16_t v; memcpy(&v, p, 2); return ntohs(v);
+}
+uint32_t load_u32(const char *p) {
+    uint32_t v; memcpy(&v, p, 4); return ntohl(v);
+}
+uint64_t load_u64(const char *p) {
+    uint32_t hi = load_u32(p), lo = load_u32(p + 4);
+    return ((uint64_t)hi << 32) | lo;
+}
+void store_u64(char *p, uint64_t v) {
+    uint32_t hi = htonl((uint32_t)(v >> 32)), lo = htonl((uint32_t)v);
+    memcpy(p, &hi, 4); memcpy(p + 4, &lo, 4);
+}
+
+bool send_response(int fd, uint8_t status, const char *val, uint64_t vlen) {
+    char hdr[9];
+    hdr[0] = (char)status;
+    store_u64(hdr + 1, vlen);
+    if (!write_all(fd, hdr, 9)) return false;
+    if (vlen && !write_all(fd, val, vlen)) return false;
+    return true;
+}
+
+void serve_connection(LruStore *store, std::atomic<int> *active, int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::vector<char> val;
+    for (;;) {
+        char hdr[15];
+        if (!read_all(fd, hdr, 15)) break;
+        if (load_u32(hdr) != kMagic) break;
+        uint8_t op = (uint8_t)hdr[4];
+        uint16_t klen = load_u16(hdr + 5);
+        uint64_t vlen = load_u64(hdr + 7);
+        if (vlen > kMaxVal) break;
+        std::string key(klen, '\0');
+        if (klen && !read_all(fd, &key[0], klen)) break;
+        val.resize(vlen);
+        if (vlen && !read_all(fd, val.data(), vlen)) break;
+
+        bool ok = true;
+        switch (op) {
+        case 1:  // PUT
+            store->put(key, val.data(), vlen);
+            ok = send_response(fd, 0, nullptr, 0);
+            break;
+        case 2: {  // GET
+            int64_t n = store->get(key, nullptr, 0, false);
+            if (n < 0) { ok = send_response(fd, 1, nullptr, 0); break; }
+            std::vector<char> out((size_t)n);
+            n = store->get(key, out.data(), out.size(), true);
+            if (n < 0)
+                ok = send_response(fd, 1, nullptr, 0);
+            else
+                ok = send_response(fd, 0, out.data(), (uint64_t)n);
+            break;
+        }
+        case 3:  // EXISTS
+            ok = send_response(fd, store->exists(key) ? 0 : 1, nullptr, 0);
+            break;
+        case 4:  // DEL
+            store->del(key);
+            ok = send_response(fd, 0, nullptr, 0);
+            break;
+        case 5: {  // STATS (JSON)
+            char js[256];
+            int n = snprintf(js, sizeof(js),
+                             "{\"bytes\": %llu, \"count\": %llu, "
+                             "\"hits\": %llu, \"misses\": %llu, "
+                             "\"evictions\": %llu}",
+                             (unsigned long long)store->bytes(),
+                             (unsigned long long)store->count(),
+                             (unsigned long long)store->hits(),
+                             (unsigned long long)store->misses(),
+                             (unsigned long long)store->evictions());
+            ok = send_response(fd, 0, js, (uint64_t)n);
+            break;
+        }
+        case 6:  // PING
+            ok = send_response(fd, 0, "pong", 4);
+            break;
+        default:
+            ok = send_response(fd, 2, nullptr, 0);
+        }
+        if (!ok) break;
+    }
+    close(fd);
+    active->fetch_sub(1);
+}
+
+}  // namespace
+
+extern "C" {
+
+void *pskv_store_new(uint64_t capacity_bytes) {
+    return new LruStore(capacity_bytes);
+}
+
+void pskv_store_free(void *s) { delete (LruStore *)s; }
+
+int pskv_store_put(void *s, const char *key, uint32_t klen, const char *val,
+                   uint64_t vlen) {
+    return ((LruStore *)s)->put(std::string(key, klen), val, vlen);
+}
+
+int64_t pskv_store_get_size(void *s, const char *key, uint32_t klen) {
+    return ((LruStore *)s)->get(std::string(key, klen), nullptr, 0, false);
+}
+
+int64_t pskv_store_get(void *s, const char *key, uint32_t klen, char *buf,
+                       uint64_t buflen) {
+    return ((LruStore *)s)->get(std::string(key, klen), buf, buflen, true);
+}
+
+int pskv_store_exists(void *s, const char *key, uint32_t klen) {
+    return ((LruStore *)s)->exists(std::string(key, klen));
+}
+
+int pskv_store_del(void *s, const char *key, uint32_t klen) {
+    return ((LruStore *)s)->del(std::string(key, klen));
+}
+
+void pskv_store_clear(void *s) { ((LruStore *)s)->clear(); }
+
+uint64_t pskv_store_bytes(void *s) { return ((LruStore *)s)->bytes(); }
+uint64_t pskv_store_count(void *s) { return ((LruStore *)s)->count(); }
+uint64_t pskv_store_hits(void *s) { return ((LruStore *)s)->hits(); }
+uint64_t pskv_store_misses(void *s) { return ((LruStore *)s)->misses(); }
+uint64_t pskv_store_evictions(void *s) {
+    return ((LruStore *)s)->evictions();
+}
+
+// Blocking TCP server on `host:port` (host NULL/empty = all interfaces,
+// port 0 = ephemeral). Writes the bound port to *bound_port, then accepts
+// until *stop_flag becomes nonzero (checked each 200 ms accept timeout).
+// Connection threads are detached (a long-lived server must not accumulate
+// unjoined threads); shutdown waits up to 5 s for in-flight connections so
+// the store outlives them. Returns 0 on clean shutdown, -errno on failure.
+int pskv_server_run_on(void *s, const char *host, uint16_t port,
+                       volatile int *stop_flag, int *bound_port) {
+    LruStore *store = (LruStore *)s;
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd < 0) return -errno;
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    if (host && host[0] &&
+        inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        close(lfd);
+        return -EINVAL;
+    }
+    addr.sin_port = htons(port);
+    if (bind(lfd, (sockaddr *)&addr, sizeof(addr)) < 0 ||
+        listen(lfd, 128) < 0) {
+        int e = errno; close(lfd); return -e;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(lfd, (sockaddr *)&addr, &alen);
+    if (bound_port) *bound_port = ntohs(addr.sin_port);
+
+    timeval tv{0, 200000};
+    setsockopt(lfd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    std::atomic<int> active{0};
+    while (!(stop_flag && *stop_flag)) {
+        int cfd = accept(lfd, nullptr, nullptr);
+        if (cfd < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+                continue;
+            break;
+        }
+        active.fetch_add(1);
+        std::thread(serve_connection, store, &active, cfd).detach();
+    }
+    close(lfd);
+    for (int i = 0; i < 500 && active.load() > 0; i++)
+        usleep(10000);
+    return 0;
+}
+
+int pskv_server_run(void *s, uint16_t port, volatile int *stop_flag,
+                    int *bound_port) {
+    return pskv_server_run_on(s, nullptr, port, stop_flag, bound_port);
+}
+
+}  // extern "C"

@@ -1,0 +1,49 @@
+// Standalone TPKV cache server — the deployable equivalent of the
+// reference's `lmcache_experimental_server` pod command (reference:
+// helm/templates/deployment-cache-server.yaml:20-24). Runs the native LRU
+// store behind the TPKV TCP protocol.
+//
+// Usage: pskv-server [--host H] [--port N] [--capacity-gb G]
+
+#include <csignal>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+void *pskv_store_new(uint64_t capacity_bytes);
+void pskv_store_free(void *);
+int pskv_server_run_on(void *, const char *host, uint16_t port,
+                       volatile int *stop_flag, int *bound_port);
+}
+
+static volatile int g_stop = 0;
+static void on_signal(int) { g_stop = 1; }
+
+int main(int argc, char **argv) {
+    int port = 8100;
+    double capacity_gb = 4.0;
+    const char *host = nullptr;  // all interfaces
+    for (int i = 1; i < argc - 1; i++) {
+        if (!strcmp(argv[i], "--port")) port = atoi(argv[++i]);
+        else if (!strcmp(argv[i], "--host")) host = argv[++i];
+        else if (!strcmp(argv[i], "--capacity-gb"))
+            capacity_gb = atof(argv[++i]);
+    }
+    signal(SIGINT, on_signal);
+    signal(SIGTERM, on_signal);
+    void *store = pskv_store_new((uint64_t)(capacity_gb * (1 << 30)));
+    int bound = 0;
+    fprintf(stderr, "pskv-server: listening on %s:%d (capacity %.1f GiB)\n",
+            host ? host : "0.0.0.0", port, capacity_gb);
+    int rc = pskv_server_run_on(store, host, (uint16_t)port, &g_stop,
+                                &bound);
+    pskv_store_free(store);
+    if (rc < 0) {
+        fprintf(stderr, "pskv-server: failed to bind :%d (%s)\n", port,
+                strerror(-rc));
+        return 1;
+    }
+    return 0;
+}

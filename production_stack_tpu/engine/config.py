@@ -7,7 +7,7 @@ Helm (reference: helm/templates/deployment-vllm-multi.yaml:68-93 —
 """
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 
 @dataclasses.dataclass
@@ -26,6 +26,10 @@ class EngineConfig:
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     enable_prefix_caching: bool = False
     max_top_k: int = 64                      # static top-k bound for sampler
+    # KV tiering (the reference's --kv-transfer-config JSON; see
+    # kvcache/connector.py). Keys: kv_role, chunk_size, local_cpu_gb,
+    # local_disk_path, local_disk_gb, remote_url.
+    kv_transfer_config: Optional[Dict[str, Any]] = None
 
     def __post_init__(self):
         # chunks never exceed prefill_chunk (or the cache), so larger

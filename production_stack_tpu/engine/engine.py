@@ -65,6 +65,18 @@ class LLMEngine:
                                    engine_cfg.max_model_len,
                                    engine_cfg.prefill_chunk)
         self.metrics = EngineMetrics(self.model_cfg.name)
+        # KV tiering (HBM→host→disk→remote; kvcache/): the reference wires
+        # the same capability through LMCache env + --kv-transfer-config
+        # (reference: helm/templates/deployment-vllm-multi.yaml:94-99,154-178)
+        self.connector = None
+        if engine_cfg.kv_transfer_config:
+            from production_stack_tpu.kvcache.connector import (
+                KVConnector, KVTransferConfig)
+            tcfg = KVTransferConfig.from_dict(engine_cfg.kv_transfer_config)
+            if tcfg.enabled:
+                self.connector = KVConnector(self.runner, self.model_cfg,
+                                             engine_cfg, tcfg)
+                self.scheduler.on_admit = self._on_admit
         self.seqs: Dict[str, Sequence] = {}
         self._finished_order: List[str] = []
         self._id_counter = itertools.count()
@@ -87,6 +99,10 @@ class LLMEngine:
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
                        options=options or SamplingOptions(),
                        detok=DetokenizeStream(self.tokenizer))
+        if self.connector is not None:
+            # tier lookup + D2H-side fetch runs here, on the caller's
+            # thread — never on the engine loop
+            seq.kv_prefetch = self.connector.prefetch(seq.prompt_tokens)
         with self._lock:
             self.scheduler.add(seq)
             self.seqs[seq_id] = seq
@@ -157,6 +173,10 @@ class LLMEngine:
         text_delta = seq.output_text[seq.chars_emitted:]
         seq.chars_emitted = len(seq.output_text)
         if reason is not None:
+            if self.connector is not None:
+                # extract while the slot still holds this sequence's KV —
+                # dispatched before scheduler.finish can recycle the slot
+                self.connector.on_finish(seq)
             self.scheduler.finish(seq, reason)
             self._remember(seq)
             self.metrics.e2e_latency.observe(
@@ -210,12 +230,28 @@ class LLMEngine:
             self._refresh_gauges()
         return self.metrics.render()
 
+    def _on_admit(self, seq: Sequence) -> None:
+        """Scheduler hook: inject a prefetched KV prefix into the slot."""
+        pf = seq.kv_prefetch
+        if pf is None:
+            return
+        seq.kv_prefetch = None   # release host buffers after injection
+        self.connector.inject(pf, seq.slot)
+        seq.num_prefilled = pf.cached_tokens
+
     def _refresh_gauges(self) -> None:
         self.metrics.num_running.set(self.scheduler.num_running)
         self.metrics.num_waiting.set(self.scheduler.num_waiting)
         usage = self.scheduler.kv_usage
         self.metrics.kv_usage.set(usage)
         self.metrics.hbm_kv_usage.set(usage)
+        if self.connector is not None:
+            self.metrics.prefix_hit_rate.set(self.connector.hit_rate)
+
+    def close(self) -> None:
+        """Flush the KV writer and release tier connections."""
+        if self.connector is not None:
+            self.connector.close()
 
     # ------------------------------------------------------------------
 

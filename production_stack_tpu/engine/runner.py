@@ -76,6 +76,9 @@ class ModelRunner:
 
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
         self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        # KV-tiering primitives (kvcache/connector.py), cached per chunk size
+        self._extract_fns = {}
+        self._inject_fns = {}
 
     # ------------------------------------------------------------------
     # jitted impls (pure)
@@ -147,6 +150,45 @@ class ModelRunner:
             jnp.int32(start), jnp.int32(length), jnp.int32(slot),
             sampling_row, self._next_key())
         return token_id
+
+    def extract_chunk(self, slot: int, start: int, size: int):
+        """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the
+        result is an independent buffer, safe to D2H after later steps
+        donate the cache). Dispatch is async — np.asarray() later blocks."""
+        fn = self._extract_fns.get(size)
+        if fn is None:
+            L = self.model_cfg.num_layers
+            Hkv, D = self.model_cfg.num_kv_heads, self.model_cfg.head_dim_
+
+            def _impl(cache: KVCache, slot, start):
+                k = jax.lax.dynamic_slice(cache.k, (0, slot, start, 0, 0),
+                                          (L, 1, size, Hkv, D))[:, 0]
+                v = jax.lax.dynamic_slice(cache.v, (0, slot, start, 0, 0),
+                                          (L, 1, size, Hkv, D))[:, 0]
+                return k, v
+
+            fn = self._extract_fns[size] = jax.jit(_impl)
+        return fn(self.cache, jnp.int32(slot), jnp.int32(start))
+
+    def inject_chunk(self, slot: int, start: int, k_chunk, v_chunk) -> None:
+        """Write host [L, size, Hkv, D] k/v into a slot (donates cache —
+        in-place HBM update)."""
+        size = k_chunk.shape[1]
+        fn = self._inject_fns.get(size)
+        if fn is None:
+            def _impl(cache: KVCache, k_chunk, v_chunk, slot, start):
+                idx = (0, slot, start, 0, 0)
+                new_k = jax.lax.dynamic_update_slice(
+                    cache.k, k_chunk[:, None], idx)
+                new_v = jax.lax.dynamic_update_slice(
+                    cache.v, v_chunk[:, None], idx)
+                return KVCache(new_k, new_v)
+
+            fn = self._inject_fns[size] = jax.jit(_impl,
+                                                  donate_argnums=(0,))
+        self.cache = fn(self.cache, jnp.asarray(k_chunk),
+                        jnp.asarray(v_chunk), jnp.int32(slot),
+                        jnp.int32(start))
 
     def warmup(self) -> float:
         """Compile decode + all prefill buckets. Returns seconds spent."""

@@ -53,6 +53,9 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_reason: Optional[str] = None
+    # host-side KV for a cached prompt prefix, fetched off the engine loop
+    # at add time (kvcache/connector.py Prefetch); injected at admission
+    kv_prefetch: object = None
     # incremental detokenization state (owned by LLMEngine)
     output_text: str = ""       # stable decoded text, stop-truncated
     chars_emitted: int = 0      # prefix of output_text already delivered
@@ -85,6 +88,9 @@ class Scheduler:
         self.running: Dict[int, Sequence] = {}        # slot -> seq
         self.free_slots: List[int] = list(range(max_num_seqs - 1, -1, -1))
         self._prefilling: Optional[Sequence] = None
+        # invoked right after a slot is assigned, before the first prefill
+        # chunk is cut — may rewind seq.num_prefilled past a cached prefix
+        self.on_admit: Optional[object] = None
 
     # ------------------------------------------------------------------
 
@@ -101,6 +107,7 @@ class Scheduler:
                 self.waiting.remove(seq)
                 seq.status = SeqStatus.FINISHED
                 seq.finish_reason = "abort"
+                seq.kv_prefetch = None   # release host KV buffers
                 return True
         for slot, seq in list(self.running.items()):
             if seq.seq_id == seq_id:
@@ -136,6 +143,8 @@ class Scheduler:
             seq.slot = self.free_slots.pop()
             seq.status = SeqStatus.PREFILLING
             self._prefilling = seq
+            if self.on_admit is not None:
+                self.on_admit(seq)
         start = seq.num_prefilled
         end = min(start + self.prefill_chunk, len(seq.prompt_tokens))
         return PrefillWork(seq=seq, chunk=seq.prompt_tokens[start:end],
@@ -155,6 +164,7 @@ class Scheduler:
     def _release(self, slot: int, seq: Sequence, reason: str) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
+        seq.kv_prefetch = None   # finished seqs are retained; drop host KV
         if slot >= 0:
             self.running.pop(slot, None)
             self.free_slots.append(slot)

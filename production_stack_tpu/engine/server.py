@@ -253,6 +253,9 @@ def build_app(engine: AsyncLLMEngine) -> web.Application:
 
     async def on_cleanup(app):
         engine.stop()
+        # flush queued KV-tier saves + close tier sockets (pod rotation
+        # must not drop the write-behind queue)
+        engine.engine.close()
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
@@ -275,17 +278,26 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--kv-transfer-config", default=None,
+                   help="JSON dict enabling KV tiering, e.g. "
+                        '\'{"kv_role": "kv_both", "local_cpu_gb": 4, '
+                        '"remote_url": "tpukv://cache:8100"}\' '
+                        "(the reference engine's --kv-transfer-config "
+                        "equivalent; see kvcache/connector.py)")
     return p.parse_args(argv)
 
 
 def main(argv=None) -> None:
     args = parse_args(argv)
     set_ulimit()
+    kv_transfer = json.loads(args.kv_transfer_config) \
+        if args.kv_transfer_config else None
     cfg = EngineConfig(
         model=args.model, tokenizer=args.tokenizer,
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
-        tensor_parallel_size=args.tensor_parallel_size, seed=args.seed)
+        tensor_parallel_size=args.tensor_parallel_size, seed=args.seed,
+        kv_transfer_config=kv_transfer)
     engine = AsyncLLMEngine(cfg)
     if not args.no_warmup:
         engine.engine.runner.warmup()

@@ -1,0 +1,27 @@
+"""KV tiering: HBM -> host DRAM -> local disk -> remote shared store.
+
+The reference stack gets this capability from LMCache, wired purely through
+engine env vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178
+sets LMCACHE_LOCAL_CPU / LMCACHE_MAX_LOCAL_CPU_SIZE / LMCACHE_LOCAL_DISK /
+LMCACHE_REMOTE_URL) plus a standalone `lmcache_experimental_server` pod
+(deployment-cache-server.yaml:20-24). Here the whole subsystem is
+first-class: token-chunk hashing (chunks.py), tiered byte stores backed by a
+native C++ LRU (store.py, native/pskv.cpp), a TPKV TCP wire protocol +
+standalone cache server (protocol.py, server.py), and the engine-side
+connector that moves KV between TPU HBM and the tiers without entering the
+jit path (connector.py).
+"""
+
+from production_stack_tpu.kvcache.chunks import (ChunkHasher,
+                                                 model_fingerprint)
+from production_stack_tpu.kvcache.connector import (KVConnector,
+                                                    KVTransferConfig)
+from production_stack_tpu.kvcache.store import (DiskStore, HostMemoryStore,
+                                                KVStore, RemoteStore,
+                                                TieredStore, make_store)
+
+__all__ = [
+    "ChunkHasher", "model_fingerprint", "KVConnector", "KVTransferConfig",
+    "KVStore", "HostMemoryStore", "DiskStore", "RemoteStore", "TieredStore",
+    "make_store",
+]

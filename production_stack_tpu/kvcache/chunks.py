@@ -1,0 +1,56 @@
+"""Token-chunk prefix hashing for KV reuse.
+
+KV for a token depends on the whole prefix before it, so chunk keys are a
+hash *chain*: chunk i's key digests chunk i's tokens together with chunk
+i-1's key. Two prompts sharing a prefix produce identical keys exactly up to
+their longest common chunk-aligned prefix — lookup walks the chain until the
+first miss. Only full chunks are stored (a partial tail is recomputed),
+mirroring chunk-granular KV stores like the reference's LMCache tier
+(reference: deployment-vllm-multi.yaml:154-178 sets LMCACHE_CHUNK_SIZE).
+
+Keys must be identical across processes/replicas (router affinity sends
+same-session requests to the same replica, but the remote tier is shared by
+all replicas) — so hashing is hashlib.blake2b over a canonical little-endian
+int32 packing, never Python's salted hash().
+"""
+
+import hashlib
+import struct
+from typing import List, Sequence
+
+from production_stack_tpu.models.config import ModelConfig
+
+DEFAULT_CHUNK_SIZE = 256
+
+
+def model_fingerprint(cfg: ModelConfig, kv_dtype: str = "bfloat16") -> str:
+    """Cache-key namespace: everything the KV layout/values depend on."""
+    raw = (f"{cfg.name}|L{cfg.num_layers}|H{cfg.num_kv_heads}"
+           f"|D{cfg.head_dim_}|rope{cfg.rope_theta}|{kv_dtype}")
+    return hashlib.blake2b(raw.encode(), digest_size=8).hexdigest()
+
+
+class ChunkHasher:
+    def __init__(self, chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 namespace: str = ""):
+        if chunk_size <= 0:
+            raise ValueError("chunk_size must be positive")
+        self.chunk_size = chunk_size
+        self.namespace = namespace
+
+    def num_full_chunks(self, num_tokens: int) -> int:
+        return num_tokens // self.chunk_size
+
+    def chunk_keys(self, tokens: Sequence[int]) -> List[bytes]:
+        """Keys for every *full* chunk of `tokens`, in order."""
+        keys: List[bytes] = []
+        prev = self.namespace.encode()
+        for i in range(self.num_full_chunks(len(tokens))):
+            chunk = tokens[i * self.chunk_size:(i + 1) * self.chunk_size]
+            h = hashlib.blake2b(digest_size=16)
+            h.update(prev)
+            h.update(struct.pack(f"<{len(chunk)}i", *chunk))
+            digest = h.digest()
+            keys.append(self.namespace.encode() + b":" + digest.hex().encode())
+            prev = digest
+        return keys

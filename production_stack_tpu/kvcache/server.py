@@ -1,0 +1,137 @@
+"""Standalone TPKV cache server (`python -m production_stack_tpu.kvcache.server`).
+
+The deployable shared-KV pod — the reference runs
+`lmcache_experimental_server <host> <port>` for the same role (reference:
+helm/templates/deployment-cache-server.yaml:20-24). Two interchangeable
+implementations serve the identical wire protocol:
+
+  * native: the C++ `pskv-server` binary (default when built) — store and
+    transport never touch Python.
+  * asyncio: pure-Python front-end over HostMemoryStore, for environments
+    without the toolchain (``--backend python``).
+"""
+
+import argparse
+import asyncio
+import os
+import signal
+from typing import Optional
+
+from production_stack_tpu.kvcache import protocol
+from production_stack_tpu.kvcache._native import server_binary
+from production_stack_tpu.kvcache.store import HostMemoryStore
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class CacheServer:
+    """Asyncio TPKV server over a HostMemoryStore."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8100,
+                 capacity_bytes: int = 4 << 30):
+        self.host, self.port = host, port
+        self.store = HostMemoryStore(capacity_bytes)
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        logger.info("TPKV cache server on %s:%d (backend=%s)", self.host,
+                    self.port, self.store.backend)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        await self.start()
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                try:
+                    hdr = await reader.readexactly(protocol.REQ_HDR_SIZE)
+                except asyncio.IncompleteReadError:
+                    break
+                op, klen, vlen = protocol.decode_request_header(hdr)
+                key = await reader.readexactly(klen) if klen else b""
+                val = await reader.readexactly(vlen) if vlen else b""
+                writer.write(self._dispatch(op, key, val))
+                await writer.drain()
+        except (ValueError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    def _dispatch(self, op: int, key: bytes, val: bytes) -> bytes:
+        enc, P = protocol.encode_response, protocol
+        if op == P.OP_PUT:
+            return enc(P.STATUS_OK if self.store.put(key, val)
+                       else P.STATUS_ERROR)
+        if op == P.OP_GET:
+            data = self.store.get(key)
+            return enc(P.STATUS_MISSING) if data is None \
+                else enc(P.STATUS_OK, data)
+        if op == P.OP_EXISTS:
+            return enc(P.STATUS_OK if self.store.exists(key)
+                       else P.STATUS_MISSING)
+        if op == P.OP_DEL:
+            self.store.delete(key)
+            return enc(P.STATUS_OK)
+        if op == P.OP_STATS:
+            import json
+            return enc(P.STATUS_OK,
+                       json.dumps(self.store.stats()).encode())
+        if op == P.OP_PING:
+            return enc(P.STATUS_OK, b"pong")
+        return enc(P.STATUS_ERROR)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="TPKV shared cache server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8100)
+    parser.add_argument("--capacity-gb", type=float, default=4.0)
+    parser.add_argument("--backend", choices=["auto", "native", "python"],
+                        default="auto",
+                        help="native = exec the C++ pskv-server binary")
+    args = parser.parse_args(argv)
+
+    if args.backend in ("auto", "native"):
+        binary = server_binary()
+        if binary is not None:
+            os.execv(binary, [binary, "--host", args.host,
+                              "--port", str(args.port),
+                              "--capacity-gb", str(args.capacity_gb)])
+        if args.backend == "native":
+            logger.error("native pskv-server binary unavailable")
+            return 1
+
+    server = CacheServer(args.host, args.port,
+                         int(args.capacity_gb * (1 << 30)))
+    loop = asyncio.new_event_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, loop.stop)
+    loop.run_until_complete(server.start())
+    try:
+        loop.run_forever()
+    finally:
+        loop.run_until_complete(server.stop())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,236 @@
+"""KV tiering tests: chunk hashing, tier stores, TPKV server, and
+engine-level prefix reuse (the LMCache-equivalent path, SURVEY.md §2.9).
+"""
+
+import asyncio
+import contextlib
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from production_stack_tpu.kvcache._native import load as load_native
+from production_stack_tpu.kvcache._native import server_binary
+from production_stack_tpu.kvcache.chunks import ChunkHasher
+from production_stack_tpu.kvcache.server import CacheServer
+from production_stack_tpu.kvcache.store import (DiskStore, HostMemoryStore,
+                                                RemoteStore, TieredStore)
+
+# ---------------------------------------------------------------------------
+# chunk hashing
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_keys_prefix_property():
+    h = ChunkHasher(chunk_size=4, namespace="m")
+    a = h.chunk_keys([1, 2, 3, 4, 5, 6, 7, 8, 9])      # 2 full chunks
+    b = h.chunk_keys([1, 2, 3, 4, 5, 6, 7, 8, 100, 200])
+    c = h.chunk_keys([1, 2, 3, 4, 99, 6, 7, 8])
+    assert len(a) == 2
+    assert a == b[:2]            # shared 8-token prefix -> same keys
+    assert a[0] == c[0]          # first chunk equal
+    assert a[1] != c[1]          # divergence poisons later chunks (chain)
+
+
+def test_chunk_keys_deterministic_and_namespaced():
+    assert ChunkHasher(4, "m").chunk_keys([1, 2, 3, 4]) == \
+        ChunkHasher(4, "m").chunk_keys([1, 2, 3, 4])
+    assert ChunkHasher(4, "m1").chunk_keys([1, 2, 3, 4]) != \
+        ChunkHasher(4, "m2").chunk_keys([1, 2, 3, 4])
+    assert ChunkHasher(4, "m").chunk_keys([1, 2, 3]) == []  # no full chunk
+
+
+# ---------------------------------------------------------------------------
+# stores
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("force_python", [True, False])
+def test_host_store_roundtrip(force_python):
+    if not force_python and load_native() is None:
+        pytest.skip("libpskv.so not built")
+    st = HostMemoryStore(1 << 20, force_python=force_python)
+    assert st.get(b"k") is None
+    assert st.put(b"k", b"v" * 100)
+    assert st.get(b"k") == b"v" * 100
+    assert st.exists(b"k")
+    assert st.delete(b"k")
+    assert not st.exists(b"k")
+
+
+@pytest.mark.parametrize("force_python", [True, False])
+def test_host_store_lru_eviction(force_python):
+    if not force_python and load_native() is None:
+        pytest.skip("libpskv.so not built")
+    st = HostMemoryStore(1000, force_python=force_python)
+    st.put(b"a", b"x" * 400)
+    st.put(b"b", b"x" * 400)
+    st.get(b"a")                  # touch: b is now LRU
+    st.put(b"c", b"x" * 400)      # evicts b
+    assert st.exists(b"a") and st.exists(b"c") and not st.exists(b"b")
+    assert st.stats()["bytes"] <= 1000
+    assert not st.put(b"big", b"x" * 2000)  # can never fit
+
+
+def test_disk_store(tmp_path):
+    st = DiskStore(str(tmp_path), capacity_bytes=1 << 20)
+    assert st.get(b"\x01\x02") is None
+    assert st.put(b"\x01\x02", b"payload")
+    assert st.get(b"\x01\x02") == b"payload"
+    assert st.exists(b"\x01\x02")
+    assert st.stats()["count"] == 1
+    assert st.delete(b"\x01\x02")
+    assert st.get(b"\x01\x02") is None
+
+
+def test_tiered_promotion_and_writethrough(tmp_path):
+    fast = HostMemoryStore(1 << 20, force_python=True)
+    slow = DiskStore(str(tmp_path))
+    tiered = TieredStore([fast, slow])
+    tiered.put(b"k", b"v")                 # write-through
+    assert fast.exists(b"k") and slow.exists(b"k")
+    fast.delete(b"k")
+    assert tiered.get(b"k") == b"v"        # slow hit
+    assert fast.exists(b"k")               # promoted
+
+
+# ---------------------------------------------------------------------------
+# TPKV server / client
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def python_cache_server():
+    loop = asyncio.new_event_loop()
+    server = CacheServer(host="127.0.0.1", port=0, capacity_bytes=1 << 22)
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(5)
+    try:
+        yield server
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(5)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(5)
+
+
+def _roundtrip(url):
+    client = RemoteStore(url)
+    assert client.ping()
+    assert client.get(b"k") is None
+    assert client.put(b"k", b"\x00\x01" * 500)
+    assert client.get(b"k") == b"\x00\x01" * 500
+    assert client.exists(b"k")
+    assert client.delete(b"k")
+    assert not client.exists(b"k")
+    stats = client.stats()
+    assert "bytes" in stats and "hits" in stats
+    client.close()
+
+
+def test_python_server_roundtrip():
+    with python_cache_server() as server:
+        _roundtrip(f"tpukv://127.0.0.1:{server.port}")
+
+
+def test_native_server_roundtrip():
+    binary = server_binary()
+    if binary is None:
+        pytest.skip("pskv-server binary not built")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen([binary, "--port", str(port),
+                             "--capacity-gb", "0.1"],
+                            stderr=subprocess.PIPE)
+    try:
+        client = RemoteStore(f"tpukv://127.0.0.1:{port}")
+        for _ in range(50):
+            if client.ping():
+                break
+            time.sleep(0.1)
+        _roundtrip(f"tpukv://127.0.0.1:{port}")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_remote_store_unreachable_is_soft():
+    client = RemoteStore("tpukv://127.0.0.1:1", connect_timeout=0.2)
+    assert client.get(b"k") is None
+    assert not client.put(b"k", b"v")
+    assert not client.ping()
+
+
+# ---------------------------------------------------------------------------
+# engine-level prefix reuse
+# ---------------------------------------------------------------------------
+
+
+def _make_engine(kv_cfg=None):
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    cfg = EngineConfig(model="debug-tiny", max_model_len=256, max_num_seqs=2,
+                       prefill_chunk=64, kv_transfer_config=kv_cfg)
+    return LLMEngine(cfg)
+
+
+def _run(engine, prompt, max_tokens=8):
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    sid = engine.add_request(prompt, SamplingOptions(temperature=0.0,
+                                                     max_tokens=max_tokens))
+    while engine.has_work:
+        engine.step()
+    return list(engine.seqs[sid].output_tokens)
+
+
+PROMPT = [(i * 37 + 11) % 500 for i in range(100)]
+
+
+def test_engine_prefix_reuse_local_cpu():
+    engine = _make_engine({"local_cpu_gb": 0.25, "chunk_size": 32})
+    baseline = _make_engine(None)
+    try:
+        first = _run(engine, PROMPT)
+        engine.connector.flush()
+        assert engine.connector.hit_tokens == 0
+        second = _run(engine, PROMPT)
+        # 3 full 32-token chunks of the 100-token prompt were reused
+        assert engine.connector.hit_tokens == 96
+        assert second == first
+        # cached-path decode matches an engine that never cached
+        assert _run(baseline, PROMPT) == first
+    finally:
+        engine.close()
+
+
+def test_engine_prefix_reuse_via_remote_server():
+    """Two engine replicas sharing KV through the remote tier (the
+    cross-replica story config 3 of BASELINE.md targets)."""
+    with python_cache_server() as server:
+        url = f"tpukv://127.0.0.1:{server.port}"
+        producer = _make_engine({"remote_url": url, "chunk_size": 32})
+        consumer = _make_engine({"remote_url": url, "chunk_size": 32})
+        try:
+            first = _run(producer, PROMPT)
+            producer.connector.flush()
+            second = _run(consumer, PROMPT)
+            assert consumer.connector.hit_tokens == 96
+            assert second == first
+        finally:
+            producer.close()
+            consumer.close()
+
+
+def test_engine_divergent_prompt_partial_hit():
+    engine = _make_engine({"local_cpu_gb": 0.25, "chunk_size": 32})
+    try:
+        _run(engine, PROMPT)
+        engine.connector.flush()
+        divergent = PROMPT[:40] + [7] * 60   # shares one 32-token chunk
+        _run(engine, divergent)
+        assert engine.connector.hit_tokens == 32
+    finally:
+        engine.close()
