@@ -1,0 +1,178 @@
+// Flat inner-product vector index for the router's semantic cache.
+//
+// The reference consumes FAISS IndexFlatIP through the faiss-cpu wheel
+// (reference: src/vllm_router/experimental/semantic_cache/db_adapters/
+// faiss_adapter.py:30-70 — add_with_ids / search / persist to disk). This is
+// the same semantics as a small C ABI: contiguous row-major float32 matrix,
+// brute-force dot products (g++ -O2/-O3 auto-vectorizes the inner loop),
+// swap-remove by id, and a versioned binary save/load format.
+//
+// Exposed via ctypes from production_stack_tpu/router/semantic_cache.py;
+// compiled into libpskv.so (the stack's single native library).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kVecMagic = 0x50535649;  // "PSVI"
+constexpr uint32_t kVecVersion = 1;
+
+struct VecIndex {
+    int dim;
+    std::vector<float> data;       // n x dim, row-major
+    std::vector<int64_t> ids;      // n
+    std::unordered_map<int64_t, size_t> pos;  // id -> row
+    std::mutex mu;
+
+    size_t size() const { return ids.size(); }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *psvi_new(int dim) {
+    if (dim <= 0) return nullptr;
+    auto *ix = new VecIndex();
+    ix->dim = dim;
+    return ix;
+}
+
+void psvi_free(void *h) { delete (VecIndex *)h; }
+
+int psvi_dim(void *h) { return ((VecIndex *)h)->dim; }
+
+uint64_t psvi_size(void *h) {
+    VecIndex *ix = (VecIndex *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    return ix->size();
+}
+
+// Adds (or replaces) a vector under `id`.
+int psvi_add(void *h, const float *vec, int64_t id) {
+    VecIndex *ix = (VecIndex *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    auto it = ix->pos.find(id);
+    if (it != ix->pos.end()) {
+        memcpy(&ix->data[it->second * ix->dim], vec,
+               ix->dim * sizeof(float));
+        return 0;
+    }
+    ix->pos[id] = ix->size();
+    ix->ids.push_back(id);
+    ix->data.insert(ix->data.end(), vec, vec + ix->dim);
+    return 0;
+}
+
+// Swap-remove by id. Returns 1 if removed, 0 if absent.
+int psvi_remove(void *h, int64_t id) {
+    VecIndex *ix = (VecIndex *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    auto it = ix->pos.find(id);
+    if (it == ix->pos.end()) return 0;
+    size_t row = it->second, last = ix->size() - 1;
+    if (row != last) {
+        memcpy(&ix->data[row * ix->dim], &ix->data[last * ix->dim],
+               ix->dim * sizeof(float));
+        ix->ids[row] = ix->ids[last];
+        ix->pos[ix->ids[row]] = row;
+    }
+    ix->data.resize(last * ix->dim);
+    ix->ids.pop_back();
+    ix->pos.erase(it);
+    return 1;
+}
+
+// Top-k by inner product. Writes up to k (score, id) pairs, returns count.
+int psvi_search(void *h, const float *query, int k, float *out_scores,
+                int64_t *out_ids) {
+    VecIndex *ix = (VecIndex *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    size_t n = ix->size();
+    if (n == 0 || k <= 0) return 0;
+    std::vector<std::pair<float, int64_t>> scored(n);
+    const int dim = ix->dim;
+    for (size_t r = 0; r < n; r++) {
+        const float *row = &ix->data[r * dim];
+        float dot = 0.f;
+        for (int d = 0; d < dim; d++) dot += row[d] * query[d];
+        scored[r] = {dot, ix->ids[r]};
+    }
+    int out = std::min<size_t>(k, n);
+    std::partial_sort(scored.begin(), scored.begin() + out, scored.end(),
+                      [](auto &a, auto &b) { return a.first > b.first; });
+    for (int i = 0; i < out; i++) {
+        out_scores[i] = scored[i].first;
+        out_ids[i] = scored[i].second;
+    }
+    return out;
+}
+
+// Binary persistence: magic | version | dim | n | ids[n] | data[n*dim].
+int psvi_save(void *h, const char *path) {
+    VecIndex *ix = (VecIndex *)h;
+    std::lock_guard<std::mutex> g(ix->mu);
+    std::string tmp = std::string(path) + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    uint32_t dim = ix->dim;
+    uint64_t n = ix->size();
+    bool ok = fwrite(&kVecMagic, 4, 1, f) == 1 &&
+              fwrite(&kVecVersion, 4, 1, f) == 1 &&
+              fwrite(&dim, 4, 1, f) == 1 && fwrite(&n, 8, 1, f) == 1;
+    if (ok && n) {
+        ok = fwrite(ix->ids.data(), sizeof(int64_t), n, f) == n &&
+             fwrite(ix->data.data(), sizeof(float), n * dim, f) == n * dim;
+    }
+    ok = (fclose(f) == 0) && ok;
+    if (!ok || rename(tmp.c_str(), path) != 0) {
+        remove(tmp.c_str());
+        return -1;
+    }
+    return 0;
+}
+
+void *psvi_load(const char *path) {
+    FILE *f = fopen(path, "rb");
+    if (!f) return nullptr;
+    uint32_t magic = 0, version = 0, dim = 0;
+    uint64_t n = 0;
+    bool ok = fread(&magic, 4, 1, f) == 1 && magic == kVecMagic &&
+              fread(&version, 4, 1, f) == 1 && version == kVecVersion &&
+              fread(&dim, 4, 1, f) == 1 && dim > 0 &&
+              fread(&n, 8, 1, f) == 1;
+    // never trust the on-disk count: the payload must be exactly
+    // n * (id + dim floats) bytes, or resize() below could throw
+    // bad_alloc through the C ABI and abort the loading process
+    if (ok) {
+        long payload_start = ftell(f);
+        ok = payload_start >= 0 && fseek(f, 0, SEEK_END) == 0;
+        long end = ftell(f);
+        ok = ok && end >= payload_start &&
+             (uint64_t)(end - payload_start) ==
+                 n * (sizeof(int64_t) + (uint64_t)dim * sizeof(float)) &&
+             fseek(f, payload_start, SEEK_SET) == 0;
+    }
+    if (!ok) { fclose(f); return nullptr; }
+    auto *ix = new VecIndex();
+    ix->dim = (int)dim;
+    ix->ids.resize(n);
+    ix->data.resize(n * dim);
+    if (n) {
+        ok = fread(ix->ids.data(), sizeof(int64_t), n, f) == n &&
+             fread(ix->data.data(), sizeof(float), n * dim, f) == n * dim;
+    }
+    fclose(f);
+    if (!ok) { delete ix; return nullptr; }
+    for (size_t r = 0; r < n; r++) ix->pos[ix->ids[r]] = r;
+    return ix;
+}
+
+}  // extern "C"

@@ -53,6 +53,27 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pskv_server_run_on.argtypes = [p, cp, ctypes.c_uint16,
                                        ctypes.POINTER(ctypes.c_int),
                                        ctypes.POINTER(ctypes.c_int)]
+    # psvi_*: flat inner-product vector index (native/vecindex.cpp),
+    # consumed by router/semantic_cache.py
+    fp = ctypes.POINTER(ctypes.c_float)
+    ip = ctypes.POINTER(ctypes.c_int64)
+    lib.psvi_new.restype = p
+    lib.psvi_new.argtypes = [i32]
+    lib.psvi_free.argtypes = [p]
+    lib.psvi_dim.restype = i32
+    lib.psvi_dim.argtypes = [p]
+    lib.psvi_size.restype = u64
+    lib.psvi_size.argtypes = [p]
+    lib.psvi_add.restype = i32
+    lib.psvi_add.argtypes = [p, fp, ctypes.c_int64]
+    lib.psvi_remove.restype = i32
+    lib.psvi_remove.argtypes = [p, ctypes.c_int64]
+    lib.psvi_search.restype = i32
+    lib.psvi_search.argtypes = [p, fp, i32, fp, ip]
+    lib.psvi_save.restype = i32
+    lib.psvi_save.argtypes = [p, cp]
+    lib.psvi_load.restype = p
+    lib.psvi_load.argtypes = [cp]
     return lib
 
 

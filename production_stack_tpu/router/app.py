@@ -91,6 +91,10 @@ async def metrics(request: web.Request) -> web.Response:
     endpoints = state["discovery"].get_endpoints()
     state["request_stats"].evict_except(ep.url for ep in endpoints)
     state["metrics"].refresh(state["request_stats"].get(), len(endpoints))
+    if state.get("semantic_cache") is not None:
+        state["metrics"].refresh_semantic_cache(state["semantic_cache"])
+    if state.get("pii_middleware") is not None:
+        state["metrics"].refresh_pii(state["pii_middleware"])
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
 
@@ -130,6 +134,21 @@ def build_app(args: argparse.Namespace) -> web.Application:
                          "KVAwareRouting feature gate (BETA, on by "
                          "default; it was explicitly disabled)")
     state["router"] = make_router(args.routing_logic, args.session_key)
+
+    if state["feature_gates"].enabled("PIIDetection"):
+        from production_stack_tpu.router.pii import PIIConfig, PIIMiddleware
+        state["pii_middleware"] = PIIMiddleware(PIIConfig.from_args(
+            args.pii_analyzer, args.pii_action, args.pii_types))
+        app.middlewares.append(state["pii_middleware"].middleware)
+
+    if state["feature_gates"].enabled("SemanticCache"):
+        from production_stack_tpu.router.semantic_cache import (
+            SemanticCache, make_embedder)
+        state["semantic_cache"] = SemanticCache(
+            embedder=make_embedder(args.semantic_cache_embedder),
+            threshold=args.semantic_cache_threshold,
+            max_entries=args.semantic_cache_max_entries,
+            persist_dir=args.semantic_cache_dir)
     # indirect through state so dynamic-config discovery swaps are followed
     state["scraper"] = EngineStatsScraper(
         lambda: state["discovery"].get_endpoints(),
@@ -168,6 +187,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
         await state["scraper"].close()
         await state["discovery"].close()
         await state["client"].close()
+        if state.get("semantic_cache") is not None:
+            state["semantic_cache"].persist()
 
     app.on_startup.append(on_startup)
     app.on_cleanup.append(on_cleanup)
@@ -203,6 +224,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--dynamic-config-interval", type=float, default=10.0)
     p.add_argument("--feature-gates", default=None,
                    help="Name=true,Name2=false")
+    p.add_argument("--semantic-cache-dir", default=None,
+                   help="persist the semantic cache index/metadata here")
+    p.add_argument("--semantic-cache-threshold", type=float, default=0.95)
+    p.add_argument("--semantic-cache-max-entries", type=int, default=4096)
+    p.add_argument("--semantic-cache-embedder", default="hashing",
+                   help="'hashing' (dependency-free) or "
+                        "'sentence-transformers/<model>'")
+    p.add_argument("--pii-analyzer", default="regex")
+    p.add_argument("--pii-action", choices=["block", "redact"],
+                   default="block")
+    p.add_argument("--pii-types", default=None,
+                   help="comma-separated PIIType values (default: all)")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

@@ -32,6 +32,25 @@ class RouterMetrics:
         self.healthy_pods = Gauge("vllm:healthy_pods_total",
                                   "Routable engine endpoints",
                                   registry=self.registry)
+        # semantic-cache surface (reference:
+        # semantic_cache_integration.py:25-44 gauge names)
+        def plain(name, doc):
+            return Gauge(name, doc, registry=self.registry)
+        self.semantic_hits = plain("vllm:semantic_cache_hits",
+                                   "Semantic cache hits")
+        self.semantic_misses = plain("vllm:semantic_cache_misses",
+                                     "Semantic cache misses")
+        self.semantic_hit_ratio = plain("vllm:semantic_cache_hit_ratio",
+                                        "Semantic cache hit ratio")
+        self.semantic_size = plain("vllm:semantic_cache_size",
+                                   "Semantic cache entries")
+        # PII surface (reference: pii/middleware.py:20-39 counters)
+        self.pii_scanned = plain("vllm:pii_requests_scanned",
+                                 "Requests scanned for PII")
+        self.pii_blocked = plain("vllm:pii_requests_blocked",
+                                 "Requests blocked for PII")
+        self.pii_redacted = plain("vllm:pii_requests_redacted",
+                                  "Requests redacted for PII")
         self._seen_servers = set()
 
     def refresh(self, request_stats: dict, num_endpoints: int) -> None:
@@ -55,6 +74,17 @@ class RouterMetrics:
             self.num_decoding.labels(server=url).set(st.in_decoding)
             self.num_running.labels(server=url).set(st.in_flight)
         self.healthy_pods.set(num_endpoints)
+
+    def refresh_semantic_cache(self, cache) -> None:
+        self.semantic_hits.set(cache.hits)
+        self.semantic_misses.set(cache.misses)
+        self.semantic_hit_ratio.set(cache.hit_ratio)
+        self.semantic_size.set(len(cache))
+
+    def refresh_pii(self, middleware) -> None:
+        self.pii_scanned.set(middleware.scanned)
+        self.pii_blocked.set(middleware.blocked)
+        self.pii_redacted.set(middleware.redacted)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

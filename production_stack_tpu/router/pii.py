@@ -1,0 +1,327 @@
+"""PII detection for incoming requests (feature gate ``PIIDetection``).
+
+Capability parity with reference src/vllm_router/experimental/pii/
+(types.py:1-53 PIIType enum; analyzers/base.py + analyzers/regex.py
+dependency-free analyzer; middleware.py:60-154 request-blocking with
+conservative block-on-error). Differences by design:
+
+  * aiohttp middleware (this stack's server) instead of FastAPI;
+  * REDACT is implemented, not just declared: matched spans are replaced
+    with ``[REDACTED:<type>]`` and the sanitized body is handed to the
+    proxy, so requests can proceed PII-free — the reference lists redaction
+    as future work (types.py:10);
+  * credit-card candidates are Luhn-validated to cut false positives.
+
+The analyzer abstraction allows a model-based backend (the reference wraps
+Microsoft Presidio) to slot in later; the regex analyzer is the
+dependency-free default, as in the reference.
+"""
+
+import enum
+import json
+import re
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from aiohttp import web
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class PIIAction(enum.Enum):
+    BLOCK = "block"
+    REDACT = "redact"
+
+
+class PIIType(enum.Enum):
+    EMAIL = "email"
+    PHONE = "phone"
+    SSN = "ssn"
+    CREDIT_CARD = "credit_card"
+    IP_ADDRESS = "ip_address"
+    API_KEY = "api_key"
+    BANK_ACCOUNT = "bank_account"
+    IBAN = "iban"
+    PASSPORT = "passport"
+    DRIVERS_LICENSE = "drivers_license"
+    TAX_ID = "tax_id"
+    MEDICAL_RECORD = "medical_record"
+    MAC_ADDRESS = "mac_address"
+    DOB = "date_of_birth"
+    PASSWORD = "password"
+    SECRET_URL_CRED = "url_credential"
+
+
+@dataclass
+class PIIMatch:
+    pii_type: PIIType
+    start: int
+    end: int
+    text: str
+
+
+@dataclass
+class PIIAnalysisResult:
+    detected: bool = False
+    types: Set[PIIType] = field(default_factory=set)
+    matches: List[PIIMatch] = field(default_factory=list)
+
+
+class PIIAnalyzer(ABC):
+    """Analyzer abstraction (reference analyzers/base.py:1-65)."""
+
+    @abstractmethod
+    def analyze(self, text: str,
+                types: Optional[Set[PIIType]] = None) -> PIIAnalysisResult:
+        ...
+
+
+def _luhn_ok(digits: str) -> bool:
+    total, alt = 0, False
+    for ch in reversed(digits):
+        d = ord(ch) - 48
+        if alt:
+            d *= 2
+            if d > 9:
+                d -= 9
+        total += d
+        alt = not alt
+    return total % 10 == 0
+
+
+class RegexPIIAnalyzer(PIIAnalyzer):
+    """Dependency-free pattern analyzer (reference analyzers/regex.py)."""
+
+    PATTERNS: Dict[PIIType, str] = {
+        PIIType.EMAIL:
+            r"\b[A-Za-z0-9._%+-]+@[A-Za-z0-9.-]+\.[A-Za-z]{2,}\b",
+        # classic NANP-style shapes only; the trailing lookahead rejects a
+        # fourth digit group so card-number-like runs never match
+        PIIType.PHONE:
+            r"(?<![\w.)-])(?:\+\d{1,2}[ .-]?)?(?:\(\d{3}\)[ .-]?"
+            r"|\d{3}[ .-])\d{3}[ .-]\d{4}(?![ .-]?\d)",
+        PIIType.SSN:
+            r"\b\d{3}-\d{2}-\d{4}\b",
+        PIIType.CREDIT_CARD:
+            r"\b\d(?:[ -]?\d){12,18}\b",   # 13-19 digits, ends on a digit
+        PIIType.IP_ADDRESS:
+            r"\b(?:(?:25[0-5]|2[0-4]\d|1?\d?\d)\.){3}"
+            r"(?:25[0-5]|2[0-4]\d|1?\d?\d)\b"
+            r"|\b(?:[A-Fa-f0-9]{1,4}:){7}[A-Fa-f0-9]{1,4}\b",
+        PIIType.API_KEY:
+            r"\b(?:sk|pk|rk)-[A-Za-z0-9_-]{16,}\b"
+            r"|\bAKIA[0-9A-Z]{16}\b"
+            r"|\bgh[pousr]_[A-Za-z0-9]{20,}\b"
+            r"|\bxox[baprs]-[A-Za-z0-9-]{10,}\b",
+        PIIType.IBAN:
+            r"\b[A-Z]{2}\d{2}[A-Z0-9]{11,30}\b",
+        PIIType.BANK_ACCOUNT:
+            r"(?i)\b(?:account|acct)\.?\s*(?:number|no|#)?\s*[:=]?\s*"
+            r"\d{8,17}\b",
+        PIIType.PASSPORT:
+            r"(?i)\bpassport\s*(?:number|no|#)?\s*[:=]?\s*[A-Z0-9]{6,9}\b",
+        PIIType.DRIVERS_LICENSE:
+            r"(?i)\b(?:driver'?s?\s+licen[cs]e|dl)\s*(?:number|no|#)?"
+            r"\s*[:=]?\s*[A-Z0-9]{5,13}\b",
+        PIIType.TAX_ID:
+            r"\b\d{2}-\d{7}\b",
+        PIIType.MEDICAL_RECORD:
+            r"(?i)\b(?:mrn|medical\s+record\s*(?:number|no|#)?)\s*[:=]?"
+            r"\s*[A-Z0-9]{6,12}\b",
+        PIIType.MAC_ADDRESS:
+            r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b",
+        PIIType.DOB:
+            r"(?i)\b(?:dob|date\s+of\s+birth|born(?:\s+on)?)\s*[:=]?\s*"
+            r"\d{1,4}[/-]\d{1,2}[/-]\d{1,4}\b",
+        PIIType.PASSWORD:
+            r"(?i)\b(?:password|passwd|pwd)\s*[:=]\s*\S{4,}",
+        PIIType.SECRET_URL_CRED:
+            r"\b[a-z][a-z0-9+.-]*://[^/\s:@]+:[^/\s:@]+@",
+    }
+
+    def __init__(self):
+        self._compiled = {t: re.compile(p) for t, p in self.PATTERNS.items()}
+
+    def analyze(self, text: str,
+                types: Optional[Set[PIIType]] = None) -> PIIAnalysisResult:
+        result = PIIAnalysisResult()
+        for pii_type, pattern in self._compiled.items():
+            if types is not None and pii_type not in types:
+                continue
+            for m in pattern.finditer(text):
+                if pii_type == PIIType.CREDIT_CARD:
+                    digits = re.sub(r"\D", "", m.group())
+                    if not (13 <= len(digits) <= 19 and _luhn_ok(digits)):
+                        continue
+                result.detected = True
+                result.types.add(pii_type)
+                result.matches.append(PIIMatch(pii_type, m.start(), m.end(),
+                                               m.group()))
+        return result
+
+
+def make_analyzer(spec: str = "regex") -> PIIAnalyzer:
+    if spec == "regex":
+        return RegexPIIAnalyzer()
+    raise ValueError(f"unknown PII analyzer {spec!r} (available: regex)")
+
+
+# ---------------------------------------------------------------- config
+
+
+@dataclass
+class PIIConfig:
+    """Reference config surface (pii/config.py): analyzer, action, types."""
+    analyzer: str = "regex"
+    action: PIIAction = PIIAction.BLOCK
+    types: Optional[Set[PIIType]] = None     # None = all
+
+    @classmethod
+    def from_args(cls, analyzer: str, action: str,
+                  types_csv: Optional[str]) -> "PIIConfig":
+        types = None
+        if types_csv:
+            types = {PIIType(t.strip()) for t in types_csv.split(",")
+                     if t.strip()}
+        return cls(analyzer=analyzer, action=PIIAction(action), types=types)
+
+
+# ---------------------------------------------------------------- middleware
+
+
+def _extract_texts(body: dict) -> List[Tuple[str, object]]:
+    """(text, setter-path) pairs from the OpenAI body fields that carry
+    user text: chat message content (string or multimodal content-part
+    list), `prompt`, `input`."""
+    out = []
+    messages = body.get("messages")
+    if isinstance(messages, list):
+        for i, m in enumerate(messages):
+            if not isinstance(m, dict):
+                continue
+            content = m.get("content")
+            if isinstance(content, str):
+                out.append((content, ("messages", i)))
+            elif isinstance(content, list):   # multimodal content parts
+                for j, part in enumerate(content):
+                    if isinstance(part, dict) and \
+                            isinstance(part.get("text"), str):
+                        out.append((part["text"], ("messages", i, j)))
+    for key in ("prompt", "input"):
+        val = body.get(key)
+        if isinstance(val, str):
+            out.append((val, (key,)))
+        elif isinstance(val, list):
+            for i, item in enumerate(val):
+                if isinstance(item, str):
+                    out.append((item, (key, i)))
+    return out
+
+
+def _apply_redaction(body: dict, path, redacted_text: str) -> None:
+    if path[0] == "messages":
+        if len(path) == 3:   # multimodal content part
+            body["messages"][path[1]]["content"][path[2]]["text"] = \
+                redacted_text
+        else:
+            body["messages"][path[1]]["content"] = redacted_text
+    elif len(path) == 1:
+        body[path[0]] = redacted_text
+    else:
+        body[path[0]][path[1]] = redacted_text
+
+
+def redact(text: str, matches: List[PIIMatch]) -> str:
+    """Replace matched spans with [REDACTED:<type>] tags.
+
+    Overlapping matches from different patterns (e.g. a card number inside
+    an 'account number: …' span) are merged first — offsets were computed
+    on the original string, so replacements must never nest."""
+    merged: List[PIIMatch] = []
+    for m in sorted(matches, key=lambda m: (m.start, -m.end)):
+        if merged and m.start < merged[-1].end:
+            if m.end > merged[-1].end:   # extend the covering span
+                prev = merged[-1]
+                merged[-1] = PIIMatch(prev.pii_type, prev.start, m.end,
+                                      text[prev.start:m.end])
+            continue
+        merged.append(m)
+    for m in reversed(merged):
+        text = (text[:m.start] + f"[REDACTED:{m.pii_type.value}]"
+                + text[m.end:])
+    return text
+
+
+PII_SCAN_PATHS = ("/v1/chat/completions", "/v1/completions",
+                  "/v1/embeddings")
+
+
+class PIIMiddleware:
+    """Scans request bodies; blocks (400) or redacts before proxying.
+
+    Conservative on errors: an analyzer failure blocks the request rather
+    than letting unscanned text through (reference middleware.py:99-103).
+    The redacted body is stashed on the request for the proxy to forward
+    (aiohttp requests are read-once, so the original body stays intact
+    for non-scanned paths).
+    """
+
+    def __init__(self, config: PIIConfig, metrics=None):
+        self.config = config
+        self.analyzer = make_analyzer(config.analyzer)
+        self.metrics = metrics
+        self.scanned = 0
+        self.blocked = 0
+        self.redacted = 0
+
+    @web.middleware
+    async def middleware(self, request: web.Request, handler):
+        if request.method != "POST" or \
+                request.path not in PII_SCAN_PATHS:
+            return await handler(request)
+        try:
+            raw = await request.read()
+            body = json.loads(raw) if raw else {}
+            if not isinstance(body, dict):
+                return await handler(request)
+            texts = _extract_texts(body)
+            self.scanned += 1
+            detected_types: Set[PIIType] = set()
+            mutated = False
+            for text, path in texts:
+                result = self.analyzer.analyze(text, self.config.types)
+                if not result.detected:
+                    continue
+                detected_types |= result.types
+                if self.config.action == PIIAction.REDACT:
+                    _apply_redaction(body, path,
+                                     redact(text, result.matches))
+                    mutated = True
+            if detected_types and self.config.action == PIIAction.BLOCK:
+                self.blocked += 1
+                logger.warning("blocked request with PII: %s",
+                               sorted(t.value for t in detected_types))
+                return web.json_response(
+                    {"error": {
+                        "message": "request blocked: detected PII of "
+                                   "types "
+                                   f"{sorted(t.value for t in detected_types)}",
+                        "type": "invalid_request_error",
+                        "code": "pii_detected"}}, status=400)
+            if mutated:
+                self.redacted += 1
+                request["pii_sanitized_raw"] = json.dumps(body).encode()
+        except web.HTTPException:
+            raise
+        except Exception as e:
+            # conservative: failure to scan blocks the request
+            logger.error("PII analysis failed; blocking request: %s", e)
+            self.blocked += 1
+            return web.json_response(
+                {"error": {"message": "PII analysis failed",
+                           "type": "server_error",
+                           "code": "pii_analysis_error"}}, status=400)
+        return await handler(request)

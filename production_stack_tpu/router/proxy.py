@@ -7,6 +7,7 @@ ClientSession: the relay forwards raw bytes chunk-by-chunk (no SSE
 re-parse on the hot loop) and fires first-byte/complete stats hooks.
 """
 
+import asyncio
 import json
 import time
 import uuid
@@ -38,7 +39,9 @@ async def route_general_request(request: web.Request,
     state = app["state"]
     t_route0 = time.monotonic()
 
-    raw = await request.read()
+    # the PII middleware may have redacted the body (read-once request;
+    # the sanitized copy is stashed on the request object)
+    raw = request.get("pii_sanitized_raw") or await request.read()
     try:
         body = json.loads(raw) if raw else {}
     except json.JSONDecodeError:
@@ -55,6 +58,24 @@ async def route_general_request(request: web.Request,
     rewriter = state.get("rewriter")
     if rewriter is not None:
         body, raw = rewriter.rewrite(endpoint_path, body, raw)
+
+    # semantic cache short-circuit (gated; chat completions only) —
+    # reference hooks the same spot (main_router.py:44-51 checks before
+    # routing, request.py:113-117 stores after completion)
+    semantic_cache = state.get("semantic_cache")
+    check_cache = (semantic_cache is not None
+                   and endpoint_path == "/v1/chat/completions")
+    if check_cache:
+        try:
+            # embed + index search are sync CPU work — keep them off the
+            # event loop so concurrent streams never stall behind them
+            cached = await asyncio.get_running_loop().run_in_executor(
+                None, semantic_cache.check, body)
+        except Exception as e:
+            logger.warning("semantic cache check failed: %s", e)
+            cached = None
+        if cached is not None:
+            return web.json_response(cached)
 
     endpoints = [ep for ep in state["discovery"].get_endpoints()
                  if ep.serves(model)]
@@ -85,14 +106,28 @@ async def route_general_request(request: web.Request,
                 if k.lower() not in HOP_HEADERS:
                     resp.headers[k] = v
             await resp.prepare(request)
+            # capture the body for the semantic cache only when this
+            # response is storable (non-streaming 200 on the chat path)
+            capture = (check_cache and backend.status == 200
+                       and not body.get("stream"))
+            captured = bytearray() if capture else None
             first = True
             async for chunk in backend.content.iter_any():
                 if first:
                     monitor.on_first_token(url, request_id)
                     first = False
                 monitor.on_token(url, request_id)
+                if captured is not None:
+                    captured.extend(chunk)
                 await resp.write(chunk)
             await resp.write_eof()
+            if captured is not None:
+                try:
+                    response_body = json.loads(bytes(captured))
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, semantic_cache.store, body, response_body)
+                except Exception as e:
+                    logger.warning("semantic cache store failed: %s", e)
             return resp
     except (aiohttp.ClientError, ConnectionError) as e:
         logger.warning("backend %s failed: %s", url, e)

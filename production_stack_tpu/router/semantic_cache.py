@@ -1,0 +1,450 @@
+"""Semantic cache: serve repeated-meaning chat requests without an engine.
+
+Capability parity with reference src/vllm_router/experimental/semantic_cache/
+(semantic_cache.py:1-353 + db_adapters/faiss_adapter.py:30-134 +
+semantic_cache_integration.py:25-306): embed the request messages, nearest-
+neighbor against cached request embeddings, and short-circuit the router
+when similarity clears a threshold; store non-streaming completions after
+they finish. Differences by design:
+
+  * the vector index is this repo's native C++ flat-IP index
+    (native/vecindex.cpp) with a numpy fallback — not a FAISS wheel;
+  * the default embedder is a dependency-free feature-hashing embedder
+    (deterministic across replicas), with sentence-transformers as an
+    optional drop-in when the wheel exists — the reference hard-requires it;
+  * persistence is the index's own binary format + a JSON metadata file,
+    not pickles (no code execution on load).
+
+Gated behind the ``SemanticCache`` feature gate (ALPHA, off by default),
+like the reference (feature_gates.py).
+"""
+
+import ctypes
+import itertools
+import json
+import math
+import os
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from production_stack_tpu.kvcache import _native
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+DEFAULT_SIMILARITY_THRESHOLD = 0.95
+DEFAULT_DIM = 384
+
+
+# ---------------------------------------------------------------- embedders
+
+class Embedder(ABC):
+    dim: int
+
+    @abstractmethod
+    def embed(self, text: str) -> np.ndarray:
+        """-> L2-normalized float32 [dim]."""
+
+
+class HashingEmbedder(Embedder):
+    """Deterministic feature-hashing embedder (no model download).
+
+    Words and character trigrams are hashed into `dim` buckets with a
+    +/- sign (the classic hashing trick), tf-weighted, L2-normalized.
+    Inner product then behaves like an n-gram cosine similarity: near-1.0
+    for same meaning-ish strings with small edits, low for unrelated text.
+    Deterministic across processes/replicas (blake2b, not PYTHONHASHSEED).
+    """
+
+    def __init__(self, dim: int = DEFAULT_DIM):
+        self.dim = dim
+
+    def _features(self, text: str):
+        text = " ".join(text.lower().split())
+        for word in text.split(" "):
+            yield "w:" + word
+        padded = f"  {text} "
+        for i in range(len(padded) - 2):
+            yield "c:" + padded[i:i + 3]
+
+    def embed(self, text: str) -> np.ndarray:
+        import hashlib
+        vec = np.zeros(self.dim, np.float32)
+        for feat in self._features(text):
+            h = int.from_bytes(
+                hashlib.blake2b(feat.encode(), digest_size=8).digest(),
+                "little")
+            idx = (h >> 1) % self.dim
+            sign = 1.0 if h & 1 else -1.0
+            vec[idx] += sign
+        norm = float(np.linalg.norm(vec))
+        return vec / norm if norm > 0 else vec
+
+
+class SentenceTransformerEmbedder(Embedder):
+    """Optional wrapper over sentence-transformers (reference default
+    all-MiniLM-L6-v2, semantic_cache.py); only usable when the wheel and
+    model weights are present."""
+
+    def __init__(self, model_name: str = "all-MiniLM-L6-v2"):
+        from sentence_transformers import SentenceTransformer  # lazy
+        self._model = SentenceTransformer(model_name)
+        self.dim = self._model.get_sentence_embedding_dimension()
+
+    def embed(self, text: str) -> np.ndarray:
+        vec = np.asarray(self._model.encode([text])[0], np.float32)
+        norm = float(np.linalg.norm(vec))
+        return vec / norm if norm > 0 else vec
+
+
+def make_embedder(spec: str = "hashing", dim: int = DEFAULT_DIM) -> Embedder:
+    if spec == "hashing":
+        return HashingEmbedder(dim)
+    if spec.startswith("sentence-transformers/") or spec == "minilm":
+        name = spec.split("/", 1)[1] if "/" in spec else "all-MiniLM-L6-v2"
+        try:
+            return SentenceTransformerEmbedder(name)
+        except Exception as e:
+            logger.warning("sentence-transformers unavailable (%s); "
+                           "falling back to hashing embedder", e)
+            return HashingEmbedder(dim)
+    raise ValueError(f"unknown embedder {spec!r}")
+
+
+# ---------------------------------------------------------------- index
+
+class VectorIndex(ABC):
+    """FlatIP semantics: add/replace by id, top-k search, swap-remove."""
+
+    @abstractmethod
+    def add(self, vec: np.ndarray, vid: int) -> None: ...
+
+    @abstractmethod
+    def remove(self, vid: int) -> bool: ...
+
+    @abstractmethod
+    def search(self, vec: np.ndarray, k: int) -> \
+        Tuple[List[float], List[int]]: ...
+
+    @abstractmethod
+    def __len__(self) -> int: ...
+
+    @abstractmethod
+    def save(self, path: str) -> None: ...
+
+
+class NativeVectorIndex(VectorIndex):
+    """ctypes binding of native/vecindex.cpp (psvi_*)."""
+
+    def __init__(self, dim: int, _handle=None):
+        self._lib = _native.load()
+        if self._lib is None:
+            raise RuntimeError("libpskv.so unavailable")
+        self.dim = dim
+        self._h = _handle if _handle is not None else \
+            self._lib.psvi_new(dim)
+
+    @classmethod
+    def load(cls, path: str) -> Optional["NativeVectorIndex"]:
+        lib = _native.load()
+        if lib is None:
+            return None
+        h = lib.psvi_load(path.encode())
+        if not h:
+            return None
+        return cls(lib.psvi_dim(h), _handle=h)
+
+    def _as_fp(self, vec: np.ndarray):
+        vec = np.ascontiguousarray(vec, np.float32)
+        return vec, vec.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+    def add(self, vec: np.ndarray, vid: int) -> None:
+        keepalive, ptr = self._as_fp(vec)
+        self._lib.psvi_add(self._h, ptr, vid)
+
+    def remove(self, vid: int) -> bool:
+        return bool(self._lib.psvi_remove(self._h, vid))
+
+    def search(self, vec: np.ndarray, k: int):
+        keepalive, ptr = self._as_fp(vec)
+        scores = np.empty(k, np.float32)
+        ids = np.empty(k, np.int64)
+        n = self._lib.psvi_search(
+            self._h, ptr, k,
+            scores.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return scores[:n].tolist(), ids[:n].tolist()
+
+    def __len__(self) -> int:
+        return int(self._lib.psvi_size(self._h))
+
+    def save(self, path: str) -> None:
+        if self._lib.psvi_save(self._h, path.encode()) != 0:
+            raise OSError(f"failed to save vector index to {path}")
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.psvi_free(h)
+            self._h = None
+
+
+class NumpyVectorIndex(VectorIndex):
+    """Pure-numpy fallback with identical semantics + file format."""
+
+    _MAGIC, _VERSION = 0x50535649, 1
+
+    def __init__(self, dim: int):
+        self.dim = dim
+        self._vecs: List[np.ndarray] = []
+        self._ids: List[int] = []
+        self._pos: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def load(cls, path: str) -> Optional["NumpyVectorIndex"]:
+        try:
+            with open(path, "rb") as f:
+                hdr = np.frombuffer(f.read(12), np.uint32)
+                if hdr[0] != cls._MAGIC or hdr[1] != cls._VERSION:
+                    return None
+                dim = int(hdr[2])
+                (n,) = np.frombuffer(f.read(8), np.uint64)
+                n = int(n)
+                ids = np.frombuffer(f.read(8 * n), np.int64)
+                data = np.frombuffer(f.read(4 * n * dim),
+                                     np.float32).reshape(n, dim)
+            ix = cls(dim)
+            for i in range(n):
+                ix.add(data[i], int(ids[i]))
+            return ix
+        except (OSError, ValueError, MemoryError):
+            # ValueError: truncated payload; MemoryError: absurd on-disk
+            # count from a corrupt header
+            return None
+
+    def add(self, vec: np.ndarray, vid: int) -> None:
+        vec = np.ascontiguousarray(vec, np.float32)
+        with self._lock:
+            if vid in self._pos:
+                self._vecs[self._pos[vid]] = vec
+                return
+            self._pos[vid] = len(self._ids)
+            self._ids.append(vid)
+            self._vecs.append(vec)
+
+    def remove(self, vid: int) -> bool:
+        with self._lock:
+            row = self._pos.pop(vid, None)
+            if row is None:
+                return False
+            last = len(self._ids) - 1
+            if row != last:
+                self._vecs[row] = self._vecs[last]
+                self._ids[row] = self._ids[last]
+                self._pos[self._ids[row]] = row
+            self._vecs.pop()
+            self._ids.pop()
+            return True
+
+    def search(self, vec: np.ndarray, k: int):
+        with self._lock:
+            if not self._ids:
+                return [], []
+            mat = np.stack(self._vecs)
+            scores = mat @ np.asarray(vec, np.float32)
+            order = np.argsort(-scores)[:k]
+            return scores[order].tolist(), [self._ids[i] for i in order]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ids)
+
+    def save(self, path: str) -> None:
+        with self._lock:
+            n = len(self._ids)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(np.asarray([self._MAGIC, self._VERSION, self.dim],
+                                   np.uint32).tobytes())
+                f.write(np.asarray([n], np.uint64).tobytes())
+                f.write(np.asarray(self._ids, np.int64).tobytes())
+                if n:
+                    f.write(np.stack(self._vecs).astype(
+                        np.float32).tobytes())
+            os.replace(tmp, path)
+
+
+def make_index(dim: int) -> VectorIndex:
+    if _native.load() is not None:
+        return NativeVectorIndex(dim)
+    return NumpyVectorIndex(dim)
+
+
+def load_index(path: str) -> Optional[VectorIndex]:
+    ix = NativeVectorIndex.load(path) if _native.load() is not None else None
+    return ix if ix is not None else NumpyVectorIndex.load(path)
+
+
+# ---------------------------------------------------------------- cache
+
+class SemanticCache:
+    """check() before routing, store() after completion (non-streaming).
+
+    Request text = concatenated chat messages (role + content), matching
+    the reference's extraction (semantic_cache.py). Per-request knobs in
+    the body mirror the reference integration: ``skip_cache: true``
+    bypasses, ``cache_similarity_threshold`` overrides the default.
+    """
+
+    INDEX_FILE = "semantic_index.bin"
+    META_FILE = "semantic_meta.json"
+
+    def __init__(self, embedder: Optional[Embedder] = None,
+                 threshold: float = DEFAULT_SIMILARITY_THRESHOLD,
+                 max_entries: int = 4096,
+                 persist_dir: Optional[str] = None):
+        self.embedder = embedder or HashingEmbedder()
+        self.threshold = threshold
+        self.max_entries = max_entries
+        self.persist_dir = persist_dir
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+        self._meta: Dict[int, dict] = {}
+        self._order: List[int] = []          # insertion order for eviction
+        self._ids = itertools.count()
+        self.index: VectorIndex = make_index(self.embedder.dim)
+        if persist_dir:
+            self._load_persisted()
+
+    # -- request plumbing ------------------------------------------------
+
+    @staticmethod
+    def request_text(body: dict) -> Optional[str]:
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            return None
+        parts = []
+        for m in messages:
+            if not isinstance(m, dict):
+                return None
+            content = m.get("content", "")
+            if isinstance(content, list):  # multimodal: text parts only
+                content = " ".join(p.get("text", "") for p in content
+                                   if isinstance(p, dict))
+            parts.append(f"{m.get('role', '')}: {content}")
+        return "\n".join(parts)
+
+    @staticmethod
+    def _cacheable(body: dict) -> bool:
+        return not body.get("stream") and not body.get("skip_cache")
+
+    # -- core ------------------------------------------------------------
+
+    def check(self, body: dict) -> Optional[dict]:
+        """Cached response for a semantically-equivalent request, or None."""
+        if not self._cacheable(body):
+            return None
+        text = self.request_text(body)
+        if text is None:
+            return None
+        threshold = float(body.get("cache_similarity_threshold",
+                                   self.threshold))
+        t0 = time.monotonic()
+        vec = self.embedder.embed(text)
+        # k > 1: in multi-model deployments the global nearest neighbor may
+        # belong to another model; take the best same-model hit instead
+        scores, ids = self.index.search(vec, 8)
+        self.last_lookup_s = time.monotonic() - t0
+        for score, vid in zip(scores, ids):
+            if score < threshold:
+                break
+            with self._lock:
+                meta = self._meta.get(vid)
+            if meta is not None and meta.get("model") == body.get("model"):
+                self.hits += 1
+                response = dict(meta["response"])
+                response["cached"] = True
+                return response
+        self.misses += 1
+        return None
+
+    def store(self, body: dict, response: dict) -> bool:
+        if not self._cacheable(body):
+            return False
+        text = self.request_text(body)
+        if text is None:
+            return False
+        vec = self.embedder.embed(text)
+        with self._lock:
+            vid = next(self._ids)
+            self._meta[vid] = {"model": body.get("model"),
+                               "response": response}
+            self._order.append(vid)
+            evict = []
+            while len(self._order) > self.max_entries:
+                old = self._order.pop(0)
+                self._meta.pop(old, None)
+                evict.append(old)
+        self.index.add(vec, vid)
+        for old in evict:
+            self.index.remove(old)
+        return True
+
+    # -- persistence -----------------------------------------------------
+
+    def persist(self) -> None:
+        if not self.persist_dir:
+            return
+        os.makedirs(self.persist_dir, exist_ok=True)
+        self.index.save(os.path.join(self.persist_dir, self.INDEX_FILE))
+        tmp = os.path.join(self.persist_dir, self.META_FILE + ".tmp")
+        with self._lock:
+            payload = {"next_id": next(self._ids),
+                       "order": self._order,
+                       "meta": {str(k): v for k, v in self._meta.items()}}
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, os.path.join(self.persist_dir, self.META_FILE))
+
+    def _load_persisted(self) -> None:
+        index_path = os.path.join(self.persist_dir, self.INDEX_FILE)
+        meta_path = os.path.join(self.persist_dir, self.META_FILE)
+        if not (os.path.exists(index_path) and os.path.exists(meta_path)):
+            return
+        try:
+            with open(meta_path) as f:
+                payload = json.load(f)
+            loaded = load_index(index_path)
+            if loaded is None:
+                return
+            if loaded.dim != self.embedder.dim:
+                logger.warning(
+                    "semantic cache restore skipped: persisted index dim "
+                    "%d != embedder dim %d (embedder changed?)",
+                    loaded.dim, self.embedder.dim)
+                return
+            self.index = loaded
+            self._meta = {int(k): v for k, v in payload["meta"].items()}
+            self._order = list(payload["order"])
+            self._ids = itertools.count(int(payload["next_id"]))
+            logger.info("semantic cache restored: %d entries",
+                        len(self._meta))
+        except (OSError, ValueError, KeyError) as e:
+            logger.warning("semantic cache restore failed: %s", e)
+
+    # -- metrics ---------------------------------------------------------
+
+    @property
+    def hit_ratio(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._meta)

@@ -31,6 +31,7 @@ class FakeEngine:
             "vllm:gpu_prefix_cache_hit_rate": 0.0,
         }
         self.requests_seen = []          # (path, user header, model)
+        self.last_chat_body = ""         # raw JSON of the last chat request
         self._in_flight = 0
 
     def build_app(self) -> web.Application:
@@ -48,6 +49,7 @@ class FakeEngine:
 
     async def chat(self, request: web.Request) -> web.StreamResponse:
         body = await request.json()
+        self.last_chat_body = json.dumps(body)
         self.requests_seen.append(
             ("/v1/chat/completions", request.headers.get("x-user-id"),
              body.get("model")))

@@ -1,0 +1,214 @@
+"""Semantic cache tests: hashing embedder, native/numpy FlatIP index
+parity, cache check/store semantics, and router short-circuit e2e
+(reference surface: src/vllm_router/experimental/semantic_cache/)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.kvcache._native import load as load_native
+from production_stack_tpu.router.semantic_cache import (HashingEmbedder,
+                                                        NativeVectorIndex,
+                                                        NumpyVectorIndex,
+                                                        SemanticCache)
+from production_stack_tpu.router.app import build_app, parse_args
+from tests.fake_engine import FakeEngine
+
+# ---------------------------------------------------------------- embedder
+
+
+def test_hashing_embedder_properties():
+    emb = HashingEmbedder(dim=256)
+    a = emb.embed("What is the capital of France?")
+    b = emb.embed("What is the capital of France?")
+    c = emb.embed("What is the capital of   france?")   # case/space folding
+    d = emb.embed("Write me a sorting algorithm in C++")
+    assert np.allclose(a, b)                    # deterministic
+    assert abs(float(a @ a) - 1.0) < 1e-5       # L2-normalized
+    assert float(a @ c) > 0.95                  # near-identical text
+    assert float(a @ d) < 0.5                   # unrelated text
+
+
+# ---------------------------------------------------------------- index
+
+
+def _index_contract(ix):
+    emb = HashingEmbedder(dim=64)
+    va, vb = emb.embed("alpha beta"), emb.embed("totally different words")
+    ix.add(va, 1)
+    ix.add(vb, 2)
+    assert len(ix) == 2
+    scores, ids = ix.search(va, 2)
+    assert ids[0] == 1 and scores[0] > 0.99
+    assert ids[1] == 2 and scores[1] < scores[0]
+    assert ix.remove(1)
+    assert not ix.remove(1)
+    scores, ids = ix.search(va, 2)
+    assert ids == [2]
+    assert len(ix) == 1
+
+
+def test_numpy_index_contract():
+    _index_contract(NumpyVectorIndex(64))
+
+
+def test_native_index_contract():
+    if load_native() is None:
+        pytest.skip("libpskv.so not built")
+    _index_contract(NativeVectorIndex(64))
+
+
+@pytest.mark.parametrize("cls", [NumpyVectorIndex, NativeVectorIndex])
+def test_index_save_load_cross_impl(cls, tmp_path):
+    """Both impls write the same format; each can load the other's file."""
+    if load_native() is None:
+        pytest.skip("libpskv.so not built")
+    emb = HashingEmbedder(dim=32)
+    ix = cls(32)
+    for i, text in enumerate(["one", "two", "three"]):
+        ix.add(emb.embed(text), i)
+    path = str(tmp_path / "ix.bin")
+    ix.save(path)
+    other_cls = NumpyVectorIndex if cls is NativeVectorIndex \
+        else NativeVectorIndex
+    loaded = other_cls.load(path)
+    assert loaded is not None and len(loaded) == 3
+    scores, ids = loaded.search(emb.embed("two"), 1)
+    assert ids == [1] and scores[0] > 0.99
+
+
+# ---------------------------------------------------------------- cache
+
+
+def _chat_body(text, model="m-a", **kw):
+    return {"model": model,
+            "messages": [{"role": "user", "content": text}], **kw}
+
+
+RESPONSE = {"id": "chatcmpl-1", "choices": [
+    {"message": {"role": "assistant", "content": "Paris."}}]}
+
+
+def test_cache_check_store_roundtrip():
+    cache = SemanticCache(threshold=0.9)
+    body = _chat_body("What is the capital of France?")
+    assert cache.check(body) is None
+    assert cache.store(body, RESPONSE)
+    hit = cache.check(body)
+    assert hit is not None and hit["cached"] is True
+    assert hit["choices"] == RESPONSE["choices"]
+    # near-identical phrasing still hits (hashing embedder, low threshold)
+    assert cache.check(_chat_body("what is the capital of  FRANCE?"))
+    # unrelated misses
+    assert cache.check(_chat_body("Write a C++ sorting algorithm")) is None
+    assert cache.hits == 2 and cache.misses == 2
+
+
+def test_cache_model_and_knob_semantics():
+    cache = SemanticCache(threshold=0.9)
+    body = _chat_body("hello world, how are you today?")
+    cache.store(body, RESPONSE)
+    # different model never hits another model's cache entry
+    assert cache.check(_chat_body("hello world, how are you today?",
+                                  model="other")) is None
+    # per-request threshold override (1.01 is unreachable)
+    assert cache.check(_chat_body("hello world, how are you today?",
+                                  cache_similarity_threshold=1.01)) is None
+    # streaming + skip_cache bypass entirely
+    assert cache.check(_chat_body("hello world, how are you today?",
+                                  stream=True)) is None
+    assert not cache.store(_chat_body("x", stream=True), RESPONSE)
+    assert cache.check(_chat_body("hello world, how are you today?",
+                                  skip_cache=True)) is None
+
+
+def test_cache_eviction_bound():
+    cache = SemanticCache(threshold=0.99, max_entries=3)
+    for i in range(5):
+        cache.store(_chat_body(f"unique prompt number {i} xyz"), RESPONSE)
+    assert len(cache) == 3
+    assert len(cache.index) == 3
+
+
+def test_cache_persistence(tmp_path):
+    d = str(tmp_path)
+    cache = SemanticCache(threshold=0.9, persist_dir=d)
+    cache.store(_chat_body("persist me across restarts"), RESPONSE)
+    cache.persist()
+    restored = SemanticCache(threshold=0.9, persist_dir=d)
+    assert len(restored) == 1
+    assert restored.check(_chat_body("persist me across restarts"))
+
+
+def test_cache_restore_skips_dim_mismatch(tmp_path):
+    d = str(tmp_path)
+    cache = SemanticCache(embedder=HashingEmbedder(dim=128), persist_dir=d)
+    cache.store(_chat_body("some prompt"), RESPONSE)
+    cache.persist()
+    restored = SemanticCache(embedder=HashingEmbedder(dim=256),
+                             persist_dir=d)
+    assert len(restored) == 0            # skipped, not crashed/corrupted
+    assert restored.check(_chat_body("some prompt")) is None
+
+
+def test_corrupt_index_file_is_rejected(tmp_path):
+    from production_stack_tpu.router.semantic_cache import load_index
+    path = str(tmp_path / "bad.bin")
+    # valid magic/version/dim but an absurd count with no payload
+    with open(path, "wb") as f:
+        f.write(np.asarray([0x50535649, 1, 64], np.uint32).tobytes())
+        f.write(np.asarray([2 ** 40], np.uint64).tobytes())
+    assert load_index(path) is None      # rejected, process survives
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    assert load_index(path) is None
+
+
+def test_cache_multi_model_neighbor_does_not_mask():
+    cache = SemanticCache(threshold=0.9)
+    prompt = "what is the answer to everything?"
+    cache.store(_chat_body(prompt, model="model-b"),
+                {"choices": [{"message": {"content": "B says 42"}}]})
+    cache.store(_chat_body(prompt, model="m-a"), RESPONSE)
+    hit = cache.check(_chat_body(prompt, model="m-a"))
+    assert hit is not None
+    assert hit["choices"] == RESPONSE["choices"]   # not model-b's entry
+
+
+# ---------------------------------------------------------------- router e2e
+
+
+def test_router_semantic_cache_short_circuit():
+    async def body():
+        fake = FakeEngine(model="m-a")
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url, "--static-models", "m-a",
+            "--feature-gates", "SemanticCache=true",
+            "--semantic-cache-threshold", "0.9"])
+        app = build_app(args)
+        async with TestClient(TestServer(app)) as client:
+            req = {"model": "m-a",
+                   "messages": [{"role": "user",
+                                 "content": "what is two plus two?"}]}
+            r1 = await client.post("/v1/chat/completions", json=req)
+            assert r1.status == 200
+            first = await r1.json()
+            assert len(fake.requests_seen) == 1
+
+            r2 = await client.post("/v1/chat/completions", json=req)
+            second = await r2.json()
+            assert len(fake.requests_seen) == 1       # served from cache
+            assert second["cached"] is True
+            assert second["choices"] == first["choices"]
+
+            m = await (await client.get("/metrics")).text()
+            assert "vllm:semantic_cache_hits 1.0" in m
+            assert "vllm:semantic_cache_size 1.0" in m
+        await server.close()
+    asyncio.run(body())
