@@ -148,16 +148,19 @@ void *psvi_load(const char *path) {
               fread(&version, 4, 1, f) == 1 && version == kVecVersion &&
               fread(&dim, 4, 1, f) == 1 && dim > 0 &&
               fread(&n, 8, 1, f) == 1;
-    // never trust the on-disk count: the payload must be exactly
-    // n * (id + dim floats) bytes, or resize() below could throw
-    // bad_alloc through the C ABI and abort the loading process
+    // never trust the on-disk count: derive it from the payload size by
+    // division (a multiply of the stored n could wrap uint64 and dodge
+    // the check), or resize() below could throw through the C ABI and
+    // abort the loading process
     if (ok) {
+        const uint64_t per_item =
+            sizeof(int64_t) + (uint64_t)dim * sizeof(float);
         long payload_start = ftell(f);
         ok = payload_start >= 0 && fseek(f, 0, SEEK_END) == 0;
         long end = ftell(f);
         ok = ok && end >= payload_start &&
-             (uint64_t)(end - payload_start) ==
-                 n * (sizeof(int64_t) + (uint64_t)dim * sizeof(float)) &&
+             (uint64_t)(end - payload_start) % per_item == 0 &&
+             (uint64_t)(end - payload_start) / per_item == n &&
              fseek(f, payload_start, SEEK_SET) == 0;
     }
     if (!ok) { fclose(f); return nullptr; }

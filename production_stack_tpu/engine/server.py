@@ -24,7 +24,8 @@ from production_stack_tpu import protocol as proto
 from production_stack_tpu.engine.async_engine import AsyncLLMEngine
 from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.scheduler import SamplingOptions
-from production_stack_tpu.utils import init_logger, set_ulimit
+from production_stack_tpu.utils import (honor_platform_env, init_logger,
+                                          set_ulimit)
 from production_stack_tpu.version import __version__
 
 logger = init_logger(__name__)
@@ -289,6 +290,7 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 def main(argv=None) -> None:
     args = parse_args(argv)
+    honor_platform_env()
     set_ulimit()
     kv_transfer = json.loads(args.kv_transfer_config) \
         if args.kv_transfer_config else None

@@ -77,22 +77,52 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
+def _make(*make_args: str) -> bool:
+    if os.environ.get("PSKV_NO_BUILD"):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR, *make_args],
+                       capture_output=True, timeout=120, check=True)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
 def load() -> Optional[ctypes.CDLL]:
     """The loaded library, or None when unavailable (cached)."""
     global _lib, _load_failed
     with _lock:
         if _lib is not None or _load_failed:
             return _lib
-        if not os.path.exists(_LIB_PATH) and \
-                not os.environ.get("PSKV_NO_BUILD"):
-            try:
-                subprocess.run(["make", "-C", _NATIVE_DIR,
-                                "build/libpskv.so"],
-                               capture_output=True, timeout=120, check=True)
-            except (OSError, subprocess.SubprocessError):
-                pass
+        if not os.path.exists(_LIB_PATH):
+            _make("build/libpskv.so")
         try:
             _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except AttributeError:
+            # .so predates a symbol we now bind (build dir is gitignored,
+            # so a stale library survives checkouts): force a rebuild and
+            # retry once. dlopen caches by path, so the retry must map
+            # the rebuilt library from a fresh temp copy (unlinking a
+            # mapped .so is safe on Linux).
+            if _make("-B", "build/libpskv.so"):
+                import shutil
+                import tempfile
+
+                fd, tmp = tempfile.mkstemp(suffix=".so", prefix="libpskv-")
+                os.close(fd)
+                try:
+                    shutil.copyfile(_LIB_PATH, tmp)
+                    _lib = _configure(ctypes.CDLL(tmp))
+                except (OSError, AttributeError):
+                    _lib = None
+                    _load_failed = True
+                finally:
+                    try:
+                        os.unlink(tmp)
+                    except OSError:
+                        pass
+            else:
+                _load_failed = True
         except OSError:
             _load_failed = True
         return _lib

@@ -44,6 +44,8 @@ class RouterMetrics:
                                         "Semantic cache hit ratio")
         self.semantic_size = plain("vllm:semantic_cache_size",
                                    "Semantic cache entries")
+        self.semantic_latency = plain("vllm:semantic_cache_latency",
+                                      "Last semantic cache lookup seconds")
         # PII surface (reference: pii/middleware.py:20-39 counters)
         self.pii_scanned = plain("vllm:pii_requests_scanned",
                                  "Requests scanned for PII")
@@ -80,6 +82,7 @@ class RouterMetrics:
         self.semantic_misses.set(cache.misses)
         self.semantic_hit_ratio.set(cache.hit_ratio)
         self.semantic_size.set(len(cache))
+        self.semantic_latency.set(cache.last_lookup_s)
 
     def refresh_pii(self, middleware) -> None:
         self.pii_scanned.set(middleware.scanned)

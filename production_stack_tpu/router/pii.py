@@ -17,6 +17,7 @@ Microsoft Presidio) to slot in later; the regex analyzer is the
 dependency-free default, as in the reference.
 """
 
+import asyncio
 import enum
 import json
 import re
@@ -79,6 +80,13 @@ class PIIAnalyzer(ABC):
         ...
 
 
+def _iban_ok(candidate: str) -> bool:
+    """ISO 7064 mod-97 check (same false-positive cut as Luhn for cards)."""
+    s = candidate[4:] + candidate[:4]
+    num = "".join(str(int(c, 36)) for c in s)
+    return int(num) % 97 == 1
+
+
 def _luhn_ok(digits: str) -> bool:
     total, alt = 0, False
     for ch in reversed(digits):
@@ -121,16 +129,21 @@ class RegexPIIAnalyzer(PIIAnalyzer):
         PIIType.BANK_ACCOUNT:
             r"(?i)\b(?:account|acct)\.?\s*(?:number|no|#)?\s*[:=]?\s*"
             r"\d{8,17}\b",
+        # keyword-prefixed IDs: the keyword is case-insensitive but the ID
+        # token is uppercase-or-digit WITH at least one digit, so plain
+        # English after the keyword ("passport yesterday", "dl speed")
+        # never matches
         PIIType.PASSPORT:
-            r"(?i)\bpassport\s*(?:number|no|#)?\s*[:=]?\s*[A-Z0-9]{6,9}\b",
+            r"\b(?i:passport)\s*(?:(?i:number|no)|#)?\s*[:=]?\s*"
+            r"(?=[A-Z0-9]*\d)[A-Z0-9]{6,9}\b",
         PIIType.DRIVERS_LICENSE:
-            r"(?i)\b(?:driver'?s?\s+licen[cs]e|dl)\s*(?:number|no|#)?"
-            r"\s*[:=]?\s*[A-Z0-9]{5,13}\b",
+            r"\b(?i:driver'?s?\s+licen[cs]e|dl)\s*(?:(?i:number|no)|#)?"
+            r"\s*[:=]?\s*(?=[A-Z0-9]*\d)[A-Z0-9]{5,13}\b",
         PIIType.TAX_ID:
             r"\b\d{2}-\d{7}\b",
         PIIType.MEDICAL_RECORD:
-            r"(?i)\b(?:mrn|medical\s+record\s*(?:number|no|#)?)\s*[:=]?"
-            r"\s*[A-Z0-9]{6,12}\b",
+            r"\b(?i:mrn|medical\s+record\s*(?:(?i:number|no)|#)?)"
+            r"\s*[:=]?\s*(?=[A-Z0-9]*\d)[A-Z0-9]{6,12}\b",
         PIIType.MAC_ADDRESS:
             r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b",
         PIIType.DOB:
@@ -156,6 +169,8 @@ class RegexPIIAnalyzer(PIIAnalyzer):
                     digits = re.sub(r"\D", "", m.group())
                     if not (13 <= len(digits) <= 19 and _luhn_ok(digits)):
                         continue
+                if pii_type == PIIType.IBAN and not _iban_ok(m.group()):
+                    continue
                 result.detected = True
                 result.types.add(pii_type)
                 result.matches.append(PIIMatch(pii_type, m.start(), m.end(),
@@ -269,13 +284,28 @@ class PIIMiddleware:
     for non-scanned paths).
     """
 
-    def __init__(self, config: PIIConfig, metrics=None):
+    def __init__(self, config: PIIConfig):
         self.config = config
         self.analyzer = make_analyzer(config.analyzer)
-        self.metrics = metrics
         self.scanned = 0
         self.blocked = 0
         self.redacted = 0
+
+    def _scan(self, body: dict):
+        """Analyze (and under REDACT, mutate) the body. Pure CPU work —
+        called via run_in_executor so multi-MB prompts never stall the
+        event loop (same treatment as the semantic cache's embed)."""
+        detected_types: Set[PIIType] = set()
+        mutated = False
+        for text, path in _extract_texts(body):
+            result = self.analyzer.analyze(text, self.config.types)
+            if not result.detected:
+                continue
+            detected_types |= result.types
+            if self.config.action == PIIAction.REDACT:
+                _apply_redaction(body, path, redact(text, result.matches))
+                mutated = True
+        return detected_types, mutated
 
     @web.middleware
     async def middleware(self, request: web.Request, handler):
@@ -284,22 +314,18 @@ class PIIMiddleware:
             return await handler(request)
         try:
             raw = await request.read()
-            body = json.loads(raw) if raw else {}
+            try:
+                body = json.loads(raw) if raw else {}
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                # malformed client JSON is not an analyzer failure: let
+                # the proxy produce its invalid_request_error
+                return await handler(request)
             if not isinstance(body, dict):
                 return await handler(request)
-            texts = _extract_texts(body)
             self.scanned += 1
-            detected_types: Set[PIIType] = set()
-            mutated = False
-            for text, path in texts:
-                result = self.analyzer.analyze(text, self.config.types)
-                if not result.detected:
-                    continue
-                detected_types |= result.types
-                if self.config.action == PIIAction.REDACT:
-                    _apply_redaction(body, path,
-                                     redact(text, result.matches))
-                    mutated = True
+            detected_types, mutated = \
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._scan, body)
             if detected_types and self.config.action == PIIAction.BLOCK:
                 self.blocked += 1
                 logger.warning("blocked request with PII: %s",

@@ -26,6 +26,8 @@ HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
                # must not leak through in either direction
                "accept-encoding", "content-encoding"}
 
+_CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
+
 
 def _forward_headers(request: web.Request) -> dict:
     return {k: v for k, v in request.headers.items()
@@ -77,6 +79,13 @@ async def route_general_request(request: web.Request,
         if cached is not None:
             return web.json_response(cached)
 
+    # router-level cache knobs are not OpenAI fields: strip them from the
+    # forwarded bytes (strict backends reject unknown params) while the
+    # local `body` keeps them for the store/capture decision below
+    if any(k in body for k in _CACHE_CONTROL_FIELDS):
+        raw = json.dumps({k: v for k, v in body.items()
+                          if k not in _CACHE_CONTROL_FIELDS}).encode()
+
     endpoints = [ep for ep in state["discovery"].get_endpoints()
                  if ep.serves(model)]
     if not endpoints:
@@ -109,7 +118,7 @@ async def route_general_request(request: web.Request,
             # capture the body for the semantic cache only when this
             # response is storable (non-streaming 200 on the chat path)
             capture = (check_cache and backend.status == 200
-                       and not body.get("stream"))
+                       and semantic_cache.cacheable(body))
             captured = bytearray() if capture else None
             first = True
             async for chunk in backend.content.iter_any():

@@ -200,7 +200,10 @@ class NumpyVectorIndex(VectorIndex):
 
     def __init__(self, dim: int):
         self.dim = dim
-        self._vecs: List[np.ndarray] = []
+        # contiguous row matrix grown by doubling: search is a single
+        # matvec, no per-query stack/copy (mirrors the native buffer)
+        self._data = np.empty((16, dim), np.float32)
+        self._n = 0
         self._ids: List[int] = []
         self._pos: Dict[int, int] = {}
         self._lock = threading.Lock()
@@ -209,7 +212,10 @@ class NumpyVectorIndex(VectorIndex):
     def load(cls, path: str) -> Optional["NumpyVectorIndex"]:
         try:
             with open(path, "rb") as f:
-                hdr = np.frombuffer(f.read(12), np.uint32)
+                raw = f.read(12)
+                if len(raw) < 12:  # truncated header
+                    return None
+                hdr = np.frombuffer(raw, np.uint32)
                 if hdr[0] != cls._MAGIC or hdr[1] != cls._VERSION:
                     return None
                 dim = int(hdr[2])
@@ -222,51 +228,57 @@ class NumpyVectorIndex(VectorIndex):
             for i in range(n):
                 ix.add(data[i], int(ids[i]))
             return ix
-        except (OSError, ValueError, MemoryError):
-            # ValueError: truncated payload; MemoryError: absurd on-disk
-            # count from a corrupt header
+        except (OSError, ValueError, MemoryError, OverflowError):
+            # ValueError: truncated payload; MemoryError/OverflowError:
+            # absurd on-disk count from a corrupt header
             return None
 
     def add(self, vec: np.ndarray, vid: int) -> None:
         vec = np.ascontiguousarray(vec, np.float32)
         with self._lock:
-            if vid in self._pos:
-                self._vecs[self._pos[vid]] = vec
+            row = self._pos.get(vid)
+            if row is not None:
+                self._data[row] = vec
                 return
-            self._pos[vid] = len(self._ids)
+            if self._n == len(self._data):
+                grown = np.empty((2 * len(self._data), self.dim),
+                                 np.float32)
+                grown[:self._n] = self._data[:self._n]
+                self._data = grown
+            self._data[self._n] = vec
+            self._pos[vid] = self._n
             self._ids.append(vid)
-            self._vecs.append(vec)
+            self._n += 1
 
     def remove(self, vid: int) -> bool:
         with self._lock:
             row = self._pos.pop(vid, None)
             if row is None:
                 return False
-            last = len(self._ids) - 1
+            last = self._n - 1
             if row != last:
-                self._vecs[row] = self._vecs[last]
+                self._data[row] = self._data[last]
                 self._ids[row] = self._ids[last]
                 self._pos[self._ids[row]] = row
-            self._vecs.pop()
             self._ids.pop()
+            self._n = last
             return True
 
     def search(self, vec: np.ndarray, k: int):
         with self._lock:
-            if not self._ids:
+            if not self._n:
                 return [], []
-            mat = np.stack(self._vecs)
-            scores = mat @ np.asarray(vec, np.float32)
+            scores = self._data[:self._n] @ np.asarray(vec, np.float32)
             order = np.argsort(-scores)[:k]
             return scores[order].tolist(), [self._ids[i] for i in order]
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._ids)
+            return self._n
 
     def save(self, path: str) -> None:
         with self._lock:
-            n = len(self._ids)
+            n = self._n
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(np.asarray([self._MAGIC, self._VERSION, self.dim],
@@ -274,8 +286,7 @@ class NumpyVectorIndex(VectorIndex):
                 f.write(np.asarray([n], np.uint64).tobytes())
                 f.write(np.asarray(self._ids, np.int64).tobytes())
                 if n:
-                    f.write(np.stack(self._vecs).astype(
-                        np.float32).tobytes())
+                    f.write(self._data[:n].tobytes())
             os.replace(tmp, path)
 
 
@@ -314,6 +325,7 @@ class SemanticCache:
         self.persist_dir = persist_dir
         self.hits = 0
         self.misses = 0
+        self.last_lookup_s = 0.0
         self._lock = threading.Lock()
         self._meta: Dict[int, dict] = {}
         self._order: List[int] = []          # insertion order for eviction
@@ -341,14 +353,20 @@ class SemanticCache:
         return "\n".join(parts)
 
     @staticmethod
-    def _cacheable(body: dict) -> bool:
-        return not body.get("stream") and not body.get("skip_cache")
+    def cacheable(body: dict) -> bool:
+        """Only plain single-choice text completions are cacheable: a
+        cached answer can't honor tools / response_format / n>1 /
+        logprobs, so requests carrying them must always reach an engine."""
+        return not (body.get("stream") or body.get("skip_cache")
+                    or body.get("tools") or body.get("tool_choice")
+                    or body.get("response_format") or body.get("logprobs")
+                    or body.get("n", 1) != 1)
 
     # -- core ------------------------------------------------------------
 
     def check(self, body: dict) -> Optional[dict]:
         """Cached response for a semantically-equivalent request, or None."""
-        if not self._cacheable(body):
+        if not self.cacheable(body):
             return None
         text = self.request_text(body)
         if text is None:
@@ -360,22 +378,27 @@ class SemanticCache:
         # k > 1: in multi-model deployments the global nearest neighbor may
         # belong to another model; take the best same-model hit instead
         scores, ids = self.index.search(vec, 8)
-        self.last_lookup_s = time.monotonic() - t0
+        # check() runs on executor threads: counter read-modify-writes
+        # must hold the lock or concurrent lookups lose increments
+        with self._lock:
+            self.last_lookup_s = time.monotonic() - t0
         for score, vid in zip(scores, ids):
             if score < threshold:
                 break
             with self._lock:
                 meta = self._meta.get(vid)
             if meta is not None and meta.get("model") == body.get("model"):
-                self.hits += 1
+                with self._lock:
+                    self.hits += 1
                 response = dict(meta["response"])
                 response["cached"] = True
                 return response
-        self.misses += 1
+        with self._lock:
+            self.misses += 1
         return None
 
     def store(self, body: dict, response: dict) -> bool:
-        if not self._cacheable(body):
+        if not self.cacheable(body):
             return False
         text = self.request_text(body)
         if text is None:
@@ -383,6 +406,12 @@ class SemanticCache:
         vec = self.embedder.embed(text)
         with self._lock:
             vid = next(self._ids)
+        # the vector must be in the index BEFORE vid is registered in
+        # _order: a concurrent store() may evict vid the moment it is
+        # registered, and index.remove of a not-yet-added vid would no-op,
+        # orphaning the vector forever
+        self.index.add(vec, vid)
+        with self._lock:
             self._meta[vid] = {"model": body.get("model"),
                                "response": response}
             self._order.append(vid)
@@ -391,7 +420,6 @@ class SemanticCache:
                 old = self._order.pop(0)
                 self._meta.pop(old, None)
                 evict.append(old)
-        self.index.add(vec, vid)
         for old in evict:
             self.index.remove(old)
         return True

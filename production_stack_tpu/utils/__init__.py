@@ -1,6 +1,7 @@
 from production_stack_tpu.utils.log import init_logger
 from production_stack_tpu.utils.misc import (
     SingletonMeta,
+    honor_platform_env,
     parse_comma_separated,
     parse_static_aliases,
     parse_static_model_types,
@@ -11,6 +12,7 @@ from production_stack_tpu.utils.misc import (
 
 __all__ = [
     "init_logger",
+    "honor_platform_env",
     "SingletonMeta",
     "validate_url",
     "set_ulimit",

@@ -90,3 +90,25 @@ def parse_static_aliases(value: Optional[str]) -> Dict[str, str]:
         alias, model = pair.split(":", 1)
         aliases[alias.strip()] = model.strip()
     return aliases
+
+
+def honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative before backend init.
+
+    The environment may register extra PJRT plugins via sitecustomize
+    (e.g. a TPU tunnel) that import jax early with their own platform
+    baked in, so the env var alone loses platform selection. Entry
+    points call this before any jax computation; no-op once backends
+    are initialized or when the env var is unset.
+    """
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    except Exception as e:  # backends already initialized
+        logger.warning("could not pin jax platform to %s: %s", want, e)

@@ -40,6 +40,11 @@ def test_regex_analyzer_detects(text, expected):
     "card: 4111 1111 1111 1112",          # fails Luhn
     "version 1.2.3.4567 released",        # not an IP (last octet > 255)
     "meet at 10:30 in room 42",
+    # keyword + plain English must not trip keyword-prefixed ID patterns
+    "I lost my passport yesterday",
+    "the dl speed is great today",
+    "please check my medical record tomorrow",
+    "SN29CEB7Q4X8K2M1P is the serial",    # IBAN shape, fails mod-97
 ])
 def test_regex_analyzer_clean_text(text):
     result = ANALYZER.analyze(text)
@@ -120,6 +125,28 @@ def test_router_blocks_pii():
             m = await (await client.get("/metrics")).text()
             assert "vllm:pii_requests_scanned 2.0" in m
             assert "vllm:pii_requests_blocked 1.0" in m
+        await server.close()
+    asyncio.run(body())
+
+
+def test_malformed_json_is_not_counted_as_pii_block():
+    async def body():
+        fake = FakeEngine(model="m-a")
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+        app = build_app(_args(url))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post(
+                "/v1/chat/completions", data=b"not json",
+                headers={"Content-Type": "application/json"})
+            assert r.status == 400
+            err = await r.json()
+            # the proxy's invalid-body error, not a PII analyzer error
+            assert err["error"].get("code") != "pii_analysis_error"
+            assert err["error"]["type"] == "invalid_request_error"
+            m = await (await client.get("/metrics")).text()
+            assert "vllm:pii_requests_blocked 0.0" in m
         await server.close()
     asyncio.run(body())
 

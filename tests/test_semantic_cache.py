@@ -164,6 +164,16 @@ def test_corrupt_index_file_is_rejected(tmp_path):
     with open(path, "wb") as f:
         f.write(b"garbage")
     assert load_index(path) is None
+    # count chosen so n * (8 + 4*dim) wraps uint64 to exactly 0, matching
+    # the empty payload — must still be rejected (division, not multiply)
+    with open(path, "wb") as f:
+        f.write(np.asarray([0x50535649, 1, 2], np.uint32).tobytes())
+        f.write(np.asarray([2 ** 60], np.uint64).tobytes())
+    assert load_index(path) is None
+    # header truncated mid-field (magic + version only)
+    with open(path, "wb") as f:
+        f.write(np.asarray([0x50535649, 1], np.uint32).tobytes())
+    assert load_index(path) is None
 
 
 def test_cache_multi_model_neighbor_does_not_mask():
