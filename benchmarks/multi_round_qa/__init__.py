@@ -1,0 +1,18 @@
+"""Multi-round QA benchmark: the stack's canonical serving workload.
+
+Capability parity with reference benchmarks/multi-round-qa/ (704-line
+simulator, multi-round-qa.py): N concurrent users hold M-round chat
+sessions against an OpenAI-compatible endpoint at a target aggregate QPS,
+with long shared system prompts and per-user history to stress KV reuse
+and session-affinity routing. Re-designed as a single asyncio event loop
+(the reference runs an AsyncOpenAI client on a dedicated thread,
+utils.py:52-118); metrics semantics match ProcessSummary
+(multi-round-qa.py:435-514).
+"""
+
+from benchmarks.multi_round_qa.workload import (UserSession, SessionManager,
+                                                WorkloadConfig)
+from benchmarks.multi_round_qa.client import RequestResult, StreamingClient
+
+__all__ = ["WorkloadConfig", "UserSession", "SessionManager",
+           "StreamingClient", "RequestResult"]

@@ -1,0 +1,141 @@
+"""Streaming OpenAI-protocol client for the benchmark.
+
+Reference equivalent: RequestExecutor + AsyncLoopWrapper
+(multi-round-qa.py:117-176, utils.py:52-118) — an AsyncOpenAI client
+pinned to a helper thread. Here the whole benchmark is one asyncio loop,
+so the client is a plain aiohttp session with per-request SSE parsing;
+launch_request schedules a task and reports through a callback exactly
+like the reference's executor.
+"""
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import aiohttp
+
+
+@dataclass
+class RequestResult:
+    """Per-request measurement (reference Response dataclass)."""
+    body: str = ""
+    prompt_tokens: int = 0
+    generation_tokens: int = 0
+    launch_time: float = 0.0
+    ttft: float = 0.0
+    generation_time: float = 0.0
+    finish_time: float = 0.0
+    error: Optional[str] = None
+
+
+def _estimate_tokens(messages: List[dict]) -> int:
+    # whitespace tokenization — good enough when the server omits usage
+    return sum(len(str(m.get("content", "")).split()) for m in messages)
+
+
+class StreamingClient:
+    """Fires /v1/chat/completions streaming requests, measures TTFT and
+    generation throughput from SSE chunk arrival times."""
+
+    def __init__(self, base_url: str, model: str,
+                 api_key: Optional[str] = None,
+                 request_timeout: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.model = model
+        self.api_key = api_key
+        self.request_timeout = request_timeout
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._tasks: List[asyncio.Task] = []
+        self.in_flight = 0
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0))
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._session:
+            await self._session.close()
+
+    def launch_request(self, messages: List[dict], max_tokens: int,
+                       on_finish: Callable[[RequestResult], None],
+                       extra_headers: Optional[Dict[str, str]] = None
+                       ) -> None:
+        """Schedule a streaming request; `on_finish` runs on completion."""
+        task = asyncio.ensure_future(
+            self._run(list(messages), max_tokens, on_finish,
+                      dict(extra_headers or {})))
+        self._tasks.append(task)
+        # prune completed handles so long runs don't accumulate them
+        if len(self._tasks) > 4096:
+            self._tasks = [t for t in self._tasks if not t.done()]
+
+    async def _run(self, messages, max_tokens, on_finish, headers) -> None:
+        result = RequestResult(launch_time=time.time())
+        self.in_flight += 1
+        headers["Content-Type"] = "application/json"
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        payload = {"model": self.model, "messages": messages,
+                   "max_tokens": max_tokens, "stream": True,
+                   "stream_options": {"include_usage": True},
+                   "temperature": 0.0}
+        t0 = time.monotonic()
+        first_at: Optional[float] = None
+        chunks: List[str] = []
+        usage: Optional[dict] = None
+        try:
+            async with self._session.post(
+                    f"{self.base_url}/v1/chat/completions", json=payload,
+                    headers=headers,
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.request_timeout)) as resp:
+                if resp.status != 200:
+                    result.error = f"HTTP {resp.status}: " \
+                                   f"{(await resp.text())[:200]}"
+                else:
+                    async for raw_line in resp.content:
+                        line = raw_line.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        data = line[5:].strip()
+                        if data == "[DONE]":
+                            break
+                        try:
+                            chunk = json.loads(data)
+                        except json.JSONDecodeError:
+                            continue
+                        if chunk.get("usage"):
+                            usage = chunk["usage"]
+                        for choice in chunk.get("choices", []):
+                            delta = choice.get("delta") or {}
+                            if delta.get("content"):
+                                # TTFT = first actual token, not the
+                                # empty role-preamble chunk
+                                if first_at is None:
+                                    first_at = time.monotonic()
+                                chunks.append(delta["content"])
+        except asyncio.CancelledError:
+            raise
+        except (aiohttp.ClientError, ConnectionError, asyncio.TimeoutError,
+                OSError) as e:
+            result.error = f"{type(e).__name__}: {e}"
+        end = time.monotonic()
+        result.finish_time = time.time()
+        result.body = "".join(chunks)
+        result.ttft = (first_at - t0) if first_at is not None else end - t0
+        result.generation_time = max(end - (first_at or end), 1e-9)
+        if usage:
+            result.prompt_tokens = usage.get("prompt_tokens", 0)
+            result.generation_tokens = usage.get("completion_tokens",
+                                                 len(chunks))
+        else:
+            result.prompt_tokens = _estimate_tokens(messages)
+            result.generation_tokens = len(chunks)
+        self.in_flight -= 1
+        on_finish(result)

@@ -1,0 +1,109 @@
+"""Benchmark entry point.
+
+Usage (mirrors reference CLI, multi-round-qa.py argparse):
+
+    python -m benchmarks.multi_round_qa.main \
+        --base-url http://localhost:8000 --model llama-3.1-8b \
+        --num-users 15 --num-rounds 20 --qps 0.5 \
+        --shared-system-prompt 1000 --user-history-prompt 20000 \
+        --answer-len 100 --time 300 --output summary.csv
+
+Discrete 0.1 s simulation steps (reference sleeps the same cadence);
+``--time`` bounds the run; the summary window excludes the ramp-up
+portion via --init-duration.
+"""
+
+import argparse
+import asyncio
+import logging
+import time
+
+from benchmarks.multi_round_qa.client import StreamingClient
+from benchmarks.multi_round_qa.summary import summarize, write_csv
+from benchmarks.multi_round_qa.workload import SessionManager, WorkloadConfig
+
+logger = logging.getLogger("multi_round_qa")
+
+STEP_S = 0.1
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="multi-round QA benchmark")
+    p.add_argument("--base-url", required=True)
+    p.add_argument("--model", required=True)
+    p.add_argument("--api-key", default=None)
+    p.add_argument("--num-users", type=int, required=True)
+    p.add_argument("--num-rounds", type=int, required=True)
+    p.add_argument("--qps", type=float, required=True)
+    p.add_argument("--shared-system-prompt", type=int, default=1000,
+                   help="shared system prompt length (tokens)")
+    p.add_argument("--user-history-prompt", type=int, default=2000,
+                   help="per-user context length (tokens)")
+    p.add_argument("--answer-len", type=int, default=100)
+    p.add_argument("--time", type=float, default=None,
+                   help="wall-clock bound for the run (s)")
+    p.add_argument("--init-duration", type=float, default=0.0,
+                   help="exclude the first N seconds from the summary")
+    p.add_argument("--init-user-id", type=int, default=0)
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--output", default="summary.csv")
+    p.add_argument("--log-interval", type=float, default=30.0)
+    return p.parse_args(argv)
+
+
+async def run(args) -> int:
+    cfg = WorkloadConfig(
+        num_users=args.num_users, num_rounds=args.num_rounds, qps=args.qps,
+        system_prompt_len=args.shared_system_prompt,
+        user_history_len=args.user_history_prompt,
+        answer_len=args.answer_len, init_user_id=args.init_user_id)
+    logger.info("gap between users: %.2fs; per-user request gap: %.2fs",
+                cfg.gap_between_users, cfg.gap_between_requests)
+    manager = SessionManager(cfg, continuous=args.time is not None)
+    client = StreamingClient(args.base_url, args.model, args.api_key,
+                             args.request_timeout)
+    await client.start()
+    start = time.time()
+    last_log = start
+    try:
+        while True:
+            now = time.time()
+            if args.time is not None and now - start >= args.time:
+                break
+            manager.step(now, client)
+            if not manager.sessions and manager.done_sessions and \
+                    args.time is None:
+                break     # finite run: every session completed
+            if now - last_log >= args.log_interval:
+                done = len(manager.all_results())
+                logger.info("t=%.0fs active=%d finished_reqs=%d "
+                            "in_flight=%d", now - start,
+                            len(manager.sessions), done, client.in_flight)
+                last_log = now
+            await asyncio.sleep(STEP_S)
+        # drain in-flight requests briefly so their stats are counted
+        drain_until = time.time() + 10.0
+        while client.in_flight > 0 and time.time() < drain_until:
+            await asyncio.sleep(STEP_S)
+    finally:
+        pending = client.in_flight
+        results = manager.all_results()
+        await client.close()
+    window_start = start + args.init_duration if args.init_duration else None
+    s = summarize(results, pending, start_time=window_start)
+    s.print_table()
+    print(s.json_line())
+    write_csv(results, args.output)
+    logger.info("wrote %d request rows to %s", len(results), args.output)
+    return 0
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    return asyncio.run(run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

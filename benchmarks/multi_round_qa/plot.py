@@ -1,0 +1,72 @@
+"""Plot QPS-sweep results (reference plot.py equivalent).
+
+Reads the per-QPS CSVs written by the driver scripts
+(``summary_qps<q>.csv``) and plots mean TTFT and per-request generation
+throughput against offered QPS. matplotlib is optional: without it the
+script prints the table it would have plotted.
+"""
+
+import argparse
+import csv
+import glob
+import os
+import re
+
+
+def load_sweep(pattern: str):
+    rows = []
+    for path in sorted(glob.glob(pattern)):
+        m = re.search(r"qps([0-9.]+)\.csv$", os.path.basename(path))
+        if not m:
+            continue
+        qps = float(m.group(1).rstrip("."))
+        ttfts, speeds = [], []
+        with open(path) as f:
+            for rec in csv.DictReader(f):
+                if rec.get("error"):
+                    continue
+                ttfts.append(float(rec["ttft"]))
+                gt = float(rec["generation_time"])
+                if gt > 0:
+                    speeds.append(float(rec["generation_tokens"]) / gt)
+        if ttfts:
+            rows.append((qps, sum(ttfts) / len(ttfts),
+                         sum(speeds) / len(speeds) if speeds else 0.0))
+    return sorted(rows)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--pattern", default="summary_qps*.csv")
+    p.add_argument("--output", default="sweep.png")
+    args = p.parse_args(argv)
+    rows = load_sweep(args.pattern)
+    if not rows:
+        print(f"no files matched {args.pattern}")
+        return 1
+    print(f"{'QPS':>8} {'mean TTFT (s)':>14} {'tok/req/s':>10}")
+    for qps, ttft, speed in rows:
+        print(f"{qps:8.2f} {ttft:14.4f} {speed:10.2f}")
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; table printed above")
+        return 0
+    qs = [r[0] for r in rows]
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(10, 4))
+    ax1.plot(qs, [r[1] for r in rows], marker="o")
+    ax1.set_xlabel("offered QPS")
+    ax1.set_ylabel("mean TTFT (s)")
+    ax2.plot(qs, [r[2] for r in rows], marker="o")
+    ax2.set_xlabel("offered QPS")
+    ax2.set_ylabel("generation throughput (tok/req/s)")
+    fig.tight_layout()
+    fig.savefig(args.output, dpi=120)
+    print(f"wrote {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

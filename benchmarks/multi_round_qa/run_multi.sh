@@ -1,0 +1,28 @@
+#!/bin/bash
+# Multi-replica QPS sweep through the router (reference run.sh:14-84
+# config: warmup with 400 users, then 320 users x 10 rounds, QPS
+# 0.1 -> 4.1, session routing on x-user-id).
+set -euo pipefail
+
+BASE_URL="${1:?usage: run_multi.sh <router-url> <model>}"
+MODEL="${2:?usage: run_multi.sh <router-url> <model>}"
+KEY="${OPENAI_API_KEY:-}"
+
+# warmup: populate KV/prefix caches across replicas
+python -m benchmarks.multi_round_qa.main \
+  --base-url "$BASE_URL" --model "$MODEL" ${KEY:+--api-key "$KEY"} \
+  --num-users 400 --num-rounds 2 --qps 2.0 \
+  --shared-system-prompt 1000 --user-history-prompt 20000 \
+  --answer-len 20 --time 180 --output warmup.csv
+
+for qps in 0.1 0.5 1.1 1.7 2.3 2.9 3.5 4.1; do
+  python -m benchmarks.multi_round_qa.main \
+    --base-url "$BASE_URL" --model "$MODEL" ${KEY:+--api-key "$KEY"} \
+    --num-users 320 --num-rounds 10 --qps "$qps" \
+    --shared-system-prompt 1000 --user-history-prompt 20000 \
+    --answer-len 100 --time 300 --init-duration 60 \
+    --output "summary_qps${qps}.csv"
+  sleep 10
+done
+
+python -m benchmarks.multi_round_qa.plot --pattern 'summary_qps*.csv'

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Single-replica QPS sweep (reference run_single.sh:12-41 config:
+# 15 users x 20 rounds, 1000-token system prompt, 20000-token history,
+# 100-token answers, QPS 0.1 -> 1.1).
+set -euo pipefail
+
+BASE_URL="${1:?usage: run_single.sh <base-url> <model>}"
+MODEL="${2:?usage: run_single.sh <base-url> <model>}"
+KEY="${OPENAI_API_KEY:-}"
+
+run_bench() {
+  qps=$1
+  out="summary_qps${qps}.csv"
+  python -m benchmarks.multi_round_qa.main \
+    --base-url "$BASE_URL" --model "$MODEL" ${KEY:+--api-key "$KEY"} \
+    --num-users 15 --num-rounds 20 --qps "$qps" \
+    --shared-system-prompt 1000 --user-history-prompt 20000 \
+    --answer-len 100 --time 300 --init-duration 60 --output "$out"
+  sleep 10
+}
+
+for qps in 0.1 0.3 0.5 0.7 0.9 1.1; do
+  run_bench "$qps"
+done
+
+python -m benchmarks.multi_round_qa.plot --pattern 'summary_qps*.csv'

@@ -1,0 +1,100 @@
+"""Result aggregation: per-request CSV + performance summary.
+
+Metric semantics match the reference's ProcessSummary
+(multi-round-qa.py:435-514): QPS (launched+pending over wall time),
+processing speed (finished req/s), input/output tokens/s, per-request
+generation throughput, mean TTFT. Additionally emits one machine-readable
+JSON line so driver tooling can scrape results without parsing the
+pretty table.
+"""
+
+import csv
+import json
+from dataclasses import asdict, dataclass
+from typing import List, Optional
+
+from benchmarks.multi_round_qa.client import RequestResult
+
+
+@dataclass
+class Summary:
+    qps: float                       # offered request rate
+    processing_speed: float          # finished requests / s
+    pending_requests: int
+    input_tokens_per_s: float
+    output_tokens_per_s: float
+    gen_throughput_per_request: float
+    mean_ttft: float
+    p90_ttft: float
+    finished_requests: int
+    errored_requests: int
+    duration_s: float
+
+    def print_table(self) -> None:
+        rows = [
+            ("QPS", f"{self.qps:.4f} reqs/s"),
+            ("Processing speed", f"{self.processing_speed:.4f} reqs/s"),
+            ("Requests on-the-fly", str(self.pending_requests)),
+            ("Input tokens per second",
+             f"{self.input_tokens_per_s:.4f} tokens/s"),
+            ("Output tokens per second",
+             f"{self.output_tokens_per_s:.4f} tokens/s"),
+            ("Average generation throughput (per request)",
+             f"{self.gen_throughput_per_request:.4f} tokens/req/s"),
+            ("Average TTFT", f"{self.mean_ttft:.4f}s"),
+            ("P90 TTFT", f"{self.p90_ttft:.4f}s"),
+            ("Errors", str(self.errored_requests)),
+        ]
+        print("==================== Performance summary ====================")
+        for k, v in rows:
+            print(f"  {k}: {v}")
+        print(f"  Duration: {self.duration_s:.2f}s "
+              f"({self.finished_requests} finished)")
+        print("=============================================================")
+
+    def json_line(self) -> str:
+        return json.dumps(asdict(self))
+
+
+def summarize(results: List[RequestResult], pending: int,
+              start_time: Optional[float] = None,
+              end_time: Optional[float] = None) -> Summary:
+    ok = [r for r in results if r.error is None]
+    errs = len(results) - len(ok)
+    launched = len(results) + pending
+    if start_time is None:
+        start_time = min((r.launch_time for r in ok), default=0.0)
+    if end_time is None:
+        end_time = max((r.finish_time for r in ok), default=start_time)
+    # only requests fully inside the window count toward finished stats
+    ok = [r for r in ok if start_time <= r.finish_time <= end_time]
+    total = max(end_time - start_time, 1e-9)
+    n = len(ok)
+    ttfts = sorted(r.ttft for r in ok)
+    gen_speeds = [r.generation_tokens / r.generation_time for r in ok
+                  if r.generation_time > 0]
+    return Summary(
+        qps=launched / total,
+        processing_speed=n / total,
+        pending_requests=pending,
+        input_tokens_per_s=sum(r.prompt_tokens for r in ok) / total,
+        output_tokens_per_s=sum(r.generation_tokens for r in ok) / total,
+        gen_throughput_per_request=(sum(gen_speeds) / len(gen_speeds))
+        if gen_speeds else 0.0,
+        mean_ttft=(sum(ttfts) / n) if n else 0.0,
+        p90_ttft=ttfts[int(0.9 * (n - 1))] if n else 0.0,
+        finished_requests=n,
+        errored_requests=errs,
+        duration_s=total,
+    )
+
+
+def write_csv(results: List[RequestResult], path: str) -> None:
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["launch_time", "finish_time", "ttft", "generation_time",
+                    "prompt_tokens", "generation_tokens", "error"])
+        for r in results:
+            w.writerow([r.launch_time, r.finish_time, r.ttft,
+                        r.generation_time, r.prompt_tokens,
+                        r.generation_tokens, r.error or ""])

@@ -1,0 +1,177 @@
+"""Workload model: users, sessions, arrival cadence.
+
+Reference semantics (multi-round-qa.py:180-433): each of `num_users`
+concurrent users asks `num_rounds` questions, pacing requests so the
+aggregate arrival rate is `qps`; new users join at a cadence that keeps
+the population stationary; at start, ramp-up fast-forwards sessions to
+mid-conversation state so steady-state is reached immediately. Sessions
+carry the full chat history each round (the KV-reuse stressor) and tag
+requests with ``x-user-id`` for session-affinity routing.
+"""
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from benchmarks.multi_round_qa.client import RequestResult, StreamingClient
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkloadConfig:
+    num_users: int
+    num_rounds: int
+    qps: float
+    system_prompt_len: int = 1000    # tokens of shared system prompt
+    user_history_len: int = 2000     # tokens of per-user context
+    answer_len: int = 100            # max_tokens per answer
+    init_user_id: int = 0
+
+    @property
+    def gap_between_requests(self) -> float:
+        """Per-user seconds between questions at the target aggregate QPS."""
+        return self.num_users / self.qps
+
+    @property
+    def session_lifetime(self) -> float:
+        return self.gap_between_requests * (self.num_rounds - 1)
+
+    @property
+    def gap_between_users(self) -> float:
+        """Join cadence keeping the user population stationary."""
+        return self.session_lifetime / max(self.num_users, 1)
+
+
+def _dummy_text(n_tokens: int) -> str:
+    return " ".join(["hi"] * n_tokens)
+
+
+class UserSession:
+    """One user's multi-round conversation state machine."""
+
+    def __init__(self, user_id: int, cfg: WorkloadConfig):
+        self.user_id = user_id
+        self.cfg = cfg
+        self.messages: List[dict] = []
+        self.question_id = 0
+        self.last_request_time: Optional[float] = None
+        self.request_pending = False
+        self.finished = False
+        self.results: List[RequestResult] = []
+        self._last_lag_warn = 0.0
+
+    def _system_prompt(self) -> str:
+        return (f"Here is some shared context: "
+                f"{_dummy_text(self.cfg.system_prompt_len)}. For user "
+                f"{self.user_id} specifically: "
+                f"{_dummy_text(self.cfg.user_history_len)}.")
+
+    def _next_question(self) -> str:
+        self.question_id += 1
+        return (f"Question #{self.question_id}: please tell me a new "
+                f"long story with a happy ending.")
+
+    def fast_forward(self, offset: float, now: float) -> None:
+        """Place a fresh session `offset` seconds into its life so the
+        population starts at steady state (reference set_internal_state,
+        multi-round-qa.py:285-301). History stays empty — the cost of a
+        cold prefix on the first real question is the point of ramp-up
+        warmup, not simulated history."""
+        assert not self.messages, "fast_forward before first request"
+        n_done = int(offset / self.cfg.gap_between_requests) + 1
+        self.question_id = n_done
+        self.last_request_time = \
+            now - offset + (n_done - 1) * self.cfg.gap_between_requests
+
+    def _launch(self, now: float, client: StreamingClient) -> None:
+        question = self._next_question()
+        if not self.messages:
+            self.messages.append({"role": "system",
+                                  "content": self._system_prompt()})
+        self.messages.append({"role": "user", "content": question})
+        client.launch_request(
+            self.messages, self.cfg.answer_len, self._on_finish,
+            extra_headers={"x-user-id": str(self.user_id)})
+        self.request_pending = True
+        self.last_request_time = now
+
+    def _on_finish(self, result: RequestResult) -> None:
+        self.request_pending = False
+        self.results.append(result)
+        self.messages.append({"role": "assistant",
+                              "content": result.body or "(no answer)"})
+
+    def step(self, now: float, client: StreamingClient) -> None:
+        if self.question_id >= self.cfg.num_rounds and \
+                not self.request_pending:
+            self.finished = True
+            return
+        if self.last_request_time is None:
+            self._launch(now, client)
+            return
+        if now - self.last_request_time > self.cfg.gap_between_requests:
+            if self.request_pending:
+                if now - self._last_lag_warn > 10:
+                    logger.warning(
+                        "user %d: previous request still pending; "
+                        "server can't sustain target QPS", self.user_id)
+                    self._last_lag_warn = now
+                return
+            self._launch(now, client)
+
+
+class SessionManager:
+    """Steps all sessions on a discrete clock; joins users on cadence.
+
+    ``continuous=False`` stops admitting users after ramp-up so a finite
+    run (no --time bound) terminates once the initial population finishes
+    its rounds."""
+
+    def __init__(self, cfg: WorkloadConfig, continuous: bool = True):
+        self.cfg = cfg
+        self.continuous = continuous
+        self.sessions: List[UserSession] = []
+        self.done_sessions: List[UserSession] = []
+        self._next_user_id = cfg.init_user_id
+        self._last_join = 0.0
+        self._ramped = False
+
+    def _new_session(self) -> UserSession:
+        self._next_user_id += 1
+        s = UserSession(self._next_user_id, self.cfg)
+        self.sessions.append(s)
+        return s
+
+    def _ramp_up(self, now: float) -> None:
+        ramp = self.cfg.num_users * self.cfg.gap_between_users
+        for i in range(self.cfg.num_users):
+            offset = ramp - i * self.cfg.gap_between_users
+            if offset < 0:
+                break
+            self._new_session().fast_forward(offset, now)
+        self._ramped = True
+
+    def step(self, now: float, client: StreamingClient) -> None:
+        if not self._ramped:
+            self._ramp_up(now)
+            self._last_join = now
+        if self.continuous and \
+                now - self._last_join > self.cfg.gap_between_users:
+            self._new_session()
+            self._last_join = now
+            logger.info("user %d joined (active: %d)", self._next_user_id,
+                        len(self.sessions))
+        for s in self.sessions:
+            s.step(now, client)
+        finished = [s for s in self.sessions if s.finished]
+        if finished:
+            self.done_sessions.extend(finished)
+            self.sessions = [s for s in self.sessions if not s.finished]
+
+    def all_results(self) -> List[RequestResult]:
+        out: List[RequestResult] = []
+        for s in self.done_sessions + self.sessions:
+            out.extend(s.results)
+        return out

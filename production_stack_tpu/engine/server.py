@@ -95,16 +95,22 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     rid = proto._gen_id("chatcmpl")
 
     if req.stream:
+        include_usage = bool(req.stream_options
+                             and req.stream_options.include_usage)
+
         async def gen():
             first = proto.ChatCompletionChunk(
                 id=rid, model=req.model,
                 choices=[proto.ChatCompletionChunkChoice(
                     delta=proto.DeltaMessage(role="assistant", content=""))])
-            yield first.model_dump_json()
+            yield first.model_dump_json(exclude={"usage"})
+            num_tokens = 0
             # aclosing => a dropped consumer deterministically runs
             # engine.stream's cleanup (slot abort), not at GC's leisure
             async with aclosing(engine.stream(prompt_ids, options)) as it:
                 async for out in it:
+                    if out.new_token is not None:
+                        num_tokens += 1
                     if out.text_delta or out.finished:
                         chunk = proto.ChatCompletionChunk(
                             id=rid, model=req.model,
@@ -113,7 +119,16 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                                     content=out.text_delta or None),
                                 finish_reason=out.finish_reason if out.finished
                                 else None)])
-                        yield chunk.model_dump_json()
+                        yield chunk.model_dump_json(exclude={"usage"})
+            if include_usage:
+                # OpenAI semantics: one final chunk, empty choices, usage
+                tail = proto.ChatCompletionChunk(
+                    id=rid, model=req.model, choices=[],
+                    usage=proto.UsageInfo(
+                        prompt_tokens=len(prompt_ids),
+                        completion_tokens=num_tokens,
+                        total_tokens=len(prompt_ids) + num_tokens))
+                yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
     parts: List[str] = []
@@ -167,9 +182,15 @@ async def completions(request: web.Request) -> web.StreamResponse:
     rid = proto._gen_id("cmpl")
 
     if req.stream:
+        include_usage = bool(req.stream_options
+                             and req.stream_options.include_usage)
+
         async def gen():
+            num_tokens = 0
             async with aclosing(engine.stream(prompt_ids, options)) as it:
                 async for out in it:
+                    if out.new_token is not None:
+                        num_tokens += 1
                     if out.text_delta or out.finished:
                         chunk = proto.CompletionChunk(
                             id=rid, model=req.model,
@@ -177,7 +198,15 @@ async def completions(request: web.Request) -> web.StreamResponse:
                                 text=out.text_delta,
                                 finish_reason=out.finish_reason if out.finished
                                 else None)])
-                        yield chunk.model_dump_json()
+                        yield chunk.model_dump_json(exclude={"usage"})
+            if include_usage:
+                tail = proto.CompletionChunk(
+                    id=rid, model=req.model, choices=[],
+                    usage=proto.UsageInfo(
+                        prompt_tokens=len(prompt_ids),
+                        completion_tokens=num_tokens,
+                        total_tokens=len(prompt_ids) + num_tokens))
+                yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
     parts: List[str] = []

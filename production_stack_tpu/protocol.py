@@ -36,6 +36,7 @@ class CompletionRequest(OpenAIBase):
     top_k: int = 0                      # vLLM extension
     n: int = 1
     stream: bool = False
+    stream_options: Optional["StreamOptions"] = None
     stop: Optional[Union[str, List[str]]] = None
     stop_token_ids: Optional[List[int]] = None  # vLLM extension
     ignore_eos: bool = False            # vLLM extension
@@ -49,6 +50,10 @@ class ChatMessage(OpenAIBase):
     content: Optional[Union[str, List[Dict[str, Any]]]] = ""
 
 
+class StreamOptions(OpenAIBase):
+    include_usage: bool = False
+
+
 class ChatCompletionRequest(OpenAIBase):
     model: str
     messages: List[ChatMessage]
@@ -59,6 +64,7 @@ class ChatCompletionRequest(OpenAIBase):
     top_k: int = 0
     n: int = 1
     stream: bool = False
+    stream_options: Optional[StreamOptions] = None
     stop: Optional[Union[str, List[str]]] = None
     stop_token_ids: Optional[List[int]] = None
     ignore_eos: bool = False
@@ -127,6 +133,8 @@ class ChatCompletionChunk(OpenAIBase):
     created: int = Field(default_factory=_now)
     model: str = ""
     choices: List[ChatCompletionChunkChoice] = Field(default_factory=list)
+    # present only on the final chunk when stream_options.include_usage
+    usage: Optional[UsageInfo] = None
 
 
 class CompletionChunkChoice(OpenAIBase):
@@ -141,6 +149,8 @@ class CompletionChunk(OpenAIBase):
     created: int = Field(default_factory=_now)
     model: str = ""
     choices: List[CompletionChunkChoice] = Field(default_factory=list)
+    # present only on the final chunk when stream_options.include_usage
+    usage: Optional[UsageInfo] = None
 
 
 # ---------------------------------------------------------------- models API

@@ -129,21 +129,20 @@ class RegexPIIAnalyzer(PIIAnalyzer):
         PIIType.BANK_ACCOUNT:
             r"(?i)\b(?:account|acct)\.?\s*(?:number|no|#)?\s*[:=]?\s*"
             r"\d{8,17}\b",
-        # keyword-prefixed IDs: the keyword is case-insensitive but the ID
-        # token is uppercase-or-digit WITH at least one digit, so plain
-        # English after the keyword ("passport yesterday", "dl speed")
-        # never matches
+        # keyword-prefixed IDs: the ID token must contain a digit, so
+        # plain English after the keyword ("passport yesterday",
+        # "dl speed") never matches while real identifiers (any case) do
         PIIType.PASSPORT:
-            r"\b(?i:passport)\s*(?:(?i:number|no)|#)?\s*[:=]?\s*"
+            r"(?i)\bpassport\s*(?:number|no|#)?\s*[:=]?\s*"
             r"(?=[A-Z0-9]*\d)[A-Z0-9]{6,9}\b",
         PIIType.DRIVERS_LICENSE:
-            r"\b(?i:driver'?s?\s+licen[cs]e|dl)\s*(?:(?i:number|no)|#)?"
+            r"(?i)\b(?:driver'?s?\s+licen[cs]e|dl)\s*(?:number|no|#)?"
             r"\s*[:=]?\s*(?=[A-Z0-9]*\d)[A-Z0-9]{5,13}\b",
         PIIType.TAX_ID:
             r"\b\d{2}-\d{7}\b",
         PIIType.MEDICAL_RECORD:
-            r"\b(?i:mrn|medical\s+record\s*(?:(?i:number|no)|#)?)"
-            r"\s*[:=]?\s*(?=[A-Z0-9]*\d)[A-Z0-9]{6,12}\b",
+            r"(?i)\b(?:mrn|medical\s+record\s*(?:number|no|#)?)\s*[:=]?"
+            r"\s*(?=[A-Z0-9]*\d)[A-Z0-9]{6,12}\b",
         PIIType.MAC_ADDRESS:
             r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b",
         PIIType.DOB:

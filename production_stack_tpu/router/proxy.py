@@ -16,6 +16,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.semantic_cache import CACHE_CONTROL_FIELDS
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -25,8 +26,6 @@ HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
                # aiohttp's client auto-decompresses, so encoding headers
                # must not leak through in either direction
                "accept-encoding", "content-encoding"}
-
-_CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
 
 
 def _forward_headers(request: web.Request) -> dict:
@@ -46,7 +45,7 @@ async def route_general_request(request: web.Request,
     raw = request.get("pii_sanitized_raw") or await request.read()
     try:
         body = json.loads(raw) if raw else {}
-    except json.JSONDecodeError:
+    except (json.JSONDecodeError, UnicodeDecodeError):
         return web.json_response(
             {"error": {"message": "request body is not valid JSON",
                        "type": "invalid_request_error"}}, status=400)
@@ -82,9 +81,9 @@ async def route_general_request(request: web.Request,
     # router-level cache knobs are not OpenAI fields: strip them from the
     # forwarded bytes (strict backends reject unknown params) while the
     # local `body` keeps them for the store/capture decision below
-    if any(k in body for k in _CACHE_CONTROL_FIELDS):
+    if any(k in body for k in CACHE_CONTROL_FIELDS):
         raw = json.dumps({k: v for k, v in body.items()
-                          if k not in _CACHE_CONTROL_FIELDS}).encode()
+                          if k not in CACHE_CONTROL_FIELDS}).encode()
 
     endpoints = [ep for ep in state["discovery"].get_endpoints()
                  if ep.serves(model)]

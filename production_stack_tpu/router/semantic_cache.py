@@ -39,6 +39,10 @@ logger = init_logger(__name__)
 DEFAULT_SIMILARITY_THRESHOLD = 0.95
 DEFAULT_DIM = 384
 
+# router-level request knobs consumed here; the proxy strips them from
+# forwarded bodies (not OpenAI fields — strict backends reject them)
+CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
+
 
 # ---------------------------------------------------------------- embedders
 

@@ -1,0 +1,94 @@
+"""Benchmark harness tests: workload cadence, client SSE parsing, and a
+small end-to-end run against the fake engine (reference pattern: the
+perftest tier drives the real tooling against mocks, SURVEY.md §4.2)."""
+
+import asyncio
+
+from aiohttp.test_utils import TestServer
+
+from benchmarks.multi_round_qa.client import StreamingClient
+from benchmarks.multi_round_qa.summary import summarize, write_csv
+from benchmarks.multi_round_qa.workload import (SessionManager, UserSession,
+                                                WorkloadConfig)
+from tests.fake_engine import FakeEngine
+
+
+def test_workload_cadence_math():
+    cfg = WorkloadConfig(num_users=10, num_rounds=5, qps=2.0)
+    assert cfg.gap_between_requests == 5.0        # 10 users / 2 qps
+    assert cfg.session_lifetime == 20.0           # 4 gaps
+    assert cfg.gap_between_users == 2.0           # stationary population
+
+
+def test_fast_forward_places_session_mid_life():
+    cfg = WorkloadConfig(num_users=4, num_rounds=10, qps=1.0)
+    s = UserSession(1, cfg)
+    now = 1000.0
+    s.fast_forward(offset=9.0, now=now)           # gap=4s -> 3 questions in
+    assert s.question_id == 3
+    # next request becomes due one gap after the (virtual) last one
+    assert s.last_request_time == now - 9.0 + 2 * cfg.gap_between_requests
+
+
+def test_ramp_up_creates_full_population():
+    cfg = WorkloadConfig(num_users=5, num_rounds=4, qps=5.0)
+    mgr = SessionManager(cfg)
+    mgr._ramp_up(now=0.0)
+    assert len(mgr.sessions) == cfg.num_users
+    # sessions are staggered across their lifetime, not all at question 1
+    qids = {s.question_id for s in mgr.sessions}
+    assert len(qids) > 1
+
+
+def test_benchmark_end_to_end_against_fake_engine(tmp_path):
+    async def body():
+        fake = FakeEngine(model="bench-model", num_tokens=4)
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+
+        cfg = WorkloadConfig(num_users=3, num_rounds=4, qps=30.0,
+                             system_prompt_len=20, user_history_len=10,
+                             answer_len=4)
+        mgr = SessionManager(cfg, continuous=False)
+        client = StreamingClient(url, "bench-model")
+        await client.start()
+        deadline = asyncio.get_event_loop().time() + 30
+        import time
+        while asyncio.get_event_loop().time() < deadline:
+            mgr.step(time.time(), client)
+            if not mgr.sessions and mgr.done_sessions:
+                break
+            await asyncio.sleep(0.02)
+        while client.in_flight:
+            await asyncio.sleep(0.02)
+        results = mgr.all_results()
+        await client.close()
+        await server.close()
+
+        # every finished session produced num_rounds results
+        assert len(mgr.done_sessions) >= cfg.num_users
+        assert all(r.error is None for r in results), results
+        assert all(r.generation_tokens == 4 for r in results)
+        assert all(r.ttft > 0 for r in results)
+        # multi-round: assistant turns fed back into each history (ramp-up
+        # fast-forwards sessions mid-life, so counts vary per session but
+        # must always match that session's completed rounds)
+        multi = [s for s in mgr.done_sessions if len(s.results) >= 2]
+        assert multi, [len(s.results) for s in mgr.done_sessions]
+        for s in multi:
+            roles = [m["role"] for m in s.messages]
+            assert roles.count("user") == len(s.results)
+            assert roles.count("assistant") == len(s.results)
+        # session affinity header flowed on every request
+        users = {u for _, u, _ in fake.requests_seen}
+        assert all(u is not None for u in users)
+
+        s = summarize(results, pending=0)
+        assert s.finished_requests == len(results)
+        assert s.output_tokens_per_s > 0
+        assert s.mean_ttft > 0
+        out = tmp_path / "bench.csv"
+        write_csv(results, str(out))
+        assert out.read_text().count("\n") == len(results) + 1
+    asyncio.run(body())

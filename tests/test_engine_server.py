@@ -74,6 +74,29 @@ def test_chat_completion_stream(engine):
     _with_client(engine, body)
 
 
+def test_chat_stream_include_usage(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "hello"}],
+            "max_tokens": 5, "stream": True,
+            "stream_options": {"include_usage": True}})
+        assert r.status == 200
+        raw = (await r.read()).decode()
+        events = [line[len("data: "):] for line in raw.splitlines()
+                  if line.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        chunks = [json.loads(e) for e in events[:-1]]
+        # non-final chunks never carry usage; the tail chunk carries only
+        # usage (empty choices), per OpenAI stream_options semantics
+        assert all("usage" not in c for c in chunks[:-1])
+        tail = chunks[-1]
+        assert tail["choices"] == []
+        assert tail["usage"]["completion_tokens"] == 5
+        assert tail["usage"]["prompt_tokens"] > 0
+    _with_client(engine, body)
+
+
 def test_completions_and_token_api(engine):
     async def body(client):
         r = await client.post("/v1/completions", json={
