@@ -61,13 +61,16 @@ def summarize(results: List[RequestResult], pending: int,
               end_time: Optional[float] = None) -> Summary:
     ok = [r for r in results if r.error is None]
     errs = len(results) - len(ok)
-    launched = len(results) + pending
     if start_time is None:
         start_time = min((r.launch_time for r in ok), default=0.0)
     if end_time is None:
         end_time = max((r.finish_time for r in ok), default=start_time)
-    # only requests fully inside the window count toward finished stats
-    ok = [r for r in ok if start_time <= r.finish_time <= end_time]
+    # offered rate and finished stats both count only the measurement
+    # window — requests launched during a warmup --init-duration are out
+    launched = len([r for r in results
+                    if start_time <= r.launch_time <= end_time]) + pending
+    ok = [r for r in ok
+          if start_time <= r.launch_time and r.finish_time <= end_time]
     total = max(end_time - start_time, 1e-9)
     n = len(ok)
     ttfts = sorted(r.ttft for r in ok)

@@ -145,9 +145,13 @@ class SessionManager:
         return s
 
     def _ramp_up(self, now: float) -> None:
+        # offsets span [0, lifetime - gap_between_users]: the oldest
+        # session still has >= 1 question left (an offset of a full
+        # lifetime would finish instantly with zero requests, leaving the
+        # steady-state population one user short of num_users)
         ramp = self.cfg.num_users * self.cfg.gap_between_users
         for i in range(self.cfg.num_users):
-            offset = ramp - i * self.cfg.gap_between_users
+            offset = ramp - (i + 1) * self.cfg.gap_between_users
             if offset < 0:
                 break
             self._new_session().fast_forward(offset, now)

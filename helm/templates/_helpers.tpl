@@ -1,0 +1,100 @@
+{{/*
+Helper templates (reference: helm/templates/_helpers.tpl). Names keep the
+`chart.` prefix so golden values files port over mechanically.
+*/}}
+
+{{- define "chart.engineLabels" -}}
+{{- with .Values.servingEngineSpec.labels }}
+{{- toYaml . }}
+{{- end }}
+{{- end }}
+
+{{- define "chart.routerLabels" -}}
+{{- with .Values.routerSpec.labels }}
+{{- toYaml . }}
+{{- end }}
+{{- end }}
+
+{{- define "chart.cacheserverLabels" -}}
+{{- with .Values.cacheserverSpec.labels }}
+{{- toYaml . }}
+{{- end }}
+{{- end }}
+
+{{/* Engine container resources: host cpu/memory + google.com/tpu chips.
+     modelSpec is passed as the dict context. */}}
+{{- define "chart.engineResources" -}}
+requests:
+  {{- if .requestCPU }}
+  cpu: {{ .requestCPU | quote }}
+  {{- end }}
+  {{- if .requestMemory }}
+  memory: {{ .requestMemory | quote }}
+  {{- end }}
+  {{- if .requestTPU }}
+  google.com/tpu: {{ .requestTPU | quote }}
+  {{- end }}
+limits:
+  {{- if .limitCPU }}
+  cpu: {{ .limitCPU | quote }}
+  {{- end }}
+  {{- if .limitMemory }}
+  memory: {{ .limitMemory | quote }}
+  {{- end }}
+  {{- if .requestTPU }}
+  {{/* TPU chips must be limited == requested (extended resource) */}}
+  google.com/tpu: {{ .requestTPU | quote }}
+  {{- end }}
+{{- end }}
+
+{{/* Zero-downtime rolling update (reference _helpers.tpl:44-53): bring
+     the full replacement capacity up before taking any replica down. */}}
+{{- define "chart.engineStrategy" -}}
+{{- if .Values.servingEngineSpec.strategy }}
+{{- toYaml .Values.servingEngineSpec.strategy }}
+{{- else }}
+rollingUpdate:
+  maxSurge: 100%
+  maxUnavailable: 0
+type: RollingUpdate
+{{- end }}
+{{- end }}
+
+{{/* tpukv:// URL of the chart's cache-server service (reference
+     cacheserver.formatRemoteUrl -> lm://name:port). */}}
+{{- define "chart.kvRemoteUrl" -}}
+tpukv://{{ .Release.Name }}-cache-server-service:{{ .Values.cacheserverSpec.servicePort }}
+{{- end }}
+
+{{/* --kv-transfer-config JSON from a modelSpec.kvCacheConfig block
+     (context: dict with "root" = $ and "spec" = kvCacheConfig).
+     Reference equivalent: the LMCache env block + --kv-transfer-config
+     (deployment-vllm-multi.yaml:94-99,154-178). */}}
+{{- define "chart.kvTransferJson" -}}
+{{- $cfg := dict "kv_role" (.spec.role | default "kv_both") -}}
+{{- if .spec.hostOffloadGiB -}}
+{{- $_ := set $cfg "local_cpu_gb" .spec.hostOffloadGiB -}}
+{{- end -}}
+{{- if .spec.diskPath -}}
+{{- $_ := set $cfg "local_disk_path" .spec.diskPath -}}
+{{- $_ := set $cfg "local_disk_gb" (.spec.diskGiB | default 16) -}}
+{{- end -}}
+{{- if .spec.useRemote -}}
+{{- if not .root.Values.cacheserverSpec.enabled -}}
+{{- fail "kvCacheConfig.useRemote requires cacheserverSpec.enabled=true (the tpukv service would not exist)" -}}
+{{- end -}}
+{{- $_ := set $cfg "remote_url" (include "chart.kvRemoteUrl" .root) -}}
+{{- end -}}
+{{- toJson $cfg -}}
+{{- end }}
+
+{{/* Label selector string the router passes to --k8s-label-selector,
+     derived from servingEngineSpec.labels (reference
+     deployment-router.yaml:41-77). */}}
+{{- define "chart.engineLabelSelector" -}}
+{{- $pairs := list -}}
+{{- range $k, $v := .Values.servingEngineSpec.labels -}}
+{{- $pairs = append $pairs (printf "%s=%s" $k $v) -}}
+{{- end -}}
+{{- join "," $pairs -}}
+{{- end }}

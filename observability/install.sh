@@ -1,0 +1,22 @@
+#!/bin/bash
+# Install the observability stack (reference: observability/install.sh).
+set -euo pipefail
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+helm repo add prometheus-community https://prometheus-community.github.io/helm-charts
+
+helm upgrade --install kube-prom-stack prometheus-community/kube-prometheus-stack \
+  --namespace monitoring \
+  --create-namespace \
+  -f "$SCRIPT_DIR/kube-prom-stack.yaml" --wait
+
+helm upgrade --install prometheus-adapter prometheus-community/prometheus-adapter \
+  --namespace monitoring \
+  -f "$SCRIPT_DIR/prom-adapter.yaml"
+
+# Provision the Grafana dashboard through the sidecar
+kubectl create configmap tpu-stack-dashboard \
+  --from-file="$SCRIPT_DIR/tpu-stack-dashboard.json" \
+  --namespace monitoring \
+  --dry-run=client -o yaml | kubectl label -f - --local \
+  grafana_dashboard=1 -o yaml | kubectl apply -f -

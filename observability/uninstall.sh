@@ -1,0 +1,4 @@
+#!/bin/bash
+helm uninstall prometheus-adapter -n monitoring
+helm uninstall kube-prom-stack -n monitoring
+kubectl delete configmap tpu-stack-dashboard -n monitoring --ignore-not-found

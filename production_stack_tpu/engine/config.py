@@ -14,6 +14,8 @@ from typing import Any, Dict, Optional, Tuple
 class EngineConfig:
     model: str = "debug-tiny"
     tokenizer: Optional[str] = None          # defaults to model path
+    chat_template: Optional[str] = None      # Jinja file overriding the
+                                             # tokenizer's chat template
     max_model_len: int = 2048                # max prompt+generation length
     max_num_seqs: int = 8                    # concurrent batch slots
     prefill_chunk: int = 512                 # chunked-prefill chunk size

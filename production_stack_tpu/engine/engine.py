@@ -49,7 +49,8 @@ class LLMEngine:
         self.cfg = engine_cfg
         self.model_cfg = get_config(engine_cfg.model)
         self.tokenizer = load_tokenizer(engine_cfg.model,
-                                        engine_cfg.tokenizer)
+                                        engine_cfg.tokenizer,
+                                        engine_cfg.chat_template)
         if params is None and engine_cfg.checkpoint:
             params = load_checkpoint(self.model_cfg, engine_cfg.checkpoint)
         if mesh is None and engine_cfg.tensor_parallel_size > 1:

@@ -308,6 +308,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
+    p.add_argument("--chat-template", default=None,
+                   help="Jinja file overriding the tokenizer chat template")
     p.add_argument("--kv-transfer-config", default=None,
                    help="JSON dict enabling KV tiering, e.g. "
                         '\'{"kv_role": "kv_both", "local_cpu_gb": 4, '
@@ -325,6 +327,7 @@ def main(argv=None) -> None:
         if args.kv_transfer_config else None
     cfg = EngineConfig(
         model=args.model, tokenizer=args.tokenizer,
+        chat_template=args.chat_template,
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
         tensor_parallel_size=args.tensor_parallel_size, seed=args.seed,

@@ -44,6 +44,8 @@ class HFTokenizer:
         self.bos_token_id = self._tok.bos_token_id
         self.eos_token_id = self._tok.eos_token_id
         self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
+        self.bos_token = self._tok.bos_token or ""
+        self.eos_token = self._tok.eos_token or ""
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_bos)
@@ -66,16 +68,60 @@ def _content_text(message: dict) -> str:
     return str(content)
 
 
-def load_tokenizer(model_or_path: str, tokenizer_path: Optional[str] = None):
-    """HF tokenizer when a checkpoint dir exists; byte tokenizer otherwise."""
+def render_chat_template(template_text: str, messages: List[dict],
+                         **extra_vars) -> str:
+    """Render a user-supplied Jinja chat template (HF conventions:
+    `messages` in scope, `add_generation_prompt` true). StrictUndefined:
+    a template referencing a variable we don't provide errors loudly
+    instead of silently rendering empty strings."""
+    import jinja2
+    env = jinja2.Environment(autoescape=False,
+                             undefined=jinja2.StrictUndefined)
+    return env.from_string(template_text).render(
+        messages=messages, add_generation_prompt=True, **extra_vars)
+
+
+def load_tokenizer(model_or_path: str, tokenizer_path: Optional[str] = None,
+                   chat_template_path: Optional[str] = None):
+    """HF tokenizer when a checkpoint dir exists; byte tokenizer otherwise.
+    `chat_template_path` (a Jinja file) overrides the built-in template —
+    the reference surfaces the same knob as the engine's chat-template
+    mount (deployment-vllm-multi.yaml:100-103)."""
     import os
     path = tokenizer_path or model_or_path
+    tok = None
     if os.path.isdir(path):
         try:
-            return HFTokenizer(path)
+            tok = HFTokenizer(path)
         except Exception:
             pass
-    return ByteTokenizer()
+    if tok is None:
+        tok = ByteTokenizer()
+    if chat_template_path:
+        with open(chat_template_path) as f:
+            template_text = f.read()
+        extra = {
+            # common HF template variables
+            "bos_token": getattr(tok, "bos_token", "") or "",
+            "eos_token": getattr(tok, "eos_token", "") or "",
+        }
+
+        def apply_with_override(messages: List[dict]) -> str:
+            return render_chat_template(template_text, messages, **extra)
+
+        # fail at startup, not per-request: a broken template (Jinja
+        # typo, missing jinja2, undefined variable) must never silently
+        # fall back to the default and serve wrong prompts
+        probe = [{"role": "system", "content": "probe"},
+                 {"role": "user", "content": "probe"}]
+        try:
+            apply_with_override(probe)
+        except Exception as e:
+            raise ValueError(
+                f"chat template {chat_template_path!r} failed to render: "
+                f"{e}") from e
+        tok.apply_chat_template = apply_with_override  # type: ignore
+    return tok
 
 
 class DetokenizeStream:

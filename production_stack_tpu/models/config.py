@@ -109,12 +109,33 @@ PRESETS: Dict[str, ModelConfig] = {
 }
 
 
+# HF hub ids commonly passed as --model (e.g. from helm modelSpec
+# entries) resolved to the preset with the same geometry; weights still
+# come from --checkpoint (or are random-initialized).
+HF_ALIASES: Dict[str, str] = {
+    "meta-llama/Meta-Llama-3-8B": "llama-3-8b",
+    "meta-llama/Meta-Llama-3-8B-Instruct": "llama-3-8b",
+    "meta-llama/Llama-3.1-8B": "llama-3-8b",
+    "meta-llama/Llama-3.1-8B-Instruct": "llama-3-8b",
+    "meta-llama/Meta-Llama-3-70B": "llama-3-70b",
+    "meta-llama/Meta-Llama-3-70B-Instruct": "llama-3-70b",
+    "meta-llama/Llama-3.1-70B-Instruct": "llama-3-70b",
+    "mistralai/Mistral-7B-v0.1": "mistral-7b",
+    "mistralai/Mistral-7B-Instruct-v0.2": "mistral-7b",
+    "mistralai/Mistral-7B-Instruct-v0.3": "mistral-7b",
+    "TinyLlama/TinyLlama-1.1B-Chat-v1.0": "tinyllama-1.1b",
+}
+
+
 def get_config(name: str) -> ModelConfig:
     if name in PRESETS:
         return PRESETS[name]
+    if name in HF_ALIASES:
+        cfg = PRESETS[HF_ALIASES[name]]
+        return dataclasses.replace(cfg, name=name)
     if os.path.exists(name):
         return ModelConfig.from_json(name)
     raise KeyError(
-        f"unknown model {name!r}; presets: {sorted(PRESETS)} or a path to an "
-        "HF checkpoint directory"
+        f"unknown model {name!r}; presets: {sorted(PRESETS)}, known HF ids: "
+        f"{sorted(HF_ALIASES)}, or a path to an HF checkpoint directory"
     )

@@ -100,6 +100,20 @@ def _luhn_ok(digits: str) -> bool:
     return total % 10 == 0
 
 
+def _keyword_id_pattern(keyword: str, lo: int, hi: int) -> str:
+    """Keyword-prefixed identifier pattern with two alternatives:
+    (a) an explicit separator (:, =, #, or the words number/no) admits an
+        any-case token, so "passport no: ab1234567" is caught;
+    (b) bare whitespace admits only an UPPERCASE token, so prose like
+        "my passport b4monday trip" or "dl 100mbps" never matches.
+    Both require at least one digit in the token."""
+    return (
+        rf"\b(?i:{keyword})\s*(?:(?i:number|no)|#|:|=)+\s*[:=]?\s*"
+        rf"(?=[A-Za-z0-9]*\d)[A-Za-z0-9]{{{lo},{hi}}}\b"
+        rf"|\b(?i:{keyword})\s+(?=[A-Z0-9]*\d)[A-Z0-9]{{{lo},{hi}}}\b"
+    )
+
+
 class RegexPIIAnalyzer(PIIAnalyzer):
     """Dependency-free pattern analyzer (reference analyzers/regex.py)."""
 
@@ -129,20 +143,13 @@ class RegexPIIAnalyzer(PIIAnalyzer):
         PIIType.BANK_ACCOUNT:
             r"(?i)\b(?:account|acct)\.?\s*(?:number|no|#)?\s*[:=]?\s*"
             r"\d{8,17}\b",
-        # keyword-prefixed IDs: the ID token must contain a digit, so
-        # plain English after the keyword ("passport yesterday",
-        # "dl speed") never matches while real identifiers (any case) do
-        PIIType.PASSPORT:
-            r"(?i)\bpassport\s*(?:number|no|#)?\s*[:=]?\s*"
-            r"(?=[A-Z0-9]*\d)[A-Z0-9]{6,9}\b",
+        PIIType.PASSPORT: _keyword_id_pattern("passport", 6, 9),
         PIIType.DRIVERS_LICENSE:
-            r"(?i)\b(?:driver'?s?\s+licen[cs]e|dl)\s*(?:number|no|#)?"
-            r"\s*[:=]?\s*(?=[A-Z0-9]*\d)[A-Z0-9]{5,13}\b",
+            _keyword_id_pattern(r"driver'?s?\s+licen[cs]e|dl", 5, 13),
         PIIType.TAX_ID:
             r"\b\d{2}-\d{7}\b",
         PIIType.MEDICAL_RECORD:
-            r"(?i)\b(?:mrn|medical\s+record\s*(?:number|no|#)?)\s*[:=]?"
-            r"\s*(?=[A-Z0-9]*\d)[A-Z0-9]{6,12}\b",
+            _keyword_id_pattern(r"mrn|medical\s+record", 6, 12),
         PIIType.MAC_ADDRESS:
             r"\b(?:[0-9A-Fa-f]{2}[:-]){5}[0-9A-Fa-f]{2}\b",
         PIIType.DOB:

@@ -16,7 +16,6 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
-from production_stack_tpu.router.semantic_cache import CACHE_CONTROL_FIELDS
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -26,6 +25,13 @@ HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
                # aiohttp's client auto-decompresses, so encoding headers
                # must not leak through in either direction
                "accept-encoding", "content-encoding"}
+
+# Router-level cache knobs (consumed by semantic_cache.SemanticCache,
+# which imports this tuple): stripped from forwarded bodies because they
+# are not OpenAI fields and strict backends reject unknown params.
+# Defined here, not in semantic_cache, so the hot proxy path never pulls
+# in numpy/kvcache when the cache gate is off.
+CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
 
 
 def _forward_headers(request: web.Request) -> dict:

@@ -39,9 +39,10 @@ logger = init_logger(__name__)
 DEFAULT_SIMILARITY_THRESHOLD = 0.95
 DEFAULT_DIM = 384
 
-# router-level request knobs consumed here; the proxy strips them from
-# forwarded bodies (not OpenAI fields — strict backends reject them)
-CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
+# canonical home is proxy.py (keeps this numpy-heavy module out of the
+# hot path's imports); re-exported here because the knobs are consumed
+# by SemanticCache.cacheable/check
+from production_stack_tpu.router.proxy import CACHE_CONTROL_FIELDS  # noqa: E402,F401
 
 
 # ---------------------------------------------------------------- embedders

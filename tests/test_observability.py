@@ -1,0 +1,74 @@
+"""Observability asset checks: dashboard JSON parses, every PromQL
+metric it references is actually exported by the engine or router, and
+the adapter/HPA metric names line up."""
+
+import json
+import os
+import re
+
+import yaml
+
+OBS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "observability")
+
+
+def _exported_metrics():
+    """Union of metric names the engine + router register."""
+    from prometheus_client import CollectorRegistry
+    from production_stack_tpu.engine.metrics import EngineMetrics
+    from production_stack_tpu.router.metrics import RouterMetrics
+    names = set()
+    for metrics in (EngineMetrics(model="test"), RouterMetrics()):
+        for collector in metrics.registry._collector_to_names:
+            for m in collector.describe() if hasattr(collector, "describe") \
+                    else []:
+                names.add(m.name)
+        names |= {n for ns in metrics.registry._collector_to_names.values()
+                  for n in ns}
+    return names
+
+
+def test_dashboard_json_parses_and_metrics_exist():
+    with open(os.path.join(OBS, "tpu-stack-dashboard.json")) as f:
+        dash = json.load(f)
+    assert dash["title"]
+    exported = _exported_metrics()
+    exprs = [t["expr"] for p in dash["panels"] for t in p.get("targets", [])]
+    assert exprs
+    for expr in exprs:
+        for metric in re.findall(r"[a-z]+:[a-z0-9_]+", expr):
+            base = re.sub(r"_(bucket|sum|count|total)$", "", metric)
+            candidates = {metric, base, metric + "_total", base + "_total"}
+            assert candidates & exported, \
+                f"dashboard references unexported metric {metric}"
+
+
+def test_prom_adapter_rules_reference_real_metrics():
+    with open(os.path.join(OBS, "prom-adapter.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    exported = _exported_metrics()
+    rules = cfg["rules"]["custom"]
+    assert rules
+    for rule in rules:
+        m = re.search(r"\^?([a-z]+:[a-z_]+)\$?", rule["seriesQuery"])
+        assert m, rule
+        assert m.group(1) in exported, m.group(1)
+
+
+def test_hpa_metric_matches_adapter_export():
+    with open(os.path.join(OBS, "hpa-queue-depth.yaml")) as f:
+        hpa = yaml.safe_load(f)
+    with open(os.path.join(OBS, "prom-adapter.yaml")) as f:
+        adapter = yaml.safe_load(f)
+    exported_as = {r["name"]["as"] for r in adapter["rules"]["custom"]}
+    for metric in hpa["spec"]["metrics"]:
+        assert metric["object"]["metric"]["name"] in exported_as
+
+
+def test_kube_prom_stack_values_parse():
+    with open(os.path.join(OBS, "kube-prom-stack.yaml")) as f:
+        cfg = yaml.safe_load(f)
+    mon = cfg["prometheus"]["additionalServiceMonitors"][0]
+    ports = {e["port"] for e in mon["endpoints"]}
+    # the ports must match the chart's container port names
+    assert ports == {"engine-port", "router-port"}
