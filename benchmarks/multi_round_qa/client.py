@@ -48,7 +48,17 @@ class StreamingClient:
         self.request_timeout = request_timeout
         self._session: Optional[aiohttp.ClientSession] = None
         self._tasks: List[asyncio.Task] = []
-        self.in_flight = 0
+        self._inflight: Dict[int, float] = {}   # request id -> launch time
+        self._next_id = 0
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._inflight)
+
+    def pending_launches(self) -> List[float]:
+        """Launch times of requests still in flight (the summary window
+        filters these the same way it filters finished requests)."""
+        return list(self._inflight.values())
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession(
@@ -77,7 +87,9 @@ class StreamingClient:
 
     async def _run(self, messages, max_tokens, on_finish, headers) -> None:
         result = RequestResult(launch_time=time.time())
-        self.in_flight += 1
+        rid = self._next_id
+        self._next_id += 1
+        self._inflight[rid] = result.launch_time
         headers["Content-Type"] = "application/json"
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
@@ -137,5 +149,5 @@ class StreamingClient:
         else:
             result.prompt_tokens = _estimate_tokens(messages)
             result.generation_tokens = len(chunks)
-        self.in_flight -= 1
+        del self._inflight[rid]
         on_finish(result)

@@ -86,11 +86,11 @@ async def run(args) -> int:
         while client.in_flight > 0 and time.time() < drain_until:
             await asyncio.sleep(STEP_S)
     finally:
-        pending = client.in_flight
+        pending_launches = client.pending_launches()
         results = manager.all_results()
         await client.close()
     window_start = start + args.init_duration if args.init_duration else None
-    s = summarize(results, pending, start_time=window_start)
+    s = summarize(results, pending_launches, start_time=window_start)
     s.print_table()
     print(s.json_line())
     write_csv(results, args.output)

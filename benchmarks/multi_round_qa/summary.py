@@ -56,7 +56,8 @@ class Summary:
         return json.dumps(asdict(self))
 
 
-def summarize(results: List[RequestResult], pending: int,
+def summarize(results: List[RequestResult],
+              pending_launches: List[float] = (),
               start_time: Optional[float] = None,
               end_time: Optional[float] = None) -> Summary:
     ok = [r for r in results if r.error is None]
@@ -65,8 +66,11 @@ def summarize(results: List[RequestResult], pending: int,
         start_time = min((r.launch_time for r in ok), default=0.0)
     if end_time is None:
         end_time = max((r.finish_time for r in ok), default=start_time)
-    # offered rate and finished stats both count only the measurement
-    # window — requests launched during a warmup --init-duration are out
+    # offered rate and finished stats count only the measurement window —
+    # requests launched during a warmup --init-duration are out, for
+    # pending (still in flight) requests just like finished ones
+    pending = len([t for t in pending_launches
+                   if start_time <= t <= end_time])
     launched = len([r for r in results
                     if start_time <= r.launch_time <= end_time]) + pending
     ok = [r for r in ok

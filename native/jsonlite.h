@@ -1,0 +1,373 @@
+// Minimal JSON value / parser / serializer (header-only, no deps).
+//
+// Written for the C++ operator (native/operator_main.cc), which talks to
+// the Kubernetes REST API; the environment ships no JSON library headers
+// (no nlohmann/rapidjson), so the stack carries its own ~300-line
+// implementation. Supports the full JSON grammar; numbers are stored as
+// double (adequate for K8s resourceVersion strings are strings anyway).
+//
+// Reference-parity note: the reference operator is Go (kubebuilder,
+// src/router-controller/) and gets JSON from the stdlib; this is the
+// equivalent plumbing for a C++ build.
+
+#ifndef PSTPU_JSONLITE_H_
+#define PSTPU_JSONLITE_H_
+
+#include <cmath>
+#include <cstdio>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace jsonlite {
+
+class Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+class Value {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Value() : type_(Type::Null) {}
+  Value(std::nullptr_t) : type_(Type::Null) {}
+  Value(bool b) : type_(Type::Bool), bool_(b) {}
+  Value(int n) : type_(Type::Number), num_(n) {}
+  Value(long n) : type_(Type::Number), num_(static_cast<double>(n)) {}
+  Value(double n) : type_(Type::Number), num_(n) {}
+  Value(const char *s) : type_(Type::String), str_(s) {}
+  Value(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Value(Array a) : type_(Type::Array),
+                   arr_(std::make_shared<Array>(std::move(a))) {}
+  Value(Object o) : type_(Type::Object),
+                    obj_(std::make_shared<Object>(std::move(o))) {}
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool(bool dflt = false) const {
+    return type_ == Type::Bool ? bool_ : dflt;
+  }
+  double as_number(double dflt = 0) const {
+    return type_ == Type::Number ? num_ : dflt;
+  }
+  const std::string &as_string() const {
+    static const std::string kEmpty;
+    return type_ == Type::String ? str_ : kEmpty;
+  }
+
+  // Object access. get() is safe on any type (returns Null value).
+  const Value &get(const std::string &key) const {
+    static const Value kNull;
+    if (type_ != Type::Object || !obj_) return kNull;
+    auto it = obj_->find(key);
+    return it == obj_->end() ? kNull : it->second;
+  }
+  void set(const std::string &key, Value v) {
+    if (type_ != Type::Object) {
+      type_ = Type::Object;
+      obj_ = std::make_shared<Object>();
+    }
+    (*obj_)[key] = std::move(v);
+  }
+  bool has(const std::string &key) const {
+    return type_ == Type::Object && obj_ && obj_->count(key) > 0;
+  }
+  const Object &object() const {
+    static const Object kEmpty;
+    return (type_ == Type::Object && obj_) ? *obj_ : kEmpty;
+  }
+  const Array &array() const {
+    static const Array kEmpty;
+    return (type_ == Type::Array && arr_) ? *arr_ : kEmpty;
+  }
+  void push_back(Value v) {
+    if (type_ != Type::Array) {
+      type_ = Type::Array;
+      arr_ = std::make_shared<Array>();
+    }
+    arr_->push_back(std::move(v));
+  }
+
+  std::string dump() const {
+    std::string out;
+    write(out);
+    return out;
+  }
+
+ private:
+  void write(std::string &out) const {
+    switch (type_) {
+      case Type::Null: out += "null"; break;
+      case Type::Bool: out += bool_ ? "true" : "false"; break;
+      case Type::Number: {
+        char buf[32];
+        if (std::isfinite(num_) && num_ == (long long)num_ &&
+            std::fabs(num_) < 1e15) {
+          snprintf(buf, sizeof buf, "%lld", (long long)num_);
+        } else {
+          snprintf(buf, sizeof buf, "%.17g", num_);
+        }
+        out += buf;
+        break;
+      }
+      case Type::String: write_string(str_, out); break;
+      case Type::Array: {
+        out += '[';
+        bool first = true;
+        for (const auto &v : *arr_) {
+          if (!first) out += ',';
+          first = false;
+          v.write(out);
+        }
+        out += ']';
+        break;
+      }
+      case Type::Object: {
+        out += '{';
+        bool first = true;
+        for (const auto &kv : *obj_) {
+          if (!first) out += ',';
+          first = false;
+          write_string(kv.first, out);
+          out += ':';
+          kv.second.write(out);
+        }
+        out += '}';
+        break;
+      }
+    }
+  }
+
+  static void write_string(const std::string &s, std::string &out) {
+    out += '"';
+    for (unsigned char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\r': out += "\\r"; break;
+        case '\t': out += "\\t"; break;
+        case '\b': out += "\\b"; break;
+        case '\f': out += "\\f"; break;
+        default:
+          if (c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof buf, "\\u%04x", c);
+            out += buf;
+          } else {
+            out += static_cast<char>(c);
+          }
+      }
+    }
+    out += '"';
+  }
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::shared_ptr<Array> arr_;
+  std::shared_ptr<Object> obj_;
+};
+
+// ---------------------------------------------------------------- parser
+
+class Parser {
+ public:
+  explicit Parser(const std::string &text) : s_(text) {}
+
+  bool parse(Value *out) {
+    pos_ = 0;
+    if (!value(out)) return false;
+    skip_ws();
+    return pos_ == s_.size();
+  }
+
+ private:
+  void skip_ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
+                                s_[pos_] == '\n' || s_[pos_] == '\r')) {
+      pos_++;
+    }
+  }
+
+  bool literal(const char *lit) {
+    size_t n = strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) return false;
+    pos_ += n;
+    return true;
+  }
+
+  bool value(Value *out) {
+    skip_ws();
+    if (pos_ >= s_.size()) return false;
+    char c = s_[pos_];
+    if (c == '{') return object(out);
+    if (c == '[') return array(out);
+    if (c == '"') {
+      std::string str;
+      if (!string(&str)) return false;
+      *out = Value(std::move(str));
+      return true;
+    }
+    if (c == 't') { if (!literal("true")) return false;
+      *out = Value(true); return true; }
+    if (c == 'f') { if (!literal("false")) return false;
+      *out = Value(false); return true; }
+    if (c == 'n') { if (!literal("null")) return false;
+      *out = Value(nullptr); return true; }
+    return number(out);
+  }
+
+  bool number(Value *out) {
+    size_t start = pos_;
+    if (pos_ < s_.size() && s_[pos_] == '-') pos_++;
+    while (pos_ < s_.size() &&
+           (isdigit((unsigned char)s_[pos_]) || s_[pos_] == '.' ||
+            s_[pos_] == 'e' || s_[pos_] == 'E' || s_[pos_] == '+' ||
+            s_[pos_] == '-')) {
+      pos_++;
+    }
+    if (pos_ == start) return false;
+    try {
+      *out = Value(std::stod(s_.substr(start, pos_ - start)));
+    } catch (...) {
+      return false;
+    }
+    return true;
+  }
+
+  bool hex4(unsigned *out) {
+    if (pos_ + 4 > s_.size()) return false;
+    unsigned v = 0;
+    for (int i = 0; i < 4; i++) {
+      char c = s_[pos_ + i];
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else return false;
+    }
+    pos_ += 4;
+    *out = v;
+    return true;
+  }
+
+  static void utf8_append(unsigned cp, std::string *out) {
+    if (cp < 0x80) {
+      *out += (char)cp;
+    } else if (cp < 0x800) {
+      *out += (char)(0xC0 | (cp >> 6));
+      *out += (char)(0x80 | (cp & 0x3F));
+    } else if (cp < 0x10000) {
+      *out += (char)(0xE0 | (cp >> 12));
+      *out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      *out += (char)(0x80 | (cp & 0x3F));
+    } else {
+      *out += (char)(0xF0 | (cp >> 18));
+      *out += (char)(0x80 | ((cp >> 12) & 0x3F));
+      *out += (char)(0x80 | ((cp >> 6) & 0x3F));
+      *out += (char)(0x80 | (cp & 0x3F));
+    }
+  }
+
+  bool string(std::string *out) {
+    if (s_[pos_] != '"') return false;
+    pos_++;
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c == '"') { pos_++; return true; }
+      if (c == '\\') {
+        pos_++;
+        if (pos_ >= s_.size()) return false;
+        char e = s_[pos_++];
+        switch (e) {
+          case '"': *out += '"'; break;
+          case '\\': *out += '\\'; break;
+          case '/': *out += '/'; break;
+          case 'n': *out += '\n'; break;
+          case 't': *out += '\t'; break;
+          case 'r': *out += '\r'; break;
+          case 'b': *out += '\b'; break;
+          case 'f': *out += '\f'; break;
+          case 'u': {
+            unsigned cp;
+            if (!hex4(&cp)) return false;
+            if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 1 < s_.size() &&
+                s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+              pos_ += 2;
+              unsigned lo;
+              if (!hex4(&lo)) return false;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            utf8_append(cp, out);
+            break;
+          }
+          default: return false;
+        }
+      } else {
+        *out += c;
+        pos_++;
+      }
+    }
+    return false;  // unterminated
+  }
+
+  bool array(Value *out) {
+    pos_++;  // '['
+    *out = Value(Array{});
+    skip_ws();
+    if (pos_ < s_.size() && s_[pos_] == ']') { pos_++; return true; }
+    while (true) {
+      Value v;
+      if (!value(&v)) return false;
+      out->push_back(std::move(v));
+      skip_ws();
+      if (pos_ >= s_.size()) return false;
+      if (s_[pos_] == ',') { pos_++; continue; }
+      if (s_[pos_] == ']') { pos_++; return true; }
+      return false;
+    }
+  }
+
+  bool object(Value *out) {
+    pos_++;  // '{'
+    *out = Value(Object{});
+    skip_ws();
+    if (pos_ < s_.size() && s_[pos_] == '}') { pos_++; return true; }
+    while (true) {
+      skip_ws();
+      std::string key;
+      if (pos_ >= s_.size() || s_[pos_] != '"' || !string(&key)) return false;
+      skip_ws();
+      if (pos_ >= s_.size() || s_[pos_] != ':') return false;
+      pos_++;
+      Value v;
+      if (!value(&v)) return false;
+      out->set(key, std::move(v));
+      skip_ws();
+      if (pos_ >= s_.size()) return false;
+      if (s_[pos_] == ',') { pos_++; continue; }
+      if (s_[pos_] == '}') { pos_++; return true; }
+      return false;
+    }
+  }
+
+  const std::string &s_;
+  size_t pos_ = 0;
+};
+
+inline bool parse(const std::string &text, Value *out) {
+  return Parser(text).parse(out);
+}
+
+}  // namespace jsonlite
+
+#endif  // PSTPU_JSONLITE_H_

@@ -1,0 +1,420 @@
+// ps-operator: native C++ control-plane operator for the TPU serving
+// stack's router.
+//
+// Capability parity with the reference's Go kubebuilder operator
+// (reference: src/router-controller/ — StaticRoute CRD
+// api/v1alpha1/staticroute_types.go:28-60; reconcile loop
+// internal/controller/staticroute_controller.go:74-137: fetch CR ->
+// reconcileConfigMap (:140-196, marshals DynamicConfig into ConfigMap
+// key dynamic_config.json, owner-ref'd) -> status update ->
+// checkRouterHealth (:199-380, threshold-based conditions) -> requeue).
+//
+// Transport: plain HTTP to the Kubernetes API. In-cluster this runs
+// beside a `kubectl proxy` sidecar (operator/deployment.yaml) — the
+// environment provides no TLS headers, and the proxy pattern also gives
+// us API-server `services/.../proxy` routing for router health checks
+// without cluster DNS. Tests drive the binary against a mock API server
+// (tests/test_operator.py).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <string>
+
+#include "jsonlite.h"
+
+using jsonlite::Value;
+
+namespace {
+
+constexpr const char *kGroup = "production-stack.vllm.ai";
+constexpr const char *kVersion = "v1alpha1";
+
+// ---------------------------------------------------------------- http
+
+struct HttpResponse {
+  int status = 0;
+  std::string body;
+  bool ok() const { return status >= 200 && status < 300; }
+};
+
+// Decode HTTP/1.1 chunked transfer encoding.
+std::string dechunk(const std::string &in) {
+  std::string out;
+  size_t pos = 0;
+  while (pos < in.size()) {
+    size_t eol = in.find("\r\n", pos);
+    if (eol == std::string::npos) break;
+    long len = strtol(in.substr(pos, eol - pos).c_str(), nullptr, 16);
+    if (len <= 0) break;
+    pos = eol + 2;
+    if (pos + len > in.size()) break;
+    out.append(in, pos, len);
+    pos += len + 2;  // skip trailing CRLF
+  }
+  return out;
+}
+
+HttpResponse http_request(const std::string &host, int port,
+                          const std::string &method, const std::string &path,
+                          const std::string &body = "",
+                          const std::string &content_type =
+                              "application/json",
+                          int timeout_s = 10) {
+  HttpResponse resp;
+  struct addrinfo hints = {}, *res = nullptr;
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0 || !res) {
+    resp.status = -1;
+    return resp;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) { freeaddrinfo(res); resp.status = -1; return resp; }
+  struct timeval tv = {timeout_s, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+  if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    freeaddrinfo(res);
+    close(fd);
+    resp.status = -1;
+    return resp;
+  }
+  freeaddrinfo(res);
+
+  std::string req = method + " " + path + " HTTP/1.1\r\n" +
+                    "Host: " + host + "\r\n" +
+                    "Connection: close\r\n";
+  if (!body.empty()) {
+    req += "Content-Type: " + content_type + "\r\n";
+    req += "Content-Length: " + std::to_string(body.size()) + "\r\n";
+  }
+  req += "\r\n" + body;
+  size_t sent = 0;
+  while (sent < req.size()) {
+    ssize_t n = send(fd, req.data() + sent, req.size() - sent, 0);
+    if (n <= 0) { close(fd); resp.status = -1; return resp; }
+    sent += n;
+  }
+  std::string raw;
+  char buf[8192];
+  ssize_t n;
+  while ((n = recv(fd, buf, sizeof buf, 0)) > 0) raw.append(buf, n);
+  close(fd);
+
+  size_t hdr_end = raw.find("\r\n\r\n");
+  if (hdr_end == std::string::npos) { resp.status = -1; return resp; }
+  sscanf(raw.c_str(), "HTTP/%*s %d", &resp.status);
+  std::string headers = raw.substr(0, hdr_end);
+  std::string payload = raw.substr(hdr_end + 4);
+  // case-insensitive-ish scan for chunked encoding
+  for (auto &c : headers) c = tolower(c);
+  if (headers.find("transfer-encoding: chunked") != std::string::npos) {
+    payload = dechunk(payload);
+  }
+  resp.body = std::move(payload);
+  return resp;
+}
+
+std::string now_rfc3339() {
+  char buf[32];
+  time_t t = time(nullptr);
+  struct tm tmv;
+  gmtime_r(&t, &tmv);
+  strftime(buf, sizeof buf, "%Y-%m-%dT%H:%M:%SZ", &tmv);
+  return buf;
+}
+
+// ---------------------------------------------------------------- k8s
+
+class K8sClient {
+ public:
+  K8sClient(std::string host, int port)
+      : host_(std::move(host)), port_(port) {}
+
+  HttpResponse get(const std::string &path) {
+    return http_request(host_, port_, "GET", path);
+  }
+  HttpResponse post(const std::string &path, const Value &body) {
+    return http_request(host_, port_, "POST", path, body.dump());
+  }
+  HttpResponse put(const std::string &path, const Value &body) {
+    return http_request(host_, port_, "PUT", path, body.dump());
+  }
+
+  std::string routes_path(const std::string &ns) const {
+    std::string p = std::string("/apis/") + kGroup + "/" + kVersion;
+    if (!ns.empty()) p += "/namespaces/" + ns;
+    return p + "/staticroutes";
+  }
+
+ private:
+  std::string host_;
+  int port_;
+};
+
+// ---------------------------------------------------------------- logic
+
+// Builds the dynamic_config.json content the router hot-reloads
+// (production_stack_tpu/router/dynamic_config.py DynamicRouterConfig).
+Value build_dynamic_config(const Value &spec) {
+  Value cfg{jsonlite::Object{}};
+  cfg.set("service_discovery",
+          spec.get("serviceDiscovery").is_null()
+              ? Value("static") : spec.get("serviceDiscovery"));
+  cfg.set("routing_logic",
+          spec.get("routingLogic").is_null()
+              ? Value("roundrobin") : spec.get("routingLogic"));
+  Value backends{jsonlite::Array{}}, models{jsonlite::Array{}};
+  for (const auto &b : spec.get("staticBackends").array()) backends.push_back(b);
+  for (const auto &m : spec.get("staticModels").array()) models.push_back(m);
+  // the CRD also allows comma-separated strings (reference CRD uses
+  // strings; the router's parser accepts both)
+  if (spec.get("staticBackends").is_string())
+    backends = spec.get("staticBackends");
+  if (spec.get("staticModels").is_string())
+    models = spec.get("staticModels");
+  cfg.set("static_backends", backends);
+  cfg.set("static_models", models);
+  if (spec.get("sessionKey").is_string())
+    cfg.set("session_key", spec.get("sessionKey"));
+  return cfg;
+}
+
+void set_condition(Value *status, const std::string &type,
+                   bool ok, const std::string &reason,
+                   const std::string &message) {
+  Value cond{jsonlite::Object{}};
+  cond.set("type", type);
+  cond.set("status", ok ? "True" : "False");
+  cond.set("reason", reason);
+  cond.set("message", message);
+  cond.set("lastTransitionTime", now_rfc3339());
+  Value conds{jsonlite::Array{}};
+  bool replaced = false;
+  for (const auto &c : status->get("conditions").array()) {
+    if (c.get("type").as_string() == type) {
+      conds.push_back(cond);
+      replaced = true;
+    } else {
+      conds.push_back(c);
+    }
+  }
+  if (!replaced) conds.push_back(cond);
+  status->set("conditions", conds);
+}
+
+struct HealthState {
+  int successes = 0;
+  int failures = 0;
+};
+
+class Reconciler {
+ public:
+  Reconciler(K8sClient *k8s, bool verbose)
+      : k8s_(k8s), verbose_(verbose) {}
+
+  // One reconcile pass over every StaticRoute in `ns` ("" = all).
+  // Returns the number of CRs processed, or -1 on list failure.
+  int run(const std::string &ns) {
+    auto resp = k8s_->get(k8s_->routes_path(ns));
+    if (!resp.ok()) {
+      fprintf(stderr, "[operator] list staticroutes failed: HTTP %d\n",
+              resp.status);
+      return -1;
+    }
+    Value list;
+    if (!jsonlite::parse(resp.body, &list)) {
+      fprintf(stderr, "[operator] list response is not JSON\n");
+      return -1;
+    }
+    int count = 0;
+    for (const auto &item : list.get("items").array()) {
+      reconcile(item);
+      count++;
+    }
+    return count;
+  }
+
+ private:
+  void reconcile(const Value &cr) {
+    const std::string name = cr.get("metadata").get("name").as_string();
+    const std::string ns =
+        cr.get("metadata").get("namespace").as_string().empty()
+            ? "default" : cr.get("metadata").get("namespace").as_string();
+    const Value &spec = cr.get("spec");
+
+    Value status = cr.get("status").is_object()
+                       ? cr.get("status") : Value{jsonlite::Object{}};
+
+    // 1. ConfigMap holding dynamic_config.json (owner-ref'd to the CR
+    //    so deleting the route garbage-collects the config).
+    std::string cm_name = spec.get("configMapName").as_string();
+    if (cm_name.empty()) cm_name = name + "-dynamic-config";
+    bool cm_ok = apply_configmap(cr, ns, cm_name);
+    set_condition(&status, "ConfigMapApplied", cm_ok,
+                  cm_ok ? "Reconciled" : "ApplyFailed",
+                  cm_ok ? "dynamic_config.json up to date"
+                        : "ConfigMap create/update failed");
+    if (cm_ok) {
+      Value ref{jsonlite::Object{}};
+      ref.set("name", cm_name);
+      ref.set("namespace", ns);
+      status.set("configMapRef", ref);
+      status.set("lastAppliedTime", now_rfc3339());
+    }
+
+    // 2. Router health through the API server's service proxy
+    //    (reference checkRouterHealth resolves the Service and polls
+    //    /health with success/failure thresholds).
+    const Value &router_ref = spec.get("routerRef");
+    if (!router_ref.is_null()) {
+      check_router_health(ns, name, router_ref, spec.get("healthCheck"),
+                          &status);
+    }
+
+    // 3. Status subresource update.
+    Value updated{jsonlite::Object{}};
+    for (const auto &kv : cr.object()) updated.set(kv.first, kv.second);
+    updated.set("status", status);
+    std::string path = k8s_->routes_path(ns) + "/" + name + "/status";
+    auto resp = k8s_->put(path, updated);
+    if (!resp.ok() && verbose_) {
+      fprintf(stderr, "[operator] status update for %s/%s: HTTP %d\n",
+              ns.c_str(), name.c_str(), resp.status);
+    }
+    if (verbose_) {
+      fprintf(stderr, "[operator] reconciled %s/%s (cm=%s)\n", ns.c_str(),
+              name.c_str(), cm_name.c_str());
+    }
+  }
+
+  bool apply_configmap(const Value &cr, const std::string &ns,
+                       const std::string &cm_name) {
+    Value cm{jsonlite::Object{}};
+    cm.set("apiVersion", "v1");
+    cm.set("kind", "ConfigMap");
+    Value meta{jsonlite::Object{}};
+    meta.set("name", cm_name);
+    meta.set("namespace", ns);
+    Value owner{jsonlite::Object{}};
+    owner.set("apiVersion", std::string(kGroup) + "/" + kVersion);
+    owner.set("kind", "StaticRoute");
+    owner.set("name", cr.get("metadata").get("name"));
+    owner.set("uid", cr.get("metadata").get("uid"));
+    owner.set("controller", true);
+    Value owners{jsonlite::Array{}};
+    owners.push_back(owner);
+    meta.set("ownerReferences", owners);
+    cm.set("metadata", meta);
+    Value data{jsonlite::Object{}};
+    data.set("dynamic_config.json",
+             build_dynamic_config(cr.get("spec")).dump());
+    cm.set("data", data);
+
+    std::string base = "/api/v1/namespaces/" + ns + "/configmaps";
+    auto existing = k8s_->get(base + "/" + cm_name);
+    if (existing.status == 404) {
+      return k8s_->post(base, cm).ok();
+    }
+    if (!existing.ok()) return false;
+    return k8s_->put(base + "/" + cm_name, cm).ok();
+  }
+
+  void check_router_health(const std::string &ns, const std::string &cr_name,
+                           const Value &router_ref, const Value &hc,
+                           Value *status) {
+    const std::string svc = router_ref.get("name").as_string();
+    const std::string svc_ns = router_ref.get("namespace").as_string().empty()
+                                   ? ns
+                                   : router_ref.get("namespace").as_string();
+    int port = (int)router_ref.get("port").as_number(80);
+    int success_needed = (int)hc.get("successThreshold").as_number(1);
+    int failure_needed = (int)hc.get("failureThreshold").as_number(3);
+
+    std::string path = "/api/v1/namespaces/" + svc_ns + "/services/" + svc +
+                       ":" + std::to_string(port) + "/proxy/health";
+    bool healthy_now = k8s_->get(path).ok();
+    HealthState &st = health_[ns + "/" + cr_name];
+    if (healthy_now) {
+      st.successes++;
+      st.failures = 0;
+    } else {
+      st.failures++;
+      st.successes = 0;
+    }
+    if (st.successes >= success_needed) {
+      set_condition(status, "HealthCheckSucceeded", true, "RouterHealthy",
+                    "router /health responded OK");
+    } else if (st.failures >= failure_needed) {
+      set_condition(status, "HealthCheckSucceeded", false, "RouterUnhealthy",
+                    "router /health failed " + std::to_string(st.failures) +
+                        " consecutive times");
+    }
+    // below both thresholds: leave the previous condition in place
+  }
+
+  K8sClient *k8s_;
+  bool verbose_;
+  std::map<std::string, HealthState> health_;
+};
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  std::string server = "http://127.0.0.1:8001";
+  std::string ns;  // empty = all namespaces
+  int period_s = 30;
+  int iterations = 0;  // 0 = run forever
+  bool verbose = false;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return i + 1 < argc ? argv[++i] : "";
+    };
+    if (a == "--server") server = next();
+    else if (a == "--namespace") ns = next();
+    else if (a == "--period") period_s = atoi(next().c_str());
+    else if (a == "--iterations") iterations = atoi(next().c_str());
+    else if (a == "--verbose") verbose = true;
+    else if (a == "--help") {
+      printf("ps-operator: StaticRoute -> router dynamic-config "
+             "reconciler\n"
+             "  --server URL      k8s API (default http://127.0.0.1:8001,"
+             " a kubectl-proxy sidecar)\n"
+             "  --namespace NS    watch one namespace (default: all)\n"
+             "  --period S        reconcile interval seconds (default 30)\n"
+             "  --iterations N    stop after N passes (0 = forever)\n"
+             "  --verbose         log each reconcile\n");
+      return 0;
+    }
+  }
+  // parse http://host:port
+  std::string hostport = server;
+  if (hostport.rfind("http://", 0) == 0) hostport = hostport.substr(7);
+  if (!hostport.empty() && hostport.back() == '/') hostport.pop_back();
+  std::string host = hostport;
+  int port = 80;
+  auto colon = hostport.rfind(':');
+  if (colon != std::string::npos) {
+    host = hostport.substr(0, colon);
+    port = atoi(hostport.substr(colon + 1).c_str());
+  }
+
+  K8sClient k8s(host, port);
+  Reconciler rec(&k8s, verbose);
+  fprintf(stderr, "[operator] watching %s (ns=%s) every %ds\n",
+          server.c_str(), ns.empty() ? "<all>" : ns.c_str(), period_s);
+  for (int i = 0; iterations == 0 || i < iterations; i++) {
+    if (i > 0) sleep(period_s);
+    rec.run(ns);
+  }
+  return 0;
+}

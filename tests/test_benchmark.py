@@ -84,7 +84,7 @@ def test_benchmark_end_to_end_against_fake_engine(tmp_path):
         users = {u for _, u, _ in fake.requests_seen}
         assert all(u is not None for u in users)
 
-        s = summarize(results, pending=0)
+        s = summarize(results)
         assert s.finished_requests == len(results)
         assert s.output_tokens_per_s > 0
         assert s.mean_ttft > 0

@@ -72,3 +72,9 @@ def test_kube_prom_stack_values_parse():
     ports = {e["port"] for e in mon["endpoints"]}
     # the ports must match the chart's container port names
     assert ports == {"engine-port", "router-port"}
+    # the selector uses the fixed scrape marker the Services carry
+    marker = "production-stack.vllm.ai/scrape"
+    assert mon["selector"]["matchLabels"] == {marker: "true"}
+    tdir = os.path.join(os.path.dirname(OBS), "helm", "templates")
+    for svc in ("service-engine.yaml", "service-router.yaml"):
+        assert marker in open(os.path.join(tdir, svc)).read(), svc

@@ -1,0 +1,247 @@
+"""C++ operator tests against a mock Kubernetes API server.
+
+The envtest equivalent of the reference's Go operator suite
+(src/router-controller/internal/controller/suite_test.go): an in-process
+aiohttp server implements the handful of API routes the operator uses —
+list StaticRoutes, ConfigMap CRUD, status subresource update, and the
+service-proxy health path — and the compiled ps-operator binary runs
+against it for a bounded number of reconcile passes.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OPERATOR_BIN = os.path.join(REPO, "native", "build", "ps-operator")
+
+GROUP_PATH = "/apis/production-stack.vllm.ai/v1alpha1"
+
+
+def _build_operator():
+    if not os.path.exists(OPERATOR_BIN):
+        subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                        "build/ps-operator"], check=True, timeout=120,
+                       capture_output=True)
+    return OPERATOR_BIN
+
+
+class MockK8s:
+    """Just enough of the K8s REST surface for the operator."""
+
+    def __init__(self, router_healthy: bool = True):
+        self.staticroutes = {}           # (ns, name) -> object
+        self.configmaps = {}             # (ns, name) -> object
+        self.status_updates = []
+        self.router_healthy = router_healthy
+        self.health_hits = 0
+
+    def add_route(self, name, ns="default", spec=None):
+        self.staticroutes[(ns, name)] = {
+            "apiVersion": "production-stack.vllm.ai/v1alpha1",
+            "kind": "StaticRoute",
+            "metadata": {"name": name, "namespace": ns, "uid": f"uid-{name}"},
+            "spec": spec or {},
+        }
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get(GROUP_PATH + "/staticroutes", self.list_all)
+        app.router.add_get(
+            GROUP_PATH + "/namespaces/{ns}/staticroutes", self.list_ns)
+        app.router.add_put(
+            GROUP_PATH + "/namespaces/{ns}/staticroutes/{name}/status",
+            self.put_status)
+        app.router.add_get("/api/v1/namespaces/{ns}/configmaps/{name}",
+                           self.get_cm)
+        app.router.add_post("/api/v1/namespaces/{ns}/configmaps",
+                            self.post_cm)
+        app.router.add_put("/api/v1/namespaces/{ns}/configmaps/{name}",
+                           self.put_cm)
+        app.router.add_get(
+            "/api/v1/namespaces/{ns}/services/{svcport}/proxy/health",
+            self.proxy_health)
+        return app
+
+    async def list_all(self, request):
+        return web.json_response(
+            {"items": list(self.staticroutes.values())})
+
+    async def list_ns(self, request):
+        ns = request.match_info["ns"]
+        return web.json_response(
+            {"items": [v for (n, _), v in
+                       zip(self.staticroutes.keys(),
+                           self.staticroutes.values()) if n == ns]})
+
+    async def put_status(self, request):
+        ns, name = request.match_info["ns"], request.match_info["name"]
+        body = await request.json()
+        self.status_updates.append(body)
+        if (ns, name) in self.staticroutes:
+            self.staticroutes[(ns, name)]["status"] = body.get("status", {})
+        return web.json_response(body)
+
+    async def get_cm(self, request):
+        key = (request.match_info["ns"], request.match_info["name"])
+        if key not in self.configmaps:
+            return web.json_response({"reason": "NotFound"}, status=404)
+        return web.json_response(self.configmaps[key])
+
+    async def post_cm(self, request):
+        body = await request.json()
+        key = (request.match_info["ns"], body["metadata"]["name"])
+        self.configmaps[key] = body
+        return web.json_response(body, status=201)
+
+    async def put_cm(self, request):
+        body = await request.json()
+        key = (request.match_info["ns"], request.match_info["name"])
+        self.configmaps[key] = body
+        return web.json_response(body)
+
+    async def proxy_health(self, request):
+        self.health_hits += 1
+        if self.router_healthy:
+            return web.json_response({"status": "ok"})
+        return web.json_response({"status": "down"}, status=503)
+
+
+SPEC = {
+    "serviceDiscovery": "static",
+    "routingLogic": "session",
+    "sessionKey": "x-user-id",
+    "staticBackends": "http://e1:8000,http://e2:8000",
+    "staticModels": "m1,m2",
+    "routerRef": {"name": "router-svc", "port": 80},
+    "healthCheck": {"successThreshold": 1, "failureThreshold": 2},
+}
+
+
+def _run_operator(port, iterations=1, extra=()):
+    return subprocess.run(
+        [_build_operator(), "--server", f"http://127.0.0.1:{port}",
+         "--iterations", str(iterations), "--period", "1", "--verbose",
+         *extra],
+        capture_output=True, timeout=60, text=True)
+
+
+def test_operator_reconciles_configmap_and_status():
+    async def body():
+        mock = MockK8s(router_healthy=True)
+        mock.add_route("route-a", spec=SPEC)
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        proc = await asyncio.to_thread(_run_operator, server.port)
+        await server.close()
+        assert proc.returncode == 0, proc.stderr
+
+        # ConfigMap created with the router's dynamic-config contract
+        cm = mock.configmaps[("default", "route-a-dynamic-config")]
+        cfg = json.loads(cm["data"]["dynamic_config.json"])
+        assert cfg["service_discovery"] == "static"
+        assert cfg["routing_logic"] == "session"
+        assert cfg["session_key"] == "x-user-id"
+        assert cfg["static_backends"] == "http://e1:8000,http://e2:8000"
+        assert cfg["static_models"] == "m1,m2"
+        # owner reference ties the ConfigMap to the CR for GC
+        owner = cm["metadata"]["ownerReferences"][0]
+        assert owner["kind"] == "StaticRoute"
+        assert owner["name"] == "route-a"
+        assert owner["uid"] == "uid-route-a"
+
+        # status: ConfigMapApplied + HealthCheckSucceeded conditions
+        assert mock.status_updates
+        status = mock.status_updates[-1]["status"]
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds["ConfigMapApplied"] == "True"
+        assert conds["HealthCheckSucceeded"] == "True"
+        assert status["configMapRef"]["name"] == "route-a-dynamic-config"
+        assert status["lastAppliedTime"]
+        assert mock.health_hits == 1
+    asyncio.run(body())
+
+
+def test_operator_marks_unhealthy_after_threshold():
+    async def body():
+        mock = MockK8s(router_healthy=False)
+        mock.add_route("route-b", spec=SPEC)
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        # failureThreshold=2: the second pass flips the condition
+        proc = await asyncio.to_thread(_run_operator, server.port, 2)
+        await server.close()
+        assert proc.returncode == 0, proc.stderr
+        status = mock.status_updates[-1]["status"]
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds["ConfigMapApplied"]["status"] == "True"
+        assert conds["HealthCheckSucceeded"]["status"] == "False"
+        assert "2 consecutive" in conds["HealthCheckSucceeded"]["message"]
+        # first pass (1 failure < threshold) must NOT have set it
+        first = mock.status_updates[0]["status"]
+        first_conds = {c["type"] for c in first["conditions"]}
+        assert "HealthCheckSucceeded" not in first_conds
+    asyncio.run(body())
+
+
+def test_operator_updates_existing_configmap():
+    async def body():
+        mock = MockK8s()
+        mock.add_route("route-c", spec=dict(SPEC, routingLogic="roundrobin"))
+        mock.configmaps[("default", "route-c-dynamic-config")] = {
+            "apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "route-c-dynamic-config",
+                         "namespace": "default"},
+            "data": {"dynamic_config.json": "{\"stale\": true}"}}
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        proc = await asyncio.to_thread(_run_operator, server.port)
+        await server.close()
+        assert proc.returncode == 0, proc.stderr
+        cfg = json.loads(
+            mock.configmaps[("default", "route-c-dynamic-config")]
+            ["data"]["dynamic_config.json"])
+        assert "stale" not in cfg
+        assert cfg["routing_logic"] == "roundrobin"
+    asyncio.run(body())
+
+
+def test_operator_respects_explicit_configmap_name():
+    async def body():
+        mock = MockK8s()
+        mock.add_route("route-d",
+                       spec=dict(SPEC, configMapName="my-config"))
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        proc = await asyncio.to_thread(_run_operator, server.port)
+        await server.close()
+        assert proc.returncode == 0, proc.stderr
+        assert ("default", "my-config") in mock.configmaps
+    asyncio.run(body())
+
+
+def test_dynamic_config_roundtrips_into_router():
+    """The operator-written JSON must be loadable by the router's
+    DynamicRouterConfig (the consumer side of the contract)."""
+    async def body():
+        mock = MockK8s()
+        mock.add_route("route-e", spec=SPEC)
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        await asyncio.to_thread(_run_operator, server.port)
+        await server.close()
+        raw = mock.configmaps[("default", "route-e-dynamic-config")][
+            "data"]["dynamic_config.json"]
+        from production_stack_tpu.router.dynamic_config import \
+            DynamicRouterConfig
+        cfg = DynamicRouterConfig.from_json(json.loads(raw))
+        assert cfg.routing_logic == "session"
+        assert cfg.static_backends == ["http://e1:8000", "http://e2:8000"]
+        assert cfg.static_models == ["m1", "m2"]
+        assert cfg.session_key == "x-user-id"
+    asyncio.run(body())
