@@ -13,8 +13,10 @@
 #ifndef PSTPU_JSONLITE_H_
 #define PSTPU_JSONLITE_H_
 
+#include <cctype>
 #include <cmath>
 #include <cstdio>
+#include <cstring>
 #include <map>
 #include <memory>
 #include <string>
@@ -108,8 +110,10 @@ class Value {
       case Type::Bool: out += bool_ ? "true" : "false"; break;
       case Type::Number: {
         char buf[32];
-        if (std::isfinite(num_) && num_ == (long long)num_ &&
-            std::fabs(num_) < 1e15) {
+        // magnitude guard must precede the integer cast: converting a
+        // finite double >= 2^63 to long long is UB
+        if (std::isfinite(num_) && std::fabs(num_) < 1e15 &&
+            num_ == (long long)num_) {
           snprintf(buf, sizeof buf, "%lld", (long long)num_);
         } else {
           snprintf(buf, sizeof buf, "%.17g", num_);

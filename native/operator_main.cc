@@ -21,6 +21,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <cctype>
 #include <cstring>
 #include <ctime>
 #include <map>
@@ -191,9 +192,10 @@ Value build_dynamic_config(const Value &spec) {
 void set_condition(Value *status, const std::string &type,
                    bool ok, const std::string &reason,
                    const std::string &message) {
+  const std::string want = ok ? "True" : "False";
   Value cond{jsonlite::Object{}};
   cond.set("type", type);
-  cond.set("status", ok ? "True" : "False");
+  cond.set("status", want);
   cond.set("reason", reason);
   cond.set("message", message);
   cond.set("lastTransitionTime", now_rfc3339());
@@ -201,6 +203,11 @@ void set_condition(Value *status, const std::string &type,
   bool replaced = false;
   for (const auto &c : status->get("conditions").array()) {
     if (c.get("type").as_string() == type) {
+      // K8s condition contract: lastTransitionTime marks the last
+      // status FLIP, so an unchanged status keeps the old stamp
+      if (c.get("status").as_string() == want) {
+        cond.set("lastTransitionTime", c.get("lastTransitionTime"));
+      }
       conds.push_back(cond);
       replaced = true;
     } else {

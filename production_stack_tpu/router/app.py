@@ -149,6 +149,13 @@ def build_app(args: argparse.Namespace) -> web.Application:
             threshold=args.semantic_cache_threshold,
             max_entries=args.semantic_cache_max_entries,
             persist_dir=args.semantic_cache_dir)
+    from production_stack_tpu.router.disagg import make_orchestrator
+    disagg = make_orchestrator(args)
+    if disagg is not None:
+        state["disagg"] = disagg
+        logger.info("disaggregated prefill: %d prefill backends",
+                    len(disagg.endpoints))
+
     # indirect through state so dynamic-config discovery swaps are followed
     state["scraper"] = EngineStatsScraper(
         lambda: state["discovery"].get_endpoints(),
@@ -236,6 +243,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default="block")
     p.add_argument("--pii-types", default=None,
                    help="comma-separated PIIType values (default: all)")
+    p.add_argument("--prefill-backends", default="",
+                   help="comma-separated kv_producer engine URLs enabling "
+                        "disaggregated prefill")
+    p.add_argument("--prefill-models", default="",
+                   help="comma-separated model names for the prefill pool "
+                        "(same order)")
+    p.add_argument("--prefill-timeout", type=float, default=120.0)
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

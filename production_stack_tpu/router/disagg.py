@@ -1,0 +1,107 @@
+"""Disaggregated prefill orchestration (green-field — the reference only
+roadmaps it: README.md:56, docs/source/tutorials/disagg.rst "Coming
+soon"; the --kv-transfer-config kv_role producer/consumer knob,
+deployment-vllm-multi.yaml:96-97, is its engine-side hook).
+
+Architecture: a *prefill pool* of kv_producer engines (e.g. v5p slices —
+prefill is compute-bound and loves MXU width) and a *decode pool* of
+kv_consumer engines (e.g. v5e — decode is HBM-bandwidth-bound), joined
+by the shared KV tier (host DRAM / disk / tpukv remote server over DCN).
+
+Request flow: the router first sends the prompt to a prefill engine as a
+1-token non-streaming completion. That engine computes the prompt KV and
+its producer connector writes the full chunks through the shared tier.
+The router then forwards the original request to a decode engine, whose
+consumer connector pulls the cached prefix, so decode-side prefill
+collapses to the chunk remainder. Prefill failures degrade gracefully:
+the decode engine can always recompute from scratch.
+"""
+
+import asyncio
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.router.proxy import CACHE_CONTROL_FIELDS
+from production_stack_tpu.router.service_discovery import EndpointInfo
+from production_stack_tpu.utils import init_logger, parse_comma_separated
+
+logger = init_logger(__name__)
+
+PREFILL_PATHS = ("/v1/chat/completions", "/v1/completions")
+
+
+class DisaggPrefillOrchestrator:
+    """Round-robins prompts over the prefill pool before decode routing."""
+
+    def __init__(self, backends: List[str], models: List[str],
+                 timeout_s: float = 120.0):
+        if len(backends) != len(models):
+            raise ValueError(
+                f"{len(backends)} prefill backends but {len(models)} models")
+        self.endpoints = [EndpointInfo(url=u, model=m)
+                          for u, m in zip(backends, models)]
+        self.timeout_s = timeout_s
+        # per-model counters: a shared counter advanced by other models'
+        # traffic would skew (or fully starve) a pool's rotation
+        self._rr: Dict[str, int] = {}
+        self.prefills = 0
+        self.prefill_errors = 0
+
+    def pick(self, model: str) -> Optional[str]:
+        pool = [ep.url for ep in self.endpoints if ep.serves(model)]
+        if not pool:
+            return None
+        idx = self._rr.get(model, 0)
+        self._rr[model] = idx + 1
+        return pool[idx % len(pool)]
+
+    @staticmethod
+    def prefill_body(body: dict) -> dict:
+        """The original request reduced to a 1-token non-streaming pass:
+        enough for the producer engine to compute + publish the prompt
+        KV, cheap enough to run serially before decode."""
+        drop = ("stream", "stream_options") + CACHE_CONTROL_FIELDS
+        out = {k: v for k, v in body.items() if k not in drop}
+        out["max_tokens"] = 1
+        out.pop("max_completion_tokens", None)
+        return out
+
+    async def run_prefill(self, session: aiohttp.ClientSession,
+                          endpoint_path: str, model: str, body: dict,
+                          headers: Optional[Dict[str, str]] = None) -> bool:
+        """Fire the prefill pass; True when the pool accepted it."""
+        if endpoint_path not in PREFILL_PATHS:
+            return False
+        url = self.pick(model)
+        if url is None:
+            return False
+        self.prefills += 1
+        try:
+            async with session.post(
+                    f"{url}{endpoint_path}",
+                    json=self.prefill_body(body),
+                    headers=headers or {},
+                    timeout=aiohttp.ClientTimeout(
+                        total=self.timeout_s)) as resp:
+                await resp.read()
+                if resp.status == 200:
+                    return True
+                logger.warning("disagg prefill on %s returned %d", url,
+                               resp.status)
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            logger.warning("disagg prefill on %s failed: %s", url, e)
+        self.prefill_errors += 1
+        return False
+
+
+def make_orchestrator(args) -> Optional[DisaggPrefillOrchestrator]:
+    backends = parse_comma_separated(
+        getattr(args, "prefill_backends", None))
+    if not backends:
+        return None
+    models = parse_comma_separated(getattr(args, "prefill_models", None))
+    return DisaggPrefillOrchestrator(
+        backends, models,
+        timeout_s=getattr(args, "prefill_timeout", 120.0))

@@ -75,9 +75,8 @@ class MockK8s:
     async def list_ns(self, request):
         ns = request.match_info["ns"]
         return web.json_response(
-            {"items": [v for (n, _), v in
-                       zip(self.staticroutes.keys(),
-                           self.staticroutes.values()) if n == ns]})
+            {"items": [v for (n, _), v in self.staticroutes.items()
+                       if n == ns]})
 
     async def put_status(self, request):
         ns, name = request.match_info["ns"], request.match_info["name"]
@@ -244,4 +243,23 @@ def test_dynamic_config_roundtrips_into_router():
         assert cfg.static_backends == ["http://e1:8000", "http://e2:8000"]
         assert cfg.static_models == ["m1", "m2"]
         assert cfg.session_key == "x-user-id"
+    asyncio.run(body())
+
+
+def test_condition_transition_time_stable_when_status_unchanged():
+    async def body():
+        mock = MockK8s(router_healthy=True)
+        mock.add_route("route-f", spec=SPEC)
+        server = TestServer(mock.build_app())
+        await server.start_server()
+        proc = await asyncio.to_thread(_run_operator, server.port, 2)
+        await server.close()
+        assert proc.returncode == 0, proc.stderr
+        stamps = []
+        for upd in mock.status_updates:
+            for c in upd["status"]["conditions"]:
+                if c["type"] == "HealthCheckSucceeded":
+                    stamps.append(c["lastTransitionTime"])
+        # two passes, same True status -> the transition stamp must not move
+        assert len(stamps) == 2 and stamps[0] == stamps[1]
     asyncio.run(body())
