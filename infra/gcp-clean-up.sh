@@ -1,0 +1,13 @@
+#!/bin/bash
+# Tear down everything gcp-entry-point.sh created (reference:
+# deployment_on_cloud/gcp/clean_up.sh).
+set -euo pipefail
+PROJECT="${1:?usage: gcp-clean-up.sh <gcp-project>}"
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+helm uninstall production-stack-tpu || true
+"$(dirname "$SCRIPT_DIR")/observability/uninstall.sh" || true
+
+pushd "$SCRIPT_DIR/terraform/gke"
+terraform destroy -auto-approve -var "project=$PROJECT"
+popd

@@ -1,0 +1,26 @@
+#!/bin/bash
+# One-shot GKE TPU bring-up (reference: deployment_on_cloud/gcp/
+# entry_point.sh:23-63): terraform the cluster + TPU pool, fetch creds,
+# install observability, install the stack chart.
+set -euo pipefail
+
+PROJECT="${1:?usage: gcp-entry-point.sh <gcp-project> [values-file]}"
+VALUES="${2:-helm/examples/values-minimal-tpu.yaml}"
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO="$(dirname "$SCRIPT_DIR")"
+
+pushd "$SCRIPT_DIR/terraform/gke"
+terraform init
+terraform apply -auto-approve -var "project=$PROJECT"
+eval "$(terraform output -raw kubeconfig_command)"
+popd
+
+"$REPO/observability/install.sh"
+
+helm upgrade --install production-stack-tpu "$REPO/helm" -f "$REPO/$VALUES"
+kubectl apply -f "$REPO/operator/crd.yaml"
+kubectl apply -f "$REPO/operator/rbac.yaml"
+kubectl apply -f "$REPO/operator/deployment.yaml"
+
+echo "stack deployed; router service:"
+kubectl get svc | grep router

@@ -1,0 +1,25 @@
+#!/bin/bash
+# Local single-node cluster for CPU-only stack testing (reference:
+# utils/install-minikube-cluster.sh). Engines run the debug-tiny preset;
+# no TPU required.
+set -euo pipefail
+
+if ! command -v minikube >/dev/null; then
+  curl -LO https://storage.googleapis.com/minikube/releases/latest/minikube-linux-amd64
+  sudo install minikube-linux-amd64 /usr/local/bin/minikube
+  rm minikube-linux-amd64
+fi
+
+if ! command -v kubectl >/dev/null; then
+  curl -LO "https://dl.k8s.io/release/$(curl -Ls https://dl.k8s.io/release/stable.txt)/bin/linux/amd64/kubectl"
+  sudo install -o root -g root -m 0755 kubectl /usr/local/bin/kubectl
+  rm kubectl
+fi
+
+if ! command -v helm >/dev/null; then
+  curl -fsSL https://raw.githubusercontent.com/helm/helm/main/scripts/get-helm-3 | bash
+fi
+
+minikube start --cpus 8 --memory 16g --driver docker
+echo "cluster ready; install the stack with:"
+echo "  helm install pstpu ./helm -f helm/examples/values-minimal-tpu.yaml"

@@ -1,0 +1,26 @@
+# GKE cluster for the TPU serving stack (reference:
+# tutorials/terraform/gke/gke-infrastructure/cluster.tf).
+
+resource "google_container_cluster" "primary" {
+  name     = var.cluster_name
+  location = var.zone
+
+  # node pools are managed explicitly below
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = "REGULAR"
+  }
+
+  # required for TPU workload scheduling metadata
+  addons_config {
+    gcs_fuse_csi_driver_config {
+      enabled = true
+    }
+  }
+
+  workload_identity_config {
+    workload_pool = "${var.project}.svc.id.goog"
+  }
+}

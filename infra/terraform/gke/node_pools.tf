@@ -1,0 +1,94 @@
+# Node pools (reference: gke-infrastructure/node_pools.tf). The GPU
+# accelerator pool becomes a TPU slice pool: GKE schedules
+# TPU pods by machine type + the implicit
+# cloud.google.com/gke-tpu-accelerator / gke-tpu-topology node labels,
+# and taints TPU nodes with google.com/tpu automatically.
+
+resource "google_container_node_pool" "tpu_pool" {
+  name     = "${var.cluster_name}-tpu-pool"
+  location = var.zone
+  cluster  = google_container_cluster.primary.name
+
+  initial_node_count = var.tpu_node_count
+
+  autoscaling {
+    min_node_count = var.tpu_pool_min_nodes
+    max_node_count = var.tpu_pool_max_nodes
+  }
+
+  node_config {
+    image_type   = "COS_CONTAINERD"
+    disk_type    = "pd-balanced"
+    disk_size_gb = 200
+
+    machine_type = var.tpu_machine_type
+
+    oauth_scopes = [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring",
+      "https://www.googleapis.com/auth/servicecontrol",
+      "https://www.googleapis.com/auth/service.management.readonly",
+      "https://www.googleapis.com/auth/trace.append",
+    ]
+
+    labels = {
+      env = var.project
+      app = "tpu-inference"
+    }
+  }
+
+  # single-host slice pools pin the topology via placement policy
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.tpu_topology
+  }
+
+  management {
+    auto_repair  = true
+    auto_upgrade = true
+  }
+
+  upgrade_settings {
+    max_surge       = 1
+    max_unavailable = 0
+  }
+
+  depends_on = [google_container_cluster.primary]
+}
+
+# Management pool: router, operator, cache server, Prometheus/Grafana.
+resource "google_container_node_pool" "mgmt_pool" {
+  name       = "${var.cluster_name}-mgmt-pool"
+  location   = var.zone
+  cluster    = google_container_cluster.primary.name
+  node_count = var.mgmt_node_count
+
+  node_config {
+    image_type   = "COS_CONTAINERD"
+    disk_type    = "pd-balanced"
+    disk_size_gb = 100
+    machine_type = var.mgmt_machine_type
+
+    oauth_scopes = [
+      "https://www.googleapis.com/auth/devstorage.read_only",
+      "https://www.googleapis.com/auth/logging.write",
+      "https://www.googleapis.com/auth/monitoring",
+      "https://www.googleapis.com/auth/servicecontrol",
+      "https://www.googleapis.com/auth/service.management.readonly",
+      "https://www.googleapis.com/auth/trace.append",
+    ]
+
+    labels = {
+      env = var.project
+      app = "stack-management"
+    }
+  }
+
+  management {
+    auto_repair  = true
+    auto_upgrade = true
+  }
+
+  depends_on = [google_container_cluster.primary]
+}

@@ -1,0 +1,16 @@
+output "cluster_name" {
+  value = google_container_cluster.primary.name
+}
+
+output "cluster_endpoint" {
+  value     = google_container_cluster.primary.endpoint
+  sensitive = true
+}
+
+output "kubeconfig_command" {
+  value = "gcloud container clusters get-credentials ${google_container_cluster.primary.name} --zone ${var.zone} --project ${var.project}"
+}
+
+output "tpu_pool" {
+  value = google_container_node_pool.tpu_pool.name
+}

@@ -1,0 +1,65 @@
+# GKE TPU infrastructure variables (reference:
+# tutorials/terraform/gke/gke-infrastructure/variables.tf, re-targeted
+# from GPU node pools to TPU slice node pools).
+
+variable "project" {
+  type        = string
+  description = "GCP project id"
+}
+
+variable "region" {
+  type        = string
+  default     = "us-central2"
+  description = "Region with TPU availability"
+}
+
+variable "zone" {
+  type        = string
+  default     = "us-central2-b"
+  description = "Zone with the requested TPU topology"
+}
+
+variable "cluster_name" {
+  type        = string
+  default     = "production-stack-tpu"
+}
+
+variable "tpu_machine_type" {
+  type        = string
+  default     = "ct5lp-hightpu-4t"
+  description = "TPU VM machine type (ct5lp-* = v5e, ct5p-* = v5p)"
+}
+
+variable "tpu_topology" {
+  type        = string
+  default     = "2x2"
+  description = "Slice topology; must match the machine type's chip count"
+}
+
+variable "tpu_node_count" {
+  type        = number
+  default     = 1
+  description = "Nodes per slice (single-host v5e-4 = 1)"
+}
+
+variable "tpu_pool_min_nodes" {
+  type        = number
+  default     = 1
+}
+
+variable "tpu_pool_max_nodes" {
+  type        = number
+  default     = 4
+  description = "Autoscaler ceiling for the TPU pool (HPA adds engine replicas; the cluster autoscaler adds slices)"
+}
+
+variable "mgmt_machine_type" {
+  type        = string
+  default     = "e2-standard-8"
+  description = "Management pool (router, operator, cache server, observability)"
+}
+
+variable "mgmt_node_count" {
+  type        = number
+  default     = 2
+}

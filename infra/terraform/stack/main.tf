@@ -1,0 +1,49 @@
+# Deploys the production-stack-tpu helm chart onto an existing cluster
+# (reference: tutorials/terraform/gke/production-stack/helm.tf).
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    helm = {
+      source  = "hashicorp/helm"
+      version = ">= 2.12"
+    }
+  }
+}
+
+variable "kubeconfig_path" {
+  type    = string
+  default = "~/.kube/config"
+}
+
+variable "release_name" {
+  type    = string
+  default = "production-stack-tpu"
+}
+
+variable "namespace" {
+  type    = string
+  default = "default"
+}
+
+variable "values_file" {
+  type        = string
+  description = "Path to a chart values file (e.g. ../../helm/examples/values-minimal-tpu.yaml)"
+}
+
+provider "helm" {
+  kubernetes {
+    config_path = var.kubeconfig_path
+  }
+}
+
+resource "helm_release" "stack" {
+  name      = var.release_name
+  namespace = var.namespace
+  chart     = "${path.module}/../../../helm"
+
+  values = [file(var.values_file)]
+
+  wait    = true
+  timeout = 1200   # XLA warmup makes engine startup slow; be patient
+}

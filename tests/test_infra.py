@@ -1,0 +1,49 @@
+"""Infra asset checks: terraform files are brace-balanced and reference
+declared variables; shell scripts pass bash -n (syntax)."""
+
+import glob
+import os
+import re
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INFRA = os.path.join(REPO, "infra")
+
+
+def test_shell_scripts_parse():
+    scripts = glob.glob(os.path.join(INFRA, "*.sh")) + \
+        glob.glob(os.path.join(REPO, "observability", "*.sh")) + \
+        glob.glob(os.path.join(REPO, "benchmarks", "**", "*.sh"),
+                  recursive=True)
+    assert scripts
+    for s in scripts:
+        subprocess.run(["bash", "-n", s], check=True)
+
+
+def test_terraform_braces_balanced():
+    tfs = glob.glob(os.path.join(INFRA, "terraform", "**", "*.tf"),
+                    recursive=True)
+    assert len(tfs) >= 6
+    for tf in tfs:
+        text = open(tf).read()
+        assert text.count("{") == text.count("}"), tf
+
+
+def test_terraform_var_references_declared():
+    gke = os.path.join(INFRA, "terraform", "gke")
+    declared = set()
+    used = set()
+    for tf in glob.glob(os.path.join(gke, "*.tf")):
+        text = open(tf).read()
+        declared |= set(re.findall(r'variable\s+"(\w+)"', text))
+        used |= set(re.findall(r"var\.(\w+)", text))
+    missing = used - declared
+    assert not missing, f"undeclared terraform variables: {missing}"
+
+
+def test_tpu_pool_is_tpu_native():
+    text = open(os.path.join(INFRA, "terraform", "gke",
+                             "node_pools.tf")).read()
+    assert "tpu_topology" in text
+    assert "nvidia" not in text
+    assert "guest_accelerator" not in text
