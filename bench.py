@@ -32,11 +32,15 @@ def run_bench(small: bool) -> dict:
 
     if small:
         cfg = EngineConfig(model="debug-tiny", max_model_len=512,
-                           max_num_seqs=8, prefill_chunk=128)
+                           max_num_seqs=8, prefill_chunk=128,
+                           decode_window=16)
         prompt_len, gen_len, n_requests = 64, 32, 16
     else:
+        # decode_window 32: one dispatch + one host sync per 32 tokens
+        # per slot; 128-token answers pack into exactly 4 windows
         cfg = EngineConfig(model="tinyllama-1.1b", max_model_len=1024,
-                           max_num_seqs=8, prefill_chunk=512)
+                           max_num_seqs=8, prefill_chunk=512,
+                           decode_window=32, prefill_buckets=(128, 512))
         prompt_len, gen_len, n_requests = 128, 128, 16
 
     eng = LLMEngine(cfg)

@@ -21,6 +21,16 @@ class EngineConfig:
     prefill_chunk: int = 512                 # chunked-prefill chunk size
     # prefill lengths are bucketed to these sizes to bound XLA compiles
     prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512)
+    # decode tokens generated per device dispatch (multi-step decoding):
+    # one lax.scan-fused executable emits `decode_window` tokens per slot
+    # with a single host sync, amortizing Python dispatch overhead.
+    # Sequences that stop mid-window discard the tail (vLLM's
+    # num-scheduler-steps tradeoff). 1 = token-at-a-time.
+    decode_window: int = 8
+    # attention is computed over the cache prefix [:kv_len] where kv_len is
+    # the smallest bucket covering every live position — decode cost scales
+    # with live context, not max_model_len. Auto-derived in __post_init__.
+    kv_len_buckets: Tuple[int, ...] = ()
     dtype: str = "bfloat16"
     kv_dtype: str = "bfloat16"
     tensor_parallel_size: int = 1
@@ -42,9 +52,38 @@ class EngineConfig:
         if not buckets or buckets[-1] < self.prefill_chunk:
             buckets.append(self.prefill_chunk)
         self.prefill_buckets = tuple(buckets)
+        self.decode_window = max(1, min(self.decode_window,
+                                        self.max_model_len))
+        if not self.kv_len_buckets:
+            # powers of two from 512 (or the cache size if smaller) up to
+            # max_model_len: at 32k context that's 7 buckets — bounded
+            # compile count, per-step attention cost within 2x of live len
+            b, buckets = 512, []
+            while b < self.max_model_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_model_len)
+            self.kv_len_buckets = tuple(
+                x for x in buckets if x <= self.max_model_len)
+        else:
+            # user-supplied buckets: sort, drop over-long ones, and always
+            # cover max_model_len — kv_bucket_for must never return a
+            # kv_len smaller than a legal live position
+            buckets = sorted(b for b in self.kv_len_buckets
+                             if 0 < b <= self.max_model_len)
+            if not buckets or buckets[-1] < self.max_model_len:
+                buckets.append(self.max_model_len)
+            self.kv_len_buckets = tuple(buckets)
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:
             if length <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    def kv_bucket_for(self, length: int) -> int:
+        """Smallest kv-length bucket covering `length` cache positions."""
+        for b in self.kv_len_buckets:
+            if length <= b:
+                return b
+        return self.kv_len_buckets[-1]

@@ -83,13 +83,26 @@ class LLMEngine:
         self._id_counter = itertools.count()
         # guards scheduler state across the engine-loop and server threads
         self._lock = threading.RLock()
-        # per-slot host mirrors feeding the decode batch
+        # per-slot host mirrors feeding the decode batch. Free/prefilling
+        # slots sit at position S: their garbage window writes DUS-clamp
+        # onto S-1, which is safe because every forward writes a row's
+        # real K/V BEFORE attention reads the cache — any query that
+        # legitimately reaches position S-1 overwrites the garbage in the
+        # same executable that first attends it (see models/kv.py).
         B = engine_cfg.max_num_seqs
         self._slot_token = np.zeros((B,), np.int32)
-        self._slot_pos = np.zeros((B,), np.int32)
+        self._slot_pos = np.full((B,), engine_cfg.max_model_len, np.int32)
         self._slot_temp = np.full((B,), 1.0, np.float32)
         self._slot_top_p = np.ones((B,), np.float32)
         self._slot_top_k = np.zeros((B,), np.int32)
+        # device-resident sampling params, re-uploaded only when a slot's
+        # options change (admission/finish), never per decode window
+        self._dev_sampling = None
+        self._sampling_dirty = True
+        # decode inputs are device-carried across windows (runner); the
+        # host re-uploads its mirrors only when this is set (admission,
+        # finish, abort — any slot-composition change)
+        self._decode_dirty = True
 
     # ------------------------------------------------------------------
 
@@ -111,56 +124,121 @@ class LLMEngine:
 
     def abort(self, seq_id: str) -> bool:
         with self._lock:
+            seq = self.seqs.get(seq_id)
+            slot = seq.slot if seq is not None else -1
             ok = self.scheduler.abort(seq_id)
-            if ok and seq_id in self.seqs:
-                self._remember(self.seqs[seq_id])
+            if ok:
+                self._park_slot(slot)
+                if seq is not None:
+                    self._remember(seq)
             self._refresh_gauges()
             return ok
 
     # ------------------------------------------------------------------
 
     def step(self) -> List[StepOutput]:
+        """One engine iteration: at most one prefill chunk AND one decode
+        window — interleaved 1:1, so running sequences keep their token
+        cadence while a long prompt prefills chunk by chunk (no
+        head-of-line blocking; the reference exposes the same property as
+        --enable-chunked-prefill, reference:
+        helm/templates/deployment-vllm-multi.yaml:69-72)."""
         with self._lock:
-            work, decode_seqs = self.scheduler.schedule()
+            works, decode_seqs = self.scheduler.schedule()
             outputs: List[StepOutput] = []
-            if work is not None:
-                outputs.extend(self._do_prefill(work))
-            elif decode_seqs:
+            if works:
+                outputs.extend(self._do_prefill(works))
+                # re-snapshot: sequences whose prefill just completed are
+                # RUNNING now and must join this step's decode window —
+                # the device generates tokens for every live row, and a
+                # row the host skipped would desync the device carry
+                decode_seqs = list(self.scheduler.running.values())
+            if decode_seqs:
                 outputs.extend(self._do_decode(decode_seqs))
             self._refresh_gauges()
             return outputs
 
-    def _do_prefill(self, work) -> List[StepOutput]:
-        seq = work.seq
-        opt = seq.options
-        row = SamplingParams(
-            temperature=jnp.asarray([opt.temperature], jnp.float32),
-            top_p=jnp.asarray([opt.top_p], jnp.float32),
-            top_k=jnp.asarray([opt.top_k], jnp.int32))
-        token_dev = self.runner.prefill(work.chunk, work.start, seq.slot, row)
-        self.scheduler.on_prefill_done(work)
-        self.metrics.prompt_tokens.inc(len(work.chunk))
-        if not work.is_last:
-            return []
-        # prompt fully prefilled: the sampled id is the first output token
-        token = int(token_dev)
-        seq.first_token_time = time.monotonic()
-        self.metrics.ttft.observe(seq.first_token_time - seq.arrival_time)
-        return self._accept_token(seq, token)
+    def _do_prefill(self, works) -> List[StepOutput]:
+        """Batch-prefill every scheduled chunk: one device dispatch per
+        chunk-length bucket (usually one total), all slots at once."""
+        outputs: List[StepOutput] = []
+        for w in works:
+            self._sync_sampling(w.seq)
+        self._ensure_dev_sampling()
+        by_bucket: Dict[int, list] = {}
+        for w in works:
+            by_bucket.setdefault(self.cfg.bucket_for(len(w.chunk)),
+                                 []).append(w)
+        B, S = self.cfg.max_num_seqs, self.cfg.max_model_len
+        for bucket, group in sorted(by_bucket.items()):
+            tokens = np.zeros((B, bucket), np.int32)
+            starts = np.full((B,), S, np.int32)   # parked rows: clamp on S-1
+            lengths = np.ones((B,), np.int32)
+            kv_need = bucket
+            for w in group:
+                slot = w.seq.slot
+                tokens[slot, :len(w.chunk)] = w.chunk
+                starts[slot] = w.start
+                lengths[slot] = len(w.chunk)
+                kv_need = max(kv_need, w.start + bucket)
+            kv_len = self.cfg.kv_bucket_for(min(kv_need, S))
+            ids_dev = self.runner.prefill(tokens, starts, lengths,
+                                          self._dev_sampling, kv_len)
+            ids = None
+            for w in group:
+                self.scheduler.on_prefill_done(w)
+                self.metrics.prompt_tokens.inc(len(w.chunk))
+                if not w.is_last:
+                    continue
+                if ids is None:
+                    ids = np.asarray(ids_dev)  # one sync per bucket group
+                # prompt fully prefilled: the sampled id is the first
+                # output token
+                seq = w.seq
+                seq.first_token_time = time.monotonic()
+                self.metrics.ttft.observe(
+                    seq.first_token_time - seq.arrival_time)
+                outputs.extend(self._accept_token(seq, int(ids[seq.slot])))
+        # prefill changed slot contents/positions: refresh decode carry
+        self._decode_dirty = True
+        return outputs
+
+    def _ensure_dev_sampling(self) -> None:
+        if self._sampling_dirty:
+            self._dev_sampling = SamplingParams(
+                temperature=jnp.asarray(self._slot_temp),
+                top_p=jnp.asarray(self._slot_top_p),
+                top_k=jnp.asarray(self._slot_top_k))
+            self._sampling_dirty = False
 
     def _do_decode(self, decode_seqs) -> List[StepOutput]:
-        sampling = SamplingParams(
-            temperature=jnp.asarray(self._slot_temp),
-            top_p=jnp.asarray(self._slot_top_p),
-            top_k=jnp.asarray(self._slot_top_k))
+        W = self.cfg.decode_window
+        max_pos = max(s.next_position for s in decode_seqs)
+        kv_len = self.cfg.kv_bucket_for(
+            min(max_pos + W + 1, self.cfg.max_model_len))
+        greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
+        self._ensure_dev_sampling()
+        if self._decode_dirty:
+            self.runner.set_decode_state(self._slot_token, self._slot_pos)
+            self._decode_dirty = False
         t0 = time.monotonic()
-        ids = np.asarray(self.runner.decode(self._slot_token, self._slot_pos,
-                                            sampling))
+        ids = np.asarray(self.runner.decode(
+            self._dev_sampling, steps=W, kv_len=kv_len,
+            greedy=greedy))  # [B, W]
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
-        for seq in decode_seqs:
-            self.metrics.per_token.observe(dt)
-            outputs.extend(self._accept_token(seq, int(ids[seq.slot])))
+        alive = list(decode_seqs)
+        for j in range(W):
+            still = []
+            for seq in alive:
+                self.metrics.per_token.observe(dt / W)
+                outs = self._accept_token(seq, int(ids[seq.slot, j]))
+                outputs.extend(outs)
+                if not outs[-1].finished:
+                    still.append(seq)
+            alive = still
+            if not alive:
+                break
         return outputs
 
     def _accept_token(self, seq: Sequence, token: int) -> List[StepOutput]:
@@ -178,7 +256,9 @@ class LLMEngine:
                 # extract while the slot still holds this sequence's KV —
                 # dispatched before scheduler.finish can recycle the slot
                 self.connector.on_finish(seq)
+            slot = seq.slot
             self.scheduler.finish(seq, reason)
+            self._park_slot(slot)
             self._remember(seq)
             self.metrics.e2e_latency.observe(
                 time.monotonic() - seq.arrival_time)
@@ -219,12 +299,29 @@ class LLMEngine:
 
     def _sync_slot(self, seq: Sequence) -> None:
         """Mirror the sequence's next decode input into the slot arrays."""
-        slot, opt = seq.slot, seq.options
+        slot = seq.slot
         self._slot_token[slot] = seq.output_tokens[-1]
         self._slot_pos[slot] = seq.next_position
-        self._slot_temp[slot] = opt.temperature
-        self._slot_top_p[slot] = opt.top_p
-        self._slot_top_k[slot] = opt.top_k
+        self._sync_sampling(seq)
+
+    def _sync_sampling(self, seq: Sequence) -> None:
+        slot, opt = seq.slot, seq.options
+        if (self._slot_temp[slot] != opt.temperature
+                or self._slot_top_p[slot] != opt.top_p
+                or self._slot_top_k[slot] != opt.top_k):
+            self._slot_temp[slot] = opt.temperature
+            self._slot_top_p[slot] = opt.top_p
+            self._slot_top_k[slot] = opt.top_k
+            self._sampling_dirty = True
+
+    def _park_slot(self, slot: int) -> None:
+        """Return a freed slot's mirrors to the idle state (position S —
+        its window writes clamp onto S-1, harmless because real K/V is
+        always written before attention reads; see models/kv.py)."""
+        if slot >= 0:
+            self._slot_token[slot] = 0
+            self._slot_pos[slot] = self.cfg.max_model_len
+            self._decode_dirty = True
 
     def render_metrics(self) -> bytes:
         with self._lock:

@@ -1,13 +1,24 @@
-"""ModelRunner: owns params + KV cache and the two cached XLA executables.
+"""ModelRunner: owns params + KV cache and the cached XLA executables.
 
 TPU execution model:
-- ``decode``: ONE executable for the whole engine lifetime — batch is
-  always [max_num_seqs] (free slots run as padding rows), so every step
-  after warmup is a cache hit. Sampling is fused in; only int32 token ids
-  come back to host.
-- ``prefill``: one executable per length bucket (engine_cfg.prefill_buckets),
-  prompt chunks are right-padded to the bucket. Works on a single slot via
-  dynamic batch-axis slice so running sequences keep their state.
+- ``decode``: a *multi-step window* — ``lax.scan`` fuses
+  ``engine_cfg.decode_window`` forward+sample steps into ONE executable
+  dispatch with ONE device→host sync for the whole window (int32 ids
+  [B, W]), amortizing Python dispatch overhead ~W×. Batch is always
+  [max_num_seqs] (free slots run as padding rows). Executables are cached
+  per (window, kv-length bucket, greedy): attention cost scales with the
+  live context (kv bucket), not max_model_len, and all-greedy batches
+  skip the [B, V] sampling sort entirely.
+- ``prefill``: FULL-BATCH — every admissible sequence's next chunk is
+  prefilled in ONE dispatch (tokens [B, Tb]; idle rows are parked at
+  position S where their writes clamp harmlessly onto S-1). One
+  executable per (chunk-length bucket, kv bucket).
+- Decode inputs are *device-carried*: each window's last sampled ids and
+  advanced positions stay on device and feed the next window directly —
+  the host uploads fresh state only when slot composition changes
+  (admission / finish). A steady decode window costs exactly one
+  dispatch + one device→host sync, which matters doubly when the chip
+  is reached over a high-RTT tunnel.
 - Both donate the KV cache => XLA updates it in place in HBM.
 
 The reference has no equivalent (engine external, SURVEY.md §1 L2); this
@@ -73,9 +84,15 @@ class ModelRunner:
             self.cache = KVCache(jax.device_put(self.cache.k, cache_sh),
                                  jax.device_put(self.cache.v, cache_sh))
         self._key = jax.random.PRNGKey(engine_cfg.seed ^ 0x5EED)
+        # device-carried decode inputs: (tokens [B], positions [B]);
+        # refreshed from host mirrors only when the engine marks them stale
+        self._dec_tokens = None
+        self._dec_pos = None
 
-        self._decode_fn = jax.jit(self._decode_impl, donate_argnums=(1,))
-        self._prefill_fn = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        # executable caches: decode keyed (steps, kv_len, greedy),
+        # prefill keyed (chunk bucket, kv bucket)
+        self._decode_fns = {}
+        self._prefill_fns = {}
         # KV-tiering primitives (kvcache/connector.py), cached per chunk size
         self._extract_fns = {}
         self._inject_fns = {}
@@ -86,43 +103,59 @@ class ModelRunner:
 
     def _decode_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                      positions: jnp.ndarray, sampling: SamplingParams,
-                     key: jax.Array):
-        """tokens/positions [B] -> sampled ids [B], cache'."""
-        logits, cache = llama.forward(
-            params, self.model_cfg, tokens[:, None], positions[:, None],
-            cache, rope=self.rope)
-        ids = sample(logits[:, 0, :], sampling, key)
-        return ids, cache
+                     key: jax.Array, *, steps: int, kv_len: int,
+                     greedy: bool):
+        """tokens/positions [B] -> (ids [B, steps], tokens', positions',
+        cache').
+
+        `steps` forwards are fused via lax.scan; each step feeds its
+        sampled ids back as the next step's tokens, and the final
+        (tokens, positions) come back as device arrays to carry into the
+        next window without a host round-trip. K/V writes go to the full
+        cache (DUS clamps out-of-range padding rows onto S-1, which is
+        rewritten before any query can attend to it); attention reads
+        only cache[:, :kv_len]. Host guarantees every live position
+        stays < kv_len for the whole window.
+        """
+        def body(carry, i):
+            cache, toks, pos = carry
+            logits, cache = llama.forward(
+                params, self.model_cfg, toks[:, None], pos[:, None],
+                cache, rope=self.rope, kv_len=kv_len)
+            last = logits[:, 0, :]
+            if greedy:
+                ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            else:
+                ids = sample(last, sampling, jax.random.fold_in(key, i))
+            return (cache, ids, pos + 1), ids
+
+        (cache, toks, pos), ids = jax.lax.scan(
+            body, (cache, tokens, positions), jnp.arange(steps))
+        return ids.T, toks, pos, cache  # ids [B, steps]
 
     def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
-                      start: jnp.ndarray, length: jnp.ndarray,
-                      slot: jnp.ndarray, sampling: SamplingParams,
-                      key: jax.Array):
-        """tokens [Tb] (padded chunk) into `slot` at offset `start`.
+                      starts: jnp.ndarray, lengths: jnp.ndarray,
+                      sampling: SamplingParams, key: jax.Array, *,
+                      kv_len: int):
+        """Full-batch chunk prefill. tokens [B, Tb], starts/lengths [B].
 
-        Returns (sampled id for the chunk's last real token, cache').
+        Every row writes its chunk at its own offset (idle rows are
+        parked at start S: write_chunk's scatter clips them onto S-1,
+        which no live query can attend — see models/kv.py). Attention
+        reads cache[:, :kv_len]; host guarantees start + Tb <= kv_len
+        for every participating row (or kv_len == S).
+        Returns (sampled id of each row's last real token [B], cache').
         """
-        L = self.model_cfg.num_layers
-        S = self.engine_cfg.max_model_len
-        Hkv, D = self.model_cfg.num_kv_heads, self.model_cfg.head_dim_
-        Tb = tokens.shape[0]
-
-        k_slot = jax.lax.dynamic_slice(cache.k, (0, slot, 0, 0, 0),
-                                       (L, 1, S, Hkv, D))
-        v_slot = jax.lax.dynamic_slice(cache.v, (0, slot, 0, 0, 0),
-                                       (L, 1, S, Hkv, D))
-        positions = (start + jnp.arange(Tb))[None, :]
-        logits, slot_cache = llama.forward(
-            params, self.model_cfg, tokens[None, :], positions,
-            KVCache(k_slot, v_slot), rope=self.rope)
-        new_k = jax.lax.dynamic_update_slice(cache.k, slot_cache.k,
-                                             (0, slot, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(cache.v, slot_cache.v,
-                                             (0, slot, 0, 0, 0))
-        last = jax.lax.dynamic_slice(logits, (0, length - 1, 0),
-                                     (1, 1, logits.shape[-1]))[:, 0, :]
+        Tb = tokens.shape[1]
+        positions = starts[:, None] + jnp.arange(Tb)[None, :]
+        logits, cache = llama.forward(
+            params, self.model_cfg, tokens, positions, cache,
+            rope=self.rope, kv_len=kv_len)
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0, :]
         ids = sample(last, sampling, key)
-        return ids[0], KVCache(new_k, new_v)
+        return ids, cache
 
     # ------------------------------------------------------------------
     # host API
@@ -132,24 +165,48 @@ class ModelRunner:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def decode(self, tokens, positions, sampling: SamplingParams):
-        """Batched decode step over all slots. Returns np-convertible ids [B]."""
-        ids, self.cache = self._decode_fn(
-            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(positions, jnp.int32), sampling, self._next_key())
+    def set_decode_state(self, tokens, positions) -> None:
+        """Upload fresh decode inputs (host mirrors -> device carry)."""
+        self._dec_tokens = jnp.asarray(tokens, jnp.int32)
+        self._dec_pos = jnp.asarray(positions, jnp.int32)
+
+    def decode(self, sampling: SamplingParams, steps: int = 1,
+               kv_len: Optional[int] = None, greedy: bool = False):
+        """Multi-step decode window over all slots, reading the
+        device-carried inputs (seed them with set_decode_state). Returns
+        ids [B, steps] (np-convertible; that np.asarray() is the
+        window's single sync)."""
+        kv_len = kv_len or self.engine_cfg.max_model_len
+        fn = self._decode_fns.get((steps, kv_len, greedy))
+        if fn is None:
+            logger.info("compiling decode window (steps=%d kv=%d greedy=%s)",
+                        steps, kv_len, greedy)
+            fn = jax.jit(
+                partial(self._decode_impl, steps=steps, kv_len=kv_len,
+                        greedy=greedy),
+                donate_argnums=(1,))
+            self._decode_fns[(steps, kv_len, greedy)] = fn
+        ids, self._dec_tokens, self._dec_pos, self.cache = fn(
+            self.params, self.cache, self._dec_tokens, self._dec_pos,
+            sampling, self._next_key())
         return ids
 
-    def prefill(self, chunk_tokens, start: int, slot: int,
-                sampling_row: SamplingParams):
-        """Prefill one padded chunk into a slot. Returns sampled id (device)."""
-        bucket = self.engine_cfg.bucket_for(len(chunk_tokens))
-        length = len(chunk_tokens)
-        padded = list(chunk_tokens) + [0] * (bucket - length)
-        token_id, self.cache = self._prefill_fn(
-            self.params, self.cache, jnp.asarray(padded, jnp.int32),
-            jnp.int32(start), jnp.int32(length), jnp.int32(slot),
-            sampling_row, self._next_key())
-        return token_id
+    def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
+                kv_len: int):
+        """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
+        int32 np; starts/lengths [B]. Returns device ids [B]."""
+        Tb = tokens.shape[1]
+        fn = self._prefill_fns.get((Tb, kv_len))
+        if fn is None:
+            logger.info("compiling prefill (chunk=%d kv=%d)", Tb, kv_len)
+            fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len),
+                         donate_argnums=(1,))
+            self._prefill_fns[(Tb, kv_len)] = fn
+        ids, self.cache = fn(
+            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(starts, jnp.int32), jnp.asarray(lengths, jnp.int32),
+            sampling, self._next_key())
+        return ids
 
     def extract_chunk(self, slot: int, start: int, size: int):
         """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the
@@ -191,16 +248,36 @@ class ModelRunner:
                         jnp.int32(start))
 
     def warmup(self) -> float:
-        """Compile decode + all prefill buckets. Returns seconds spent."""
+        """Compile the hot executables: a greedy decode window at the
+        smallest kv bucket + every prefill bucket at its minimal kv
+        bucket. Larger kv buckets and the sampled decode variant compile
+        lazily on first use (one-time, logged). Returns seconds spent."""
+        import numpy as np
         t0 = time.time()
-        B = self.engine_cfg.max_num_seqs
+        cfg = self.engine_cfg
+        B = cfg.max_num_seqs
+        S = cfg.max_model_len
         sampling = SamplingParams.filled(B)
-        row = SamplingParams.filled(1)
-        self.decode([0] * B, [0] * B, sampling)
-        for bucket in self.engine_cfg.prefill_buckets:
-            self.prefill([0] * bucket, 0, 0, row)
+        # park every row at S: warmup writes only clamp onto S-1
+        self.set_decode_state(np.zeros((B,), np.int32),
+                              np.full((B,), S, np.int32))
+        # both decode variants: greedy AND sampled (the API default is
+        # temperature=1.0, so sampled is the common serving case)
+        self.decode(sampling, steps=cfg.decode_window,
+                    kv_len=cfg.kv_len_buckets[0], greedy=True)
+        self.set_decode_state(np.zeros((B,), np.int32),
+                              np.full((B,), S, np.int32))
+        self.decode(sampling, steps=cfg.decode_window,
+                    kv_len=cfg.kv_len_buckets[0], greedy=False)
+        for bucket in cfg.prefill_buckets:
+            self.prefill(np.zeros((B, bucket), np.int32),
+                         np.full((B,), S, np.int32),
+                         np.ones((B,), np.int32), sampling,
+                         cfg.kv_bucket_for(bucket))
         jax.block_until_ready(self.cache.k)
         dt = time.time() - t0
-        logger.info("warmup compiled decode + %d prefill buckets in %.1fs",
-                    len(self.engine_cfg.prefill_buckets), dt)
+        logger.info(
+            "warmup compiled decode window (%d steps, kv %d) + %d prefill "
+            "buckets in %.1fs", cfg.decode_window, cfg.kv_len_buckets[0],
+            len(cfg.prefill_buckets), dt)
         return dt

@@ -87,7 +87,7 @@ class Scheduler:
         self.waiting: Deque[Sequence] = collections.deque()
         self.running: Dict[int, Sequence] = {}        # slot -> seq
         self.free_slots: List[int] = list(range(max_num_seqs - 1, -1, -1))
-        self._prefilling: Optional[Sequence] = None
+        self._prefilling: Dict[int, Sequence] = {}    # slot -> seq
         # invoked right after a slot is assigned, before the first prefill
         # chunk is cut — may rewind seq.num_prefilled past a cached prefix
         self.on_admit: Optional[object] = None
@@ -113,38 +113,38 @@ class Scheduler:
             if seq.seq_id == seq_id:
                 self._release(slot, seq, "abort")
                 return True
-        if self._prefilling is not None and self._prefilling.seq_id == seq_id:
-            seq = self._prefilling
-            self._release(seq.slot, seq, "abort")
-            self._prefilling = None
-            return True
+        for slot, seq in list(self._prefilling.items()):
+            if seq.seq_id == seq_id:
+                del self._prefilling[slot]
+                self._release(slot, seq, "abort")
+                return True
         return False
 
     # ------------------------------------------------------------------
 
-    def schedule(self) -> Tuple[Optional[PrefillWork], List[Sequence]]:
-        """Pick the next unit of device work.
+    def schedule(self) -> Tuple[List[PrefillWork], List[Sequence]]:
+        """Pick this iteration's device work.
 
-        Returns (prefill_work, decode_seqs): exactly one of them is
-        non-empty. Prefill has priority so admitted requests reach their
-        first token quickly (TTFT) — decode-only batches run otherwise.
+        Returns (prefill_works, decode_seqs) — BOTH may be non-empty: the
+        engine batch-prefills every admissible sequence's next chunk in
+        one dispatch and then runs a decode window in the same step, so a
+        newcomer's (chunked) prefill never stalls running sequences'
+        token cadence (the reference gets this from vLLM's chunked
+        prefill, reference:
+        helm/templates/deployment-vllm-multi.yaml:69-72).
         """
-        work = self._next_prefill()
-        if work is not None:
-            return work, []
-        return None, list(self.running.values())
-
-    def _next_prefill(self) -> Optional[PrefillWork]:
-        seq = self._prefilling
-        if seq is None:
-            if not self.waiting or not self.free_slots:
-                return None
+        works = [self._chunk_of(seq) for seq in self._prefilling.values()]
+        while self.waiting and self.free_slots:
             seq = self.waiting.popleft()
             seq.slot = self.free_slots.pop()
             seq.status = SeqStatus.PREFILLING
-            self._prefilling = seq
+            self._prefilling[seq.slot] = seq
             if self.on_admit is not None:
                 self.on_admit(seq)
+            works.append(self._chunk_of(seq))
+        return works, list(self.running.values())
+
+    def _chunk_of(self, seq: Sequence) -> PrefillWork:
         start = seq.num_prefilled
         end = min(start + self.prefill_chunk, len(seq.prompt_tokens))
         return PrefillWork(seq=seq, chunk=seq.prompt_tokens[start:end],
@@ -155,8 +155,8 @@ class Scheduler:
         seq.num_prefilled += len(work.chunk)
         if work.is_last:
             seq.status = SeqStatus.RUNNING
+            self._prefilling.pop(seq.slot, None)
             self.running[seq.slot] = seq
-            self._prefilling = None
 
     def finish(self, seq: Sequence, reason: str) -> None:
         self._release(seq.slot, seq, reason)
@@ -174,7 +174,7 @@ class Scheduler:
 
     @property
     def num_waiting(self) -> int:
-        return len(self.waiting) + (1 if self._prefilling else 0)
+        return len(self.waiting) + len(self._prefilling)
 
     @property
     def num_running(self) -> int:
@@ -188,6 +188,5 @@ class Scheduler:
     def kv_usage(self) -> float:
         """Fraction of KV slot-tokens in use (the TPU HBM KV gauge)."""
         used = sum(s.num_tokens for s in self.running.values())
-        if self._prefilling:
-            used += self._prefilling.num_prefilled
+        used += sum(s.num_prefilled for s in self._prefilling.values())
         return used / float(self.max_num_seqs * self.max_model_len)

@@ -305,6 +305,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--max-model-len", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=512)
+    p.add_argument("--decode-window", type=int, default=8,
+                   help="tokens generated per fused device dispatch: "
+                        "higher = throughput (one host sync per window), "
+                        "lower = smoother streaming cadence")
+    p.add_argument("--kv-len-buckets", default=None,
+                   help="comma-separated attention-length buckets "
+                        "(default: powers of two up to max-model-len)")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
@@ -330,6 +337,9 @@ def main(argv=None) -> None:
         chat_template=args.chat_template,
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
+        decode_window=args.decode_window,
+        kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
+        if args.kv_len_buckets else (),
         tensor_parallel_size=args.tensor_parallel_size, seed=args.seed,
         kv_transfer_config=kv_transfer)
     engine = AsyncLLMEngine(cfg)

@@ -41,8 +41,13 @@ def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
     """Write new [B,T,Hkv,D] into cache_layer [B,S,Hkv,D] at per-row starts [B].
 
     T == 1 (decode): contiguous dynamic-update-slice per batch row — lowers
-    to an in-place DUS on TPU when the buffer is donated. The write offset
-    is always < S so DUS clamping never triggers.
+    to an in-place DUS on TPU when the buffer is donated. DUS start
+    clamping is LOAD-BEARING here: the engine parks free/prefilling rows
+    at position S (engine.py _park_slot), so their per-window writes
+    arrive with s >= S and must clamp onto S-1 — a position outside every
+    live kv bucket that is rewritten with real K/V (earlier in the same
+    forward) before any query could attend it. Do not replace the DUS
+    with an unclamped scatter.
 
     T > 1 (prefill): per-row scatter with clipped indices. A prefill chunk
     is right-padded to its length bucket, so start+T can exceed S near the

@@ -64,11 +64,15 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 positions: jnp.ndarray, starts: Optional[jnp.ndarray],
                 x: jnp.ndarray, lp: Params,
                 kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
-                attention_fn=None):
+                attention_fn=None, kv_len: Optional[int] = None):
     """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
 
     attention_fn(q, k, v) overrides the no-cache attention — used to swap
     in ring attention when the sequence dim is sharded (parallel/train.py).
+    kv_len (static) bounds attention to the cache prefix [:kv_len] — K/V
+    writes still target the full cache, but score/value matmuls scale with
+    the live context instead of max_model_len. Caller guarantees every
+    real query position is < kv_len.
     """
     B, T, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
@@ -90,7 +94,9 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
     else:
         k_cache = write_chunk(kv[0], k, starts)
         v_cache = write_chunk(kv[1], v, starts)
-        attn = attention_with_cache(q, k_cache, v_cache, positions,
+        k_att = k_cache if kv_len is None else k_cache[:, :kv_len]
+        v_att = v_cache if kv_len is None else v_cache[:, :kv_len]
+        attn = attention_with_cache(q, k_att, v_att, positions,
                                     scale=hd ** -0.5)
         new_kv = (k_cache, v_cache)
     x = x + (attn.reshape(B, T, nh * hd) @ lp["o"])
@@ -104,11 +110,12 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache,
             rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-            ) -> Tuple[jnp.ndarray, KVCache]:
+            kv_len: Optional[int] = None) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
     positions[b] must be contiguous starting at the sequence's current
     length; the new K/V chunk is written at that offset in slot b.
+    kv_len (static) bounds attention to cache[:, :kv_len] — see _layer_body.
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
@@ -119,7 +126,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     def scan_body(carry, xs):
         lp, k_c, v_c = xs
         out, new_kv = _layer_body(cfg, rope, positions, starts, carry, lp,
-                                  (k_c, v_c))
+                                  (k_c, v_c), kv_len=kv_len)
         return out, new_kv
 
     x, (new_k, new_v) = jax.lax.scan(

@@ -171,3 +171,117 @@ def test_finished_seq_retention_bounded(engine):
     from production_stack_tpu.engine import engine as engine_mod
     assert len(engine.seqs) <= engine_mod._FINISHED_RETENTION + \
         engine.cfg.max_num_seqs + len(engine.scheduler.waiting)
+
+
+def _drain(eng, ids):
+    done = {}
+    steps = 0
+    while len(done) < len(ids):
+        for o in eng.step():
+            if o.finished:
+                done[o.seq_id] = o.finish_reason
+        steps += 1
+        assert steps < 3000
+    return done
+
+
+def test_decode_window_greedy_parity():
+    """Greedy outputs are identical for decode_window 1 vs 4 — the fused
+    multi-step window is a pure batching transform, not a semantic one."""
+    outs = []
+    for window in (1, 4):
+        cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                           max_num_seqs=2, prefill_chunk=32,
+                           prefill_buckets=(16, 32), decode_window=window)
+        eng = LLMEngine(cfg)
+        sid = eng.add_request(list(range(5, 25)),
+                              SamplingOptions(temperature=0.0, max_tokens=11,
+                                              ignore_eos=True))
+        _drain(eng, [sid])
+        outs.append(list(eng.seqs[sid].output_tokens))
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 11  # mid-window stop drops the window tail
+
+
+def test_kv_bucket_boundary_parity():
+    """Generation crossing a kv-length bucket boundary (512) matches a
+    run that always attends the full cache."""
+    outs = []
+    for buckets in ((512, 640), (640,)):
+        cfg = EngineConfig(model="debug-tiny", max_model_len=640,
+                           max_num_seqs=2, prefill_chunk=512,
+                           prefill_buckets=(512,), decode_window=4,
+                           kv_len_buckets=buckets)
+        eng = LLMEngine(cfg)
+        sid = eng.add_request(list(range(1, 506)),
+                              SamplingOptions(temperature=0.0, max_tokens=20,
+                                              ignore_eos=True))
+        _drain(eng, [sid])
+        outs.append(list(eng.seqs[sid].output_tokens))
+    assert outs[0] == outs[1]
+
+
+def test_decode_cadence_during_long_prefill():
+    """No head-of-line blocking: a running sequence keeps emitting a full
+    decode window every engine step while a long prompt prefills chunk by
+    chunk (VERDICT round-2 item 2)."""
+    cfg = EngineConfig(model="debug-tiny", max_model_len=512,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4)
+    eng = LLMEngine(cfg)
+    runner_sid = eng.add_request(list(range(3, 13)),
+                                 SamplingOptions(temperature=0.0,
+                                                 max_tokens=200,
+                                                 ignore_eos=True))
+    # let it reach RUNNING
+    while not eng.scheduler.num_running:
+        eng.step()
+    # admit a 300-token prompt: ~10 chunks of 32
+    long_sid = eng.add_request(list(range(1, 301)),
+                               SamplingOptions(temperature=0.0, max_tokens=4))
+    before = len(eng.seqs[runner_sid].output_tokens)
+    steps_with_prefill = 0
+    done = set()
+    while eng.scheduler.num_waiting:  # prefill still in flight
+        got = len(eng.seqs[runner_sid].output_tokens)
+        done.update(o.seq_id for o in eng.step() if o.finished)
+        now = len(eng.seqs[runner_sid].output_tokens)
+        assert now >= got + cfg.decode_window, \
+            "running sequence stalled during prefill"
+        steps_with_prefill += 1
+    assert steps_with_prefill >= 8, "prompt should take many chunked steps"
+    assert len(eng.seqs[runner_sid].output_tokens) >= before + \
+        steps_with_prefill * cfg.decode_window
+    steps = 0
+    while done < {runner_sid, long_sid}:
+        done.update(o.seq_id for o in eng.step() if o.finished)
+        steps += 1
+        assert steps < 3000
+
+    # content parity: the long sequence joined the decode batch mid-flight
+    # (promoted while another row was decoding) — its greedy output must
+    # match a solo run; a discarded first window would shift the stream
+    solo = LLMEngine(cfg)
+    solo_sid = solo.add_request(list(range(1, 301)),
+                                SamplingOptions(temperature=0.0,
+                                                max_tokens=4))
+    _drain(solo, [solo_sid])
+    assert eng.seqs[long_sid].output_tokens == \
+        solo.seqs[solo_sid].output_tokens
+
+
+def test_sampled_window_stays_in_distribution():
+    """Non-greedy multi-step windows sample real tokens (no NaN/garbage)
+    and respect max_tokens exactly."""
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4)
+    eng = LLMEngine(cfg)
+    sid = eng.add_request(list(range(2, 20)),
+                          SamplingOptions(temperature=0.8, top_p=0.9,
+                                          top_k=40, max_tokens=10,
+                                          ignore_eos=True))
+    _drain(eng, [sid])
+    toks = eng.seqs[sid].output_tokens
+    assert len(toks) == 10
+    assert all(0 <= t < eng.model_cfg.vocab_size for t in toks)
