@@ -48,6 +48,15 @@ class LLMEngine:
     def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
         self.cfg = engine_cfg
         self.model_cfg = get_config(engine_cfg.model)
+        # honor the engine's --dtype (the reference passes --dtype down to
+        # vllm serve the same way, reference:
+        # helm/templates/deployment-vllm-multi.yaml:80-83)
+        want_dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" \
+            else jnp.float32
+        if self.model_cfg.dtype != want_dtype:
+            import dataclasses
+            self.model_cfg = dataclasses.replace(self.model_cfg,
+                                                 dtype=want_dtype)
         self.tokenizer = load_tokenizer(engine_cfg.model,
                                         engine_cfg.tokenizer,
                                         engine_cfg.chat_template)

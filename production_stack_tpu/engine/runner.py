@@ -121,7 +121,7 @@ class ModelRunner:
             cache, toks, pos = carry
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
-                cache, rope=self.rope, kv_len=kv_len)
+                cache, rope=self.rope, kv_len=kv_len, use_flash=False)
             last = logits[:, 0, :]
             if greedy:
                 ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -150,7 +150,8 @@ class ModelRunner:
         positions = starts[:, None] + jnp.arange(Tb)[None, :]
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, cache,
-            rope=self.rope, kv_len=kv_len)
+            rope=self.rope, kv_len=kv_len,
+            use_flash=None if self.mesh is None else False)
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
@@ -194,7 +195,30 @@ class ModelRunner:
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int):
         """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
-        int32 np; starts/lengths [B]. Returns device ids [B]."""
+        int32 np; starts/lengths [B]. Returns device ids [B].
+
+        Prefill executables compile lazily per (chunk, kv bucket); if the
+        pallas flash kernel fails to build for a combination (backend or
+        VMEM limits beyond flash_viable's estimate), the jnp attention
+        path is compiled instead — once, for the whole process.
+        """
+        Tb = tokens.shape[1]
+        try:
+            return self._prefill_dispatch(tokens, starts, lengths,
+                                          sampling, kv_len)
+        except Exception:
+            from production_stack_tpu.ops import pallas_attention
+            if self.mesh is not None or not pallas_attention.flash_enabled():
+                raise
+            logger.exception(
+                "flash prefill (chunk=%d kv=%d) failed to compile; "
+                "falling back to the jnp attention path", Tb, kv_len)
+            pallas_attention.set_flash_enabled(False)
+            self._prefill_fns.clear()
+            return self._prefill_dispatch(tokens, starts, lengths,
+                                          sampling, kv_len)
+
+    def _prefill_dispatch(self, tokens, starts, lengths, sampling, kv_len):
         Tb = tokens.shape[1]
         fn = self._prefill_fns.get((Tb, kv_len))
         if fn is None:
@@ -270,6 +294,8 @@ class ModelRunner:
         self.decode(sampling, steps=cfg.decode_window,
                     kv_len=cfg.kv_len_buckets[0], greedy=False)
         for bucket in cfg.prefill_buckets:
+            # prefill() falls back to the jnp path by itself if the
+            # flash kernel cannot compile on this backend
             self.prefill(np.zeros((B, bucket), np.int32),
                          np.full((B,), S, np.int32),
                          np.ones((B,), np.int32), sampling,

@@ -8,7 +8,8 @@ decode step a single cached XLA executable; per-sequence lengths are data
 The reference stack's KV management is configuration around LMCache env
 vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178); the
 actual in-engine cache is external to it. Here the cache is a first-class
-functional object so tiering (engine/offload.py) can snapshot/restore slots.
+functional object so tiering (kvcache/connector.py) can snapshot/restore
+slots via the runner's extract_chunk/inject_chunk primitives.
 """
 
 from typing import NamedTuple

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, write_chunk
+from production_stack_tpu.ops import pallas_attention
 from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
 from production_stack_tpu.ops.norms import rms_norm
 from production_stack_tpu.ops.rope import apply_rope, rope_table
@@ -64,7 +65,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 positions: jnp.ndarray, starts: Optional[jnp.ndarray],
                 x: jnp.ndarray, lp: Params,
                 kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
-                attention_fn=None, kv_len: Optional[int] = None):
+                attention_fn=None, kv_len: Optional[int] = None,
+                use_flash: bool = False):
     """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
 
     attention_fn(q, k, v) overrides the no-cache attention — used to swap
@@ -96,8 +98,17 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
         v_cache = write_chunk(kv[1], v, starts)
         k_att = k_cache if kv_len is None else k_cache[:, :kv_len]
         v_att = v_cache if kv_len is None else v_cache[:, :kv_len]
-        attn = attention_with_cache(q, k_att, v_att, positions,
-                                    scale=hd ** -0.5)
+        if (use_flash and T > 1
+                and pallas_attention.flash_viable(
+                    k_att.shape[1], hd, jnp.dtype(k_att.dtype).itemsize)):
+            # prefill chunks hit the pallas flash kernel: no [T, S] score
+            # materialization, causal block skipping over the cache
+            attn = pallas_attention.flash_attention_with_cache(
+                q, k_att, v_att, starts,
+                interpret=pallas_attention.needs_interpret())
+        else:
+            attn = attention_with_cache(q, k_att, v_att, positions,
+                                        scale=hd ** -0.5)
         new_kv = (k_cache, v_cache)
     x = x + (attn.reshape(B, T, nh * hd) @ lp["o"])
 
@@ -110,23 +121,30 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache,
             rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-            kv_len: Optional[int] = None) -> Tuple[jnp.ndarray, KVCache]:
+            kv_len: Optional[int] = None,
+            use_flash: Optional[bool] = None) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
     positions[b] must be contiguous starting at the sequence's current
     length; the new K/V chunk is written at that offset in slot b.
     kv_len (static) bounds attention to cache[:, :kv_len] — see _layer_body.
+    use_flash: None = auto (pallas flash prefill when the runtime gate is
+    on); pass False on sharded executables — pallas_call has no GSPMD
+    partitioning rule (see ops/pallas_attention.py).
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
                           cfg.rope_theta)
+    if use_flash is None:
+        use_flash = pallas_attention.flash_enabled()
     starts = positions[:, 0]
     x = params["embed"][tokens].astype(cfg.dtype)
 
     def scan_body(carry, xs):
         lp, k_c, v_c = xs
         out, new_kv = _layer_body(cfg, rope, positions, starts, carry, lp,
-                                  (k_c, v_c), kv_len=kv_len)
+                                  (k_c, v_c), kv_len=kv_len,
+                                  use_flash=use_flash)
         return out, new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
