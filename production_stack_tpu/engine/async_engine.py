@@ -75,18 +75,21 @@ class AsyncLLMEngine:
 
     async def submit(self, prompt_tokens: List[int],
                      options: SamplingOptions,
-                     seq_id: Optional[str] = None) -> Tuple[str, asyncio.Queue]:
+                     seq_id: Optional[str] = None,
+                     model: Optional[str] = None) -> Tuple[str, asyncio.Queue]:
         q: asyncio.Queue = asyncio.Queue()
         seq_id = self.engine.add_request(prompt_tokens, options,
-                                        seq_id=seq_id)
+                                        seq_id=seq_id, model=model)
         self._queues[seq_id] = q
         with self._wake:
             self._wake.notify_all()
         return seq_id, q
 
     async def stream(self, prompt_tokens: List[int],
-                     options: SamplingOptions) -> AsyncIterator[StepOutput]:
-        seq_id, q = await self.submit(prompt_tokens, options)
+                     options: SamplingOptions,
+                     model: Optional[str] = None
+                     ) -> AsyncIterator[StepOutput]:
+        seq_id, q = await self.submit(prompt_tokens, options, model=model)
         try:
             while True:
                 out = await q.get()

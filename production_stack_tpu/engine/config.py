@@ -34,6 +34,12 @@ class EngineConfig:
     dtype: str = "bfloat16"
     kv_dtype: str = "bfloat16"
     tensor_parallel_size: int = 1
+    # multi-slice passthrough knobs (SURVEY §2.9: the reference exposes
+    # PP/EP only as engine passthrough; same here — the chart forwards
+    # them, the engine validates). Values > 1 are rejected until the
+    # engine grows pipeline/expert sharding over DCN.
+    pipeline_parallel_size: int = 1
+    expert_parallel_size: int = 1
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     enable_prefix_caching: bool = False
@@ -42,8 +48,29 @@ class EngineConfig:
     # kvcache/connector.py). Keys: kv_role, chunk_size, local_cpu_gb,
     # local_disk_path, local_disk_gb, remote_url.
     kv_transfer_config: Optional[Dict[str, Any]] = None
+    # Multi-LoRA serving (reference: --enable-lora + LoraAdapter CRD
+    # proposal, helm/templates/deployment-vllm-multi.yaml:65-67).
+    # name -> .npz path (models/lora.py format), or name -> "random:SEED"
+    # for synthetic adapters (tests/demos). Each adapter is served as its
+    # own model id next to the base model.
+    lora_adapters: Optional[Dict[str, str]] = None
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    lora_targets: Tuple[str, ...] = ("q", "v")
 
     def __post_init__(self):
+        for field_name in ("dtype", "kv_dtype"):
+            val = getattr(self, field_name)
+            if val not in ("bfloat16", "float32"):
+                raise ValueError(
+                    f"{field_name}={val!r} unsupported: TPU serving runs "
+                    f"bfloat16 (MXU-native) or float32")
+        if self.pipeline_parallel_size != 1 or self.expert_parallel_size != 1:
+            raise NotImplementedError(
+                "pipeline/expert parallelism over DCN is not implemented "
+                "in this engine yet; scale within a slice via "
+                "tensor_parallel_size and across slices via replicaCount "
+                "(data parallelism)")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)

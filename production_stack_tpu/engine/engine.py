@@ -48,9 +48,9 @@ class LLMEngine:
     def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
         self.cfg = engine_cfg
         self.model_cfg = get_config(engine_cfg.model)
-        # honor the engine's --dtype (the reference passes --dtype down to
-        # vllm serve the same way, reference:
-        # helm/templates/deployment-vllm-multi.yaml:80-83)
+        # honor --dtype (validated to bfloat16/float32 in EngineConfig;
+        # the reference passes --dtype down to vllm serve the same way,
+        # reference: helm/templates/deployment-vllm-multi.yaml:80-83)
         want_dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" \
             else jnp.float32
         if self.model_cfg.dtype != want_dtype:
@@ -62,6 +62,33 @@ class LLMEngine:
                                         engine_cfg.chat_template)
         if params is None and engine_cfg.checkpoint:
             params = load_checkpoint(self.model_cfg, engine_cfg.checkpoint)
+        # multi-LoRA: every adapter is served as its own model id; the
+        # stacked adapter pytree rides in the runner, rows select their
+        # adapter per request (reference surface: --enable-lora +
+        # proposals/lora-k8s-support.md routing by served model name)
+        self.lora_ids: Dict[str, int] = {}
+        lora_stacked, lora_scaling = None, 1.0
+        if engine_cfg.lora_adapters:
+            import jax
+            from production_stack_tpu.models import lora as lora_mod
+            lcfg = lora_mod.LoRAConfig(
+                rank=engine_cfg.lora_rank, alpha=engine_cfg.lora_alpha,
+                targets=tuple(engine_cfg.lora_targets))
+            adapters = []
+            for name, src in sorted(engine_cfg.lora_adapters.items()):
+                if src.startswith("random:"):
+                    ad = lora_mod.random_adapter(
+                        self.model_cfg, lcfg,
+                        jax.random.PRNGKey(int(src.split(":", 1)[1])))
+                else:
+                    ad = lora_mod.load_adapter_npz(self.model_cfg, lcfg,
+                                                   src)
+                adapters.append(ad)
+                self.lora_ids[name] = len(adapters)
+            lora_stacked = lora_mod.stack_adapters(self.model_cfg, lcfg,
+                                                   adapters)
+            lora_scaling = lcfg.scaling
+        self.served_models = [engine_cfg.model] + list(self.lora_ids)
         if mesh is None and engine_cfg.tensor_parallel_size > 1:
             from production_stack_tpu.parallel.mesh import (MeshConfig,
                                                             build_mesh)
@@ -70,7 +97,8 @@ class LLMEngine:
             mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=tp),
                               jax.devices()[:tp])
         self.runner = ModelRunner(self.model_cfg, engine_cfg, params=params,
-                                  mesh=mesh)
+                                  mesh=mesh, lora_stacked=lora_stacked,
+                                  lora_scaling=lora_scaling)
         self.scheduler = Scheduler(engine_cfg.max_num_seqs,
                                    engine_cfg.max_model_len,
                                    engine_cfg.prefill_chunk)
@@ -104,6 +132,7 @@ class LLMEngine:
         self._slot_temp = np.full((B,), 1.0, np.float32)
         self._slot_top_p = np.ones((B,), np.float32)
         self._slot_top_k = np.zeros((B,), np.int32)
+        self._slot_adapter = np.zeros((B,), np.int32)
         # device-resident sampling params, re-uploaded only when a slot's
         # options change (admission/finish), never per decode window
         self._dev_sampling = None
@@ -115,17 +144,40 @@ class LLMEngine:
 
     # ------------------------------------------------------------------
 
+    def _adapter_salt(self, adapter_id: int) -> str:
+        """KV-tier key salt: adapter NAME (stable across processes and
+        config orderings, unlike the id) — adapter-colored KV chunks must
+        never collide with the base model's or each other's."""
+        if adapter_id == 0:
+            return ""
+        for name, aid in self.lora_ids.items():
+            if aid == adapter_id:
+                return f"lora:{name}"
+        return f"lora-id:{adapter_id}"
+
+    def resolve_model(self, model: Optional[str]) -> int:
+        """Served model name -> adapter id (0 = base). Raises on unknown."""
+        if model is None or model == self.cfg.model:
+            return 0
+        if model in self.lora_ids:
+            return self.lora_ids[model]
+        raise ValueError(f"unknown model {model!r}; serving "
+                         f"{self.served_models}")
+
     def add_request(self, prompt_tokens: List[int],
                     options: Optional[SamplingOptions] = None,
-                    seq_id: Optional[str] = None) -> str:
+                    seq_id: Optional[str] = None,
+                    model: Optional[str] = None) -> str:
         seq_id = seq_id or f"seq-{next(self._id_counter)}"
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
                        options=options or SamplingOptions(),
+                       adapter_id=self.resolve_model(model),
                        detok=DetokenizeStream(self.tokenizer))
         if self.connector is not None:
             # tier lookup + D2H-side fetch runs here, on the caller's
             # thread — never on the engine loop
-            seq.kv_prefetch = self.connector.prefetch(seq.prompt_tokens)
+            seq.kv_prefetch = self.connector.prefetch(
+                seq.prompt_tokens, salt=self._adapter_salt(seq.adapter_id))
         with self._lock:
             self.scheduler.add(seq)
             self.seqs[seq_id] = seq
@@ -217,7 +269,8 @@ class LLMEngine:
             self._dev_sampling = SamplingParams(
                 temperature=jnp.asarray(self._slot_temp),
                 top_p=jnp.asarray(self._slot_top_p),
-                top_k=jnp.asarray(self._slot_top_k))
+                top_k=jnp.asarray(self._slot_top_k),
+                adapter=jnp.asarray(self._slot_adapter))
             self._sampling_dirty = False
 
     def _do_decode(self, decode_seqs) -> List[StepOutput]:
@@ -264,7 +317,8 @@ class LLMEngine:
             if self.connector is not None:
                 # extract while the slot still holds this sequence's KV —
                 # dispatched before scheduler.finish can recycle the slot
-                self.connector.on_finish(seq)
+                self.connector.on_finish(
+                    seq, salt=self._adapter_salt(seq.adapter_id))
             slot = seq.slot
             self.scheduler.finish(seq, reason)
             self._park_slot(slot)
@@ -317,10 +371,12 @@ class LLMEngine:
         slot, opt = seq.slot, seq.options
         if (self._slot_temp[slot] != opt.temperature
                 or self._slot_top_p[slot] != opt.top_p
-                or self._slot_top_k[slot] != opt.top_k):
+                or self._slot_top_k[slot] != opt.top_k
+                or self._slot_adapter[slot] != seq.adapter_id):
             self._slot_temp[slot] = opt.temperature
             self._slot_top_p[slot] = opt.top_p
             self._slot_top_k[slot] = opt.top_k
+            self._slot_adapter[slot] = seq.adapter_id
             self._sampling_dirty = True
 
     def _park_slot(self, slot: int) -> None:

@@ -45,10 +45,16 @@ logger = init_logger(__name__)
 
 class ModelRunner:
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
-                 params=None, mesh=None):
+                 params=None, mesh=None, lora_stacked=None,
+                 lora_scaling: float = 1.0):
         self.model_cfg = model_cfg
         self.engine_cfg = engine_cfg
         self.mesh = mesh
+        # stacked multi-LoRA adapters, layer axis leading for lax.scan
+        # (models/lora.py); row selection comes in via sampling.adapter
+        from production_stack_tpu.models import lora as lora_mod
+        self._lora = lora_mod.layer_slice(lora_stacked)
+        self._lora_scaling = lora_scaling
         # rope table must cover the cache length, not just the model's
         # native max (see ops/rope.py clamping note)
         self.rope = rope_table(engine_cfg.max_model_len, model_cfg.head_dim_,
@@ -83,6 +89,11 @@ class ModelRunner:
             cache_sh = NamedSharding(mesh, cache_pspec())
             self.cache = KVCache(jax.device_put(self.cache.k, cache_sh),
                                  jax.device_put(self.cache.v, cache_sh))
+            if self._lora is not None:
+                # adapters are small (rank << hidden): replicate
+                from jax.sharding import PartitionSpec
+                self._lora = jax.device_put(
+                    self._lora, NamedSharding(mesh, PartitionSpec()))
         self._key = jax.random.PRNGKey(engine_cfg.seed ^ 0x5EED)
         # device-carried decode inputs: (tokens [B], positions [B]);
         # refreshed from host mirrors only when the engine marks them stale
@@ -121,7 +132,9 @@ class ModelRunner:
             cache, toks, pos = carry
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
-                cache, rope=self.rope, kv_len=kv_len, use_flash=False)
+                cache, rope=self.rope, kv_len=kv_len, use_flash=False,
+                lora_params=self._lora, adapter_ids=sampling.adapter,
+                lora_scaling=self._lora_scaling)
             last = logits[:, 0, :]
             if greedy:
                 ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -151,7 +164,9 @@ class ModelRunner:
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, cache,
             rope=self.rope, kv_len=kv_len,
-            use_flash=None if self.mesh is None else False)
+            use_flash=None if self.mesh is None else False,
+            lora_params=self._lora, adapter_ids=sampling.adapter,
+            lora_scaling=self._lora_scaling)
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
@@ -198,39 +213,43 @@ class ModelRunner:
         int32 np; starts/lengths [B]. Returns device ids [B].
 
         Prefill executables compile lazily per (chunk, kv bucket); if the
-        pallas flash kernel fails to build for a combination (backend or
+        pallas flash kernel fails to BUILD for a combination (backend or
         VMEM limits beyond flash_viable's estimate), the jnp attention
-        path is compiled instead — once, for the whole process.
+        path is compiled instead — once, for the whole process. The
+        fallback is compile-scoped: compilation happens via an explicit
+        lower+compile before any buffers are donated, so a runtime
+        failure of an already-working executable propagates unchanged
+        (retrying it would re-pass a donated, deleted cache buffer).
         """
         Tb = tokens.shape[1]
-        try:
-            return self._prefill_dispatch(tokens, starts, lengths,
-                                          sampling, kv_len)
-        except Exception:
-            from production_stack_tpu.ops import pallas_attention
-            if self.mesh is not None or not pallas_attention.flash_enabled():
-                raise
-            logger.exception(
-                "flash prefill (chunk=%d kv=%d) failed to compile; "
-                "falling back to the jnp attention path", Tb, kv_len)
-            pallas_attention.set_flash_enabled(False)
-            self._prefill_fns.clear()
-            return self._prefill_dispatch(tokens, starts, lengths,
-                                          sampling, kv_len)
-
-    def _prefill_dispatch(self, tokens, starts, lengths, sampling, kv_len):
-        Tb = tokens.shape[1]
+        args = (self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(starts, jnp.int32),
+                jnp.asarray(lengths, jnp.int32), sampling, self._next_key())
         fn = self._prefill_fns.get((Tb, kv_len))
         if fn is None:
-            logger.info("compiling prefill (chunk=%d kv=%d)", Tb, kv_len)
-            fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len),
-                         donate_argnums=(1,))
-            self._prefill_fns[(Tb, kv_len)] = fn
-        ids, self.cache = fn(
-            self.params, self.cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(starts, jnp.int32), jnp.asarray(lengths, jnp.int32),
-            sampling, self._next_key())
+            try:
+                fn = self._compile_prefill(Tb, kv_len, args)
+            except Exception:
+                from production_stack_tpu.ops import pallas_attention
+                if (self.mesh is not None
+                        or not pallas_attention.flash_enabled()):
+                    raise
+                logger.exception(
+                    "flash prefill (chunk=%d kv=%d) failed to compile; "
+                    "falling back to the jnp attention path", Tb, kv_len)
+                pallas_attention.set_flash_enabled(False)
+                self._prefill_fns.clear()
+                fn = self._compile_prefill(Tb, kv_len, args)
+        ids, self.cache = fn(*args)
         return ids
+
+    def _compile_prefill(self, Tb: int, kv_len: int, args):
+        logger.info("compiling prefill (chunk=%d kv=%d)", Tb, kv_len)
+        fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len),
+                     donate_argnums=(1,))
+        fn.lower(*args).compile()   # donation applies at execution only
+        self._prefill_fns[(Tb, kv_len)] = fn
+        return fn
 
     def extract_chunk(self, slot: int, start: int, size: int):
         """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the

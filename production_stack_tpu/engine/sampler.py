@@ -17,18 +17,26 @@ _NEG_INF = -1e30
 
 
 class SamplingParams(NamedTuple):
-    """Per-sequence device-side sampling state, shape [B] each."""
+    """Per-sequence device-side request state, shape [B] each.
+
+    ``adapter`` selects each row's LoRA adapter (0 = base model,
+    models/lora.py); it rides with the sampling params because both
+    change only at slot (re)assignment, so one dirty-flag upload covers
+    them. sample() itself ignores it.
+    """
 
     temperature: jnp.ndarray  # fp32; 0 => greedy
     top_p: jnp.ndarray        # fp32 in (0, 1]
     top_k: jnp.ndarray        # int32; 0 => disabled
+    adapter: jnp.ndarray      # int32 adapter id; 0 => base model
 
     @staticmethod
-    def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0):
+    def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0, adapter=0):
         return SamplingParams(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
+            adapter=jnp.full((batch,), adapter, jnp.int32),
         )
 
 

@@ -48,6 +48,7 @@ class Sequence:
     options: SamplingOptions
     status: SeqStatus = SeqStatus.WAITING
     slot: int = -1
+    adapter_id: int = 0      # LoRA adapter (0 = base model, models/lora.py)
     output_tokens: List[int] = field(default_factory=list)
     num_prefilled: int = 0
     arrival_time: float = field(default_factory=time.monotonic)

@@ -81,6 +81,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         return _error(400, f"invalid request: {e}")
     if req.n != 1:
         return _error(400, "n>1 is not supported yet")
+    try:
+        engine.engine.resolve_model(req.model or None)
+    except ValueError as e:
+        return _error(404, str(e))
 
     tok = engine.tokenizer
     prompt = tok.apply_chat_template(
@@ -107,7 +111,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             num_tokens = 0
             # aclosing => a dropped consumer deterministically runs
             # engine.stream's cleanup (slot abort), not at GC's leisure
-            async with aclosing(engine.stream(prompt_ids, options)) as it:
+            async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
                 async for out in it:
                     if out.new_token is not None:
                         num_tokens += 1
@@ -134,7 +138,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     parts: List[str] = []
     num_tokens = 0
     finish_reason = None
-    async with aclosing(engine.stream(prompt_ids, options)) as it:
+    async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
         async for out in it:
             parts.append(out.text_delta)
             if out.new_token is not None:
@@ -162,6 +166,10 @@ async def completions(request: web.Request) -> web.StreamResponse:
         return _error(400, f"invalid request: {e}")
     if req.n != 1:
         return _error(400, "n>1 is not supported yet")
+    try:
+        engine.engine.resolve_model(req.model or None)
+    except ValueError as e:
+        return _error(404, str(e))
 
     tok = engine.tokenizer
     prompt = req.prompt
@@ -187,7 +195,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
         async def gen():
             num_tokens = 0
-            async with aclosing(engine.stream(prompt_ids, options)) as it:
+            async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
                 async for out in it:
                     if out.new_token is not None:
                         num_tokens += 1
@@ -212,7 +220,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
     parts: List[str] = []
     num_tokens = 0
     finish_reason = None
-    async with aclosing(engine.stream(prompt_ids, options)) as it:
+    async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
         async for out in it:
             parts.append(out.text_delta)
             if out.new_token is not None:
@@ -231,7 +239,12 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
 async def list_models(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
-    cards = proto.ModelList(data=[proto.ModelCard(id=engine.model_name)])
+    served = engine.engine.served_models
+    base = served[0]
+    cards = proto.ModelList(data=[
+        proto.ModelCard(id=name, root=base if i else None,
+                        parent=base if i else None)
+        for i, name in enumerate(served)])
     return web.json_response(cards.model_dump())
 
 
@@ -303,6 +316,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=8100)
     p.add_argument("--max-model-len", type=int, default=2048)
+    p.add_argument("--dtype", choices=["bfloat16", "float32"],
+                   default="bfloat16")
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--decode-window", type=int, default=8,
@@ -313,10 +328,26 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="comma-separated attention-length buckets "
                         "(default: powers of two up to max-model-len)")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
+    p.add_argument("--pipeline-parallel-size", type=int, default=1,
+                   help="multi-slice DCN passthrough knob (must be 1; "
+                        "see EngineConfig)")
+    p.add_argument("--expert-parallel-size", type=int, default=1,
+                   help="MoE passthrough knob (must be 1)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--chat-template", default=None,
                    help="Jinja file overriding the tokenizer chat template")
+    p.add_argument("--lora-adapters", default=None,
+                   help="comma-separated name=source pairs; source is an "
+                        ".npz adapter checkpoint (models/lora.py) or "
+                        "random:SEED. Each adapter is served as its own "
+                        "model id (reference: --enable-lora, "
+                        "deployment-vllm-multi.yaml:65-67)")
+    p.add_argument("--lora-rank", type=int, default=8)
+    p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--lora-targets", default="q,v",
+                   help="comma-separated projections to adapt "
+                        "(q,k,v,o,gate,up,down)")
     p.add_argument("--kv-transfer-config", default=None,
                    help="JSON dict enabling KV tiering, e.g. "
                         '\'{"kv_role": "kv_both", "local_cpu_gb": 4, '
@@ -336,12 +367,20 @@ def main(argv=None) -> None:
         model=args.model, tokenizer=args.tokenizer,
         chat_template=args.chat_template,
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
+        dtype=args.dtype,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
         decode_window=args.decode_window,
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),
-        tensor_parallel_size=args.tensor_parallel_size, seed=args.seed,
-        kv_transfer_config=kv_transfer)
+        tensor_parallel_size=args.tensor_parallel_size,
+        pipeline_parallel_size=args.pipeline_parallel_size,
+        expert_parallel_size=args.expert_parallel_size, seed=args.seed,
+        kv_transfer_config=kv_transfer,
+        lora_adapters=dict(pair.split("=", 1)
+                           for pair in args.lora_adapters.split(","))
+        if args.lora_adapters else None,
+        lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+        lora_targets=tuple(args.lora_targets.split(",")))
     engine = AsyncLLMEngine(cfg)
     if not args.no_warmup:
         engine.engine.runner.warmup()

@@ -41,10 +41,16 @@ class ChunkHasher:
     def num_full_chunks(self, num_tokens: int) -> int:
         return num_tokens // self.chunk_size
 
-    def chunk_keys(self, tokens: Sequence[int]) -> List[bytes]:
-        """Keys for every *full* chunk of `tokens`, in order."""
+    def chunk_keys(self, tokens: Sequence[int],
+                   salt: str = "") -> List[bytes]:
+        """Keys for every *full* chunk of `tokens`, in order.
+
+        ``salt`` extends the namespace for variants that produce
+        different KV from the same tokens under the same model geometry
+        — e.g. a LoRA adapter name (adapters with k/v targets color the
+        cache, so adapter and base chunks must never collide)."""
         keys: List[bytes] = []
-        prev = self.namespace.encode()
+        prev = (self.namespace + ("|" + salt if salt else "")).encode()
         for i in range(self.num_full_chunks(len(tokens))):
             chunk = tokens[i * self.chunk_size:(i + 1) * self.chunk_size]
             h = hashlib.blake2b(digest_size=16)

@@ -127,20 +127,22 @@ class KVConnector:
 
     # -- consumer path --------------------------------------------------
 
-    def prefetch(self, prompt_tokens: Sequence[int]) -> Optional[Prefetch]:
+    def prefetch(self, prompt_tokens: Sequence[int],
+                 salt: str = "") -> Optional[Prefetch]:
         """Fetch the longest cached chunk-prefix into host memory.
 
         Runs off the engine loop (server thread at request-add time). The
         last prompt token is never served from cache — prefill must compute
         at least one position to produce first-token logits — so hits are
-        capped at len(prompt)-1.
+        capped at len(prompt)-1. ``salt`` keys KV variants (LoRA adapter
+        name) so adapter-colored chunks never serve other models.
         """
         if not self.cfg.is_consumer:
             return None
         n = len(prompt_tokens)
         self.queries += 1
         self.query_tokens += n
-        keys = self.hasher.chunk_keys(prompt_tokens)
+        keys = self.hasher.chunk_keys(prompt_tokens, salt=salt)
         chunks: List[Tuple[np.ndarray, np.ndarray]] = []
         hit_keys: List[bytes] = []
         for key in keys:
@@ -168,7 +170,7 @@ class KVConnector:
 
     # -- producer path --------------------------------------------------
 
-    def on_finish(self, seq) -> None:
+    def on_finish(self, seq, salt: str = "") -> None:
         """Queue full-chunk KV of a finished sequence for write-through.
 
         The final sampled token is excluded: decode writes KV for its
@@ -183,7 +185,7 @@ class KVConnector:
         n_chunks = self.hasher.num_full_chunks(len(tokens))
         if n_chunks == 0 or slot < 0:
             return
-        keys = self.hasher.chunk_keys(tokens)
+        keys = self.hasher.chunk_keys(tokens, salt=salt)
         work = []
         for i, key in enumerate(keys):
             if key in self._seen_keys:

@@ -22,6 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from production_stack_tpu.models import lora
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, write_chunk
 from production_stack_tpu.ops import pallas_attention
@@ -66,7 +67,9 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 x: jnp.ndarray, lp: Params,
                 kv: Optional[Tuple[jnp.ndarray, jnp.ndarray]],
                 attention_fn=None, kv_len: Optional[int] = None,
-                use_flash: bool = False):
+                use_flash: bool = False, lora_layer=None,
+                adapter_ids: Optional[jnp.ndarray] = None,
+                lora_scaling: float = 1.0):
     """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
 
     attention_fn(q, k, v) overrides the no-cache attention — used to swap
@@ -75,15 +78,24 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
     writes still target the full cache, but score/value matmuls scale with
     the live context instead of max_model_len. Caller guarantees every
     real query position is < kv_len.
+    lora_layer: this layer's stacked adapters {proj: {a, b}} + per-row
+    adapter_ids [B] (models/lora.py) — batched multi-LoRA.
     """
     B, T, _ = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     cos, sin = rope
 
+    def proj(h, name):
+        out = h @ lp[name]
+        if lora_layer is not None and name in lora_layer:
+            out = lora.apply(h, out, lora_layer[name], adapter_ids,
+                             lora_scaling)
+        return out
+
     hidden = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (hidden @ lp["q"]).reshape(B, T, nh, hd)
-    k = (hidden @ lp["k"]).reshape(B, T, nkv, hd)
-    v = (hidden @ lp["v"]).reshape(B, T, nkv, hd)
+    q = proj(hidden, "q").reshape(B, T, nh, hd)
+    k = proj(hidden, "k").reshape(B, T, nkv, hd)
+    v = proj(hidden, "v").reshape(B, T, nkv, hd)
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 
@@ -110,11 +122,11 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             attn = attention_with_cache(q, k_att, v_att, positions,
                                         scale=hd ** -0.5)
         new_kv = (k_cache, v_cache)
-    x = x + (attn.reshape(B, T, nh * hd) @ lp["o"])
+    x = x + proj(attn.reshape(B, T, nh * hd), "o")
 
     hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gated = jax.nn.silu(hidden @ lp["gate"]) * (hidden @ lp["up"])
-    x = x + gated @ lp["down"]
+    gated = jax.nn.silu(proj(hidden, "gate")) * proj(hidden, "up")
+    x = x + proj(gated, "down")
     return x, new_kv
 
 
@@ -122,7 +134,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache,
             rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
             kv_len: Optional[int] = None,
-            use_flash: Optional[bool] = None) -> Tuple[jnp.ndarray, KVCache]:
+            use_flash: Optional[bool] = None,
+            lora_params=None, adapter_ids: Optional[jnp.ndarray] = None,
+            lora_scaling: float = 1.0) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
     positions[b] must be contiguous starting at the sequence's current
@@ -131,6 +145,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     use_flash: None = auto (pallas flash prefill when the runtime gate is
     on); pass False on sharded executables — pallas_call has no GSPMD
     partitioning rule (see ops/pallas_attention.py).
+    lora_params: layer-leading stacked adapters (models/lora.layer_slice)
+    + adapter_ids [B] selecting each row's adapter (0 = base).
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
@@ -140,15 +156,29 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     starts = positions[:, 0]
     x = params["embed"][tokens].astype(cfg.dtype)
 
-    def scan_body(carry, xs):
-        lp, k_c, v_c = xs
-        out, new_kv = _layer_body(cfg, rope, positions, starts, carry, lp,
-                                  (k_c, v_c), kv_len=kv_len,
-                                  use_flash=use_flash)
-        return out, new_kv
+    if lora_params is not None:
+        def scan_body(carry, xs):
+            lp, k_c, v_c, ll = xs
+            out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
+                                      lp, (k_c, v_c), kv_len=kv_len,
+                                      use_flash=use_flash, lora_layer=ll,
+                                      adapter_ids=adapter_ids,
+                                      lora_scaling=lora_scaling)
+            return out, new_kv
 
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_body, x, (params["layers"], cache.k, cache.v))
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x,
+            (params["layers"], cache.k, cache.v, lora_params))
+    else:
+        def scan_body(carry, xs):
+            lp, k_c, v_c = xs
+            out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
+                                      lp, (k_c, v_c), kv_len=kv_len,
+                                      use_flash=use_flash)
+            return out, new_kv
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _lm_head(params, cfg, x)
     return logits, KVCache(k=new_k, v=new_v)

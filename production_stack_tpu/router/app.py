@@ -118,6 +118,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
             parse_static_urls(args.static_backends),
             parse_comma_separated(args.static_models),
             aliases=parse_static_aliases(args.static_model_aliases),
+            probe=args.probe_backends,
         )
     elif args.service_discovery == "k8s":
         state["discovery"] = K8sServiceDiscovery(
@@ -214,6 +215,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="comma-separated engine URLs")
     p.add_argument("--static-models", default="",
                    help="comma-separated model names (same order)")
+    p.add_argument("--probe-backends", action="store_true",
+                   help="query each static backend's /v1/models at "
+                        "startup; extra served models (e.g. LoRA "
+                        "adapters) become routable aliases")
     p.add_argument("--static-model-aliases", default="",
                    help="alias:model,... pairs")
     p.add_argument("--k8s-namespace", default="default")

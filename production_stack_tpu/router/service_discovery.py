@@ -75,7 +75,7 @@ async def probe_model_name(session: aiohttp.ClientSession,
 class StaticServiceDiscovery(ServiceDiscovery):
     def __init__(self, urls: List[str], models: List[str],
                  aliases: Optional[Dict[str, str]] = None,
-                 probe: bool = False):
+                 probe: bool = False, probe_interval: float = 30.0):
         if len(urls) != len(models):
             raise ValueError(
                 f"{len(urls)} backends but {len(models)} model names")
@@ -87,6 +87,8 @@ class StaticServiceDiscovery(ServiceDiscovery):
                          model_aliases=alias_map.get(m, []))
             for u, m in zip(urls, models)]
         self._probe = probe
+        self._probe_interval = probe_interval
+        self._probe_task: Optional[asyncio.Task] = None
 
     def get_endpoints(self) -> List[EndpointInfo]:
         return list(self._endpoints)
@@ -94,13 +96,46 @@ class StaticServiceDiscovery(ServiceDiscovery):
     async def start(self) -> None:
         if not self._probe:
             return
+        # one immediate pass (routers usually start after engines), then
+        # keep re-probing: an engine that is still warming up at router
+        # start would otherwise never contribute its extra served models
+        # (e.g. LoRA adapters) as routable aliases
+        await self._probe_once()
+        self._probe_task = asyncio.create_task(self._probe_loop(),
+                                               name="static-probe")
+
+    async def close(self) -> None:
+        if self._probe_task:
+            self._probe_task.cancel()
+            self._probe_task = None
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._probe_interval)
+            try:
+                await self._probe_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("static backend probe failed")
+
+    async def _probe_once(self) -> None:
         async with aiohttp.ClientSession() as session:
             for ep in self._endpoints:
                 models = await probe_model_name(session, ep.url)
-                if models and ep.model not in models:
+                if not models:
+                    continue
+                if ep.model not in models:
                     logger.warning(
                         "backend %s reports models %s, flag says %s",
                         ep.url, models, ep.model)
+                extra = [m for m in models
+                         if m != ep.model and m not in ep.model_aliases]
+                if extra:
+                    # adapters/aliases the engine serves beyond the flag
+                    # (e.g. LoRA adapters as model ids) become routable
+                    logger.info("backend %s also serves %s", ep.url, extra)
+                    ep.model_aliases = ep.model_aliases + extra
 
 
 class K8sServiceDiscovery(ServiceDiscovery):

@@ -142,6 +142,12 @@ def test_engine_argv_matches_cli():
                 value = '{"kv_role": "kv_both", "local_cpu_gb": 1}'
             if flag in ("--host", "--checkpoint"):
                 value = "x"
+            if flag == "--dtype":
+                value = "bfloat16"
+            if flag == "--lora-adapters":
+                value = "demo=random:7"
+            if flag == "--lora-targets":
+                value = "q,v"
             argv += [flag, value]
         try:
             parse_args(argv)

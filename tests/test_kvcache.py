@@ -234,3 +234,17 @@ def test_engine_divergent_prompt_partial_hit():
         assert engine.connector.hit_tokens == 32
     finally:
         engine.close()
+
+
+def test_chunk_keys_adapter_salt_disjoint():
+    """LoRA-salted keys never collide with base keys for the same tokens
+    (adapter-colored KV must not serve other models)."""
+    from production_stack_tpu.kvcache.chunks import ChunkHasher
+    h = ChunkHasher(4, "m")
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    base = h.chunk_keys(toks)
+    one = h.chunk_keys(toks, salt="lora:ad-one")
+    two = h.chunk_keys(toks, salt="lora:ad-two")
+    assert not (set(base) & set(one)) and not (set(one) & set(two))
+    # same salt -> same keys (shared tier across replicas)
+    assert one == h.chunk_keys(toks, salt="lora:ad-one")

@@ -1,0 +1,163 @@
+"""Multi-LoRA serving tests: per-request adapter selection in one batch.
+
+Covers the reference's LoRA surface (reference: --enable-lora flag,
+helm/templates/deployment-vllm-multi.yaml:65-67, and
+proposals/lora-k8s-support.md routing by served model name) implemented
+natively: stacked adapters, adapter-as-model-id, npz persistence.
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingOptions
+
+
+def _cfg(**kw):
+    base = dict(model="debug-tiny", max_model_len=128, max_num_seqs=4,
+                prefill_chunk=32, prefill_buckets=(32,), decode_window=4,
+                lora_adapters={"ad-one": "random:11", "ad-two": "random:22"})
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = LLMEngine(_cfg())
+    eng.runner.warmup()
+    return eng
+
+
+def _gen(eng, model, prompt=None, max_tokens=10):
+    prompt = prompt or list(range(7, 27))
+    sid = eng.add_request(prompt,
+                          SamplingOptions(temperature=0.0,
+                                          max_tokens=max_tokens,
+                                          ignore_eos=True),
+                          model=model)
+    done = set()
+    steps = 0
+    while sid not in done:
+        done.update(o.seq_id for o in eng.step() if o.finished)
+        steps += 1
+        assert steps < 500
+    return list(eng.seqs[sid].output_tokens)
+
+
+def test_adapters_served_as_models(engine):
+    assert engine.served_models == ["debug-tiny", "ad-one", "ad-two"]
+    assert engine.resolve_model(None) == 0
+    assert engine.resolve_model("debug-tiny") == 0
+    assert engine.resolve_model("ad-one") == 1
+    with pytest.raises(ValueError, match="unknown model"):
+        engine.resolve_model("nope")
+
+
+def test_adapters_produce_distinct_outputs(engine):
+    """Two adapters over one base produce three distinct greedy streams
+    (VERDICT round-2 item 5's done-criterion)."""
+    base = _gen(engine, None)
+    one = _gen(engine, "ad-one")
+    two = _gen(engine, "ad-two")
+    assert base != one and base != two and one != two
+
+
+def test_mixed_adapter_batch_matches_solo(engine):
+    """A batch mixing base + both adapters reproduces each solo stream —
+    per-row adapter selection does not leak across slots."""
+    solo = {m: _gen(engine, m) for m in (None, "ad-one", "ad-two")}
+    opts = lambda: SamplingOptions(temperature=0.0, max_tokens=10,  # noqa: E731
+                                   ignore_eos=True)
+    prompt = list(range(7, 27))
+    sids = {m: engine.add_request(prompt, opts(), model=m)
+            for m in (None, "ad-one", "ad-two")}
+    pending = set(sids.values())
+    steps = 0
+    while pending:
+        pending -= {o.seq_id for o in engine.step() if o.finished}
+        steps += 1
+        assert steps < 500
+    for m, sid in sids.items():
+        assert list(engine.seqs[sid].output_tokens) == solo[m], m
+
+
+def test_adapter_npz_round_trip(tmp_path):
+    """Saving an adapter and loading it back serves identical tokens."""
+    import jax
+    from production_stack_tpu.models import lora
+    from production_stack_tpu.models.config import get_config
+
+    mcfg = get_config("debug-tiny")
+    lcfg = lora.LoRAConfig(rank=8, alpha=16.0)
+    adapter = lora.random_adapter(mcfg, lcfg, jax.random.PRNGKey(11))
+    path = str(tmp_path / "ad.npz")
+    lora.save_adapter_npz(adapter, path)
+
+    from_file = LLMEngine(_cfg(lora_adapters={"ad": path}))
+    from_seed = LLMEngine(_cfg(lora_adapters={"ad": "random:11"}))
+    assert _gen(from_file, "ad") == _gen(from_seed, "ad")
+
+
+def test_bad_adapter_shapes_rejected(tmp_path):
+    path = str(tmp_path / "bad.npz")
+    np.savez(path, **{"q.a": np.zeros((1, 2, 3)), "q.b": np.zeros((3, 2))})
+    with pytest.raises(ValueError, match="adapter"):
+        LLMEngine(_cfg(lora_adapters={"bad": path}))
+
+
+def test_lora_zero_base_slot_is_noop():
+    """With adapters loaded, base-model requests are bit-identical to an
+    engine with no LoRA at all (slot 0 is zeroed)."""
+    with_lora = LLMEngine(_cfg())
+    without = LLMEngine(_cfg(lora_adapters=None))
+    assert _gen(with_lora, None) == _gen(without, None)
+
+
+def test_lora_routing_through_router():
+    """Adapter model names are routable end-to-end: the router probes the
+    engine's /v1/models, learns the adapters as aliases, and requests by
+    adapter name produce distinct outputs (VERDICT item 5 done-criterion)."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.server import (
+        build_app as build_engine_app)
+    from production_stack_tpu.router.app import (
+        build_app as build_router_app, parse_args)
+
+    async_eng = AsyncLLMEngine(_cfg())
+
+    async def body():
+        engine_server = TestServer(build_engine_app(async_eng))
+        await engine_server.start_server()
+        url = f"http://127.0.0.1:{engine_server.port}"
+        router_app = build_router_app(parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "debug-tiny",
+            "--probe-backends"]))
+        async with TestClient(TestServer(router_app)) as client:
+            r = await client.get("/v1/models")
+            ids = sorted(c["id"] for c in (await r.json())["data"])
+            assert ids == ["ad-one", "ad-two", "debug-tiny"]
+
+            async def ask(model):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": model, "max_tokens": 8, "temperature": 0.0,
+                    "messages": [{"role": "user", "content": "adapters"}]})
+                assert r.status == 200, await r.text()
+                return (await r.json())["choices"][0]["message"]["content"]
+
+            base = await ask("debug-tiny")
+            one = await ask("ad-one")
+            two = await ask("ad-two")
+            assert base != one and one != two
+
+            r = await client.post("/v1/chat/completions", json={
+                "model": "no-such-adapter", "max_tokens": 4,
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 400  # router: no backend serves it
+        await engine_server.close()
+    asyncio.run(body())
