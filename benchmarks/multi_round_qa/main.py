@@ -48,15 +48,25 @@ def parse_args(argv=None):
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument("--output", default="summary.csv")
     p.add_argument("--log-interval", type=float, default=30.0)
+    p.add_argument("--sharegpt", default=None,
+                   help="path to a ShareGPT-format JSON dump; user "
+                        "questions come from its conversations instead "
+                        "of the synthetic prompt (reference --sharegpt)")
     return p.parse_args(argv)
 
 
 async def run(args) -> int:
+    sharegpt = None
+    if args.sharegpt:
+        from benchmarks.multi_round_qa.workload import load_sharegpt
+        sharegpt = load_sharegpt(args.sharegpt)
+        logger.info("sharegpt workload: %d conversations", len(sharegpt))
     cfg = WorkloadConfig(
         num_users=args.num_users, num_rounds=args.num_rounds, qps=args.qps,
         system_prompt_len=args.shared_system_prompt,
         user_history_len=args.user_history_prompt,
-        answer_len=args.answer_len, init_user_id=args.init_user_id)
+        answer_len=args.answer_len, init_user_id=args.init_user_id,
+        sharegpt=sharegpt)
     logger.info("gap between users: %.2fs; per-user request gap: %.2fs",
                 cfg.gap_between_users, cfg.gap_between_requests)
     manager = SessionManager(cfg, continuous=args.time is not None)

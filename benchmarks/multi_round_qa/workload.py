@@ -28,6 +28,10 @@ class WorkloadConfig:
     user_history_len: int = 2000     # tokens of per-user context
     answer_len: int = 100            # max_tokens per answer
     init_user_id: int = 0
+    # real conversation questions instead of the synthetic story prompt:
+    # per-conversation lists of human turns (load_sharegpt); user i plays
+    # conversation i mod len (reference --sharegpt, multi-round-qa.py)
+    sharegpt: Optional[List[List[str]]] = None
 
     @property
     def gap_between_requests(self) -> float:
@@ -46,6 +50,29 @@ class WorkloadConfig:
 
 def _dummy_text(n_tokens: int) -> str:
     return " ".join(["hi"] * n_tokens)
+
+
+def load_sharegpt(path: str) -> List[List[str]]:
+    """ShareGPT-format JSON -> per-conversation human-turn lists.
+
+    Accepts the common dump shape: a list of records with a
+    ``conversations`` array of {"from": "human"|"gpt", "value": ...}
+    turns ("user" accepted as an alias of "human").
+    """
+    import json
+    with open(path) as f:
+        data = json.load(f)
+    convs: List[List[str]] = []
+    for item in data:
+        turns = item.get("conversations") or []
+        questions = [t.get("value", "") for t in turns
+                     if t.get("from") in ("human", "user")
+                     and t.get("value")]
+        if questions:
+            convs.append(questions)
+    if not convs:
+        raise ValueError(f"{path}: no usable conversations")
+    return convs
 
 
 class UserSession:
@@ -70,6 +97,9 @@ class UserSession:
 
     def _next_question(self) -> str:
         self.question_id += 1
+        if self.cfg.sharegpt:
+            conv = self.cfg.sharegpt[self.user_id % len(self.cfg.sharegpt)]
+            return conv[(self.question_id - 1) % len(conv)]
         return (f"Question #{self.question_id}: please tell me a new "
                 f"long story with a happy ending.")
 

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Minimal OpenAI-compatible chat client against the router: one
+non-streaming call, one streaming call (SSE), with session affinity via
+the x-user-id header (the routing key the benchmark and the reference's
+session router use).
+
+    python examples/chat_client.py --base-url http://localhost:8000 \
+        --model debug-tiny
+"""
+
+import argparse
+import json
+import urllib.request
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://localhost:8000")
+    ap.add_argument("--model", default="debug-tiny")
+    ap.add_argument("--user", default="example-user")
+    args = ap.parse_args()
+    base = args.base_url.rstrip("/")
+    headers = {"Content-Type": "application/json",
+               "x-user-id": args.user}
+
+    body = {"model": args.model, "max_tokens": 24, "temperature": 0.7,
+            "messages": [{"role": "user",
+                          "content": "Tell me something interesting."}]}
+
+    print("-- non-streaming --")
+    req = urllib.request.Request(base + "/v1/chat/completions",
+                                 data=json.dumps(body).encode(),
+                                 headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        data = json.loads(resp.read())
+    print(data["choices"][0]["message"]["content"])
+    print("usage:", data["usage"])
+
+    print("-- streaming --")
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps({**body, "stream": True}).encode(),
+        headers=headers)
+    with urllib.request.urlopen(req) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunk = json.loads(payload)
+            for choice in chunk.get("choices", []):
+                delta = (choice.get("delta") or {}).get("content")
+                if delta:
+                    print(delta, end="", flush=True)
+    print()
+
+
+if __name__ == "__main__":
+    main()

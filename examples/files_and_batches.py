@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Files + Batches API walkthrough against the router.
+
+Uploads a JSONL batch input through /v1/files, submits a /v1/batches job
+that executes every line through routing, polls to completion, and
+downloads the output file. (Reference ships the same walkthrough as
+examples/example_file_upload.py + a batch client; unlike the reference's
+placeholder batch processor, this stack's batches actually execute.)
+
+Start a stack first, e.g.:
+
+    python -m production_stack_tpu.engine.server --model debug-tiny \
+        --port 8100 &
+    python -m production_stack_tpu.router.app --port 8000 \
+        --service-discovery static \
+        --static-backends http://localhost:8100 \
+        --static-models debug-tiny \
+        --enable-files-api --enable-batch-api &
+
+    python examples/files_and_batches.py --base-url http://localhost:8000
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+import uuid
+
+
+def api(base, path, data=None, headers=None, method=None):
+    req = urllib.request.Request(
+        base + path, data=data, headers=headers or {},
+        method=method or ("POST" if data is not None else "GET"))
+    with urllib.request.urlopen(req) as resp:
+        return resp.read()
+
+
+def upload_jsonl(base, lines):
+    boundary = uuid.uuid4().hex
+    body = b""
+    fields = {"purpose": "batch"}
+    for name, value in fields.items():
+        body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+                 f'name="{name}"\r\n\r\n{value}\r\n').encode()
+    payload = "\n".join(json.dumps(line) for line in lines)
+    body += (f"--{boundary}\r\nContent-Disposition: form-data; "
+             f'name="file"; filename="input.jsonl"\r\n'
+             f"Content-Type: application/jsonl\r\n\r\n").encode()
+    body += payload.encode() + f"\r\n--{boundary}--\r\n".encode()
+    raw = api(base, "/v1/files", data=body, headers={
+        "Content-Type": f"multipart/form-data; boundary={boundary}"})
+    return json.loads(raw)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://localhost:8000")
+    ap.add_argument("--model", default="debug-tiny")
+    args = ap.parse_args()
+    base = args.base_url.rstrip("/")
+
+    print("1) upload batch input via /v1/files")
+    lines = [
+        {"custom_id": f"req-{i}",
+         "method": "POST", "url": "/v1/chat/completions",
+         "body": {"model": args.model, "max_tokens": 8,
+                  "messages": [{"role": "user",
+                                "content": f"Question {i}: say something"}]}}
+        for i in range(3)
+    ]
+    file_obj = upload_jsonl(base, lines)
+    print("   uploaded:", file_obj["id"], f"({file_obj['bytes']} bytes)")
+
+    print("2) submit the batch")
+    batch = json.loads(api(base, "/v1/batches", data=json.dumps({
+        "input_file_id": file_obj["id"],
+        "endpoint": "/v1/chat/completions",
+        "completion_window": "24h"}).encode(),
+        headers={"Content-Type": "application/json"}))
+    print("   batch:", batch["id"], batch["status"])
+
+    print("3) poll until it finishes")
+    for _ in range(120):
+        batch = json.loads(api(base, f"/v1/batches/{batch['id']}"))
+        if batch["status"] in ("completed", "failed", "cancelled"):
+            break
+        time.sleep(1)
+    print("   final status:", batch["status"])
+    if batch["status"] != "completed":
+        sys.exit(1)
+
+    print("4) download results")
+    out = api(base, f"/v1/files/{batch['output_file_id']}/content")
+    for line in out.decode().strip().splitlines():
+        rec = json.loads(line)
+        body = rec["response"]["body"]
+        text = body["choices"][0]["message"]["content"]
+        print(f"   {rec['custom_id']}: {text[:60]!r}")
+
+
+if __name__ == "__main__":
+    main()

@@ -195,6 +195,14 @@ class Parser {
   }
 
  private:
+  static constexpr int kMaxDepth = 128;
+  int depth_ = 0;
+  struct DepthGuard {
+    explicit DepthGuard(Parser *p) : p_(p) { p_->depth_++; }
+    ~DepthGuard() { p_->depth_--; }
+    Parser *p_;
+  };
+
   void skip_ws() {
     while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\t' ||
                                 s_[pos_] == '\n' || s_[pos_] == '\r')) {
@@ -212,9 +220,13 @@ class Parser {
   bool value(Value *out) {
     skip_ws();
     if (pos_ >= s_.size()) return false;
+    // bound nesting: value/array/object recurse per level, so adversarial
+    // input like 100k '[' would otherwise smash the stack. 128 levels is
+    // far beyond any config/CRD payload this parser sees.
+    if (depth_ >= kMaxDepth) return false;
     char c = s_[pos_];
-    if (c == '{') return object(out);
-    if (c == '[') return array(out);
+    if (c == '{') { DepthGuard g(this); return object(out); }
+    if (c == '[') { DepthGuard g(this); return array(out); }
     if (c == '"') {
       std::string str;
       if (!string(&str)) return false;

@@ -249,6 +249,11 @@ class LLMEngine:
             for w in group:
                 self.scheduler.on_prefill_done(w)
                 self.metrics.prompt_tokens.inc(len(w.chunk))
+                if self.connector is not None:
+                    # progressive publish: disagg decode engines can pull
+                    # the prefix while later chunks still prefill
+                    self.connector.on_prefill_progress(
+                        w.seq, salt=self._adapter_salt(w.seq.adapter_id))
                 if not w.is_last:
                     continue
                 if ids is None:
@@ -387,6 +392,28 @@ class LLMEngine:
             self._slot_token[slot] = 0
             self._slot_pos[slot] = self.cfg.max_model_len
             self._decode_dirty = True
+
+    def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
+        """Mean-pooled prompt embeddings [n, H] fp32 (the /v1/embeddings
+        path; rerank and score pool on top of it). Length-bucketed and
+        batch-padded to bound executable count; runs off the engine loop
+        (read-only on params, nothing donated)."""
+        B = self.cfg.max_num_seqs
+        buckets = sorted(set(self.cfg.prefill_buckets)
+                         | set(self.cfg.kv_len_buckets))
+        out: List[np.ndarray] = []
+        for i in range(0, len(token_lists), B):
+            group = token_lists[i:i + B]
+            need = max(len(t) for t in group)
+            tb = next((b for b in buckets if b >= need), need)
+            tokens = np.zeros((B, tb), np.int32)
+            lengths = np.ones((B,), np.int32)
+            for j, toks in enumerate(group):
+                tokens[j, :len(toks)] = toks
+                lengths[j] = len(toks)
+            pooled = np.asarray(self.runner.embed(tokens, lengths))
+            out.append(pooled[:len(group)])
+        return np.concatenate(out, axis=0)
 
     def render_metrics(self) -> bytes:
         with self._lock:

@@ -107,6 +107,8 @@ class ModelRunner:
         # KV-tiering primitives (kvcache/connector.py), cached per chunk size
         self._extract_fns = {}
         self._inject_fns = {}
+        # embeddings path, cached per (batch, padded length)
+        self._embed_fns = {}
 
     # ------------------------------------------------------------------
     # jitted impls (pure)
@@ -250,6 +252,31 @@ class ModelRunner:
         fn.lower(*args).compile()   # donation applies at execution only
         self._prefill_fns[(Tb, kv_len)] = fn
         return fn
+
+    def embed(self, tokens, lengths):
+        """Mean-pooled final hidden states for padded prompts.
+
+        tokens [N, Tb] int32 np (right-padded), lengths [N] -> fp32
+        [N, H]. Powers /v1/embeddings (and rerank/score built on it);
+        no KV cache involved, nothing donated, safe to dispatch from the
+        server thread next to the engine loop.
+        """
+        N, Tb = tokens.shape
+        fn = self._embed_fns.get((N, Tb))
+        if fn is None:
+            logger.info("compiling embed (batch=%d len=%d)", N, Tb)
+
+            def _impl(params, toks, lens):
+                h = llama.encode(params, self.model_cfg, toks,
+                                 rope=self.rope)
+                mask = (jnp.arange(Tb)[None, :] < lens[:, None])
+                pooled = jnp.sum(
+                    h.astype(jnp.float32) * mask[:, :, None], axis=1)
+                return pooled / jnp.maximum(lens, 1)[:, None]
+
+            fn = self._embed_fns[(N, Tb)] = jax.jit(_impl)
+        return fn(self.params, jnp.asarray(tokens, jnp.int32),
+                  jnp.asarray(lengths, jnp.int32))
 
     def extract_chunk(self, slot: int, start: int, size: int):
         """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the

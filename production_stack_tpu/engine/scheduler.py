@@ -57,6 +57,9 @@ class Sequence:
     # host-side KV for a cached prompt prefix, fetched off the engine loop
     # at add time (kvcache/connector.py Prefetch); injected at admission
     kv_prefetch: object = None
+    # incremental chunk-key chain state for progressive KV publish
+    # (kvcache/connector.py _publish)
+    kv_publish_state: object = None
     # incremental detokenization state (owned by LLMEngine)
     output_text: str = ""       # stable decoded text, stop-truncated
     chars_emitted: int = 0      # prefix of output_text already delivered

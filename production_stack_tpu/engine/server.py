@@ -103,11 +103,15 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                              and req.stream_options.include_usage)
 
         async def gen():
+            # OpenAI chunk shape: with include_usage every chunk carries
+            # "usage": null until the final usage chunk; without it the
+            # field is omitted entirely
+            exclude = None if include_usage else {"usage"}
             first = proto.ChatCompletionChunk(
                 id=rid, model=req.model,
                 choices=[proto.ChatCompletionChunkChoice(
                     delta=proto.DeltaMessage(role="assistant", content=""))])
-            yield first.model_dump_json(exclude={"usage"})
+            yield first.model_dump_json(exclude=exclude)
             num_tokens = 0
             # aclosing => a dropped consumer deterministically runs
             # engine.stream's cleanup (slot abort), not at GC's leisure
@@ -123,7 +127,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                                     content=out.text_delta or None),
                                 finish_reason=out.finish_reason if out.finished
                                 else None)])
-                        yield chunk.model_dump_json(exclude={"usage"})
+                        yield chunk.model_dump_json(exclude=exclude)
             if include_usage:
                 # OpenAI semantics: one final chunk, empty choices, usage
                 tail = proto.ChatCompletionChunk(
@@ -194,6 +198,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                              and req.stream_options.include_usage)
 
         async def gen():
+            exclude = None if include_usage else {"usage"}
             num_tokens = 0
             async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
                 async for out in it:
@@ -206,7 +211,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                                 text=out.text_delta,
                                 finish_reason=out.finish_reason if out.finished
                                 else None)])
-                        yield chunk.model_dump_json(exclude={"usage"})
+                        yield chunk.model_dump_json(exclude=exclude)
             if include_usage:
                 tail = proto.CompletionChunk(
                     id=rid, model=req.model, choices=[],
@@ -235,6 +240,162 @@ async def completions(request: web.Request) -> web.StreamResponse:
             prompt_tokens=len(prompt_ids), completion_tokens=num_tokens,
             total_tokens=len(prompt_ids) + num_tokens))
     return web.json_response(resp.model_dump())
+
+
+def _as_token_lists(engine, raw) -> List[List[int]]:
+    """OpenAI embeddings `input`: str | [str] | [int] | [[int]]."""
+    tok = engine.tokenizer
+    if isinstance(raw, str):
+        return [tok.encode(raw)]
+    if not isinstance(raw, list):
+        raise ValueError("input must be str, [str], [int], or [[int]]")
+    if raw and all(isinstance(x, int) and not isinstance(x, bool)
+                   for x in raw):
+        return [list(raw)]
+    out: List[List[int]] = []
+    for item in raw:
+        if isinstance(item, str):
+            out.append(tok.encode(item))
+        elif isinstance(item, list) and all(
+                isinstance(x, int) and not isinstance(x, bool)
+                for x in item):
+            out.append(list(item))
+        else:
+            raise ValueError("input must be str, [str], [int], or [[int]]")
+    return out
+
+
+def _check_pool_model(engine, model) -> Optional[web.Response]:
+    """Pooling endpoints serve only the BASE model: embeddings pool raw
+    hidden states, which the LoRA path does not color (adapters would
+    need an adapter-aware encode). Unknown models 404, adapters 400."""
+    try:
+        adapter_id = engine.engine.resolve_model(model or None)
+    except ValueError as e:
+        return _error(404, str(e))
+    if adapter_id != 0:
+        return _error(400, f"model {model!r} is a LoRA adapter; "
+                           f"embeddings/rerank/score serve the base "
+                           f"model only")
+    return None
+
+
+async def _pooled(request: web.Request, token_lists: List[List[int]]):
+    """Run the embedding batch off the event loop (device-blocking)."""
+    engine = request.app[ENGINE_KEY]
+    max_len = engine.engine.cfg.max_model_len
+    for toks in token_lists:
+        if not toks:
+            raise ValueError("empty input")
+        if len(toks) > max_len:
+            raise ValueError(f"input has {len(toks)} tokens, which "
+                             f"exceeds max_model_len {max_len}")
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(
+        None, engine.engine.embed_tokens, token_lists)
+
+
+async def embeddings(request: web.Request) -> web.Response:
+    """OpenAI-compatible /v1/embeddings: mean-pooled final hidden states
+    (reference surface: src/vllm_router/routers/main_router.py:42-160
+    proxies this path to the engine)."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        bad = _check_pool_model(engine, body.get("model"))
+        if bad is not None:
+            return bad
+        token_lists = _as_token_lists(engine, body.get("input"))
+        if not token_lists:
+            return _error(400, "missing 'input'")
+        vecs = await _pooled(request, token_lists)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        return _error(400, f"invalid request: {e}")
+    n_tokens = sum(len(t) for t in token_lists)
+    return web.json_response({
+        "object": "list",
+        "model": body.get("model") or engine.model_name,
+        "data": [{"object": "embedding", "index": i,
+                  "embedding": vec.tolist()}
+                 for i, vec in enumerate(vecs)],
+        "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+    })
+
+
+def _cosine(a, b):
+    import numpy as np
+    num = float(np.dot(a, b))
+    den = float(np.linalg.norm(a) * np.linalg.norm(b)) or 1e-12
+    return num / den
+
+
+async def rerank(request: web.Request) -> web.Response:
+    """/v1/rerank: order documents by embedding similarity to the query
+    (bi-encoder scoring over the served model's hidden states)."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        bad = _check_pool_model(engine, body.get("model"))
+        if bad is not None:
+            return bad
+        query = body.get("query")
+        docs = body.get("documents")
+        if not isinstance(query, str) or not isinstance(docs, list) \
+                or not docs or not all(isinstance(d, str) for d in docs):
+            return _error(400, "need 'query' (str) and 'documents' "
+                               "(non-empty list of str)")
+        token_lists = _as_token_lists(engine, [query] + list(docs))
+        vecs = await _pooled(request, token_lists)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        return _error(400, f"invalid request: {e}")
+    q, dvecs = vecs[0], vecs[1:]
+    scored = sorted(
+        ({"index": i, "document": {"text": d},
+          "relevance_score": _cosine(q, v)}
+         for i, (d, v) in enumerate(zip(docs, dvecs))),
+        key=lambda r: r["relevance_score"], reverse=True)
+    top_n = body.get("top_n")
+    if isinstance(top_n, int) and top_n > 0:
+        scored = scored[:top_n]
+    return web.json_response({
+        "id": proto._gen_id("rerank"),
+        "model": body.get("model") or engine.model_name,
+        "results": scored,
+        "usage": {"total_tokens": sum(len(t) for t in token_lists)},
+    })
+
+
+async def score(request: web.Request) -> web.Response:
+    """/v1/score: similarity of text_1 against each text_2 entry."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+        bad = _check_pool_model(engine, body.get("model"))
+        if bad is not None:
+            return bad
+        t1, t2 = body.get("text_1"), body.get("text_2")
+        if isinstance(t2, str):
+            texts = [t2]
+        elif isinstance(t2, list) and t2 and all(isinstance(x, str)
+                                                 for x in t2):
+            texts = list(t2)
+        else:
+            texts = None
+        if not isinstance(t1, str) or texts is None:
+            return _error(400, "need 'text_1' (str) and 'text_2' "
+                               "(str or non-empty list of str)")
+        token_lists = _as_token_lists(engine, [t1] + texts)
+        vecs = await _pooled(request, token_lists)
+    except (ValueError, TypeError, json.JSONDecodeError) as e:
+        return _error(400, f"invalid request: {e}")
+    base = vecs[0]
+    return web.json_response({
+        "id": proto._gen_id("score"),
+        "model": body.get("model") or engine.model_name,
+        "data": [{"index": i, "score": _cosine(base, v)}
+                 for i, v in enumerate(vecs[1:])],
+        "usage": {"total_tokens": sum(len(t) for t in token_lists)},
+    })
 
 
 async def list_models(request: web.Request) -> web.Response:
@@ -283,6 +444,10 @@ def build_app(engine: AsyncLLMEngine) -> web.Application:
     app[ENGINE_KEY] = engine
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
+    app.router.add_post("/v1/embeddings", embeddings)
+    app.router.add_post("/v1/rerank", rerank)
+    app.router.add_post("/v2/rerank", rerank)
+    app.router.add_post("/v1/score", score)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/health", health)
     app.router.add_get("/version", version)

@@ -74,9 +74,20 @@ def render_chat_template(template_text: str, messages: List[dict],
     `messages` in scope, `add_generation_prompt` true). StrictUndefined:
     a template referencing a variable we don't provide errors loudly
     instead of silently rendering empty strings."""
+    import datetime
+
     import jinja2
     env = jinja2.Environment(autoescape=False,
                              undefined=jinja2.StrictUndefined)
+
+    # helpers stock HF chat templates expect (many Llama/Mistral templates
+    # call raise_exception on bad role sequences; some stamp dates)
+    def raise_exception(message):
+        raise jinja2.exceptions.TemplateError(message)
+
+    env.globals["raise_exception"] = raise_exception
+    env.globals["strftime_now"] = \
+        lambda fmt: datetime.datetime.now().strftime(fmt)
     return env.from_string(template_text).render(
         messages=messages, add_generation_prompt=True, **extra_vars)
 

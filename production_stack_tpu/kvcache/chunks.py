@@ -16,7 +16,7 @@ int32 packing, never Python's salted hash().
 
 import hashlib
 import struct
-from typing import List, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 from production_stack_tpu.models.config import ModelConfig
 
@@ -49,9 +49,26 @@ class ChunkHasher:
         different KV from the same tokens under the same model geometry
         — e.g. a LoRA adapter name (adapters with k/v targets color the
         cache, so adapter and base chunks must never collide)."""
-        keys: List[bytes] = []
+        keys, _ = self.chain_keys(tokens, salt=salt)
+        return keys
+
+    def chain_keys(self, tokens: Sequence[int], salt: str = "",
+                   state: Optional[Tuple[int, bytes]] = None,
+                   ) -> Tuple[List[bytes], Tuple[int, bytes]]:
+        """Incremental chunk_keys: returns (new_keys, state').
+
+        ``state`` = (chunks_already_keyed, previous_digest) from an
+        earlier call over a PREFIX of the same token stream — the chain
+        extends in O(new chunks) instead of rehashing from the start
+        (progressive publish calls this once per prefill chunk; without
+        the state a long prompt's hashing would be quadratic)."""
+        start = 0
         prev = (self.namespace + ("|" + salt if salt else "")).encode()
-        for i in range(self.num_full_chunks(len(tokens))):
+        if state is not None:
+            start, prev = state
+        keys: List[bytes] = []
+        n = self.num_full_chunks(len(tokens))
+        for i in range(start, n):
             chunk = tokens[i * self.chunk_size:(i + 1) * self.chunk_size]
             h = hashlib.blake2b(digest_size=16)
             h.update(prev)
@@ -59,4 +76,4 @@ class ChunkHasher:
             digest = h.digest()
             keys.append(self.namespace.encode() + b":" + digest.hex().encode())
             prev = digest
-        return keys
+        return keys, (max(n, start), prev)

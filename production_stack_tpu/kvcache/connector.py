@@ -170,6 +170,21 @@ class KVConnector:
 
     # -- producer path --------------------------------------------------
 
+    def on_prefill_progress(self, seq, salt: str = "") -> None:
+        """Publish full PROMPT chunks as soon as they are prefilled.
+
+        Disaggregated prefill overlap: the decode engine can start
+        pulling the prefix while the producer is still chunk-prefilling
+        a long prompt — without this, KV only became visible at
+        ``on_finish``, serializing the two pools. Chunk keys dedup via
+        _seen_keys, so the later on_finish pass skips everything
+        published here.
+        """
+        if not self.cfg.is_producer:
+            return
+        self._publish(seq, seq.prompt_tokens[:seq.num_prefilled],
+                      getattr(seq, "slot", -1), salt)
+
     def on_finish(self, seq, salt: str = "") -> None:
         """Queue full-chunk KV of a finished sequence for write-through.
 
@@ -180,14 +195,23 @@ class KVConnector:
         """
         if not self.cfg.is_producer:
             return
-        tokens = (seq.prompt_tokens + seq.output_tokens)[:-1]
-        slot = getattr(seq, "slot", -1)
+        self._publish(seq, (seq.prompt_tokens + seq.output_tokens)[:-1],
+                      getattr(seq, "slot", -1), salt)
+
+    def _publish(self, seq, tokens, slot: int, salt: str) -> None:
         n_chunks = self.hasher.num_full_chunks(len(tokens))
         if n_chunks == 0 or slot < 0:
             return
-        keys = self.hasher.chunk_keys(tokens, salt=salt)
+        # the key chain is cached on the sequence and extended
+        # incrementally — progressive publish runs once per prefill
+        # chunk, and restarting the chain each time would be quadratic
+        state = getattr(seq, "kv_publish_state", None)
+        start_chunk = state[0] if state else 0
+        new_keys, state = self.hasher.chain_keys(tokens, salt=salt,
+                                                 state=state)
+        seq.kv_publish_state = state
         work = []
-        for i, key in enumerate(keys):
+        for i, key in enumerate(new_keys, start=start_chunk):
             if key in self._seen_keys:
                 continue
             k_dev, v_dev = self.runner.extract_chunk(

@@ -184,13 +184,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return logits, KVCache(k=new_k, v=new_v)
 
 
-def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
-                  rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-                  attention_fn=None) -> jnp.ndarray:
-    """Full-sequence causal forward without cache. tokens [B,T] -> logits fp32.
-
-    attention_fn(q, k, v) -> out replaces dense causal attention when given
-    (e.g. ring attention over an 'sp'-sharded sequence).
+def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+           rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+           attention_fn=None) -> jnp.ndarray:
+    """Full-sequence causal forward WITHOUT the LM head: final-normed
+    hidden states [B,T,H]. The embeddings/rerank/score endpoints pool
+    these (engine/server.py); forward_train puts the head on top.
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
@@ -205,8 +204,20 @@ def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         return out, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _lm_head(params, cfg, x)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
+def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
+                  rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
+                  attention_fn=None) -> jnp.ndarray:
+    """Full-sequence causal forward without cache. tokens [B,T] -> logits fp32.
+
+    attention_fn(q, k, v) -> out replaces dense causal attention when given
+    (e.g. ring attention over an 'sp'-sharded sequence).
+    """
+    return _lm_head(params, cfg,
+                    encode(params, cfg, tokens, rope=rope,
+                           attention_fn=attention_fn))
 
 
 def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:

@@ -38,7 +38,7 @@ from production_stack_tpu.version import __version__
 logger = init_logger(__name__)
 
 PROXIED_PATHS = ["/v1/chat/completions", "/v1/completions", "/v1/embeddings",
-                 "/v1/rerank", "/v1/score"]
+                 "/v1/rerank", "/v2/rerank", "/v1/score"]
 
 
 # ---------------------------------------------------------------- handlers
@@ -181,15 +181,27 @@ def build_app(args: argparse.Namespace) -> web.Application:
         from production_stack_tpu.router.batches_api import mount_batches_api
         mount_batches_api(app, args.batch_db_path)
 
+    if args.log_stats_interval > 0:
+        from production_stack_tpu.router.stats import StatLogger
+        state["stat_logger"] = StatLogger(
+            lambda: state["discovery"].get_endpoints(),
+            state["request_stats"], state["scraper"],
+            metrics=state["metrics"],
+            interval_s=args.log_stats_interval)
+
     async def on_startup(app):
         state["client"] = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=0))
         await state["discovery"].start()
         await state["scraper"].start()
+        if "stat_logger" in state:
+            await state["stat_logger"].start()
         if "config_watcher" in state:
             await state["config_watcher"].start()
 
     async def on_cleanup(app):
+        if "stat_logger" in state:
+            await state["stat_logger"].close()
         if "config_watcher" in state:
             await state["config_watcher"].close()
         await state["scraper"].close()
@@ -230,6 +242,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    default="roundrobin")
     p.add_argument("--session-key", default="x-user-id")
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
+    p.add_argument("--log-stats-interval", type=float, default=0.0,
+                   help="seconds between periodic per-engine stat log "
+                        "lines (0 disables; the reference's "
+                        "--log-stats equivalent)")
     p.add_argument("--request-stats-window", type=float, default=30.0)
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument("--dynamic-config-json", default=None)
@@ -254,7 +270,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--prefill-models", default="",
                    help="comma-separated model names for the prefill pool "
                         "(same order)")
-    p.add_argument("--prefill-timeout", type=float, default=120.0)
+    p.add_argument("--prefill-timeout", type=float, default=15.0,
+                   help="hard cap on one disagg prefill pass")
+    p.add_argument("--prefill-headstart", type=float, default=2.0,
+                   help="max seconds decode routing waits on the prefill "
+                        "pool; past it, decode proceeds while prefill "
+                        "keeps publishing KV in the background")
+    p.add_argument("--prefill-breaker-threshold", type=int, default=3,
+                   help="consecutive failures before a prefill backend's "
+                        "circuit opens")
+    p.add_argument("--prefill-breaker-cooldown", type=float, default=30.0,
+                   help="seconds an open prefill circuit stays open")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

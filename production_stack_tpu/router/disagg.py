@@ -32,29 +32,68 @@ PREFILL_PATHS = ("/v1/chat/completions", "/v1/completions")
 
 
 class DisaggPrefillOrchestrator:
-    """Round-robins prompts over the prefill pool before decode routing."""
+    """Round-robins prompts over the prefill pool before decode routing.
+
+    Failure handling: a per-backend circuit breaker opens after
+    ``breaker_threshold`` consecutive failures and skips the backend for
+    ``breaker_cooldown_s`` (decode engines can always recompute, so an
+    open breaker degrades to non-disagg behavior, never to errors).
+    Latency: the proxy gives prefill only a bounded ``headstart_s``
+    before routing decode (see run_with_headstart) — the producer keeps
+    publishing KV chunks progressively in the background either way.
+    """
 
     def __init__(self, backends: List[str], models: List[str],
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 15.0, headstart_s: float = 2.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0):
         if len(backends) != len(models):
             raise ValueError(
                 f"{len(backends)} prefill backends but {len(models)} models")
         self.endpoints = [EndpointInfo(url=u, model=m)
                           for u, m in zip(backends, models)]
         self.timeout_s = timeout_s
+        self.headstart_s = headstart_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
         # per-model counters: a shared counter advanced by other models'
         # traffic would skew (or fully starve) a pool's rotation
         self._rr: Dict[str, int] = {}
+        self._consecutive_failures: Dict[str, int] = {}
+        self._open_until: Dict[str, float] = {}
         self.prefills = 0
         self.prefill_errors = 0
+        self.breaker_opens = 0
+
+    def _now(self) -> float:
+        import time
+        return time.monotonic()
 
     def pick(self, model: str) -> Optional[str]:
-        pool = [ep.url for ep in self.endpoints if ep.serves(model)]
+        now = self._now()
+        pool = [ep.url for ep in self.endpoints
+                if ep.serves(model) and self._open_until.get(ep.url, 0.0)
+                <= now]
         if not pool:
             return None
         idx = self._rr.get(model, 0)
         self._rr[model] = idx + 1
         return pool[idx % len(pool)]
+
+    def _record(self, url: str, ok: bool) -> None:
+        if ok:
+            self._consecutive_failures[url] = 0
+            return
+        n = self._consecutive_failures.get(url, 0) + 1
+        self._consecutive_failures[url] = n
+        if n >= self.breaker_threshold:
+            self._open_until[url] = self._now() + self.breaker_cooldown_s
+            self._consecutive_failures[url] = 0
+            self.breaker_opens += 1
+            logger.warning(
+                "disagg prefill breaker OPEN for %s (%d consecutive "
+                "failures; cooldown %.0fs)", url, n,
+                self.breaker_cooldown_s)
 
     @staticmethod
     def prefill_body(body: dict) -> dict:
@@ -86,6 +125,7 @@ class DisaggPrefillOrchestrator:
                         total=self.timeout_s)) as resp:
                 await resp.read()
                 if resp.status == 200:
+                    self._record(url, True)
                     return True
                 logger.warning("disagg prefill on %s returned %d", url,
                                resp.status)
@@ -93,7 +133,28 @@ class DisaggPrefillOrchestrator:
                 asyncio.TimeoutError) as e:
             logger.warning("disagg prefill on %s failed: %s", url, e)
         self.prefill_errors += 1
+        self._record(url, False)
         return False
+
+    async def run_with_headstart(self, session: aiohttp.ClientSession,
+                                 endpoint_path: str, model: str,
+                                 body: dict,
+                                 headers: Optional[Dict[str, str]] = None,
+                                 ) -> None:
+        """Overlap: give prefill at most ``headstart_s`` before decode
+        routing proceeds. The prefill task keeps running (and its engine
+        keeps publishing KV chunks progressively) in the background; a
+        decode engine that starts early simply finds fewer cached chunks
+        — never a wrong result."""
+        task = asyncio.ensure_future(self.run_prefill(
+            session, endpoint_path, model, body, headers))
+        done, _ = await asyncio.wait({task}, timeout=self.headstart_s)
+        if not done:
+            logger.debug("disagg prefill still running after %.1fs "
+                         "head-start; routing decode now",
+                         self.headstart_s)
+            # surface late failures in logs, never as exceptions
+            task.add_done_callback(lambda t: t.exception())
 
 
 def make_orchestrator(args) -> Optional[DisaggPrefillOrchestrator]:
@@ -104,4 +165,7 @@ def make_orchestrator(args) -> Optional[DisaggPrefillOrchestrator]:
     models = parse_comma_separated(getattr(args, "prefill_models", None))
     return DisaggPrefillOrchestrator(
         backends, models,
-        timeout_s=getattr(args, "prefill_timeout", 120.0))
+        timeout_s=getattr(args, "prefill_timeout", 15.0),
+        headstart_s=getattr(args, "prefill_headstart", 2.0),
+        breaker_threshold=getattr(args, "prefill_breaker_threshold", 3),
+        breaker_cooldown_s=getattr(args, "prefill_breaker_cooldown", 30.0))

@@ -103,17 +103,19 @@ async def route_general_request(request: web.Request,
                                 dict(request.headers), body)
     request_id = request.headers.get("x-request-id", uuid.uuid4().hex)
 
-    # disaggregated prefill: publish the prompt KV from the prefill pool
-    # into the shared tier before decode routing (failures degrade to a
-    # normal full prefill on the decode engine)
+    # disaggregated prefill: the prefill pool computes the prompt KV into
+    # the shared tier (publishing chunk-by-chunk as it goes) while decode
+    # routing proceeds after a bounded head-start; failures (or an open
+    # breaker) degrade to a normal full prefill on the decode engine
     disagg = state.get("disagg")
     if disagg is not None:
         prefill_headers = {"x-request-id": request_id}
         if "Authorization" in request.headers:
             prefill_headers["Authorization"] = \
                 request.headers["Authorization"]
-        await disagg.run_prefill(state["client"], endpoint_path, model,
-                                 body, headers=prefill_headers)
+        await disagg.run_with_headstart(state["client"], endpoint_path,
+                                        model, body,
+                                        headers=prefill_headers)
     logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path, model, url,
                  1e3 * (time.monotonic() - t_route0))
 

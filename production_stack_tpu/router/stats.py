@@ -239,3 +239,72 @@ class EngineStatsScraper:
         await asyncio.gather(*(self._scrape_one(u) for u in urls))
         for gone in set(self._stats) - urls:
             del self._stats[gone]
+
+
+class StatLogger:
+    """Periodic stat dump: one log line per engine every `interval_s`
+    with request-window and scraped-engine numbers, plus a gauge refresh.
+
+    The reference ships the same capability as a thread
+    (src/vllm_router/stats/log_stats.py — whose spawn-site bug meant it
+    died on first use, SURVEY.md §2.1); here it is an asyncio task owned
+    by the app lifecycle.
+    """
+
+    def __init__(self, get_endpoints, monitor: "RequestStatsMonitor",
+                 scraper: "EngineStatsScraper", metrics=None,
+                 interval_s: float = 30.0):
+        self.get_endpoints = get_endpoints
+        self.monitor = monitor
+        self.scraper = scraper
+        self.metrics = metrics
+        self.interval_s = interval_s
+        self._task = None
+
+    async def start(self) -> None:
+        import asyncio
+        self._task = asyncio.create_task(self._loop(), name="stat-logger")
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        import asyncio
+        while True:
+            await asyncio.sleep(self.interval_s)
+            try:
+                self.log_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("stat logging failed")
+
+    def log_once(self) -> None:
+        request_stats = self.monitor.get()
+        engine_stats = self.scraper.get()
+        urls = sorted({ep.url for ep in self.get_endpoints()}
+                      | set(request_stats) | set(engine_stats))
+        if not urls:
+            logger.info("stats: no engines")
+        for url in urls:
+            rs = request_stats.get(url)
+            es = engine_stats.get(url)
+            parts = [f"engine {url}"]
+            if rs is not None:
+                parts.append(
+                    f"qps={rs.qps:.2f} ttft={rs.ttft:.3f}s "
+                    f"itl={rs.itl:.4f}s latency={rs.latency:.3f}s "
+                    f"in_prefill={rs.in_prefill} "
+                    f"in_decoding={rs.in_decoding} "
+                    f"finished={rs.finished}")
+            if es is not None:
+                parts.append(
+                    f"running={es.num_running:.0f} "
+                    f"waiting={es.num_waiting:.0f} "
+                    f"kv_usage={es.kv_usage:.1%}")
+            logger.info("stats: %s", " | ".join(parts))
+        if self.metrics is not None:
+            self.metrics.refresh(request_stats,
+                                 len(list(self.get_endpoints())))

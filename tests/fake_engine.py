@@ -120,3 +120,27 @@ class FakeEngine:
             lines.append(f'{name}{{model_name="{self.model}"}} {value}')
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+
+def main(argv=None) -> None:
+    """Standalone CLI so CI can launch fake engine fleets
+    (.github/workflows/router-e2e-test.yml), mirroring the reference's
+    fake-openai-server.py perftest entrypoint."""
+    import argparse
+    p = argparse.ArgumentParser("fake-engine")
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--model", default="fake-model")
+    p.add_argument("--ttft", type=float, default=0.0)
+    p.add_argument("--tokens-per-s", type=float, default=0.0)
+    p.add_argument("--num-tokens", type=int, default=8)
+    args = p.parse_args(argv)
+    eng = FakeEngine(model=args.model, ttft_s=args.ttft,
+                     tokens_per_s=args.tokens_per_s,
+                     num_tokens=args.num_tokens)
+    web.run_app(eng.build_app(), host=args.host, port=args.port,
+                print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,528 @@
+"""Minimal Go-template renderer for the chart's feature subset.
+
+Lets the test suite actually RENDER helm/templates/*.yaml and parse the
+output as YAML without a helm binary — previously only values/schema
+parsing and brace-balance were checked, so a typo inside any template
+body shipped silently. Real `helm template` runs in CI
+(.github/workflows/functionality-helm-chart.yml); this renderer is the
+hardware-free stand-in with identical semantics for the subset the chart
+uses: if/else-if/else, with, range (list and $k,$v dict forms), define/
+include/template, variables ($x := / $x =), parenthesized pipelines, and
+the functions quote nindent indent toYaml toJson kindIs default and or
+not eq ne set get dict list append join printf fail b64enc tpl.
+
+Not a general Go-template implementation; unknown constructs raise so
+the test fails loudly rather than rendering garbage.
+"""
+
+import base64
+import json
+import re
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+_ACTION_RE = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}", re.DOTALL)
+
+
+class TemplateError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------- parser
+
+class Node:
+    pass
+
+
+class Text(Node):
+    def __init__(self, s):
+        self.s = s
+
+
+class Action(Node):
+    def __init__(self, expr):
+        self.expr = expr
+
+
+class Cond(Node):
+    def __init__(self, branches, else_body):
+        self.branches = branches      # [(expr, body)]
+        self.else_body = else_body
+
+
+class Range(Node):
+    def __init__(self, kvar, vvar, expr, body, else_body):
+        self.kvar, self.vvar, self.expr = kvar, vvar, expr
+        self.body, self.else_body = body, else_body
+
+
+class With(Node):
+    def __init__(self, expr, body, else_body):
+        self.expr, self.body, self.else_body = expr, body, else_body
+
+
+def _parse(tokens: List[Tuple[str, str]], defines: Dict[str, list],
+           i: int = 0, stop=("end",)) -> Tuple[list, int, Optional[str]]:
+    body: list = []
+    while i < len(tokens):
+        kind, payload = tokens[i]
+        i += 1
+        if kind == "text":
+            if payload:
+                body.append(Text(payload))
+            continue
+        word = payload.split(None, 1)[0] if payload else ""
+        if re.fullmatch(r"/\*.*?\*/", payload, re.DOTALL) or not payload:
+            continue
+        if word in stop or (word == "else" and "else" in stop):
+            return body, i, payload
+        if word == "if":
+            cond = payload.split(None, 1)[1]
+            branches = []
+            inner, i, term = _parse(tokens, defines, i, ("end", "else"))
+            branches.append((cond, inner))
+            else_body: list = []
+            while term and term.startswith("else"):
+                rest = term[4:].strip()
+                if rest.startswith("if"):
+                    nxt_cond = rest.split(None, 1)[1]
+                    inner, i, term = _parse(tokens, defines, i,
+                                            ("end", "else"))
+                    branches.append((nxt_cond, inner))
+                else:
+                    else_body, i, term = _parse(tokens, defines, i,
+                                                ("end",))
+                    break
+            body.append(Cond(branches, else_body))
+        elif word == "range":
+            rest = payload.split(None, 1)[1]
+            kvar = vvar = None
+            if ":=" in rest:
+                lhs, rest = rest.split(":=", 1)
+                names = [v.strip() for v in lhs.split(",")]
+                if len(names) == 2:
+                    kvar, vvar = names
+                else:
+                    vvar = names[0]
+            inner, i, term = _parse(tokens, defines, i, ("end", "else"))
+            else_body = []
+            if term == "else":
+                else_body, i, _ = _parse(tokens, defines, i, ("end",))
+            body.append(Range(kvar, vvar, rest.strip(), inner, else_body))
+        elif word == "with":
+            rest = payload.split(None, 1)[1]
+            inner, i, term = _parse(tokens, defines, i, ("end", "else"))
+            else_body = []
+            if term == "else":
+                else_body, i, _ = _parse(tokens, defines, i, ("end",))
+            body.append(With(rest, inner, else_body))
+        elif word == "define":
+            name = payload.split(None, 1)[1].strip().strip('"')
+            inner, i, _ = _parse(tokens, defines, i, ("end",))
+            defines[name] = inner
+        else:
+            body.append(Action(payload))
+    return body, i, None
+
+
+# ---------------------------------------------------------------- expr
+
+_TOKEN_RE = re.compile(
+    r'"(?:[^"\\]|\\.)*"'      # string
+    r"|\(|\)|\|"
+    r"|[^\s()|]+")
+
+
+def _tokenize_expr(expr: str) -> List[str]:
+    return _TOKEN_RE.findall(expr)
+
+
+class Env:
+    def __init__(self, root, dot, vars_, defines, renderer):
+        self.root = root
+        self.dot = dot
+        self.vars = vars_
+        self.defines = defines
+        self.renderer = renderer
+
+    def child(self, dot=None, vars_=None) -> "Env":
+        return Env(self.root, self.dot if dot is None else dot,
+                   dict(self.vars) if vars_ is None else vars_,
+                   self.defines, self.renderer)
+
+
+def _resolve_path(base, path: str):
+    cur = base
+    for part in path.split(".")[0 if path else 1:]:
+        if not part:
+            continue
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            cur = getattr(cur, part, None)
+        if cur is None:
+            return None
+    return cur
+
+
+def _truthy(v) -> bool:
+    if v is None or v is False:
+        return False
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
+        return v != 0
+    if isinstance(v, (str, list, dict, tuple)):
+        return len(v) > 0
+    return bool(v)
+
+
+def _go_str(v) -> str:
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def _kind(v) -> str:
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, int):
+        return "int64"
+    if isinstance(v, float):
+        return "float64"
+    if isinstance(v, dict):
+        return "map"
+    if isinstance(v, (list, tuple)):
+        return "slice"
+    return "invalid"
+
+
+def _to_yaml(v) -> str:
+    return yaml.safe_dump(v, default_flow_style=False,
+                          sort_keys=False).rstrip("\n")
+
+
+def _indent(n: int, s: str) -> str:
+    pad = " " * n
+    return "\n".join(pad + line if line else line
+                     for line in s.split("\n"))
+
+
+class ExprEval:
+    def __init__(self, env: Env):
+        self.env = env
+
+    def eval(self, expr: str):
+        return self._pipeline(_tokenize_expr(expr))
+
+    def _pipeline(self, tokens: List[str]):
+        stages = self._split_stages(tokens)
+        value = self._command(stages[0], piped=None)
+        for stage in stages[1:]:
+            value = self._command(stage, piped=value)
+        return value
+
+    @staticmethod
+    def _split_stages(tokens: List[str]) -> List[List[str]]:
+        stages, cur, depth = [], [], 0
+        for t in tokens:
+            if t == "(":
+                depth += 1
+            elif t == ")":
+                depth -= 1
+            if t == "|" and depth == 0:
+                stages.append(cur)
+                cur = []
+            else:
+                cur.append(t)
+        stages.append(cur)
+        return stages
+
+    def _terms(self, tokens: List[str]) -> list:
+        """Evaluate a flat token list into terms (parens recurse)."""
+        terms, i = [], 0
+        while i < len(tokens):
+            t = tokens[i]
+            if t == "(":
+                depth, j = 1, i + 1
+                while j < len(tokens) and depth:
+                    depth += tokens[j] == "("
+                    depth -= tokens[j] == ")"
+                    j += 1
+                terms.append(self._pipeline(tokens[i + 1:j - 1]))
+                i = j
+            else:
+                terms.append(self._atom(t))
+                i += 1
+        return terms
+
+    class _Name(str):
+        """Marks a bare identifier that may be a function name."""
+
+    def _atom(self, t: str):
+        env = self.env
+        if t.startswith('"'):
+            return t[1:-1].encode().decode("unicode_escape")
+        if re.fullmatch(r"-?\d+", t):
+            return int(t)
+        if re.fullmatch(r"-?\d+\.\d+", t):
+            return float(t)
+        if t == "true":
+            return True
+        if t == "false":
+            return False
+        if t == "nil":
+            return None
+        if t == ".":
+            return env.dot
+        if t == "$":
+            return env.root
+        if t.startswith("$"):
+            name, _, path = t.partition(".")
+            base = env.vars.get(name)
+            return _resolve_path(base, path) if path else base
+        if t.startswith("."):
+            return _resolve_path(env.dot, t)
+        return self._Name(t)
+
+    def _command(self, tokens: List[str], piped):
+        if not tokens:
+            return piped
+        terms = self._terms(tokens)
+        head = terms[0]
+        if isinstance(head, self._Name):
+            args = terms[1:]
+            if piped is not None:
+                # piped value is the LAST argument in Go templates
+                args = args + [piped]
+            return self._call(str(head), args)
+        if piped is not None:
+            raise TemplateError(f"cannot pipe into non-function {tokens}")
+        if len(terms) != 1:
+            raise TemplateError(f"unexpected terms {tokens}")
+        return head
+
+    def _call(self, name: str, args: list):
+        env = self.env
+        fns = {
+            "quote": lambda v: '"' + _go_str(v).replace("\\", "\\\\")
+                              .replace('"', '\\"') + '"',
+            "nindent": lambda n, s: "\n" + _indent(n, _go_str(s)),
+            "indent": lambda n, s: _indent(n, _go_str(s)),
+            "toYaml": lambda v: _to_yaml(v),
+            "toJson": lambda v: json.dumps(v),
+            "kindIs": lambda k, v: _kind(v) == k,
+            "default": lambda d, v=None: v if _truthy(v) else d,
+            "not": lambda v: not _truthy(v),
+            "eq": lambda a, b: a == b,
+            "ne": lambda a, b: a != b,
+            "set": self._fn_set,
+            "get": lambda d, k: (d or {}).get(k),
+            "dict": self._fn_dict,
+            "list": lambda *a: list(a),
+            "append": lambda lst, v: list(lst or []) + [v],
+            "join": lambda sep, lst: sep.join(_go_str(x)
+                                              for x in (lst or [])),
+            "printf": lambda fmt, *a: self._fn_printf(fmt, a),
+            "b64enc": lambda s: base64.b64encode(
+                _go_str(s).encode()).decode(),
+            "fail": self._fn_fail,
+            "include": self._fn_include,
+            "tpl": self._fn_tpl,
+        }
+        if name == "and":
+            out = True
+            for a in args:
+                out = a
+                if not _truthy(a):
+                    return a
+            return out
+        if name == "or":
+            for a in args:
+                if _truthy(a):
+                    return a
+            return args[-1] if args else None
+        if name not in fns:
+            raise TemplateError(f"unsupported function {name!r}")
+        return fns[name](*args)
+
+    @staticmethod
+    def _fn_set(d, k, v):
+        d[k] = v
+        return d
+
+    @staticmethod
+    def _fn_dict(*kv):
+        if len(kv) % 2:
+            raise TemplateError("dict needs even args")
+        return {kv[i]: kv[i + 1] for i in range(0, len(kv), 2)}
+
+    @staticmethod
+    def _fn_printf(fmt: str, args):
+        return fmt % tuple(args)
+
+    @staticmethod
+    def _fn_fail(msg):
+        raise TemplateError(f"fail: {msg}")
+
+    def _fn_include(self, name, ctx):
+        body = self.env.defines.get(name)
+        if body is None:
+            raise TemplateError(f"include of undefined template {name!r}")
+        return self.env.renderer.render_nodes(
+            body, self.env.child(dot=ctx, vars_={"$": ctx}))
+
+    def _fn_tpl(self, text, ctx):
+        return self.env.renderer.render_string(text, ctx)
+
+
+# ---------------------------------------------------------------- render
+
+class ChartRenderer:
+    def __init__(self, chart_dir: str,
+                 values_overrides: Optional[List[str]] = None,
+                 release: str = "pstpu", namespace: str = "default"):
+        self.chart_dir = chart_dir
+        with open(os.path.join(chart_dir, "values.yaml")) as f:
+            values = yaml.safe_load(f) or {}
+        for path in values_overrides or []:
+            with open(path) as f:
+                _deep_merge(values, yaml.safe_load(f) or {})
+        with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+            chart_meta = yaml.safe_load(f)
+        self.root = {
+            "Values": values,
+            "Release": {"Name": release, "Namespace": namespace,
+                        "Service": "Helm"},
+            "Chart": {"Name": chart_meta.get("name", ""),
+                      "Version": chart_meta.get("version", "")},
+        }
+        self.defines: Dict[str, list] = {}
+        tdir = os.path.join(chart_dir, "templates")
+        self.template_files = sorted(
+            f for f in os.listdir(tdir)
+            if f.endswith((".yaml", ".tpl")))
+        self._trees: Dict[str, list] = {}
+        for fname in self.template_files:
+            with open(os.path.join(tdir, fname)) as f:
+                src = f.read()
+            tree, _, _ = _parse(_lex_trimmed(src), self.defines)
+            self._trees[fname] = tree
+
+    def render(self, fname: str) -> str:
+        env = Env(self.root, self.root, {"$": self.root}, self.defines,
+                  self)
+        return self.render_nodes(self._trees[fname], env)
+
+    def render_all(self) -> Dict[str, str]:
+        return {f: self.render(f) for f in self.template_files
+                if f.endswith(".yaml")}
+
+    def render_string(self, text: str, ctx) -> str:
+        tree, _, _ = _parse(_lex_trimmed(text), self.defines)
+        env = Env(self.root, ctx, {"$": self.root}, self.defines, self)
+        return self.render_nodes(tree, env)
+
+    def render_nodes(self, nodes: list, env: Env) -> str:
+        out: List[str] = []
+        for node in nodes:
+            if isinstance(node, Text):
+                out.append(node.s)
+            elif isinstance(node, Action):
+                out.append(self._action(node.expr, env))
+            elif isinstance(node, Cond):
+                done = False
+                for expr, body in node.branches:
+                    if _truthy(ExprEval(env).eval(expr)):
+                        out.append(self.render_nodes(body, env))
+                        done = True
+                        break
+                if not done and node.else_body:
+                    out.append(self.render_nodes(node.else_body, env))
+            elif isinstance(node, With):
+                val = ExprEval(env).eval(node.expr)
+                if _truthy(val):
+                    out.append(self.render_nodes(node.body,
+                                                 env.child(dot=val)))
+                elif node.else_body:
+                    out.append(self.render_nodes(node.else_body, env))
+            elif isinstance(node, Range):
+                val = ExprEval(env).eval(node.expr)
+                items: List[Tuple[Any, Any]]
+                if isinstance(val, dict):
+                    items = sorted(val.items())
+                elif val:
+                    items = list(enumerate(val))
+                else:
+                    items = []
+                if not items and node.else_body:
+                    out.append(self.render_nodes(node.else_body, env))
+                for k, v in items:
+                    child = env.child(dot=v)
+                    if node.kvar:
+                        child.vars[node.kvar] = k
+                    if node.vvar:
+                        child.vars[node.vvar] = v
+                    out.append(self.render_nodes(node.body, child))
+            else:
+                raise TemplateError(f"unknown node {node}")
+        return "".join(out)
+
+    def _action(self, expr: str, env: Env) -> str:
+        m = re.match(r"(\$[A-Za-z_][A-Za-z0-9_]*)\s*(:?=)\s*(.*)",
+                     expr, re.DOTALL)
+        if m:
+            env.vars[m.group(1)] = ExprEval(env).eval(m.group(3))
+            return ""
+        if expr.split(None, 1)[0] == "template":
+            rest = expr.split(None, 1)[1]
+            toks = _tokenize_expr(rest)
+            name = toks[0][1:-1]
+            ctx = ExprEval(env)._pipeline(toks[1:]) if len(toks) > 1 \
+                else env.dot
+            return ExprEval(env)._fn_include(name, ctx)
+        return _go_str(ExprEval(env).eval(expr))
+
+
+def _lex_trimmed(src: str) -> List[Tuple[str, str]]:
+    """[(kind, payload)] lexer; Go semantics: `{{-` trims ALL trailing
+    whitespace of the preceding text, `-}}` trims ALL leading whitespace
+    of the following text."""
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    pending_rtrim = False
+    for m in re.finditer(r"\{\{.*?\}\}", src, re.DOTALL):
+        text = src[pos:m.start()]
+        if pending_rtrim:
+            text = text.lstrip()
+        raw = m.group(0)
+        body = raw[2:-2]
+        if body.startswith("-") and body[1:2].strip() == "":
+            text = text.rstrip()
+        out.append(("text", text))
+        pending_rtrim = body.endswith("-") and body[-2:-1].strip() == ""
+        out.append(("action",
+                    body.removeprefix("-").removesuffix("-").strip()))
+        pos = m.end()
+    tail = src[pos:]
+    if pending_rtrim:
+        tail = tail.lstrip()
+    out.append(("text", tail))
+    return out
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_merge(base[k], v)
+        else:
+            base[k] = v
+    return base

@@ -92,3 +92,43 @@ def test_benchmark_end_to_end_against_fake_engine(tmp_path):
         write_csv(results, str(out))
         assert out.read_text().count("\n") == len(results) + 1
     asyncio.run(body())
+
+
+def test_sharegpt_workload(tmp_path):
+    """--sharegpt: questions come from the dump's human turns, cycled
+    per user (reference multi-round-qa.py --sharegpt mode)."""
+    import json
+
+    from benchmarks.multi_round_qa.workload import (UserSession,
+                                                    WorkloadConfig,
+                                                    load_sharegpt)
+
+    path = tmp_path / "sg.json"
+    path.write_text(json.dumps([
+        {"conversations": [
+            {"from": "human", "value": "What is the capital of France?"},
+            {"from": "gpt", "value": "Paris."},
+            {"from": "human", "value": "And of Italy?"}]},
+        {"conversations": [
+            {"from": "user", "value": "Explain entropy."},
+            {"from": "gpt", "value": "..."}]},
+        {"conversations": [{"from": "gpt", "value": "orphan answer"}]},
+    ]))
+    convs = load_sharegpt(str(path))
+    assert convs == [["What is the capital of France?", "And of Italy?"],
+                     ["Explain entropy."]]
+
+    cfg = WorkloadConfig(num_users=2, num_rounds=3, qps=1.0,
+                         sharegpt=convs)
+    u0 = UserSession(0, cfg)
+    assert u0._next_question() == "What is the capital of France?"
+    assert u0._next_question() == "And of Italy?"
+    assert u0._next_question() == "What is the capital of France?"  # wraps
+    u1 = UserSession(1, cfg)
+    assert u1._next_question() == "Explain entropy."
+
+    import pytest
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text("[]")
+        load_sharegpt(str(bad))

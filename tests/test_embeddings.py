@@ -1,0 +1,116 @@
+"""/v1/embeddings, /v1/rerank, /v1/score — engine-side implementation
+served end-to-end through the router (VERDICT round-2 item 7: these
+paths previously 404'd at the engine despite being proxied).
+
+Reference surface: src/vllm_router/routers/main_router.py:42-160 proxies
+all three to the engine; the engines there implement them via vLLM's
+pooling models. Here: mean-pooled final hidden states (bi-encoder).
+"""
+
+import asyncio
+import math
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+
+
+CFG = dict(model="debug-tiny", max_model_len=128, max_num_seqs=4,
+           prefill_chunk=32, prefill_buckets=(32,), decode_window=4)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return LLMEngine(EngineConfig(**CFG))
+
+
+def test_embed_shapes_and_determinism(engine):
+    toks = [[1, 2, 3], [4, 5, 6, 7, 8], [9]]
+    a = engine.embed_tokens(toks)
+    b = engine.embed_tokens(toks)
+    assert a.shape == (3, engine.model_cfg.hidden_size)
+    assert a.dtype == np.float32
+    np.testing.assert_array_equal(a, b)
+    assert np.isfinite(a).all()
+
+
+def test_embed_batch_padding_invariant(engine):
+    """An input's embedding must not depend on its neighbors or padding."""
+    solo = engine.embed_tokens([[5, 6, 7, 8]])[0]
+    grouped = engine.embed_tokens(
+        [[1, 2], [5, 6, 7, 8], list(range(1, 30))])[1]
+    np.testing.assert_allclose(grouped, solo, rtol=1e-5, atol=1e-5)
+
+
+def test_embed_more_inputs_than_batch(engine):
+    many = [[i + 1, i + 2, i + 3] for i in range(11)]  # > max_num_seqs
+    out = engine.embed_tokens(many)
+    assert out.shape[0] == 11
+    solo = engine.embed_tokens([many[9]])[0]
+    np.testing.assert_allclose(out[9], solo, rtol=1e-5, atol=1e-5)
+
+
+def test_embeddings_api_through_router(engine):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.server import (
+        build_app as build_engine_app)
+    from production_stack_tpu.router.app import (
+        build_app as build_router_app, parse_args)
+
+    async_eng = AsyncLLMEngine(EngineConfig(**CFG))
+
+    async def body():
+        engine_server = TestServer(build_engine_app(async_eng))
+        await engine_server.start_server()
+        url = f"http://127.0.0.1:{engine_server.port}"
+        router_app = build_router_app(parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "debug-tiny"]))
+        async with TestClient(TestServer(router_app)) as client:
+            r = await client.post("/v1/embeddings", json={
+                "model": "debug-tiny",
+                "input": ["first text", "second text"]})
+            assert r.status == 200, await r.text()
+            data = await r.json()
+            assert len(data["data"]) == 2
+            assert data["data"][0]["index"] == 0
+            assert len(data["data"][0]["embedding"]) == \
+                async_eng.engine.model_cfg.hidden_size
+            assert data["usage"]["prompt_tokens"] > 0
+
+            # rerank: identical doc must outrank an unrelated one
+            r = await client.post("/v1/rerank", json={
+                "model": "debug-tiny", "query": "alpha beta gamma",
+                "documents": ["zzz qqq xxx", "alpha beta gamma"]})
+            assert r.status == 200, await r.text()
+            results = (await r.json())["results"]
+            assert results[0]["index"] == 1
+            assert results[0]["relevance_score"] >= \
+                results[1]["relevance_score"]
+            assert math.isclose(results[0]["relevance_score"], 1.0,
+                                abs_tol=1e-4)
+
+            # score: self-similarity ~1
+            r = await client.post("/v1/score", json={
+                "model": "debug-tiny", "text_1": "hello world",
+                "text_2": ["hello world", "different thing"]})
+            assert r.status == 200, await r.text()
+            scores = (await r.json())["data"]
+            assert math.isclose(scores[0]["score"], 1.0, abs_tol=1e-4)
+            assert scores[0]["score"] >= scores[1]["score"]
+
+            # validation errors surface as 400 through the proxy
+            r = await client.post("/v1/embeddings", json={
+                "model": "debug-tiny", "input": []})
+            assert r.status == 400
+            r = await client.post("/v1/embeddings", json={
+                "model": "debug-tiny",
+                "input": "x " * (CFG["max_model_len"] * 3)})
+            assert r.status == 400
+        await engine_server.close()
+    asyncio.run(body())

@@ -87,9 +87,11 @@ def test_chat_stream_include_usage(engine):
                   if line.startswith("data: ")]
         assert events[-1] == "[DONE]"
         chunks = [json.loads(e) for e in events[:-1]]
-        # non-final chunks never carry usage; the tail chunk carries only
-        # usage (empty choices), per OpenAI stream_options semantics
-        assert all("usage" not in c for c in chunks[:-1])
+        # OpenAI stream_options semantics: with include_usage, every
+        # non-final chunk carries "usage": null; the tail chunk carries
+        # only usage (empty choices)
+        assert all(c.get("usage") is None and "usage" in c
+                   for c in chunks[:-1])
         tail = chunks[-1]
         assert tail["choices"] == []
         assert tail["usage"]["completion_tokens"] == 5

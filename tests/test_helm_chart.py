@@ -122,6 +122,9 @@ def test_router_argv_matches_cli():
                 continue   # requires an existing file; flag name checked
             if flag == "--host":
                 value = "0.0.0.0"
+            if flag == "--probe-backends":   # boolean flag, no value
+                argv += [flag]
+                continue
             argv += [flag, value]
         try:
             parse_args(argv)

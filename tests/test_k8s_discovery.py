@@ -1,0 +1,183 @@
+"""K8sServiceDiscovery against a mock Kubernetes API server.
+
+Drives the real watch/re-list/readiness logic end-to-end (VERDICT
+round-2 item 8: this path had zero coverage): ADDED/MODIFIED/DELETED
+events, the readiness + /v1/models gate, watch-stream reconnect with
+re-list, and membership convergence. Mirrors the reference's behavioral
+contract (service_discovery.py:157-239 there): an engine becomes
+routable only when its pod is Ready AND answers /v1/models; deletion or
+unreadiness removes it.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestServer
+
+from production_stack_tpu.router.service_discovery import K8sServiceDiscovery
+
+
+def make_pod(name: str, ip: str = "127.0.0.1", ready: bool = True,
+             deleting: bool = False) -> dict:
+    meta = {"name": name}
+    if deleting:
+        meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return {"metadata": meta,
+            "status": {"podIP": ip,
+                       "containerStatuses": [{"ready": ready}]}}
+
+
+class MockK8s:
+    """List + watch of a pod collection, event-driven from the test."""
+
+    def __init__(self):
+        self.pods = {}
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.list_calls = 0
+        self.rv = 0
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/{ns}/pods", self.handle)
+        return app
+
+    async def handle(self, request: web.Request) -> web.StreamResponse:
+        if request.query.get("watch") != "true":
+            self.list_calls += 1
+            self.rv += 1
+            return web.json_response({
+                "items": list(self.pods.values()),
+                "metadata": {"resourceVersion": str(self.rv)}})
+        resp = web.StreamResponse()
+        await resp.prepare(request)
+        while True:
+            ev = await self.queue.get()
+            if ev is None:   # test closes the stream -> client reconnects
+                break
+            await resp.write(json.dumps(ev).encode() + b"\n")
+        await resp.write_eof()
+        return resp
+
+    def push(self, etype: str, pod: dict) -> None:
+        name = pod["metadata"]["name"]
+        if etype == "DELETED":
+            self.pods.pop(name, None)
+        else:
+            self.pods[name] = pod
+        self.queue.put_nowait({"type": etype, "object": pod})
+
+    def drop_stream(self) -> None:
+        self.queue.put_nowait(None)
+
+
+async def wait_for(cond, timeout=8.0, what=""):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_k8s_discovery_lifecycle():
+    async def body():
+        # a fake engine answering /v1/models for every "pod IP"
+        eng_app = web.Application()
+        eng_app.router.add_get(
+            "/v1/models",
+            lambda r: web.json_response(
+                {"data": [{"id": "m-base"}, {"id": "m-lora"}]}))
+        # bind all interfaces: pods probe at 127.0.0.2/127.0.0.3 too
+        eng = TestServer(eng_app, host="0.0.0.0")
+        await eng.start_server()
+
+        mock = MockK8s()
+        mock.pods["pod-a"] = make_pod("pod-a")
+        api = TestServer(mock.app())
+        await api.start_server()
+
+        disc = K8sServiceDiscovery(
+            namespace="test", label_selector="app=engine",
+            engine_port=eng.port,
+            api_server=f"http://127.0.0.1:{api.port}",
+            token_path="/nonexistent", ca_path="/nonexistent")
+        await disc.start()
+        try:
+            # initial list: pod-a becomes routable with probed model+alias
+            await wait_for(lambda: len(disc.get_endpoints()) == 1,
+                           what="initial pod-a")
+            ep = disc.get_endpoints()[0]
+            assert ep.model == "m-base"
+            assert ep.model_aliases == ["m-lora"]
+            assert ep.serves("m-lora")
+            assert disc.healthy()
+
+            # ADDED: a second ready pod joins
+            mock.push("ADDED", make_pod("pod-b", ip="127.0.0.2"))
+            await wait_for(lambda: len(disc.get_endpoints()) == 2,
+                           what="pod-b added")
+
+            # MODIFIED to unready: readiness gate removes it
+            mock.push("MODIFIED", make_pod("pod-b", ip="127.0.0.2",
+                                           ready=False))
+            await wait_for(lambda: len(disc.get_endpoints()) == 1,
+                           what="pod-b unready removal")
+
+            # MODIFIED back to ready: re-admitted
+            mock.push("MODIFIED", make_pod("pod-b", ip="127.0.0.2"))
+            await wait_for(lambda: len(disc.get_endpoints()) == 2,
+                           what="pod-b readmission")
+
+            # a terminating pod (deletionTimestamp) is removed even while
+            # containers still report ready
+            mock.push("MODIFIED", make_pod("pod-b", ip="127.0.0.2",
+                                           deleting=True))
+            await wait_for(lambda: len(disc.get_endpoints()) == 1,
+                           what="pod-b termination removal")
+
+            # DELETED: pod-a leaves; membership empties
+            mock.push("DELETED", make_pod("pod-a"))
+            await wait_for(lambda: len(disc.get_endpoints()) == 0,
+                           what="pod-a deletion")
+
+            # watch stream drop: client re-lists and converges on the
+            # server's current truth (pod-c, which it has never seen)
+            mock.pods["pod-c"] = make_pod("pod-c", ip="127.0.0.3")
+            lists_before = mock.list_calls
+            mock.drop_stream()
+            await wait_for(lambda: mock.list_calls > lists_before,
+                           what="re-list after stream drop")
+            await wait_for(
+                lambda: [e.pod_name for e in disc.get_endpoints()]
+                == ["pod-c"], what="convergence on pod-c")
+        finally:
+            await disc.close()
+            await api.close()
+            await eng.close()
+    asyncio.run(body())
+
+
+def test_k8s_discovery_skips_unprobeable_pod():
+    """A Ready pod that does not answer /v1/models is not routable."""
+    async def body():
+        mock = MockK8s()
+        # point the engine port at a closed port
+        mock.pods["pod-x"] = make_pod("pod-x")
+        api = TestServer(mock.app())
+        await api.start_server()
+        disc = K8sServiceDiscovery(
+            namespace="test", label_selector="app=engine",
+            engine_port=1,    # nothing listens there
+            api_server=f"http://127.0.0.1:{api.port}",
+            token_path="/nonexistent", ca_path="/nonexistent")
+        await disc.start()
+        try:
+            await asyncio.sleep(1.0)
+            assert disc.get_endpoints() == []
+            assert disc.healthy()   # the watch itself is alive
+        finally:
+            await disc.close()
+            await api.close()
+    asyncio.run(body())

@@ -104,3 +104,45 @@ def test_make_router_unknown():
     import pytest
     with pytest.raises(ValueError, match="unknown routing"):
         make_router("nope")
+
+
+def test_stat_logger_logs_per_engine(caplog):
+    """Periodic stat logging (reference src/vllm_router/stats/log_stats.py
+    — broken there, working here): one line per engine, gauge refresh."""
+    import logging
+
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+    from production_stack_tpu.router.stats import (EngineStats,
+                                                   EngineStatsScraper,
+                                                   RequestStatsMonitor,
+                                                   StatLogger)
+
+    monitor = RequestStatsMonitor()
+    monitor.on_new_request("http://e1:8000", "r1")
+    monitor.on_first_token("http://e1:8000", "r1")
+    monitor.on_request_complete("http://e1:8000", "r1")
+    scraper = EngineStatsScraper(lambda: [])
+    scraper._stats["http://e1:8000"] = EngineStats(num_running=2,
+                                                   num_waiting=1,
+                                                   kv_usage=0.5)
+    slog = StatLogger(lambda: [EndpointInfo(url="http://e1:8000",
+                                            model="m")],
+                      monitor, scraper, interval_s=99)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    log = logging.getLogger("production_stack_tpu.router.stats")
+    handler = Capture(level=logging.INFO)
+    log.addHandler(handler)
+    try:
+        slog.log_once()
+    finally:
+        log.removeHandler(handler)
+    lines = [r.getMessage() for r in records
+             if "stats:" in r.getMessage()]
+    assert len(lines) == 1
+    assert "http://e1:8000" in lines[0]
+    assert "running=2" in lines[0] and "finished=1" in lines[0]

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Validate `helm template` output: every document must be a well-formed
+Kubernetes object (used by .github/workflows/functionality-helm-chart.yml
+after the real helm render; the in-repo render tests use
+tests/helm_render.py)."""
+
+import sys
+
+import yaml
+
+REQUIRED_TOP = ("apiVersion", "kind", "metadata")
+
+
+def validate(path: str) -> int:
+    errors = 0
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    if not docs:
+        print(f"{path}: no documents rendered")
+        return 1
+    for i, doc in enumerate(docs):
+        where = f"{path}[{i}]"
+        for key in REQUIRED_TOP:
+            if key not in doc:
+                print(f"{where}: missing {key}")
+                errors += 1
+        name = (doc.get("metadata") or {}).get("name")
+        if not name:
+            print(f"{where}: missing metadata.name")
+            errors += 1
+        kind = doc.get("kind", "")
+        if kind in ("Deployment", "StatefulSet", "DaemonSet"):
+            tmpl = ((doc.get("spec") or {}).get("template") or {})
+            containers = (tmpl.get("spec") or {}).get("containers") or []
+            if not containers:
+                print(f"{where}: {kind} {name} has no containers")
+                errors += 1
+            for c in containers:
+                if not c.get("image"):
+                    print(f"{where}: container {c.get('name')} "
+                          f"missing image")
+                    errors += 1
+    print(f"{path}: {len(docs)} documents, {errors} errors")
+    return errors
+
+
+if __name__ == "__main__":
+    total = sum(validate(p) for p in sys.argv[1:])
+    sys.exit(1 if total else 0)
