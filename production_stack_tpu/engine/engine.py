@@ -141,6 +141,11 @@ class LLMEngine:
         # host re-uploads its mirrors only when this is set (admission,
         # finish, abort — any slot-composition change)
         self._decode_dirty = True
+        # one decode window kept in flight between step() calls: the next
+        # window is dispatched right after the previous one is processed,
+        # so the device (and the host<->TPU tunnel) works while outputs
+        # stream to clients. (ids_device, window, [seqs at dispatch], t0)
+        self._inflight = None
 
     # ------------------------------------------------------------------
 
@@ -208,14 +213,25 @@ class LLMEngine:
             works, decode_seqs = self.scheduler.schedule()
             outputs: List[StepOutput] = []
             if works:
+                # drain the in-flight window first: it was dispatched
+                # from pre-prefill state and stays valid; the prefill's
+                # writes are ordered after it on device
+                outputs.extend(self._drain_decode())
                 outputs.extend(self._do_prefill(works))
                 # re-snapshot: sequences whose prefill just completed are
                 # RUNNING now and must join this step's decode window —
                 # the device generates tokens for every live row, and a
                 # row the host skipped would desync the device carry
                 decode_seqs = list(self.scheduler.running.values())
-            if decode_seqs:
-                outputs.extend(self._do_decode(decode_seqs))
+            if decode_seqs or self._inflight is not None:
+                if self._inflight is None:
+                    self._dispatch_decode(decode_seqs)
+                outputs.extend(self._drain_decode())
+                # pipeline: put the next window in flight before handing
+                # outputs back, so the device works during host I/O
+                decode_seqs = list(self.scheduler.running.values())
+                if decode_seqs:
+                    self._dispatch_decode(decode_seqs)
             self._refresh_gauges()
             return outputs
 
@@ -278,7 +294,8 @@ class LLMEngine:
                 adapter=jnp.asarray(self._slot_adapter))
             self._sampling_dirty = False
 
-    def _do_decode(self, decode_seqs) -> List[StepOutput]:
+    def _dispatch_decode(self, decode_seqs) -> None:
+        """Launch one decode window (async dispatch; no host sync)."""
         W = self.cfg.decode_window
         max_pos = max(s.next_position for s in decode_seqs)
         kv_len = self.cfg.kv_bucket_for(
@@ -288,13 +305,22 @@ class LLMEngine:
         if self._decode_dirty:
             self.runner.set_decode_state(self._slot_token, self._slot_pos)
             self._decode_dirty = False
-        t0 = time.monotonic()
-        ids = np.asarray(self.runner.decode(
-            self._dev_sampling, steps=W, kv_len=kv_len,
-            greedy=greedy))  # [B, W]
+        ids_dev = self.runner.decode(self._dev_sampling, steps=W,
+                                     kv_len=kv_len, greedy=greedy)
+        self._inflight = (ids_dev, W, list(decode_seqs), time.monotonic())
+
+    def _drain_decode(self) -> List[StepOutput]:
+        """Sync + process the in-flight window, if any. A sequence that
+        finished or aborted after dispatch simply has its rows discarded
+        (its slot is parked and the decode carry marked dirty)."""
+        if self._inflight is None:
+            return []
+        ids_dev, W, seqs, t0 = self._inflight
+        self._inflight = None
+        ids = np.asarray(ids_dev)  # [B, W] — the window's single sync
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
-        alive = list(decode_seqs)
+        alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
         for j in range(W):
             still = []
             for seq in alive:

@@ -266,8 +266,13 @@ class StatLogger:
         self._task = asyncio.create_task(self._loop(), name="stat-logger")
 
     async def close(self) -> None:
+        import asyncio
         if self._task:
             self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
             self._task = None
 
     async def _loop(self) -> None:

@@ -285,3 +285,40 @@ def test_sampled_window_stays_in_distribution():
     toks = eng.seqs[sid].output_tokens
     assert len(toks) == 10
     assert all(0 <= t < eng.model_cfg.vocab_size for t in toks)
+
+
+def test_abort_running_seq_with_inflight_window():
+    """Aborting a RUNNING sequence between steps must drop its in-flight
+    window rows (no tokens after abort) while other sequences continue."""
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4)
+    eng = LLMEngine(cfg)
+    a = eng.add_request(list(range(3, 13)),
+                        SamplingOptions(temperature=0.0, max_tokens=100,
+                                        ignore_eos=True))
+    b = eng.add_request(list(range(23, 33)),
+                        SamplingOptions(temperature=0.0, max_tokens=40,
+                                        ignore_eos=True))
+    while len(eng.seqs[a].output_tokens) < 8:
+        eng.step()   # leaves a window in flight
+    assert eng._inflight is not None
+    eng.abort(a)
+    tokens_at_abort = len(eng.seqs[a].output_tokens)
+    done = set()
+    steps = 0
+    while b not in done:
+        done.update(o.seq_id for o in eng.step() if o.finished)
+        steps += 1
+        assert steps < 500
+    assert len(eng.seqs[a].output_tokens) == tokens_at_abort
+    assert len(eng.seqs[b].output_tokens) == 40
+    # b's stream matches a solo run (the abort never corrupted it)
+    solo = LLMEngine(cfg)
+    s = solo.add_request(list(range(23, 33)),
+                         SamplingOptions(temperature=0.0, max_tokens=40,
+                                         ignore_eos=True))
+    pending = {s}
+    while pending:
+        pending -= {o.seq_id for o in solo.step() if o.finished}
+    assert eng.seqs[b].output_tokens == solo.seqs[s].output_tokens
