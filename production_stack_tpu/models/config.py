@@ -29,6 +29,11 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
+    # family variations beyond the Llama/Mistral baseline:
+    attention_bias: bool = False    # Qwen2: biases on q/k/v projections
+    activation: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
+    rms_norm_offset: bool = False   # Gemma: y *= (1 + w), not w
+    embed_scale: bool = False       # Gemma: embeddings *= sqrt(hidden)
     dtype: Any = jnp.bfloat16
 
     @property
@@ -52,7 +57,30 @@ class ModelConfig:
     @staticmethod
     def from_hf_config(cfg: Dict[str, Any], name: str = "",
                        dtype: Any = jnp.bfloat16) -> "ModelConfig":
-        """Map a HuggingFace LlamaConfig/MistralConfig dict onto ModelConfig."""
+        """Map a HuggingFace config dict onto ModelConfig.
+
+        Families: Llama-2/3, TinyLlama, Mistral (the baseline), Qwen2
+        (adds q/k/v biases), Gemma (GeGLU via gelu, scaled embeddings,
+        unit-offset RMSNorm, tied embeddings).
+        """
+        archs = cfg.get("architectures") or []
+        arch = archs[0] if archs else ""
+        model_type = cfg.get("model_type", "")
+        # EXACT family matching: substring checks would silently accept
+        # e.g. Gemma2ForCausalLM (softcapping, extra norms) or
+        # Qwen2MoeForCausalLM as their simpler cousins and serve garbage
+        is_qwen2 = model_type == "qwen2" or arch == "Qwen2ForCausalLM"
+        is_gemma = model_type == "gemma" or arch == "GemmaForCausalLM"
+        is_llama_like = (model_type in ("llama", "mistral") or arch in
+                         ("LlamaForCausalLM", "MistralForCausalLM"))
+        if not (is_qwen2 or is_gemma or is_llama_like) and (model_type
+                                                            or arch):
+            raise ValueError(
+                f"unsupported model family (model_type={model_type!r}, "
+                f"architecture={arch!r}); supported: llama, mistral, "
+                f"qwen2, gemma")
+        hidden_act = cfg.get("hidden_act") or cfg.get(
+            "hidden_activation") or ("gelu_tanh" if is_gemma else "silu")
         return ModelConfig(
             name=name or cfg.get("_name_or_path", "hf-model"),
             vocab_size=cfg["vocab_size"],
@@ -65,7 +93,11 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
+            attention_bias=cfg.get("attention_bias", is_qwen2),
+            activation="gelu_tanh" if "gelu" in hidden_act else "silu",
+            rms_norm_offset=is_gemma,
+            embed_scale=is_gemma,
             dtype=dtype,
         )
 
@@ -106,7 +138,31 @@ PRESETS: Dict[str, ModelConfig] = {
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
         max_position_embeddings=32768,
     ),
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", vocab_size=152064, hidden_size=3584,
+        intermediate_size=18944, num_layers=28, num_heads=28,
+        num_kv_heads=4, rope_theta=1000000.0,
+        max_position_embeddings=32768, attention_bias=True,
+    ),
+    "gemma-2b": ModelConfig(
+        name="gemma-2b", vocab_size=256000, hidden_size=2048,
+        intermediate_size=16384, num_layers=18, num_heads=8,
+        num_kv_heads=1, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, activation="gelu_tanh",
+        rms_norm_offset=True, embed_scale=True,
+    ),
+    "gemma-7b": ModelConfig(
+        name="gemma-7b", vocab_size=256000, hidden_size=3072,
+        intermediate_size=24576, num_layers=28, num_heads=16,
+        num_kv_heads=16, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, activation="gelu_tanh",
+        rms_norm_offset=True, embed_scale=True,
+    ),
 }
+
+# Qwen2.5-7B shares Qwen2-7B's architecture shapes exactly
+PRESETS["qwen2.5-7b"] = dataclasses.replace(PRESETS["qwen2-7b"],
+                                            name="qwen2.5-7b")
 
 
 # HF hub ids commonly passed as --model (e.g. from helm modelSpec
@@ -124,6 +180,14 @@ HF_ALIASES: Dict[str, str] = {
     "mistralai/Mistral-7B-Instruct-v0.2": "mistral-7b",
     "mistralai/Mistral-7B-Instruct-v0.3": "mistral-7b",
     "TinyLlama/TinyLlama-1.1B-Chat-v1.0": "tinyllama-1.1b",
+    "Qwen/Qwen2-7B": "qwen2-7b",
+    "Qwen/Qwen2-7B-Instruct": "qwen2-7b",
+    "Qwen/Qwen2.5-7B": "qwen2.5-7b",
+    "Qwen/Qwen2.5-7B-Instruct": "qwen2.5-7b",
+    "google/gemma-2b": "gemma-2b",
+    "google/gemma-2b-it": "gemma-2b",
+    "google/gemma-7b": "gemma-7b",
+    "google/gemma-7b-it": "gemma-7b",
 }
 
 

@@ -54,8 +54,16 @@ def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
             x = x.T
         return jnp.asarray(x, dtype=cfg.dtype)
 
+    layer_map = dict(_LAYER_MAP)
+    if cfg.attention_bias:
+        # Qwen2: q/k/v projection biases ([out] vectors; no transpose)
+        layer_map.update({
+            "q_bias": ("self_attn.q_proj.bias", False),
+            "k_bias": ("self_attn.k_proj.bias", False),
+            "v_bias": ("self_attn.v_proj.bias", False),
+        })
     layers: Dict[str, Any] = {}
-    for ours, (suffix, transpose) in _LAYER_MAP.items():
+    for ours, (suffix, transpose) in layer_map.items():
         stacked = np.stack(
             [get(f"layers.{i}.{suffix}") for i in range(cfg.num_layers)])
         if transpose:

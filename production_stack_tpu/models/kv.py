@@ -59,6 +59,9 @@ def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
     K/V by the decode step that reaches position S-1 before any query can
     attend to it.
     """
+    # the cache may be narrower than the compute dtype (fp32 model with a
+    # bf16 KV cache); DUS/scatter require matching dtypes
+    new = new.astype(cache_layer.dtype)
     if new.shape[1] == 1:
         def _one(c, x, s):
             return jax.lax.dynamic_update_slice(c, x, (s, 0, 0))

@@ -42,21 +42,27 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     def w(k, shape):
         return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(cfg.dtype)
 
+    norm_init = jnp.zeros if cfg.rms_norm_offset else jnp.ones
     params: Params = {
         "embed": w(next(keys), (v, h)),
         "layers": {
-            "attn_norm": jnp.ones((L, h), cfg.dtype),
+            "attn_norm": norm_init((L, h), cfg.dtype),
             "q": w(next(keys), (L, h, nh * hd)),
             "k": w(next(keys), (L, h, nkv * hd)),
             "v": w(next(keys), (L, h, nkv * hd)),
             "o": w(next(keys), (L, nh * hd, h)),
-            "mlp_norm": jnp.ones((L, h), cfg.dtype),
+            "mlp_norm": norm_init((L, h), cfg.dtype),
             "gate": w(next(keys), (L, h, i)),
             "up": w(next(keys), (L, h, i)),
             "down": w(next(keys), (L, i, h)),
         },
-        "final_norm": jnp.ones((h,), cfg.dtype),
+        "final_norm": norm_init((h,), cfg.dtype),
     }
+    if cfg.attention_bias:
+        # Qwen2: biases on the q/k/v projections only
+        params["layers"]["q_bias"] = jnp.zeros((L, nh * hd), cfg.dtype)
+        params["layers"]["k_bias"] = jnp.zeros((L, nkv * hd), cfg.dtype)
+        params["layers"]["v_bias"] = jnp.zeros((L, nkv * hd), cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = w(next(keys), (h, v))
     return params
@@ -87,12 +93,16 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
 
     def proj(h, name):
         out = h @ lp[name]
+        bias = lp.get(f"{name}_bias")
+        if bias is not None:
+            out = out + bias
         if lora_layer is not None and name in lora_layer:
             out = lora.apply(h, out, lora_layer[name], adapter_ids,
                              lora_scaling)
         return out
 
-    hidden = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    offset = 1.0 if cfg.rms_norm_offset else 0.0
+    hidden = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, offset=offset)
     q = proj(hidden, "q").reshape(B, T, nh, hd)
     k = proj(hidden, "k").reshape(B, T, nkv, hd)
     v = proj(hidden, "v").reshape(B, T, nkv, hd)
@@ -124,10 +134,16 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
         new_kv = (k_cache, v_cache)
     x = x + proj(attn.reshape(B, T, nh * hd), "o")
 
-    hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gated = jax.nn.silu(proj(hidden, "gate")) * proj(hidden, "up")
+    hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset=offset)
+    act = jax.nn.silu if cfg.activation == "silu" else _gelu_tanh
+    gated = act(proj(hidden, "gate")) * proj(hidden, "up")
     x = x + proj(gated, "down")
     return x, new_kv
+
+
+def _gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
+    """Gemma's gelu_pytorch_tanh (jax.nn.gelu's approximate form)."""
+    return jax.nn.gelu(x, approximate=True)
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -154,7 +170,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     if use_flash is None:
         use_flash = pallas_attention.flash_enabled()
     starts = positions[:, 0]
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed(params, cfg, tokens)
 
     if lora_params is not None:
         def scan_body(carry, xs):
@@ -179,7 +195,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
         x, (new_k, new_v) = jax.lax.scan(
             scan_body, x, (params["layers"], cache.k, cache.v))
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 offset=1.0 if cfg.rms_norm_offset else 0.0)
     logits = _lm_head(params, cfg, x)
     return logits, KVCache(k=new_k, v=new_v)
 
@@ -196,7 +213,7 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                           cfg.rope_theta)
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-    x = params["embed"][tokens].astype(cfg.dtype)
+    x = _embed(params, cfg, tokens)
 
     def scan_body(carry, lp):
         out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None,
@@ -204,7 +221,8 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         return out, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                    offset=1.0 if cfg.rms_norm_offset else 0.0)
 
 
 def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
@@ -218,6 +236,15 @@ def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     return _lm_head(params, cfg,
                     encode(params, cfg, tokens, rope=rope,
                            attention_fn=attention_fn))
+
+
+def _embed(params: Params, cfg: ModelConfig,
+           tokens: jnp.ndarray) -> jnp.ndarray:
+    x = params["embed"][tokens]
+    if cfg.embed_scale:
+        # Gemma scales embeddings by sqrt(hidden)
+        x = x.astype(jnp.float32) * jnp.sqrt(float(cfg.hidden_size))
+    return x.astype(cfg.dtype)
 
 
 def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:

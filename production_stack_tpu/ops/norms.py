@@ -11,10 +11,16 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
-    """y = x / rms(x) * weight, computed in fp32, returned in x.dtype."""
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             offset: float = 0.0) -> jnp.ndarray:
+    """y = x / rms(x) * (weight + offset), fp32 compute, x.dtype out.
+
+    offset=1.0 gives Gemma's convention (checkpoints store w with an
+    implicit unit gain); 0.0 is the Llama/Mistral/Qwen baseline.
+    """
     orig_dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(orig_dtype)
+    w = weight.astype(jnp.float32) + offset
+    return (normed * w).astype(orig_dtype)

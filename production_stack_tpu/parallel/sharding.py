@@ -26,6 +26,10 @@ _LAYER_SPECS: Dict[str, P] = {
     # [L, in, out] row-parallel: shard in over tp
     "o": P(None, "tp", None),
     "down": P(None, "tp", None),
+    # column-parallel biases [L, out] follow their projection's out shard
+    "q_bias": P(None, "tp"),
+    "k_bias": P(None, "tp"),
+    "v_bias": P(None, "tp"),
     # norms replicated
     "attn_norm": P(None, None),
     "mlp_norm": P(None, None),

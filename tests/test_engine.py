@@ -322,3 +322,16 @@ def test_abort_running_seq_with_inflight_window():
     while pending:
         pending -= {o.seq_id for o in solo.step() if o.finished}
     assert eng.seqs[b].output_tokens == solo.seqs[s].output_tokens
+
+
+def test_fp32_model_with_bf16_kv_cache():
+    """--dtype float32 with the default bfloat16 KV cache must serve
+    (the K/V write casts to the cache dtype; attention promotes)."""
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4,
+                       dtype="float32", kv_dtype="bfloat16")
+    eng = LLMEngine(cfg)
+    out = eng.generate("mixed dtype probe",
+                       SamplingOptions(temperature=0.0, max_tokens=6))
+    assert isinstance(out, str)
