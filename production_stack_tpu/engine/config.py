@@ -42,7 +42,12 @@ class EngineConfig:
     expert_parallel_size: int = 1
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
+    # in-HBM prefix cache (kvcache/hbm_pool.py): finished sequences'
+    # prompt+output KV chunks stay on device and re-inject without a
+    # host round trip (the reference's --enable-prefix-caching)
     enable_prefix_caching: bool = False
+    prefix_pool_chunks: int = 64          # pool rows (HBM budget)
+    prefix_pool_chunk_size: int = 256     # tokens per pool row
     max_top_k: int = 64                      # static top-k bound for sampler
     # KV tiering (the reference's --kv-transfer-config JSON; see
     # kvcache/connector.py). Keys: kv_role, chunk_size, local_cpu_gb,

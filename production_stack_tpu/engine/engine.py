@@ -107,6 +107,14 @@ class LLMEngine:
         # the same capability through LMCache env + --kv-transfer-config
         # (reference: helm/templates/deployment-vllm-multi.yaml:94-99,154-178)
         self.connector = None
+        self.hbm_pool = None
+        if engine_cfg.enable_prefix_caching:
+            from production_stack_tpu.kvcache.hbm_pool import HBMPrefixPool
+            self.hbm_pool = HBMPrefixPool(
+                self.runner, self.model_cfg, engine_cfg,
+                num_chunks=engine_cfg.prefix_pool_chunks,
+                chunk_size=engine_cfg.prefix_pool_chunk_size)
+            self.scheduler.on_admit = self._on_admit
         if engine_cfg.kv_transfer_config:
             from production_stack_tpu.kvcache.connector import (
                 KVConnector, KVTransferConfig)
@@ -178,6 +186,11 @@ class LLMEngine:
                        options=options or SamplingOptions(),
                        adapter_id=self.resolve_model(model),
                        detok=DetokenizeStream(self.tokenizer))
+        if self.hbm_pool is not None:
+            # chunk-key hashing only (cheap, caller thread); the device
+            # copies happen at admission on the engine loop
+            seq.hbm_match = self.hbm_pool.match(
+                seq.prompt_tokens, salt=self._adapter_salt(seq.adapter_id))
         if self.connector is not None:
             # tier lookup + D2H-side fetch runs here, on the caller's
             # thread — never on the engine loop
@@ -345,6 +358,11 @@ class LLMEngine:
         text_delta = seq.output_text[seq.chars_emitted:]
         seq.chars_emitted = len(seq.output_text)
         if reason is not None:
+            if self.hbm_pool is not None:
+                # device-to-device capture while the slot still holds
+                # this sequence's KV
+                self.hbm_pool.store(
+                    seq, salt=self._adapter_salt(seq.adapter_id))
             if self.connector is not None:
                 # extract while the slot still holds this sequence's KV —
                 # dispatched before scheduler.finish can recycle the slot
@@ -447,13 +465,29 @@ class LLMEngine:
         return self.metrics.render()
 
     def _on_admit(self, seq: Sequence) -> None:
-        """Scheduler hook: inject a prefetched KV prefix into the slot."""
+        """Scheduler hook: inject a cached KV prefix into the slot —
+        from whichever source covers more: the in-HBM pool
+        (device-to-device, no host traffic) or the host/disk/remote
+        tiers' prefetch."""
         pf = seq.kv_prefetch
-        if pf is None:
-            return
-        seq.kv_prefetch = None   # release host buffers after injection
-        self.connector.inject(pf, seq.slot)
-        seq.num_prefilled = pf.cached_tokens
+        seq.kv_prefetch = None   # release host buffers either way
+        keys, pool_covered = getattr(seq, "hbm_match", None) or ([], 0)
+        seq.hbm_match = None
+        conn_covered = pf.cached_tokens if pf is not None else 0
+        if pool_covered > 0 and pool_covered >= conn_covered:
+            # keys are re-resolved at injection: eviction between add
+            # and admission shrinks the injected prefix, never corrupts
+            injected = self.hbm_pool.inject(keys, seq.slot, pool_covered)
+            if injected >= conn_covered or pf is None:
+                seq.num_prefilled = injected
+                if pf is not None:
+                    # the tier already holds these chunks: skip the
+                    # device->host re-extract at finish
+                    self.connector.mark_seen(pf.keys)
+                return
+        if pf is not None:
+            self.connector.inject(pf, seq.slot)
+            seq.num_prefilled = conn_covered
 
     def _refresh_gauges(self) -> None:
         self.metrics.num_running.set(self.scheduler.num_running)
@@ -461,8 +495,15 @@ class LLMEngine:
         usage = self.scheduler.kv_usage
         self.metrics.kv_usage.set(usage)
         self.metrics.hbm_kv_usage.set(usage)
+        # two distinct gauges: the pool's (per-request, in-HBM) and the
+        # tiers' (token-weighted) hit rates have different semantics —
+        # shadowing one with the other would silently skew dashboards
+        if self.hbm_pool is not None:
+            self.metrics.hbm_prefix_hit_rate.set(self.hbm_pool.hit_rate)
         if self.connector is not None:
             self.metrics.prefix_hit_rate.set(self.connector.hit_rate)
+        elif self.hbm_pool is not None:
+            self.metrics.prefix_hit_rate.set(self.hbm_pool.hit_rate)
 
     def close(self) -> None:
         """Flush the KV writer and release tier connections."""

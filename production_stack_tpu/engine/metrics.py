@@ -43,6 +43,9 @@ class EngineMetrics:
                                   "KV cache HBM utilization (0-1)")
         self.prefix_hit_rate = gauge("vllm:gpu_prefix_cache_hit_rate",
                                      "Prefix cache hit rate (0-1)")
+        self.hbm_prefix_hit_rate = gauge(
+            "tpu:hbm_prefix_cache_hit_rate",
+            "In-HBM prefix pool hit rate (0-1, per request)")
         self.prompt_tokens = counter("vllm:prompt_tokens_total",
                                      "Prefilled prompt tokens")
         self.generation_tokens = counter("vllm:generation_tokens_total",

@@ -60,6 +60,9 @@ class Sequence:
     # incremental chunk-key chain state for progressive KV publish
     # (kvcache/connector.py _publish)
     kv_publish_state: object = None
+    # in-HBM prefix-pool match ([pool rows], covered_tokens) computed at
+    # add time (kvcache/hbm_pool.py); consumed at admission
+    hbm_match: object = None
     # incremental detokenization state (owned by LLMEngine)
     output_text: str = ""       # stable decoded text, stop-truncated
     chars_emitted: int = 0      # prefix of output_text already delivered

@@ -502,6 +502,12 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--chat-template", default=None,
                    help="Jinja file overriding the tokenizer chat template")
+    p.add_argument("--enable-prefix-caching", action="store_true",
+                   help="keep finished sequences' KV chunks in HBM and "
+                        "re-inject shared prefixes device-to-device "
+                        "(the reference's --enable-prefix-caching)")
+    p.add_argument("--prefix-pool-chunks", type=int, default=64)
+    p.add_argument("--prefix-pool-chunk-size", type=int, default=256)
     p.add_argument("--lora-adapters", default=None,
                    help="comma-separated name=source pairs; source is an "
                         ".npz adapter checkpoint (models/lora.py) or "
@@ -537,6 +543,9 @@ def main(argv=None) -> None:
         decode_window=args.decode_window,
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),
+        enable_prefix_caching=args.enable_prefix_caching,
+        prefix_pool_chunks=args.prefix_pool_chunks,
+        prefix_pool_chunk_size=args.prefix_pool_chunk_size,
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
         expert_parallel_size=args.expert_parallel_size, seed=args.seed,

@@ -165,7 +165,13 @@ class KVConnector:
         is async, ordered before the next cache-donating step)."""
         for i, (k, v) in enumerate(prefetch.chunks):
             self.runner.inject_chunk(slot, i * self.chunk_size, k, v)
-        for key in prefetch.keys:   # already stored; don't re-save
+        self.mark_seen(prefetch.keys)
+
+    def mark_seen(self, keys) -> None:
+        """Record keys the tier already holds (skip re-publish at
+        finish) — also used when the HBM prefix pool wins admission and
+        the prefetched chunks are dropped without injection."""
+        for key in keys:
             self._mark_seen(key)
 
     # -- producer path --------------------------------------------------

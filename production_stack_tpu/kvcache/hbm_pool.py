@@ -1,0 +1,175 @@
+"""In-HBM prefix cache: finished sequences' KV chunks stay on device.
+
+Implements the engine's ``enable_prefix_caching`` knob (the reference
+passes the same-named flag down to vLLM,
+helm/templates/deployment-vllm-multi.yaml:73-75, whose engine keeps
+shared prefixes in GPU memory). TPU-first shape: one statically-shaped
+pool buffer ``[P, L, C, Hkv, D]`` lives in HBM next to the slot cache; a
+host-side LRU maps chunk keys (the same prefix chain hashes the tiers
+use, kvcache/chunks.py — salted per LoRA adapter) to pool rows. Store
+and inject are tiny jitted device-to-device copies — a prefix hit never
+crosses the host boundary, unlike the host/disk/remote tiers
+(kvcache/connector.py) which remain the capacity layers behind it.
+
+Interplay with KV tiering: at admission the engine injects from
+whichever source covers the longer prefix (engine.py _on_admit); the
+pool is the fast small tier, the connector the big slow one.
+"""
+
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.kvcache.chunks import ChunkHasher, model_fingerprint
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class HBMPrefixPool:
+    def __init__(self, runner, model_cfg, engine_cfg,
+                 num_chunks: int = 64, chunk_size: int = 256):
+        self.runner = runner
+        self.num_chunks = num_chunks
+        self.chunk_size = chunk_size
+        self.hasher = ChunkHasher(
+            chunk_size,
+            namespace="hbm|" + model_fingerprint(model_cfg,
+                                                 engine_cfg.kv_dtype))
+        L = model_cfg.num_layers
+        Hkv, D = model_cfg.num_kv_heads, model_cfg.head_dim_
+        dtype = runner.cache.k.dtype
+        shape = (num_chunks, L, chunk_size, Hkv, D)
+        self.pool_k = jnp.zeros(shape, dtype)
+        self.pool_v = jnp.zeros(shape, dtype)
+        # key -> pool row; move_to_end on hit = LRU. match() runs on the
+        # server thread while store()/eviction run on the engine loop,
+        # so every index operation takes the lock
+        self._index: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(num_chunks - 1, -1, -1))
+        self._store_fn = jax.jit(self._store_impl, donate_argnums=(0, 1))
+        self._inject_fn = jax.jit(self._inject_impl, donate_argnums=(0,))
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+
+    # -- jitted device-to-device copies ---------------------------------
+
+    def _store_impl(self, pool_k, pool_v, cache, row, slot, start):
+        L, C = pool_k.shape[1], pool_k.shape[2]
+        Hkv, D = pool_k.shape[3], pool_k.shape[4]
+        ck = jax.lax.dynamic_slice(cache.k, (0, slot, start, 0, 0),
+                                   (L, 1, C, Hkv, D))
+        cv = jax.lax.dynamic_slice(cache.v, (0, slot, start, 0, 0),
+                                   (L, 1, C, Hkv, D))
+        pool_k = jax.lax.dynamic_update_slice(
+            pool_k, jnp.swapaxes(ck, 0, 1), (row, 0, 0, 0, 0))
+        pool_v = jax.lax.dynamic_update_slice(
+            pool_v, jnp.swapaxes(cv, 0, 1), (row, 0, 0, 0, 0))
+        return pool_k, pool_v
+
+    def _inject_impl(self, cache, pool_k, pool_v, row, slot, start):
+        L, C = pool_k.shape[1], pool_k.shape[2]
+        Hkv, D = pool_k.shape[3], pool_k.shape[4]
+        ck = jax.lax.dynamic_slice(pool_k, (row, 0, 0, 0, 0),
+                                   (1, L, C, Hkv, D))
+        cv = jax.lax.dynamic_slice(pool_v, (row, 0, 0, 0, 0),
+                                   (1, L, C, Hkv, D))
+        from production_stack_tpu.models.kv import KVCache
+        new_k = jax.lax.dynamic_update_slice(
+            cache.k, jnp.swapaxes(ck, 0, 1), (0, slot, start, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(
+            cache.v, jnp.swapaxes(cv, 0, 1), (0, slot, start, 0, 0))
+        return KVCache(new_k, new_v)
+
+    # -- host API --------------------------------------------------------
+
+    def match(self, prompt_tokens: Sequence[int],
+              salt: str = "") -> Tuple[List[bytes], int]:
+        """Longest cached chunk-prefix: ([chunk KEYS], covered_tokens).
+
+        Returns keys, not rows: admission can lag arbitrarily behind
+        add-time (queueing), during which eviction may reassign rows —
+        inject() re-resolves keys under the index lock at injection time
+        and uses only the still-valid prefix. Coverage here is the
+        add-time estimate, capped at len(prompt)-1 so prefill always
+        computes at least one position (same convention as
+        connector.prefetch).
+        """
+        keys = self.hasher.chunk_keys(prompt_tokens, salt=salt)
+        matched: List[bytes] = []
+        with self._lock:
+            for key in keys:
+                if key not in self._index:
+                    break
+                self._index.move_to_end(key)
+                matched.append(key)
+        covered = min(len(matched) * self.chunk_size,
+                      max(len(prompt_tokens) - 1, 0))
+        if matched:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return matched, covered
+
+    def inject(self, keys: Sequence[bytes], slot: int,
+               max_tokens: int) -> int:
+        """Copy the still-cached key-prefix into a slot (device-to-
+        device). Re-resolves each key at injection time; stops at the
+        first evicted key (later chunks depend on earlier positions).
+        Returns tokens actually injected, capped at max_tokens.
+        """
+        injected = 0
+        for i, key in enumerate(keys):
+            if injected >= max_tokens:
+                break
+            with self._lock:
+                row = self._index.get(key)
+                if row is None:
+                    break           # evicted since match(); stop here
+                self._index.move_to_end(key)
+            self.runner.cache = self._inject_fn(
+                self.runner.cache, self.pool_k, self.pool_v,
+                jnp.int32(row), jnp.int32(slot),
+                jnp.int32(i * self.chunk_size))
+            injected = min(injected + self.chunk_size, max_tokens)
+        return injected
+
+    def store(self, seq, salt: str = "") -> None:
+        """Capture a finished sequence's full prompt+output chunks into
+        the pool (LRU eviction). Must run while the slot still holds the
+        sequence's KV — same constraint as connector.on_finish."""
+        slot = getattr(seq, "slot", -1)
+        if slot < 0:
+            return
+        tokens = (seq.prompt_tokens + seq.output_tokens)[:-1]
+        keys = self.hasher.chunk_keys(tokens, salt=salt)
+        for i, key in enumerate(keys):
+            with self._lock:
+                if key in self._index:
+                    self._index.move_to_end(key)
+                    continue
+                row = self._alloc_locked()
+            self.pool_k, self.pool_v = self._store_fn(
+                self.pool_k, self.pool_v, self.runner.cache,
+                jnp.int32(row), jnp.int32(slot),
+                jnp.int32(i * self.chunk_size))
+            with self._lock:
+                self._index[key] = row
+            self.stores += 1
+
+    def _alloc_locked(self) -> int:
+        if self._free:
+            return self._free.pop()
+        _, row = self._index.popitem(last=False)  # LRU eviction
+        return row
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
