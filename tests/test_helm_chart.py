@@ -151,6 +151,9 @@ def test_engine_argv_matches_cli():
                 value = "demo=random:7"
             if flag == "--lora-targets":
                 value = "q,v"
+            if flag == "--enable-prefix-caching":  # boolean flag
+                argv += [flag]
+                continue
             argv += [flag, value]
         try:
             parse_args(argv)
