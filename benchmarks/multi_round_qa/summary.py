@@ -78,8 +78,11 @@ def summarize(results: List[RequestResult],
     total = max(end_time - start_time, 1e-9)
     n = len(ok)
     ttfts = sorted(r.ttft for r in ok)
-    gen_speeds = [r.generation_tokens / r.generation_time for r in ok
-                  if r.generation_time > 0]
+    # floor the stream duration at 1 ms: a whole answer can arrive in
+    # one SSE burst (multi-step decode windows), and dividing by the
+    # ~0 inter-chunk time would report absurd per-request throughput
+    gen_speeds = [r.generation_tokens / max(r.generation_time, 1e-3)
+                  for r in ok if r.generation_time > 0]
     return Summary(
         qps=launched / total,
         processing_speed=n / total,
