@@ -11,11 +11,13 @@ pallas_call more cheaply than the einsum it replaces.
 
 Kernel layout (one q block per grid step, K/V streamed in an inner loop):
 - grid (B, Hkv, Tq_blocks); per step the q block [BQ, G, D] and this
-  kv-head's full K/V [S, D] live in VMEM. All slicing happens through
-  BlockSpec index maps on the original [B, T, H, D] / [B, S, Hkv, D]
-  layouts — no host-side transposes, so nothing is materialized outside
-  the kernel. flash_viable() bounds S*D so both K and V fit the ~16 MB
-  VMEM budget; larger caches fall back to the jnp path.
+  kv-head's full K/V [S, D] live in VMEM. Queries are sliced through
+  BlockSpec index maps on the native [B, T, H, D] layout; K/V are
+  relayouted to head-major [B, Hkv, S, D] outside the kernel so a
+  per-head panel's minor dims are (S, D) — the shape Mosaic's
+  last-two-dims tiling rule can block. flash_viable() bounds S*D so
+  both K and V fit the ~16 MB VMEM budget; larger caches fall back to
+  the jnp path.
 - inner lax.fori_loop walks K/V in BK-sized blocks with the classic
   flash update; the loop's upper bound is data-dependent on the block's
   max query position, so fully-masked (future) K blocks are skipped —
@@ -91,14 +93,14 @@ def _flash_kernel(starts_ref, q_ref, k_ref, v_ref, out_ref, *,
     """One (batch, kv-head, q-block) grid step.
 
     q_ref   [1, BQ, 1, G, D]  queries for this kv-head's G query heads
-    k_ref   [1, S, 1, D]      this kv-head's full key cache
-    v_ref   [1, S, 1, D]
+    k_ref   [1, 1, S, D]      this kv-head's full key cache (head-major)
+    v_ref   [1, 1, S, D]
     starts_ref (SMEM) [B]     per-batch-row position of q row t=0
     out_ref [1, BQ, 1, G, D]
     """
     b = pl.program_id(0)
     qi = pl.program_id(2)
-    S = k_ref.shape[1]
+    S = k_ref.shape[2]
     rows = block_q * groups
     D = q_ref.shape[-1]
 
@@ -118,9 +120,9 @@ def _flash_kernel(starts_ref, q_ref, k_ref, v_ref, out_ref, *,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        k_blk = k_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), 0, :].astype(
+        v_blk = v_ref[0, 0, pl.ds(j * block_k, block_k), :].astype(
             jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -177,9 +179,19 @@ def flash_attention_with_cache(q, k_cache, v_cache, starts, *,
         q = jnp.pad(q, ((0, 0), (0, pad_t), (0, 0), (0, 0)))
     Tp = T + pad_t
 
-    # view q as [B, Tp, Hkv, G, D]: BlockSpecs carve per-(b, kv-head)
-    # panels straight out of the native layouts — no transposes
+    # view q as [B, Tp, Hkv, G, D]: its BlockSpec carves per-(b, kv-head)
+    # panels straight out of the native layout (full G and D in the
+    # minor dims keeps Mosaic's last-two-dims tiling rule satisfied).
     q5 = q.reshape(B, Tp, Hkv, G, D)
+    # K/V go in head-major [B, Hkv, S, D]: a per-head panel then has
+    # (S, D) as its last two dims (S a multiple of 8, D native), which
+    # Mosaic can tile — carving 1 of Hkv out of [B, S, Hkv, D] cannot
+    # be. The swap is a real full-cache copy (the scatter output is
+    # also carried as cache state, so it cannot fuse away): ~2*B*Hkv*
+    # S*D bf16 of extra HBM traffic per layer per chunk, well under 1%
+    # of the chunk's FFN matmul time at flash-viable sizes.
+    k_hm = jnp.swapaxes(k_cache, 1, 2)
+    v_hm = jnp.swapaxes(v_cache, 1, 2)
 
     grid = (B, Hkv, Tp // block_q)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
@@ -191,13 +203,13 @@ def flash_attention_with_cache(q, k_cache, v_cache, starts, *,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, 1, G, D),
                          lambda b, h, i: (b, i, h, 0, 0)),
-            pl.BlockSpec((1, S, 1, D), lambda b, h, i: (b, 0, h, 0)),
-            pl.BlockSpec((1, S, 1, D), lambda b, h, i: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, S, D), lambda b, h, i: (b, h, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, 1, G, D),
                                lambda b, h, i: (b, i, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Tp, Hkv, G, D), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(starts, jnp.int32), q5, k_cache, v_cache)
+    )(jnp.asarray(starts, jnp.int32), q5, k_hm, v_hm)
 
     return out.reshape(B, Tp, H, D)[:, :T]
