@@ -40,6 +40,9 @@ class EngineConfig:
     # engine grows pipeline/expert sharding over DCN.
     pipeline_parallel_size: int = 1
     expert_parallel_size: int = 1
+    # MoE prefill capacity factor override (ops/moe.py): None keeps the
+    # model family default (ModelConfig.moe_capacity_factor)
+    moe_capacity_factor: Optional[float] = None
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     # in-HBM prefix cache (kvcache/hbm_pool.py): finished sequences'
@@ -70,12 +73,14 @@ class EngineConfig:
                 raise ValueError(
                     f"{field_name}={val!r} unsupported: TPU serving runs "
                     f"bfloat16 (MXU-native) or float32")
-        if self.pipeline_parallel_size != 1 or self.expert_parallel_size != 1:
+        if self.pipeline_parallel_size != 1:
             raise NotImplementedError(
-                "pipeline/expert parallelism over DCN is not implemented "
-                "in this engine yet; scale within a slice via "
-                "tensor_parallel_size and across slices via replicaCount "
-                "(data parallelism)")
+                "pipeline parallelism over DCN is not implemented in "
+                "this engine yet; scale within a slice via "
+                "tensor_parallel_size/expert_parallel_size and across "
+                "slices via replicaCount (data parallelism)")
+        if self.expert_parallel_size < 1:
+            raise ValueError("expert_parallel_size must be >= 1")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)

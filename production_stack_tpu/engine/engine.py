@@ -57,6 +57,13 @@ class LLMEngine:
             import dataclasses
             self.model_cfg = dataclasses.replace(self.model_cfg,
                                                  dtype=want_dtype)
+        if (engine_cfg.moe_capacity_factor is not None
+                and engine_cfg.moe_capacity_factor
+                != self.model_cfg.moe_capacity_factor):
+            import dataclasses
+            self.model_cfg = dataclasses.replace(
+                self.model_cfg,
+                moe_capacity_factor=engine_cfg.moe_capacity_factor)
         self.tokenizer = load_tokenizer(engine_cfg.model,
                                         engine_cfg.tokenizer,
                                         engine_cfg.chat_template)
@@ -89,13 +96,25 @@ class LLMEngine:
                                                    adapters)
             lora_scaling = lcfg.scaling
         self.served_models = [engine_cfg.model] + list(self.lora_ids)
-        if mesh is None and engine_cfg.tensor_parallel_size > 1:
+        if mesh is None and (engine_cfg.tensor_parallel_size > 1
+                             or engine_cfg.expert_parallel_size > 1):
             from production_stack_tpu.parallel.mesh import (MeshConfig,
                                                             build_mesh)
             import jax
             tp = engine_cfg.tensor_parallel_size
-            mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=tp),
-                              jax.devices()[:tp])
+            ep = engine_cfg.expert_parallel_size
+            if ep > 1:
+                E = self.model_cfg.num_experts
+                if not E:
+                    raise ValueError(
+                        f"expert_parallel_size={ep} but model "
+                        f"{self.model_cfg.name!r} is dense (no experts)")
+                if E % ep:
+                    raise ValueError(
+                        f"expert_parallel_size={ep} does not divide "
+                        f"num_experts={E}")
+            mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=tp, ep=ep),
+                              jax.devices()[:tp * ep])
         self.runner = ModelRunner(self.model_cfg, engine_cfg, params=params,
                                   mesh=mesh, lora_stacked=lora_stacked,
                                   lora_scaling=lora_scaling)

@@ -163,12 +163,15 @@ class ModelRunner:
         """
         Tb = tokens.shape[1]
         positions = starts[:, None] + jnp.arange(Tb)[None, :]
+        # real tokens per row: right-padding and idle rows (lengths 0)
+        # must not route in MoE layers or steal expert capacity
+        token_valid = jnp.arange(Tb)[None, :] < lengths[:, None]
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, cache,
             rope=self.rope, kv_len=kv_len,
             use_flash=None if self.mesh is None else False,
             lora_params=self._lora, adapter_ids=sampling.adapter,
-            lora_scaling=self._lora_scaling)
+            lora_scaling=self._lora_scaling, token_valid=token_valid)
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]

@@ -497,7 +497,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="multi-slice DCN passthrough knob (must be 1; "
                         "see EngineConfig)")
     p.add_argument("--expert-parallel-size", type=int, default=1,
-                   help="MoE passthrough knob (must be 1)")
+                   help="shard a MoE model's experts over the mesh's ep "
+                        "axis (must divide num_experts; composes with "
+                        "--tensor-parallel-size)")
+    p.add_argument("--moe-capacity-factor", type=float, default=None,
+                   help="MoE prefill capacity factor (ops/moe.py): >= "
+                        "num_experts/top_k disables token dropping at "
+                        "dense-compute cost; default keeps the model "
+                        "family value")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--chat-template", default=None,
@@ -548,7 +555,8 @@ def main(argv=None) -> None:
         prefix_pool_chunk_size=args.prefix_pool_chunk_size,
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
-        expert_parallel_size=args.expert_parallel_size, seed=args.seed,
+        expert_parallel_size=args.expert_parallel_size,
+        moe_capacity_factor=args.moe_capacity_factor, seed=args.seed,
         kv_transfer_config=kv_transfer,
         lora_adapters=dict(pair.split("=", 1)
                            for pair in args.lora_adapters.split(","))

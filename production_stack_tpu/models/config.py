@@ -34,6 +34,11 @@ class ModelConfig:
     activation: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
     rms_norm_offset: bool = False   # Gemma: y *= (1 + w), not w
     embed_scale: bool = False       # Gemma: embeddings *= sqrt(hidden)
+    # Mixtral-style MoE: 0 experts = dense MLP. capacity_factor tunes the
+    # prefill dispatch's drop tradeoff (ops/moe.py); decode is exact.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 2.0
     dtype: Any = jnp.bfloat16
 
     @property
@@ -44,11 +49,13 @@ class ModelConfig:
     def num_params(self) -> int:
         h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
         hd = self.head_dim_
+        E = self.num_experts
+        mlp = 3 * h * i * E + h * E if E else 3 * h * i
         per_layer = (
             h * (self.num_heads * hd)            # q
             + 2 * h * (self.num_kv_heads * hd)   # k, v
             + (self.num_heads * hd) * h          # o
-            + 3 * h * i                          # gate, up, down
+            + mlp                                # experts (+ router) or dense
             + 2 * h                              # norms
         )
         emb = v * h * (1 if self.tie_word_embeddings else 2)
@@ -71,14 +78,16 @@ class ModelConfig:
         # Qwen2MoeForCausalLM as their simpler cousins and serve garbage
         is_qwen2 = model_type == "qwen2" or arch == "Qwen2ForCausalLM"
         is_gemma = model_type == "gemma" or arch == "GemmaForCausalLM"
+        is_mixtral = (model_type == "mixtral"
+                      or arch == "MixtralForCausalLM")
         is_llama_like = (model_type in ("llama", "mistral") or arch in
                          ("LlamaForCausalLM", "MistralForCausalLM"))
-        if not (is_qwen2 or is_gemma or is_llama_like) and (model_type
-                                                            or arch):
+        if not (is_qwen2 or is_gemma or is_mixtral
+                or is_llama_like) and (model_type or arch):
             raise ValueError(
                 f"unsupported model family (model_type={model_type!r}, "
                 f"architecture={arch!r}); supported: llama, mistral, "
-                f"qwen2, gemma")
+                f"qwen2, gemma, mixtral")
         hidden_act = cfg.get("hidden_act") or cfg.get(
             "hidden_activation") or ("gelu_tanh" if is_gemma else "silu")
         return ModelConfig(
@@ -98,6 +107,8 @@ class ModelConfig:
             activation="gelu_tanh" if "gelu" in hidden_act else "silu",
             rms_norm_offset=is_gemma,
             embed_scale=is_gemma,
+            num_experts=cfg.get("num_local_experts", 0) if is_mixtral else 0,
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
             dtype=dtype,
         )
 
@@ -151,6 +162,19 @@ PRESETS: Dict[str, ModelConfig] = {
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
     ),
+    # Tiny MoE for CPU tests: 4 experts, top-2, Mixtral semantics.
+    "debug-moe": ModelConfig(
+        name="debug-moe", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+        max_position_embeddings=512, num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32,
+        num_kv_heads=8, rope_theta=1000000.0,
+        max_position_embeddings=32768, num_experts=8,
+        num_experts_per_tok=2,
+    ),
     "gemma-7b": ModelConfig(
         name="gemma-7b", vocab_size=256000, hidden_size=3072,
         intermediate_size=24576, num_layers=28, num_heads=16,
@@ -184,6 +208,8 @@ HF_ALIASES: Dict[str, str] = {
     "Qwen/Qwen2-7B-Instruct": "qwen2-7b",
     "Qwen/Qwen2.5-7B": "qwen2.5-7b",
     "Qwen/Qwen2.5-7B-Instruct": "qwen2.5-7b",
+    "mistralai/Mixtral-8x7B-v0.1": "mixtral-8x7b",
+    "mistralai/Mixtral-8x7B-Instruct-v0.1": "mixtral-8x7b",
     "google/gemma-2b": "gemma-2b",
     "google/gemma-2b-it": "gemma-2b",
     "google/gemma-7b": "gemma-7b",

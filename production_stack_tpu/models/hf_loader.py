@@ -62,6 +62,11 @@ def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
             "k_bias": ("self_attn.k_proj.bias", False),
             "v_bias": ("self_attn.v_proj.bias", False),
         })
+    if cfg.num_experts:
+        # Mixtral block_sparse_moe replaces the dense MLP (stacked along
+        # a leading expert axis; w1=gate, w3=up, w2=down)
+        for name in ("gate", "up", "down"):
+            del layer_map[name]
     layers: Dict[str, Any] = {}
     for ours, (suffix, transpose) in layer_map.items():
         stacked = np.stack(
@@ -69,6 +74,20 @@ def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
         if transpose:
             stacked = np.swapaxes(stacked, -1, -2)
         layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
+    if cfg.num_experts:
+        moe_map = {"gate": "w1", "up": "w3", "down": "w2"}
+        for ours, hf in moe_map.items():
+            stacked = np.stack([
+                np.stack([
+                    get(f"layers.{i}.block_sparse_moe.experts.{e}."
+                        f"{hf}.weight").T
+                    for e in range(cfg.num_experts)])
+                for i in range(cfg.num_layers)])     # [L, E, in, out]
+            layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
+        router = np.stack(
+            [get(f"layers.{i}.block_sparse_moe.gate.weight").T
+             for i in range(cfg.num_layers)])        # [L, h, E]
+        layers["router"] = jnp.asarray(router, dtype=cfg.dtype)
 
     params = {
         "embed": cast(get("embed_tokens.weight"), False),

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from production_stack_tpu.models import lora
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, write_chunk
-from production_stack_tpu.ops import pallas_attention
+from production_stack_tpu.ops import moe, pallas_attention
 from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
 from production_stack_tpu.ops.norms import rms_norm
 from production_stack_tpu.ops.rope import apply_rope, rope_table
@@ -43,6 +43,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(cfg.dtype)
 
     norm_init = jnp.zeros if cfg.rms_norm_offset else jnp.ones
+    E = cfg.num_experts
     params: Params = {
         "embed": w(next(keys), (v, h)),
         "layers": {
@@ -52,12 +53,24 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "v": w(next(keys), (L, h, nkv * hd)),
             "o": w(next(keys), (L, nh * hd, h)),
             "mlp_norm": norm_init((L, h), cfg.dtype),
-            "gate": w(next(keys), (L, h, i)),
-            "up": w(next(keys), (L, h, i)),
-            "down": w(next(keys), (L, i, h)),
         },
         "final_norm": norm_init((h,), cfg.dtype),
     }
+    # key order matters: dense models must draw gate/up/down from the
+    # same key positions as before MoE existed (seeded tests pin outputs)
+    if E:
+        params["layers"].update({
+            "gate": w(next(keys), (L, E, h, i)),
+            "up": w(next(keys), (L, E, h, i)),
+            "down": w(next(keys), (L, E, i, h)),
+            "router": w(next(keys), (L, h, E)),
+        })
+    else:
+        params["layers"].update({
+            "gate": w(next(keys), (L, h, i)),
+            "up": w(next(keys), (L, h, i)),
+            "down": w(next(keys), (L, i, h)),
+        })
     if cfg.attention_bias:
         # Qwen2: biases on the q/k/v projections only
         params["layers"]["q_bias"] = jnp.zeros((L, nh * hd), cfg.dtype)
@@ -75,7 +88,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 attention_fn=None, kv_len: Optional[int] = None,
                 use_flash: bool = False, lora_layer=None,
                 adapter_ids: Optional[jnp.ndarray] = None,
-                lora_scaling: float = 1.0):
+                lora_scaling: float = 1.0,
+                token_valid: Optional[jnp.ndarray] = None):
     """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
 
     attention_fn(q, k, v) overrides the no-cache attention — used to swap
@@ -136,8 +150,21 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
 
     hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset=offset)
     act = jax.nn.silu if cfg.activation == "silu" else _gelu_tanh
-    gated = act(proj(hidden, "gate")) * proj(hidden, "up")
-    x = x + proj(gated, "down")
+    if cfg.num_experts:
+        H = hidden.shape[-1]
+        y = moe.moe_mlp(
+            hidden.reshape(B * T, H), lp["router"], lp["gate"],
+            lp["up"], lp["down"], top_k=cfg.num_experts_per_tok,
+            capacity_factor=cfg.moe_capacity_factor, act=act,
+            valid=None if token_valid is None
+            else token_valid.reshape(B * T),
+            # decode (T == 1) must be exact: a dropped token would
+            # corrupt a live sequence's residual stream mid-generation
+            exact=True if T == 1 else None)
+        x = x + y.reshape(B, T, H)
+    else:
+        gated = act(proj(hidden, "gate")) * proj(hidden, "up")
+        x = x + proj(gated, "down")
     return x, new_kv
 
 
@@ -152,7 +179,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             kv_len: Optional[int] = None,
             use_flash: Optional[bool] = None,
             lora_params=None, adapter_ids: Optional[jnp.ndarray] = None,
-            lora_scaling: float = 1.0) -> Tuple[jnp.ndarray, KVCache]:
+            lora_scaling: float = 1.0,
+            token_valid: Optional[jnp.ndarray] = None
+            ) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
     positions[b] must be contiguous starting at the sequence's current
@@ -163,6 +192,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     partitioning rule (see ops/pallas_attention.py).
     lora_params: layer-leading stacked adapters (models/lora.layer_slice)
     + adapter_ids [B] selecting each row's adapter (0 = base).
+    token_valid [B,T] bool marks real (non-padding) tokens — MoE models
+    use it to keep padding rows out of expert-capacity competition
+    (ops/moe.py); dense models ignore it.
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
@@ -179,7 +211,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                       lp, (k_c, v_c), kv_len=kv_len,
                                       use_flash=use_flash, lora_layer=ll,
                                       adapter_ids=adapter_ids,
-                                      lora_scaling=lora_scaling)
+                                      lora_scaling=lora_scaling,
+                                      token_valid=token_valid)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -190,7 +223,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             lp, k_c, v_c = xs
             out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
                                       lp, (k_c, v_c), kv_len=kv_len,
-                                      use_flash=use_flash)
+                                      use_flash=use_flash,
+                                      token_valid=token_valid)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(

@@ -37,18 +37,33 @@ from production_stack_tpu.models.config import ModelConfig
 def _proj_dims(cfg: ModelConfig) -> Dict[str, Tuple[int, int]]:
     h, i = cfg.hidden_size, cfg.intermediate_size
     hd = cfg.head_dim_
-    return {
+    dims = {
         "q": (h, cfg.num_heads * hd),
         "k": (h, cfg.num_kv_heads * hd),
         "v": (h, cfg.num_kv_heads * hd),
         "o": (cfg.num_heads * hd, h),
-        "gate": (h, i),
-        "up": (h, i),
-        "down": (i, h),
     }
+    if not cfg.num_experts:
+        # MoE models have no dense MLP projections: the expert FFN runs
+        # outside the LoRA-hooked proj() path (models/llama.py), so
+        # offering gate/up/down there would silently no-op
+        dims.update({"gate": (h, i), "up": (h, i), "down": (i, h)})
+    return dims
 
 
 DEFAULT_TARGETS = ("q", "v")
+
+
+def _check_targets(cfg: ModelConfig, targets: Tuple[str, ...],
+                   dims: Dict[str, Tuple[int, int]]) -> None:
+    unknown = [t for t in targets if t not in dims]
+    if unknown:
+        hint = (" (MoE expert FFNs cannot take LoRA — adapt the "
+                "attention projections instead)" if cfg.num_experts
+                else "")
+        raise ValueError(
+            f"LoRA target(s) {unknown} not available for model "
+            f"{cfg.name!r}; valid: {sorted(dims)}{hint}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +85,7 @@ def init_adapter(cfg: ModelConfig, lcfg: LoRAConfig, key: jax.Array,
     no-op until trained); ``zero`` also zeroes A (the base-model slot).
     """
     dims = _proj_dims(cfg)
+    _check_targets(cfg, lcfg.targets, dims)
     L, r = cfg.num_layers, lcfg.rank
     out: Dict[str, Dict[str, jnp.ndarray]] = {}
     for name in lcfg.targets:
@@ -87,6 +103,7 @@ def random_adapter(cfg: ModelConfig, lcfg: LoRAConfig, key: jax.Array,
     """A synthetic adapter with BOTH factors non-zero — visibly changes
     model output. For tests/demos ("random:SEED" in EngineConfig)."""
     dims = _proj_dims(cfg)
+    _check_targets(cfg, lcfg.targets, dims)
     L, r = cfg.num_layers, lcfg.rank
     out: Dict[str, Dict[str, jnp.ndarray]] = {}
     for name in lcfg.targets:
@@ -122,6 +139,7 @@ def load_adapter_npz(cfg: ModelConfig, lcfg: LoRAConfig, path: str,
     """Load one adapter from an .npz checkpoint (format in module doc)."""
     data = np.load(path)
     dims = _proj_dims(cfg)
+    _check_targets(cfg, lcfg.targets, dims)
     L, r = cfg.num_layers, lcfg.rank
     out: Dict[str, Dict[str, jnp.ndarray]] = {}
     for name in lcfg.targets:

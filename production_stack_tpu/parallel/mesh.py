@@ -10,7 +10,12 @@ collectives from sharding annotations — no process groups, no shm.
 Axes:
   dp — data parallel (batch)
   sp — sequence parallel (ring attention over sequence blocks)
+  ep — expert parallel (MoE expert weights, ops/moe.py)
   tp — tensor parallel (megatron column/row sharding of matmuls)
+
+tp stays innermost (ICI-nearest: its per-layer psums are the most
+latency-sensitive collectives); ep sits just above it so expert
+dispatch/combine also rides ICI before dp/sp cross slice boundaries.
 
 Multi-replica scaling above a slice stays at the stack level (router over
 engine replicas), exactly like the reference's L1/L3 split.
@@ -22,7 +27,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "tp")
+AXES = ("dp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -30,10 +35,11 @@ class MeshConfig:
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    ep: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.sp * self.tp * self.ep
 
     @staticmethod
     def for_devices(n: int, tp: Optional[int] = None,
@@ -63,5 +69,5 @@ def build_mesh(cfg: Optional[MeshConfig] = None,
         raise ValueError(
             f"mesh {cfg} needs {cfg.size} devices, have {len(devices)}")
     import numpy as np
-    dev_array = np.asarray(devices).reshape(cfg.dp, cfg.sp, cfg.tp)
+    dev_array = np.asarray(devices).reshape(cfg.dp, cfg.sp, cfg.ep, cfg.tp)
     return Mesh(dev_array, AXES)

@@ -36,11 +36,26 @@ _LAYER_SPECS: Dict[str, P] = {
 }
 
 
+_MOE_SPECS: Dict[str, P] = {
+    # router [L, h, E] replicated: every device routes every token
+    "router": P(None, None, None),
+    # expert-stacked FFN: experts over ep, hidden features over tp —
+    # column-parallel gate/up ([L, E, h, i] shard i), row-parallel down
+    # ([L, E, i, h] shard i), same one-psum-per-layer structure as the
+    # dense path but within each expert
+    "gate": P(None, "ep", None, "tp"),
+    "up": P(None, "ep", None, "tp"),
+    "down": P(None, "ep", "tp", None),
+}
+
+
 def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
     """PartitionSpec pytree matching models/llama.py's params layout."""
+    moe = "router" in params["layers"]
+    layer_specs = dict(_LAYER_SPECS, **_MOE_SPECS) if moe else _LAYER_SPECS
     specs: Dict[str, Any] = {
         "embed": P("tp", None),
-        "layers": {name: _LAYER_SPECS[name] for name in params["layers"]},
+        "layers": {name: layer_specs[name] for name in params["layers"]},
         "final_norm": P(None),
     }
     if "lm_head" in params:
