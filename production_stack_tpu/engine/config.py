@@ -43,6 +43,9 @@ class EngineConfig:
     # MoE prefill capacity factor override (ops/moe.py): None keeps the
     # model family default (ModelConfig.moe_capacity_factor)
     moe_capacity_factor: Optional[float] = None
+    # weight-only int8 (models/quant.py): halves decode weight-streaming
+    # HBM traffic; None serves in --dtype precision
+    quantization: Optional[str] = None
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     # in-HBM prefix cache (kvcache/hbm_pool.py): finished sequences'
@@ -81,6 +84,10 @@ class EngineConfig:
                 "slices via replicaCount (data parallelism)")
         if self.expert_parallel_size < 1:
             raise ValueError("expert_parallel_size must be >= 1")
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"quantization={self.quantization!r} unsupported: only "
+                f"weight-only 'int8' (models/quant.py) is implemented")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)

@@ -65,6 +65,14 @@ class ModelRunner:
                 engine_cfg.seed))
             logger.info("random-initialized %s (%.2fs)", model_cfg.name,
                         time.time() - t0)
+        if engine_cfg.quantization == "int8":
+            from production_stack_tpu.models import quant
+            # donate: XLA frees each fp buffer as its int8 copy is
+            # produced, avoiding a ~1.5x transient HBM peak — which is
+            # exactly when --quantization is needed (weights that barely
+            # fit). The incoming params are consumed.
+            params = jax.jit(quant.quantize_params,
+                             donate_argnums=0)(params)
         self.params = params
         self.cache: KVCache = make_cache(
             model_cfg.num_layers, engine_cfg.max_num_seqs,
@@ -84,6 +92,18 @@ class ModelRunner:
                     f"tensor_parallel_size {tp} must divide num_kv_heads "
                     f"{model_cfg.num_kv_heads} (KV-head replication is not "
                     f"implemented yet)")
+            ep = mesh.shape.get("ep", 1)
+            if ep > 1:
+                # validated here (not only in LLMEngine) so explicitly
+                # passed meshes fail with a clear error too
+                if not model_cfg.num_experts:
+                    raise ValueError(
+                        f"mesh has ep={ep} but model {model_cfg.name!r} "
+                        f"is dense (no experts)")
+                if model_cfg.num_experts % ep:
+                    raise ValueError(
+                        f"ep={ep} does not divide num_experts="
+                        f"{model_cfg.num_experts}")
             self.params = jax.device_put(
                 self.params, param_shardings(mesh, self.params))
             cache_sh = NamedSharding(mesh, cache_pspec())
@@ -270,9 +290,9 @@ class ModelRunner:
             logger.info("compiling embed (batch=%d len=%d)", N, Tb)
 
             def _impl(params, toks, lens):
-                h = llama.encode(params, self.model_cfg, toks,
-                                 rope=self.rope)
                 mask = (jnp.arange(Tb)[None, :] < lens[:, None])
+                h = llama.encode(params, self.model_cfg, toks,
+                                 rope=self.rope, token_valid=mask)
                 pooled = jnp.sum(
                     h.astype(jnp.float32) * mask[:, :, None], axis=1)
                 return pooled / jnp.maximum(lens, 1)[:, None]

@@ -500,6 +500,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="shard a MoE model's experts over the mesh's ep "
                         "axis (must divide num_experts; composes with "
                         "--tensor-parallel-size)")
+    p.add_argument("--quantization", choices=["int8"], default=None,
+                   help="weight-only int8: halves decode weight-"
+                        "streaming HBM traffic (norms/biases/router "
+                        "stay in --dtype)")
     p.add_argument("--moe-capacity-factor", type=float, default=None,
                    help="MoE prefill capacity factor (ops/moe.py): >= "
                         "num_experts/top_k disables token dropping at "
@@ -556,7 +560,8 @@ def main(argv=None) -> None:
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
         expert_parallel_size=args.expert_parallel_size,
-        moe_capacity_factor=args.moe_capacity_factor, seed=args.seed,
+        moe_capacity_factor=args.moe_capacity_factor,
+        quantization=args.quantization, seed=args.seed,
         kv_transfer_config=kv_transfer,
         lora_adapters=dict(pair.split("=", 1)
                            for pair in args.lora_adapters.split(","))

@@ -22,7 +22,7 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from production_stack_tpu.models import lora
+from production_stack_tpu.models import lora, quant
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, write_chunk
 from production_stack_tpu.ops import moe, pallas_attention
@@ -106,7 +106,7 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
     cos, sin = rope
 
     def proj(h, name):
-        out = h @ lp[name]
+        out = quant.dequant_matmul(h, lp[name])
         bias = lp.get(f"{name}_bias")
         if bias is not None:
             out = out + bias
@@ -237,10 +237,13 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
            rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
-           attention_fn=None) -> jnp.ndarray:
+           attention_fn=None,
+           token_valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Full-sequence causal forward WITHOUT the LM head: final-normed
     hidden states [B,T,H]. The embeddings/rerank/score endpoints pool
     these (engine/server.py); forward_train puts the head on top.
+    token_valid [B,T] marks real tokens in right-padded batches — on
+    MoE models padding must not compete for expert capacity.
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
@@ -251,7 +254,8 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     def scan_body(carry, lp):
         out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None,
-                             attention_fn=attention_fn)
+                             attention_fn=attention_fn,
+                             token_valid=token_valid)
         return out, None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
@@ -274,7 +278,7 @@ def forward_train(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
 def _embed(params: Params, cfg: ModelConfig,
            tokens: jnp.ndarray) -> jnp.ndarray:
-    x = params["embed"][tokens]
+    x = quant.dequant_rows(params["embed"], tokens, cfg.dtype)
     if cfg.embed_scale:
         # Gemma scales embeddings by sqrt(hidden)
         x = x.astype(jnp.float32) * jnp.sqrt(float(cfg.hidden_size))
@@ -282,6 +286,21 @@ def _embed(params: Params, cfg: ModelConfig,
 
 
 def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
-    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    if cfg.tie_word_embeddings:
+        emb = params["embed"]
+        if quant.is_quantized(emb):
+            # per-row scale (quantize_embed) lands on the vocab axis of
+            # embed.T — apply it per logit after the int8 matmul
+            logits = jnp.einsum("bth,vh->btv", x,
+                                emb["w8"].astype(x.dtype),
+                                preferred_element_type=jnp.float32)
+            return logits * emb["scale"][None, None, :]
+        return jnp.einsum("bth,hv->btv", x, emb.T,
+                          preferred_element_type=jnp.float32)
+    head = params["lm_head"]
+    if quant.is_quantized(head):
+        logits = jnp.einsum("bth,hv->btv", x, head["w8"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits * head["scale"][None, None, :]
     return jnp.einsum("bth,hv->btv", x, head,
                       preferred_element_type=jnp.float32)

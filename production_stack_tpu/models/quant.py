@@ -1,0 +1,97 @@
+"""Weight-only int8 quantization for the decoder's projection matmuls.
+
+The reference passes --quantization down to vllm serve (reference:
+helm/values.yaml modelSpec args / SURVEY.md §2.9 config surface); here
+the engine implements the TPU-appropriate variant natively:
+
+- **Symmetric per-output-channel int8** on every large matmul weight
+  (q/k/v/o, dense gate/up/down, MoE expert stacks, embed, lm_head).
+  Norm weights, biases, and the MoE router (tiny, accuracy-critical)
+  stay in the model dtype.
+- **Weight-only**: activations stay bf16. The matmul reads int8
+  weights from HBM and converts in-register; XLA fuses the
+  convert+scale into the dot epilogue. Decode is weight-bandwidth
+  bound, so halving weight bytes approaches a 2x step-time headroom
+  without the accuracy risk of activation quantization.
+- A quantized leaf is ``{"w8": int8 [..., in, out], "scale": fp32
+  [..., out]}`` in place of the raw array — same pytree *names*, so
+  checkpoint loaders and sharding-by-name rules keep working
+  (parallel/sharding.py maps the nested leaves' specs from the base
+  rule: w8 keeps the weight's spec, scale keeps (leading..., out)).
+
+Dequantized matmul identity: ``x @ (w8 * scale) == (x @ w8) * scale``
+(scale broadcasts over the out axis), so projections compute
+``(x @ w8.astype(dtype)) * scale`` — one fused multiply per output.
+"""
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+# layer-dict entries that stay un-quantized (small or accuracy-critical)
+_SKIP_LAYER = ("attn_norm", "mlp_norm", "q_bias", "k_bias", "v_bias",
+               "router")
+
+
+def quantize_tensor(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Symmetric per-output-channel int8 over the last axis.
+
+    w [..., in, out] -> {"w8": int8 same shape, "scale": fp32 [..., out]}
+    with per-channel scale = max|w| / 127 reduced over the `in` axis
+    (leading axes — layer/expert stacks — keep independent channels).
+    """
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2)               # [..., out]
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    w8 = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127
+                  ).astype(jnp.int8)
+    return {"w8": w8, "scale": scale}
+
+
+def quantize_embed(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Per-ROW int8 for the [V, H] embedding table: scale [V]. A row
+    scale serves both roles — the token gather dequantizes the gathered
+    rows, and the tied lm_head applies it per logit AFTER x @ w8.T."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-1), 1e-8) / 127.0
+    w8 = jnp.clip(jnp.round(wf / scale[:, None]), -127, 127
+                  ).astype(jnp.int8)
+    return {"w8": w8, "scale": scale}
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and "w8" in leaf
+
+
+def dequant_matmul(x: jnp.ndarray, w: Any, dtype=None) -> jnp.ndarray:
+    """x @ w for raw or quantized w, in x.dtype (or `dtype`)."""
+    if not is_quantized(w):
+        return x @ w
+    dtype = dtype or x.dtype
+    y = x @ w["w8"].astype(dtype)
+    return y * w["scale"].astype(dtype)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a stacked-params pytree (models/llama.py layout) in the
+    standard int8 recipe. Returns a new pytree; embed quantizes per
+    row so the gather and tied-lm_head roles share one scale axis."""
+    out: Dict[str, Any] = {"final_norm": params["final_norm"]}
+    out["embed"] = quantize_embed(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = quantize_tensor(params["lm_head"])
+    layers: Dict[str, Any] = {}
+    for name, w in params["layers"].items():
+        layers[name] = (w if name in _SKIP_LAYER
+                        else quantize_tensor(w))
+    out["layers"] = layers
+    return out
+
+
+def dequant_rows(w: Any, rows: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Gather rows of a (possibly quantized) [V, H] table: the embedding
+    lookup path (per-row scale from quantize_embed)."""
+    if not is_quantized(w):
+        return w[rows].astype(dtype)
+    return (w["w8"][rows].astype(dtype)
+            * w["scale"][rows].astype(dtype)[..., None])

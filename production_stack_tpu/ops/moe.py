@@ -69,18 +69,40 @@ def route(x: jnp.ndarray, router_w: jnp.ndarray, top_k: int):
     return top_p, top_i.astype(jnp.int32)
 
 
-def _expert_ffn(xb: jnp.ndarray, gate: jnp.ndarray, up: jnp.ndarray,
-                down: jnp.ndarray, act: Callable) -> jnp.ndarray:
+def _quant():
+    """models/quant.py, imported lazily: models/ imports ops/, so a
+    top-level import here would cycle. By the first moe_mlp trace both
+    packages are fully initialized."""
+    from production_stack_tpu.models import quant
+    return quant
+
+
+def _wshape(w) -> tuple:
+    """Shape of a raw or int8-quantized weight."""
+    return (w["w8"] if _quant().is_quantized(w) else w).shape
+
+
+def _edot(xb: jnp.ndarray, w) -> jnp.ndarray:
+    """einsum('ec?,e?o->eco') with weight-only int8 dequant applied in
+    the epilogue (per-expert, per-output-channel scale)."""
+    if _quant().is_quantized(w):
+        y = jnp.einsum("eci,eio->eco", xb, w["w8"].astype(xb.dtype))
+        return y * w["scale"].astype(xb.dtype)[:, None, :]
+    return jnp.einsum("eci,eio->eco", xb, w)
+
+
+def _expert_ffn(xb: jnp.ndarray, gate, up, down,
+                act: Callable) -> jnp.ndarray:
     """Batched per-expert FFN. xb [E, C, h] -> [E, C, h]."""
-    g = jnp.einsum("ech,ehi->eci", xb, gate)
-    u = jnp.einsum("ech,ehi->eci", xb, up)
-    return jnp.einsum("eci,eih->ech", act(g) * u, down)
+    g = _edot(xb, gate)
+    u = _edot(xb, up)
+    return _edot(act(g) * u, down)
 
 
 def _moe_exact(x, top_p, top_i, gate, up, down, act):
     """All experts over all tokens, combined by routing weight."""
     N = x.shape[0]
-    E = gate.shape[0]
+    E = _wshape(gate)[0]
     # combine [N, E]: routing weight where selected, else 0
     combine = jnp.zeros((N, E), jnp.float32)
     combine = combine.at[
@@ -95,7 +117,7 @@ def _moe_dispatch(x, top_p, top_i, gate, up, down, act, capacity,
                   valid=None):
     """Scatter-based capacity dispatch (see module docstring)."""
     N, h = x.shape
-    E = gate.shape[0]
+    E = _wshape(gate)[0]
     k = top_i.shape[1]
 
     flat_e = top_i.reshape(-1)                          # [N*k] token-major
@@ -141,7 +163,7 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate: jnp.ndarray,
     or whenever capacity covers every possible assignment.
     """
     N = x.shape[0]
-    E = gate.shape[0]
+    E = _wshape(gate)[0]
     top_p, top_i = route(x, router_w, top_k)
     if valid is not None:
         top_p = top_p * valid.astype(top_p.dtype)[:, None]

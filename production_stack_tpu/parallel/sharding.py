@@ -49,17 +49,30 @@ _MOE_SPECS: Dict[str, P] = {
 }
 
 
+def _qspec(leaf: Any, spec: P, per_row: bool = False) -> Any:
+    """Expand a weight's spec for int8-quantized leaves (models/quant.py
+    {"w8", "scale"} dicts): w8 keeps the weight's spec; scale drops the
+    reduced axis — the in axis (-2) for per-output-channel weights, the
+    last axis for the per-row embed table."""
+    if not (isinstance(leaf, dict) and "w8" in leaf):
+        return spec
+    dims = tuple(spec)
+    scale_spec = P(*dims[:-1]) if per_row else P(*dims[:-2], dims[-1])
+    return {"w8": spec, "scale": scale_spec}
+
+
 def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
     """PartitionSpec pytree matching models/llama.py's params layout."""
     moe = "router" in params["layers"]
     layer_specs = dict(_LAYER_SPECS, **_MOE_SPECS) if moe else _LAYER_SPECS
     specs: Dict[str, Any] = {
-        "embed": P("tp", None),
-        "layers": {name: layer_specs[name] for name in params["layers"]},
+        "embed": _qspec(params["embed"], P("tp", None), per_row=True),
+        "layers": {name: _qspec(leaf, layer_specs[name])
+                   for name, leaf in params["layers"].items()},
         "final_norm": P(None),
     }
     if "lm_head" in params:
-        specs["lm_head"] = P(None, "tp")
+        specs["lm_head"] = _qspec(params["lm_head"], P(None, "tp"))
     return specs
 
 

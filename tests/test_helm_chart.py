@@ -147,6 +147,8 @@ def test_engine_argv_matches_cli():
                 value = "x"
             if flag == "--dtype":
                 value = "bfloat16"
+            if flag == "--quantization":
+                value = "int8"
             if flag == "--lora-adapters":
                 value = "demo=random:7"
             if flag == "--lora-targets":

@@ -223,3 +223,31 @@ def test_moe_capacity_factor_plumbs_to_model():
     eng = LLMEngine(EngineConfig(model="debug-moe", max_model_len=64,
                                  moe_capacity_factor=3.5))
     assert eng.model_cfg.moe_capacity_factor == 3.5
+
+
+def test_encode_moe_ignores_padding_content():
+    """encode() (the embeddings path) masks padding: with right-padded
+    batches, changing the pad tokens' content must not change any valid
+    position's hidden state — pads neither route nor steal capacity.
+    Uses a low capacity factor so the droppy dispatch branch is live."""
+    cfg = ModelConfig(name="t-moe8", vocab_size=128, hidden_size=64,
+                      intermediate_size=128, num_layers=2, num_heads=8,
+                      num_kv_heads=4, max_position_embeddings=256,
+                      num_experts=8, num_experts_per_tok=2,
+                      moe_capacity_factor=0.8, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    T = 120
+    lengths = np.array([T, 40])
+    toks = rng.integers(0, cfg.vocab_size, (2, T))
+    mask = np.arange(T)[None, :] < lengths[:, None]
+
+    toks_a = toks.copy()
+    toks_b = toks.copy()
+    toks_b[~mask] = 7    # different garbage in the pad region
+
+    h_a = np.asarray(llama.encode(params, cfg, jnp.asarray(toks_a),
+                                  token_valid=jnp.asarray(mask)))
+    h_b = np.asarray(llama.encode(params, cfg, jnp.asarray(toks_b),
+                                  token_valid=jnp.asarray(mask)))
+    np.testing.assert_array_equal(h_a[mask], h_b[mask])
