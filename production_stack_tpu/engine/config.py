@@ -78,10 +78,13 @@ class EngineConfig:
                     f"bfloat16 (MXU-native) or float32")
         if self.pipeline_parallel_size != 1:
             raise NotImplementedError(
-                "pipeline parallelism over DCN is not implemented in "
-                "this engine yet; scale within a slice via "
-                "tensor_parallel_size/expert_parallel_size and across "
-                "slices via replicaCount (data parallelism)")
+                "pipeline-parallel SERVING is not implemented: decode "
+                "would pipeline one token at a time (pure bubble) "
+                "without multi-batch in-flight scheduling. PP exists "
+                "for training (parallel/pipeline.py, GPipe over the "
+                "'pp' mesh axis); serving scales via tensor_parallel_"
+                "size/expert_parallel_size within a slice and "
+                "replicaCount across slices")
         if self.expert_parallel_size < 1:
             raise ValueError("expert_parallel_size must be >= 1")
         if self.quantization not in (None, "int8"):

@@ -20,13 +20,21 @@ def rope_table(max_positions: int, head_dim: int, theta: float = 10000.0):
     would be clamp-gathered under jit (silently wrong logits) — callers with
     a cache longer than the model's max_position_embeddings must pass a
     table sized to the cache length (the engine does; see engine/runner.py).
+
+    Computed and CACHED in numpy: the lru_cache makes traced values
+    poisonous — a first call under a jit trace (any rope=None path)
+    would cache tracers that escape into later traces, and even
+    jnp.asarray of a constant is a traced op. Host arrays are safe to
+    cache and close over from anywhere; jnp converts them at use (XLA
+    bakes them into executables as constants either way).
     """
+    import numpy as np
     inv_freq = 1.0 / (
-        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim)
     )
-    pos = jnp.arange(max_positions, dtype=jnp.float32)
-    angles = jnp.outer(pos, inv_freq)  # [P, D/2]
-    return jnp.cos(angles), jnp.sin(angles)
+    pos = np.arange(max_positions, dtype=np.float32)
+    angles = np.outer(pos, inv_freq)  # [P, D/2]
+    return np.cos(angles), np.sin(angles)
 
 
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, cos: jnp.ndarray,
@@ -36,6 +44,9 @@ def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, cos: jnp.ndarray,
     Non-interleaved ("rotate half") convention: the head dim is split into
     two contiguous halves, matching HF Llama's ``rotate_half``.
     """
+    # tables may arrive as host numpy (rope_table caches numpy — see its
+    # docstring); numpy can't be indexed by a traced positions array
+    cos, sin = jnp.asarray(cos), jnp.asarray(sin)
     c = cos[positions].astype(jnp.float32)[..., None, :]  # [..., T, 1, D/2]
     s = sin[positions].astype(jnp.float32)[..., None, :]
     xf = x.astype(jnp.float32)

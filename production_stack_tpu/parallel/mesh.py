@@ -8,6 +8,7 @@ jax.sharding.Mesh over the slice's chips, with XLA inserting ICI
 collectives from sharding annotations — no process groups, no shm.
 
 Axes:
+  pp — pipeline parallel (layer stages, parallel/pipeline.py)
   dp — data parallel (batch)
   sp — sequence parallel (ring attention over sequence blocks)
   ep — expert parallel (MoE expert weights, ops/moe.py)
@@ -16,6 +17,9 @@ Axes:
 tp stays innermost (ICI-nearest: its per-layer psums are the most
 latency-sensitive collectives); ep sits just above it so expert
 dispatch/combine also rides ICI before dp/sp cross slice boundaries.
+pp is outermost: stages exchange one activation per microbatch hop —
+the lowest-bandwidth axis, the natural one to place across DCN
+(multi-slice) while everything else stays within a slice.
 
 Multi-replica scaling above a slice stays at the stack level (router over
 engine replicas), exactly like the reference's L1/L3 split.
@@ -27,7 +31,7 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
-AXES = ("dp", "sp", "ep", "tp")
+AXES = ("pp", "dp", "sp", "ep", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,10 +40,11 @@ class MeshConfig:
     sp: int = 1
     tp: int = 1
     ep: int = 1
+    pp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.sp * self.tp * self.ep
+        return self.dp * self.sp * self.tp * self.ep * self.pp
 
     @staticmethod
     def for_devices(n: int, tp: Optional[int] = None,
@@ -69,5 +74,6 @@ def build_mesh(cfg: Optional[MeshConfig] = None,
         raise ValueError(
             f"mesh {cfg} needs {cfg.size} devices, have {len(devices)}")
     import numpy as np
-    dev_array = np.asarray(devices).reshape(cfg.dp, cfg.sp, cfg.ep, cfg.tp)
+    dev_array = np.asarray(devices).reshape(cfg.pp, cfg.dp, cfg.sp,
+                                            cfg.ep, cfg.tp)
     return Mesh(dev_array, AXES)

@@ -1,0 +1,166 @@
+"""GPipe-style pipeline-parallel training over the mesh's 'pp' axis.
+
+The reference forwards --pipeline-parallel-size to vllm serve
+(reference: SURVEY.md §2.9 — PP is pure config surface there); here the
+TPU-native implementation targets the place PP actually pays off on
+TPU: scaling the LAYER dimension across slices/hosts where only one
+activation tensor per microbatch hop crosses the (DCN-friendly) 'pp'
+axis, while tp/ep collectives stay inside each stage's slice.
+
+Design — the stacked-params layout (models/llama.py) is the seam:
+- ``layers`` pytree leaves are [L, ...]; reshaped to [P, L/P, ...] and
+  sharded P('pp') on the stage axis, each stage holds its L/P layers.
+- ``shard_map`` over 'pp' runs the classic GPipe schedule in SPMD: for
+  step t in [0, n_micro + P - 1), every stage ppermutes its previous
+  output to the next stage, stage 0 feeds microbatch t from its input
+  queue, and each stage scans its local layers. After the pipeline
+  drains, the last stage holds every microbatch's final hidden states.
+- The schedule is an ordinary ``lax.scan`` of linear ops (ppermute,
+  where, dynamic slicing), so ``jax.grad`` differentiates straight
+  through it — the backward pass is automatically the reverse
+  pipeline, no hand-written backward schedule.
+- Embedding, final norm, LM head and the loss are replicated per
+  stage; only the last stage's loss is real, and a 'pp' psum of
+  ``where(stage == P-1, loss, 0)`` broadcasts it. Their (replicated)
+  gradients come out psummed over 'pp' — harmless for parity tests and
+  small next to the layer stacks; fold them into per-stage
+  placement if embedding cost ever matters.
+
+Bubble fraction is the usual (P-1)/(n_micro + P - 1); pick
+n_micro >= ~4P. Composes with the batch dim only (dp=1 inside this
+entry point): sp/tp/ep sharding inside a stage would need partial-auto
+shard_map — the engine keeps those on the GSPMD path instead.
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from production_stack_tpu.models import llama
+from production_stack_tpu.models.config import ModelConfig
+from production_stack_tpu.ops.norms import rms_norm
+from production_stack_tpu.ops.rope import rope_table
+from production_stack_tpu.parallel.train import nll_from_logits
+
+
+def stage_params(params: Dict[str, Any], n_stages: int) -> Dict[str, Any]:
+    """Reshape stacked layers [L, ...] -> [P, L/P, ...] (stage-major:
+    stage p owns contiguous layers [p*L/P, (p+1)*L/P))."""
+    L = jax.tree.leaves(params["layers"])[0].shape[0]
+    if L % n_stages:
+        raise ValueError(f"pp={n_stages} does not divide num_layers={L}")
+    staged = jax.tree.map(
+        lambda w: w.reshape((n_stages, L // n_stages) + w.shape[1:]),
+        params["layers"])
+    return {**params, "layers": staged}
+
+
+def stage_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Stage-axis sharding for stage_params output: layers over 'pp',
+    everything else replicated."""
+    def spec(path_leaf):
+        return NamedSharding(mesh, P("pp"))
+    reps = NamedSharding(mesh, P())
+    return {
+        name: (jax.tree.map(lambda _: spec(_), leaf) if name == "layers"
+               else jax.tree.map(lambda _: reps, leaf))
+        for name, leaf in params.items()
+    }
+
+
+def pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int):
+    """Build loss(params_staged, tokens) -> scalar, jit-able over mesh.
+
+    tokens [B, T] with B divisible by n_micro; params from
+    stage_params()/stage_shardings(). Runs the GPipe schedule above.
+    """
+    n_stages = mesh.shape["pp"]
+    rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
+                      cfg.rope_theta)
+
+    has_head = not cfg.tie_word_embeddings
+
+    def per_stage(layers_local, embed, final_norm, *rest):
+        if has_head:
+            head, tokens = rest
+        else:
+            head, (tokens,) = None, rest
+        # layers_local: [1, L/P, ...] (shard_map keeps the sharded axis
+        # with size 1) -> [L/P, ...]
+        layers_local = jax.tree.map(lambda w: w[0], layers_local)
+        stage = jax.lax.axis_index("pp")
+        B, T = tokens.shape
+        mb = B // n_micro
+        x_all = llama._embed({"embed": embed}, cfg, tokens)
+        H = x_all.shape[-1]
+        x_micro = x_all.reshape(n_micro, mb, T, H)
+        positions = jnp.broadcast_to(jnp.arange(T), (mb, T))
+
+        def run_local(x):
+            def body(carry, lp):
+                out, _ = llama._layer_body(cfg, rope, positions, None,
+                                           carry, lp, None)
+                return out, None
+            y, _ = jax.lax.scan(body, x, layers_local)
+            return y
+
+        n_steps = n_micro + n_stages - 1
+        outputs0 = jnp.zeros((n_micro, mb, T, H), x_all.dtype)
+
+        def step(carry, t):
+            prev_out, outputs = carry
+            # hop the previous step's output one stage forward
+            recv = jax.lax.ppermute(
+                prev_out, "pp",
+                [(i, i + 1) for i in range(n_stages - 1)])
+            feed = jnp.where(
+                (t < n_micro),
+                jax.lax.dynamic_index_in_dim(
+                    x_micro, jnp.minimum(t, n_micro - 1), keepdims=False),
+                jnp.zeros((mb, T, H), x_all.dtype))
+            x_in = jnp.where(stage == 0, feed, recv)
+            y = run_local(x_in)
+            # last stage banks microbatch t - (P-1) once it emerges
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+            bank = (t >= n_stages - 1) & (stage == n_stages - 1)
+            cur = jax.lax.dynamic_index_in_dim(outputs, out_idx,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(bank, y, cur), out_idx, axis=0)
+            return (y, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            step, (jnp.zeros((mb, T, H), x_all.dtype), outputs0),
+            jnp.arange(n_steps))
+
+        # loss on the last stage only; psum broadcasts it to all
+        x = outputs.reshape(B, T, H)
+        x = rms_norm(x, final_norm, cfg.rms_norm_eps,
+                     offset=1.0 if cfg.rms_norm_offset else 0.0)
+        logits = llama._lm_head(
+            {"embed": embed, **({"lm_head": head} if head is not None
+                                else {})}, cfg, x)
+        local = jnp.where(stage == n_stages - 1,
+                          nll_from_logits(logits, tokens), 0.0)
+        return jax.lax.psum(local, "pp")
+
+    def loss_fn(params_staged, tokens):
+        layer_specs = jax.tree.map(lambda _: P("pp"),
+                                   params_staged["layers"])
+        extra = (P(), P()) if has_head else (P(),)
+        fn = shard_map(
+            per_stage, mesh=mesh,
+            in_specs=(layer_specs, P(), P()) + extra,
+            out_specs=P(),
+            check_vma=False)
+        args = [params_staged["layers"], params_staged["embed"],
+                params_staged["final_norm"]]
+        if has_head:
+            args.append(params_staged["lm_head"])
+        args.append(tokens)
+        return fn(*args)
+
+    return loss_fn

@@ -54,7 +54,8 @@ def _qspec(leaf: Any, spec: P, per_row: bool = False) -> Any:
     {"w8", "scale"} dicts): w8 keeps the weight's spec; scale drops the
     reduced axis — the in axis (-2) for per-output-channel weights, the
     last axis for the per-row embed table."""
-    if not (isinstance(leaf, dict) and "w8" in leaf):
+    from production_stack_tpu.models.quant import is_quantized
+    if not is_quantized(leaf):
         return spec
     dims = tuple(spec)
     scale_spec = P(*dims[:-1]) if per_row else P(*dims[:-2], dims[-1])

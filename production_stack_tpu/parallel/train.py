@@ -31,15 +31,22 @@ def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
     return optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(lr))
 
 
+def nll_from_logits(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token cross entropy. logits [B,T,V], tokens [B,T].
+    The single definition shared by the plain and pipelined
+    (parallel/pipeline.py) losses — their parity tests depend on it."""
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
 def loss_fn(params, cfg: ModelConfig, tokens: jnp.ndarray,
             attention_fn=None) -> jnp.ndarray:
     """Next-token cross entropy; tokens [B,T] (fp32 logits internally)."""
     logits = llama.forward_train(params, cfg, tokens,
                                  attention_fn=attention_fn)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return nll_from_logits(logits, tokens)
 
 
 def train_step(state: TrainState, tokens: jnp.ndarray, cfg: ModelConfig,
