@@ -38,6 +38,8 @@ class StepOutput:
     text_delta: str
     finished: bool
     finish_reason: Optional[str]
+    # chosen token's log p under the raw model distribution (runner)
+    logprob: Optional[float] = None
 
 
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
@@ -291,9 +293,10 @@ class LLMEngine:
                 lengths[slot] = len(w.chunk)
                 kv_need = max(kv_need, w.start + bucket)
             kv_len = self.cfg.kv_bucket_for(min(kv_need, S))
-            ids_dev = self.runner.prefill(tokens, starts, lengths,
-                                          self._dev_sampling, kv_len)
-            ids = None
+            ids_dev, lps_dev = self.runner.prefill(tokens, starts, lengths,
+                                                   self._dev_sampling,
+                                                   kv_len)
+            ids = lps = None
             for w in group:
                 self.scheduler.on_prefill_done(w)
                 self.metrics.prompt_tokens.inc(len(w.chunk))
@@ -306,13 +309,15 @@ class LLMEngine:
                     continue
                 if ids is None:
                     ids = np.asarray(ids_dev)  # one sync per bucket group
+                    lps = np.asarray(lps_dev)
                 # prompt fully prefilled: the sampled id is the first
                 # output token
                 seq = w.seq
                 seq.first_token_time = time.monotonic()
                 self.metrics.ttft.observe(
                     seq.first_token_time - seq.arrival_time)
-                outputs.extend(self._accept_token(seq, int(ids[seq.slot])))
+                outputs.extend(self._accept_token(
+                    seq, int(ids[seq.slot]), float(lps[seq.slot])))
         # prefill changed slot contents/positions: refresh decode carry
         self._decode_dirty = True
         return outputs
@@ -337,9 +342,10 @@ class LLMEngine:
         if self._decode_dirty:
             self.runner.set_decode_state(self._slot_token, self._slot_pos)
             self._decode_dirty = False
-        ids_dev = self.runner.decode(self._dev_sampling, steps=W,
-                                     kv_len=kv_len, greedy=greedy)
-        self._inflight = (ids_dev, W, list(decode_seqs), time.monotonic())
+        ids_dev, lps_dev = self.runner.decode(self._dev_sampling, steps=W,
+                                              kv_len=kv_len, greedy=greedy)
+        self._inflight = (ids_dev, lps_dev, W, list(decode_seqs),
+                          time.monotonic())
 
     def _drain_decode(self) -> List[StepOutput]:
         """Sync + process the in-flight window, if any. A sequence that
@@ -347,9 +353,10 @@ class LLMEngine:
         (its slot is parked and the decode carry marked dirty)."""
         if self._inflight is None:
             return []
-        ids_dev, W, seqs, t0 = self._inflight
+        ids_dev, lps_dev, W, seqs, t0 = self._inflight
         self._inflight = None
         ids = np.asarray(ids_dev)  # [B, W] — the window's single sync
+        lps = np.asarray(lps_dev)
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
@@ -357,7 +364,8 @@ class LLMEngine:
             still = []
             for seq in alive:
                 self.metrics.per_token.observe(dt / W)
-                outs = self._accept_token(seq, int(ids[seq.slot, j]))
+                outs = self._accept_token(seq, int(ids[seq.slot, j]),
+                                          float(lps[seq.slot, j]))
                 outputs.extend(outs)
                 if not outs[-1].finished:
                     still.append(seq)
@@ -366,8 +374,10 @@ class LLMEngine:
                 break
         return outputs
 
-    def _accept_token(self, seq: Sequence, token: int) -> List[StepOutput]:
+    def _accept_token(self, seq: Sequence, token: int,
+                      logprob: Optional[float] = None) -> List[StepOutput]:
         seq.output_tokens.append(token)
+        seq.output_logprobs.append(logprob)
         self.metrics.generation_tokens.inc()
         delta = seq.detok.push(token)
         seq.output_text += delta
@@ -393,9 +403,11 @@ class LLMEngine:
             self._remember(seq)
             self.metrics.e2e_latency.observe(
                 time.monotonic() - seq.arrival_time)
-            return [StepOutput(seq.seq_id, token, text_delta, True, reason)]
+            return [StepOutput(seq.seq_id, token, text_delta, True, reason,
+                               logprob)]
         self._sync_slot(seq)
-        return [StepOutput(seq.seq_id, token, text_delta, False, None)]
+        return [StepOutput(seq.seq_id, token, text_delta, False, None,
+                           logprob)]
 
     def _stop_reason(self, seq: Sequence, token: int,
                      delta: str) -> Optional[str]:

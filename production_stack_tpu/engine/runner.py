@@ -138,8 +138,8 @@ class ModelRunner:
                      positions: jnp.ndarray, sampling: SamplingParams,
                      key: jax.Array, *, steps: int, kv_len: int,
                      greedy: bool):
-        """tokens/positions [B] -> (ids [B, steps], tokens', positions',
-        cache').
+        """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
+        tokens', positions', cache').
 
         `steps` forwards are fused via lax.scan; each step feeds its
         sampled ids back as the next step's tokens, and the final
@@ -149,6 +149,11 @@ class ModelRunner:
         rewritten before any query can attend to it); attention reads
         only cache[:, :kv_len]. Host guarantees every live position
         stays < kv_len for the whole window.
+
+        logprobs are the chosen tokens' log p under the raw (pre-
+        temperature) model distribution — one [B, V] log_softmax per
+        step, noise next to the weight streaming, so they're always
+        computed rather than forking the executable cache.
         """
         def body(carry, i):
             cache, toks, pos = carry
@@ -162,11 +167,14 @@ class ModelRunner:
                 ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
             else:
                 ids = sample(last, sampling, jax.random.fold_in(key, i))
-            return (cache, ids, pos + 1), ids
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(last, axis=-1), ids[:, None],
+                axis=-1)[:, 0]
+            return (cache, ids, pos + 1), (ids, lp)
 
-        (cache, toks, pos), ids = jax.lax.scan(
+        (cache, toks, pos), (ids, lps) = jax.lax.scan(
             body, (cache, tokens, positions), jnp.arange(steps))
-        return ids.T, toks, pos, cache  # ids [B, steps]
+        return ids.T, lps.T, toks, pos, cache  # ids/lps [B, steps]
 
     def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                       starts: jnp.ndarray, lengths: jnp.ndarray,
@@ -179,7 +187,8 @@ class ModelRunner:
         which no live query can attend — see models/kv.py). Attention
         reads cache[:, :kv_len]; host guarantees start + Tb <= kv_len
         for every participating row (or kv_len == S).
-        Returns (sampled id of each row's last real token [B], cache').
+        Returns (sampled id of each row's last real token [B], its
+        logprob [B], cache').
         """
         Tb = tokens.shape[1]
         positions = starts[:, None] + jnp.arange(Tb)[None, :]
@@ -196,7 +205,9 @@ class ModelRunner:
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
         ids = sample(last, sampling, key)
-        return ids, cache
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(last, axis=-1), ids[:, None], axis=-1)[:, 0]
+        return ids, lp, cache
 
     # ------------------------------------------------------------------
     # host API
@@ -215,8 +226,8 @@ class ModelRunner:
                kv_len: Optional[int] = None, greedy: bool = False):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
-        ids [B, steps] (np-convertible; that np.asarray() is the
-        window's single sync)."""
+        (ids, logprobs), each [B, steps] (np-convertible; the first
+        np.asarray() is the window's single sync)."""
         kv_len = kv_len or self.engine_cfg.max_model_len
         fn = self._decode_fns.get((steps, kv_len, greedy))
         if fn is None:
@@ -227,15 +238,16 @@ class ModelRunner:
                         greedy=greedy),
                 donate_argnums=(1,))
             self._decode_fns[(steps, kv_len, greedy)] = fn
-        ids, self._dec_tokens, self._dec_pos, self.cache = fn(
+        ids, lps, self._dec_tokens, self._dec_pos, self.cache = fn(
             self.params, self.cache, self._dec_tokens, self._dec_pos,
             sampling, self._next_key())
-        return ids
+        return ids, lps
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int):
         """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
-        int32 np; starts/lengths [B]. Returns device ids [B].
+        int32 np; starts/lengths [B]. Returns device (ids, logprobs),
+        each [B].
 
         Prefill executables compile lazily per (chunk, kv bucket); if the
         pallas flash kernel fails to BUILD for a combination (backend or
@@ -265,8 +277,8 @@ class ModelRunner:
                 pallas_attention.set_flash_enabled(False)
                 self._prefill_fns.clear()
                 fn = self._compile_prefill(Tb, kv_len, args)
-        ids, self.cache = fn(*args)
-        return ids
+        ids, lps, self.cache = fn(*args)
+        return ids, lps
 
     def _compile_prefill(self, Tb: int, kv_len: int, args):
         logger.info("compiling prefill (chunk=%d kv=%d)", Tb, kv_len)

@@ -50,6 +50,8 @@ class Sequence:
     slot: int = -1
     adapter_id: int = 0      # LoRA adapter (0 = base model, models/lora.py)
     output_tokens: List[int] = field(default_factory=list)
+    # per output token: chosen-token logprob (raw model distribution)
+    output_logprobs: List[Optional[float]] = field(default_factory=list)
     num_prefilled: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

@@ -73,6 +73,41 @@ async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
 
 # ---------------------------------------------------------------- handlers
 
+def _lp_skip(out) -> bool:
+    """OpenAI alignment: a token that STOPPED the sequence (EOS / stop
+    token / stop string) is excluded from the returned text, so it gets
+    no logprobs entry either. (Earlier tokens of a multi-token stop
+    string were already emitted before the match — a known, bounded
+    deviation.) Length-finished tokens are real content and stay."""
+    return out.finished and out.finish_reason == "stop"
+
+
+def _chat_lp_entry(tok, token_id: int, logprob, want_top: bool):
+    """One chat-logprobs content entry. The engine tracks the CHOSEN
+    token's logprob (raw model distribution, engine/runner.py); when
+    top_logprobs is requested, that chosen entry is the one alternative
+    reported. Token text/bytes come from the tokenizer's own token
+    representation so multi-byte-split pieces stay distinct."""
+    text, raw = tok.id_to_token(token_id)
+    lp = logprob if logprob is not None else 0.0
+    entry = proto.ChatLogprobToken(token=text, logprob=lp, bytes=raw)
+    if want_top:
+        entry.top_logprobs = [proto.ChatLogprobTop(token=text, logprob=lp,
+                                                   bytes=raw)]
+    return entry
+
+
+def _completion_logprobs(tok, token_ids, logprobs,
+                         want_top: bool) -> "proto.CompletionLogprobs":
+    """Legacy completions logprobs block from chosen-token data."""
+    texts = [tok.id_to_token(t)[0] for t in token_ids]
+    lps = [lp if lp is not None else 0.0 for lp in logprobs]
+    top = ([{text: lp} for text, lp in zip(texts, lps)]
+           if want_top else None)
+    return proto.CompletionLogprobs(tokens=texts, token_logprobs=lps,
+                                    top_logprobs=top)
+
+
 async def chat_completions(request: web.Request) -> web.StreamResponse:
     engine = request.app[ENGINE_KEY]
     try:
@@ -119,14 +154,25 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 async for out in it:
                     if out.new_token is not None:
                         num_tokens += 1
-                    if out.text_delta or out.finished:
+                    lp_block = None
+                    if (req.logprobs and out.new_token is not None
+                            and not _lp_skip(out)):
+                        lp_block = proto.ChatLogprobs(content=[
+                            _chat_lp_entry(tok, out.new_token,
+                                           out.logprob,
+                                           bool(req.top_logprobs))])
+                    # a token can produce no text yet (partial UTF-8 in
+                    # the detokenizer) — its logprob entry must still
+                    # be delivered
+                    if out.text_delta or out.finished or lp_block:
                         chunk = proto.ChatCompletionChunk(
                             id=rid, model=req.model,
                             choices=[proto.ChatCompletionChunkChoice(
                                 delta=proto.DeltaMessage(
                                     content=out.text_delta or None),
                                 finish_reason=out.finish_reason if out.finished
-                                else None)])
+                                else None,
+                                logprobs=lp_block)])
                         yield chunk.model_dump_json(exclude=exclude)
             if include_usage:
                 # OpenAI semantics: one final chunk, empty choices, usage
@@ -140,6 +186,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         return await _sse_stream(request, gen())
 
     parts: List[str] = []
+    lp_entries: List = []
     num_tokens = 0
     finish_reason = None
     async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
@@ -147,6 +194,10 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             parts.append(out.text_delta)
             if out.new_token is not None:
                 num_tokens += 1
+                if req.logprobs and not _lp_skip(out):
+                    lp_entries.append(_chat_lp_entry(
+                        tok, out.new_token, out.logprob,
+                        bool(req.top_logprobs)))
             if out.finished:
                 finish_reason = out.finish_reason
     text = "".join(parts)
@@ -154,7 +205,9 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         id=rid, model=req.model,
         choices=[proto.ChatCompletionChoice(
             message=proto.ChatChoiceMessage(content=text),
-            finish_reason=finish_reason)],
+            finish_reason=finish_reason,
+            logprobs=(proto.ChatLogprobs(content=lp_entries)
+                      if req.logprobs else None))],
         usage=proto.UsageInfo(
             prompt_tokens=len(prompt_ids),
             completion_tokens=num_tokens,
@@ -204,13 +257,21 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 async for out in it:
                     if out.new_token is not None:
                         num_tokens += 1
-                    if out.text_delta or out.finished:
+                    lp_block = None
+                    if (req.logprobs is not None
+                            and out.new_token is not None
+                            and not _lp_skip(out)):
+                        lp_block = _completion_logprobs(
+                            tok, [out.new_token], [out.logprob],
+                            req.logprobs > 0)
+                    if out.text_delta or out.finished or lp_block:
                         chunk = proto.CompletionChunk(
                             id=rid, model=req.model,
                             choices=[proto.CompletionChunkChoice(
                                 text=out.text_delta,
                                 finish_reason=out.finish_reason if out.finished
-                                else None)])
+                                else None,
+                                logprobs=lp_block)])
                         yield chunk.model_dump_json(exclude=exclude)
             if include_usage:
                 tail = proto.CompletionChunk(
@@ -223,6 +284,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
         return await _sse_stream(request, gen())
 
     parts: List[str] = []
+    out_ids: List[int] = []
+    out_lps: List = []
     num_tokens = 0
     finish_reason = None
     async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
@@ -230,12 +293,18 @@ async def completions(request: web.Request) -> web.StreamResponse:
             parts.append(out.text_delta)
             if out.new_token is not None:
                 num_tokens += 1
+                if not _lp_skip(out):
+                    out_ids.append(out.new_token)
+                    out_lps.append(out.logprob)
             if out.finished:
                 finish_reason = out.finish_reason
     resp = proto.CompletionResponse(
         id=rid, model=req.model,
-        choices=[proto.CompletionChoice(text="".join(parts),
-                                        finish_reason=finish_reason)],
+        choices=[proto.CompletionChoice(
+            text="".join(parts), finish_reason=finish_reason,
+            logprobs=(_completion_logprobs(tok, out_ids, out_lps,
+                                           req.logprobs > 0)
+                      if req.logprobs is not None else None))],
         usage=proto.UsageInfo(
             prompt_tokens=len(prompt_ids), completion_tokens=num_tokens,
             total_tokens=len(prompt_ids) + num_tokens))

@@ -28,6 +28,16 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if i < 256).decode("utf-8", errors="replace")
 
+    def id_to_token(self, token_id: int):
+        """(token string, raw bytes) for logprobs reporting — byte ids
+        keep their exact byte so clients can reassemble split UTF-8."""
+        if token_id < 256:
+            raw = bytes([token_id])
+            return raw.decode("utf-8", errors="replace"), list(raw)
+        name = {BOS_ID: "<bos>", EOS_ID: "<eos>", PAD_ID: "<pad>"}.get(
+            token_id, f"<unk:{token_id}>")
+        return name, list(name.encode("utf-8"))
+
     def apply_chat_template(self, messages: List[dict]) -> str:
         parts = [f"<|{m.get('role', 'user')}|>\n{_content_text(m)}\n"
                  for m in messages]
@@ -52,6 +62,22 @@ class HFTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(ids, skip_special_tokens=True)
+
+    def id_to_token(self, token_id: int):
+        """(token string, raw bytes) for logprobs reporting. Uses the
+        tokenizer's own token representation (convert_ids_to_tokens),
+        NOT decode([id]) — decoding a multi-byte-split piece in
+        isolation collapses distinct tokens to the replacement char and
+        loses the bytes clients need to reassemble UTF-8."""
+        piece = self._tok.convert_ids_to_tokens(token_id)
+        if piece is None:
+            piece = f"<unk:{token_id}>"
+        try:
+            raw = self._tok.convert_tokens_to_string([piece]).encode(
+                "utf-8")
+        except Exception:
+            raw = piece.encode("utf-8")
+        return piece, list(raw)
 
     def apply_chat_template(self, messages: List[dict]) -> str:
         if getattr(self._tok, "chat_template", None):

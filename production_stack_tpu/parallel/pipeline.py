@@ -61,12 +61,11 @@ def stage_params(params: Dict[str, Any], n_stages: int) -> Dict[str, Any]:
 def stage_shardings(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
     """Stage-axis sharding for stage_params output: layers over 'pp',
     everything else replicated."""
-    def spec(path_leaf):
-        return NamedSharding(mesh, P("pp"))
-    reps = NamedSharding(mesh, P())
+    staged = NamedSharding(mesh, P("pp"))
+    replicated = NamedSharding(mesh, P())
     return {
-        name: (jax.tree.map(lambda _: spec(_), leaf) if name == "layers"
-               else jax.tree.map(lambda _: reps, leaf))
+        name: jax.tree.map(
+            lambda _: staged if name == "layers" else replicated, leaf)
         for name, leaf in params.items()
     }
 

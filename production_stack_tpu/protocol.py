@@ -41,6 +41,7 @@ class CompletionRequest(OpenAIBase):
     stop_token_ids: Optional[List[int]] = None  # vLLM extension
     ignore_eos: bool = False            # vLLM extension
     echo: bool = False
+    logprobs: Optional[int] = None      # legacy: N requests logprobs
     seed: Optional[int] = None
     user: Optional[str] = None
 
@@ -68,6 +69,8 @@ class ChatCompletionRequest(OpenAIBase):
     stop: Optional[Union[str, List[str]]] = None
     stop_token_ids: Optional[List[int]] = None
     ignore_eos: bool = False
+    logprobs: Optional[bool] = False
+    top_logprobs: Optional[int] = None
     seed: Optional[int] = None
     user: Optional[str] = None
 
@@ -80,11 +83,22 @@ class UsageInfo(OpenAIBase):
     total_tokens: int = 0
 
 
+class CompletionLogprobs(OpenAIBase):
+    """Legacy completions logprobs block. Only the chosen token's
+    logprob is tracked by the engine (raw model distribution); the
+    top-N alternatives of the legacy API are not retained, so
+    top_logprobs carries just the chosen token's entry per position."""
+    tokens: List[str] = Field(default_factory=list)
+    token_logprobs: List[Optional[float]] = Field(default_factory=list)
+    top_logprobs: Optional[List[Optional[Dict[str, float]]]] = None
+    text_offset: Optional[List[int]] = None
+
+
 class CompletionChoice(OpenAIBase):
     index: int = 0
     text: str = ""
     finish_reason: Optional[str] = None
-    logprobs: Optional[Any] = None
+    logprobs: Optional[CompletionLogprobs] = None
 
 
 class CompletionResponse(OpenAIBase):
@@ -101,10 +115,28 @@ class ChatChoiceMessage(OpenAIBase):
     content: Optional[str] = None
 
 
+class ChatLogprobTop(OpenAIBase):
+    token: str = ""
+    logprob: float = 0.0
+    bytes: Optional[List[int]] = None
+
+
+class ChatLogprobToken(OpenAIBase):
+    token: str = ""
+    logprob: float = 0.0
+    bytes: Optional[List[int]] = None
+    top_logprobs: List[ChatLogprobTop] = Field(default_factory=list)
+
+
+class ChatLogprobs(OpenAIBase):
+    content: Optional[List[ChatLogprobToken]] = None
+
+
 class ChatCompletionChoice(OpenAIBase):
     index: int = 0
     message: ChatChoiceMessage = Field(default_factory=ChatChoiceMessage)
     finish_reason: Optional[str] = None
+    logprobs: Optional[ChatLogprobs] = None
 
 
 class ChatCompletionResponse(OpenAIBase):
@@ -125,6 +157,7 @@ class ChatCompletionChunkChoice(OpenAIBase):
     index: int = 0
     delta: DeltaMessage = Field(default_factory=DeltaMessage)
     finish_reason: Optional[str] = None
+    logprobs: Optional[ChatLogprobs] = None
 
 
 class ChatCompletionChunk(OpenAIBase):
@@ -141,6 +174,7 @@ class CompletionChunkChoice(OpenAIBase):
     index: int = 0
     text: str = ""
     finish_reason: Optional[str] = None
+    logprobs: Optional[CompletionLogprobs] = None
 
 
 class CompletionChunk(OpenAIBase):

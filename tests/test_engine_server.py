@@ -139,3 +139,139 @@ def test_metrics_exposition(engine):
                      "vllm:time_to_first_token_seconds"):
             assert name in text, f"missing metric {name}"
     _with_client(engine, body)
+
+
+def test_chat_logprobs(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "lp"}],
+            "max_tokens": 4, "temperature": 0.0,
+            "logprobs": True, "top_logprobs": 1})
+        assert r.status == 200
+        content = (await r.json())["choices"][0]["logprobs"]["content"]
+        assert len(content) == 4
+        for entry in content:
+            assert entry["logprob"] <= 0.0
+            assert isinstance(entry["token"], str)
+            assert entry["top_logprobs"][0]["logprob"] == entry["logprob"]
+        # without the flag the field is null
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "lp"}],
+            "max_tokens": 2, "temperature": 0.0})
+        assert (await r.json())["choices"][0]["logprobs"] is None
+    _with_client(engine, body)
+
+
+def test_chat_logprobs_stream(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "lp"}],
+            "max_tokens": 3, "temperature": 0.0,
+            "stream": True, "logprobs": True})
+        assert r.status == 200
+        text = await r.text()
+        got = []
+        for line in text.splitlines():
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunk = json.loads(line[6:])
+                for c in chunk.get("choices", []):
+                    if c.get("logprobs"):
+                        got.extend(c["logprobs"]["content"])
+        assert len(got) == 3
+        assert all(e["logprob"] <= 0.0 for e in got)
+    _with_client(engine, body)
+
+
+def test_completions_logprobs(engine):
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "legacy lp",
+            "max_tokens": 4, "temperature": 0.0, "logprobs": 1})
+        assert r.status == 200
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["tokens"]) == 4 and len(lp["token_logprobs"]) == 4
+        assert all(v <= 0.0 for v in lp["token_logprobs"])
+        assert len(lp["top_logprobs"]) == 4
+        # logprobs=0: token logprobs, no alternatives
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "legacy lp",
+            "max_tokens": 2, "temperature": 0.0, "logprobs": 0})
+        lp = (await r.json())["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 2
+        assert lp["top_logprobs"] is None
+    _with_client(engine, body)
+
+
+def test_greedy_logprob_is_max(engine):
+    """Greedy decode: every chosen token is the argmax, so its logprob
+    must be the distribution's max — cross-checked against a direct
+    forward pass on the same prompt."""
+    import numpy as np
+    import jax.numpy as jnp
+    from production_stack_tpu.models import llama
+
+    eng = engine.engine
+    seq_ids = eng.tokenizer.encode("probe")
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    opts = SamplingOptions(temperature=0.0, max_tokens=3, ignore_eos=True)
+    sid = eng.add_request(list(seq_ids), opts)
+    done = False
+    while not done:
+        for out in eng.step():
+            if out.seq_id == sid and out.finished:
+                done = True
+    seq = eng.seqs[sid]
+    assert len(seq.output_logprobs) == 3
+    # recompute: forward over prompt + outputs, compare chosen logprob
+    cfg = eng.model_cfg
+    toks = list(seq_ids) + seq.output_tokens
+    logits = llama.forward_train(eng.runner.params, cfg,
+                                 jnp.asarray([toks]))
+    full = np.asarray(logits)
+    for i, (tok_id, lp) in enumerate(zip(seq.output_tokens,
+                                         seq.output_logprobs)):
+        pos = len(seq_ids) - 1 + i
+        row = full[0, pos]
+        expect = row[tok_id] - (np.log(np.exp(row - row.max()).sum())
+                                + row.max())
+        assert abs(lp - expect) < 5e-2, (i, lp, expect)
+        assert tok_id == int(row.argmax())
+
+
+def test_stop_token_excluded_from_logprobs(engine):
+    """A token that stopped the sequence is excluded from content, so it
+    gets no logprobs entry (OpenAI alignment)."""
+    async def body(client):
+        # learn the greedy first token for this prompt
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "stop probe",
+            "max_tokens": 1, "temperature": 0.0, "logprobs": 0})
+        first = (await r.json())["choices"][0]["logprobs"]["tokens"]
+        assert len(first) == 1
+        # re-run with that token as a stop token: finishes immediately
+        # with reason=stop and an EMPTY logprobs block
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "stop probe"}],
+            "max_tokens": 4, "temperature": 0.0, "logprobs": True,
+            "stop_token_ids": []})
+        base = (await r.json())["choices"][0]
+        tok_ids = engine.engine.seqs[
+            list(engine.engine.seqs)[-1]].output_tokens
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "stop probe"}],
+            "max_tokens": 4, "temperature": 0.0, "logprobs": True,
+            "stop_token_ids": [tok_ids[-1]]})
+        data = (await r.json())["choices"][0]
+        assert data["finish_reason"] == "stop"
+        stopped = data["logprobs"]["content"]
+        # generation halts at the FIRST occurrence of the stop token;
+        # that token is absent from logprobs, earlier ones keep entries
+        expected = tok_ids.index(tok_ids[-1])
+        assert len(stopped) == expected
+        assert stopped == base["logprobs"]["content"][:expected]
+    _with_client(engine, body)
