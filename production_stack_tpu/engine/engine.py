@@ -162,6 +162,7 @@ class LLMEngine:
         self._slot_top_p = np.ones((B,), np.float32)
         self._slot_top_k = np.zeros((B,), np.int32)
         self._slot_adapter = np.zeros((B,), np.int32)
+        self._slot_seed = np.zeros((B,), np.int32)
         # device-resident sampling params, re-uploaded only when a slot's
         # options change (admission/finish), never per decode window
         self._dev_sampling = None
@@ -328,7 +329,8 @@ class LLMEngine:
                 temperature=jnp.asarray(self._slot_temp),
                 top_p=jnp.asarray(self._slot_top_p),
                 top_k=jnp.asarray(self._slot_top_k),
-                adapter=jnp.asarray(self._slot_adapter))
+                adapter=jnp.asarray(self._slot_adapter),
+                seed=jnp.asarray(self._slot_seed))
             self._sampling_dirty = False
 
     def _dispatch_decode(self, decode_seqs) -> None:
@@ -342,8 +344,10 @@ class LLMEngine:
         if self._decode_dirty:
             self.runner.set_decode_state(self._slot_token, self._slot_pos)
             self._decode_dirty = False
+        seeded = any(s.options.seed is not None for s in decode_seqs)
         ids_dev, lps_dev = self.runner.decode(self._dev_sampling, steps=W,
-                                              kv_len=kv_len, greedy=greedy)
+                                              kv_len=kv_len, greedy=greedy,
+                                              seeded=seeded)
         self._inflight = (ids_dev, lps_dev, W, list(decode_seqs),
                           time.monotonic())
 
@@ -380,6 +384,15 @@ class LLMEngine:
         seq.output_logprobs.append(logprob)
         self.metrics.generation_tokens.inc()
         delta = seq.detok.push(token)
+        opt = seq.options
+        if (token in opt.stop_token_ids
+                or (not opt.ignore_eos
+                    and token == self.tokenizer.eos_token_id)):
+            # a token that stops the sequence is excluded from the
+            # returned text (vLLM semantics) — this keeps the text
+            # aligned with logprobs (server._lp_skip). Any earlier
+            # bytes the detokenizer was still buffering drop with it.
+            delta = ""
         seq.output_text += delta
         reason = self._stop_reason(seq, token, delta)
         if reason is not None and reason != "stop":
@@ -449,14 +462,20 @@ class LLMEngine:
 
     def _sync_sampling(self, seq: Sequence) -> None:
         slot, opt = seq.slot, seq.options
+        # normalize the user seed (any int, 0 and negatives included)
+        # into a nonzero int32: 0 stays the "unseeded" sentinel only for
+        # requests that sent no seed at all
+        seed = 0 if opt.seed is None else (opt.seed % 0x7FFFFFFE) + 1
         if (self._slot_temp[slot] != opt.temperature
                 or self._slot_top_p[slot] != opt.top_p
                 or self._slot_top_k[slot] != opt.top_k
-                or self._slot_adapter[slot] != seq.adapter_id):
+                or self._slot_adapter[slot] != seq.adapter_id
+                or self._slot_seed[slot] != seed):
             self._slot_temp[slot] = opt.temperature
             self._slot_top_p[slot] = opt.top_p
             self._slot_top_k[slot] = opt.top_k
             self._slot_adapter[slot] = seq.adapter_id
+            self._slot_seed[slot] = seed
             self._sampling_dirty = True
 
     def _park_slot(self, slot: int) -> None:

@@ -120,7 +120,7 @@ class ModelRunner:
         self._dec_tokens = None
         self._dec_pos = None
 
-        # executable caches: decode keyed (steps, kv_len, greedy),
+        # executable caches: decode keyed (steps, kv_len, greedy, seeded),
         # prefill keyed (chunk bucket, kv bucket)
         self._decode_fns = {}
         self._prefill_fns = {}
@@ -137,7 +137,7 @@ class ModelRunner:
     def _decode_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                      positions: jnp.ndarray, sampling: SamplingParams,
                      key: jax.Array, *, steps: int, kv_len: int,
-                     greedy: bool):
+                     greedy: bool, seeded: bool = False):
         """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
         tokens', positions', cache').
 
@@ -166,7 +166,12 @@ class ModelRunner:
             if greedy:
                 ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
             else:
-                ids = sample(last, sampling, jax.random.fold_in(key, i))
+                # pos is the input token's position; the sampled token
+                # lands at pos + 1 — the deterministic per-seed index.
+                # seeded forks the executable so all-unseeded batches
+                # skip the per-row PRNG work entirely
+                ids = sample(last, sampling, jax.random.fold_in(key, i),
+                             positions=pos + 1 if seeded else None)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(last, axis=-1), ids[:, None],
                 axis=-1)[:, 0]
@@ -204,7 +209,8 @@ class ModelRunner:
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
-        ids = sample(last, sampling, key)
+        ids = sample(last, sampling, key,
+                     positions=starts + jnp.maximum(lengths, 1))
         lp = jnp.take_along_axis(
             jax.nn.log_softmax(last, axis=-1), ids[:, None], axis=-1)[:, 0]
         return ids, lp, cache
@@ -223,21 +229,24 @@ class ModelRunner:
         self._dec_pos = jnp.asarray(positions, jnp.int32)
 
     def decode(self, sampling: SamplingParams, steps: int = 1,
-               kv_len: Optional[int] = None, greedy: bool = False):
+               kv_len: Optional[int] = None, greedy: bool = False,
+               seeded: bool = False):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
         (ids, logprobs), each [B, steps] (np-convertible; the first
         np.asarray() is the window's single sync)."""
         kv_len = kv_len or self.engine_cfg.max_model_len
-        fn = self._decode_fns.get((steps, kv_len, greedy))
+        seeded = seeded and not greedy
+        fn = self._decode_fns.get((steps, kv_len, greedy, seeded))
         if fn is None:
-            logger.info("compiling decode window (steps=%d kv=%d greedy=%s)",
-                        steps, kv_len, greedy)
+            logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
+                        "%s)", steps, kv_len, greedy,
+                        " seeded" if seeded else "")
             fn = jax.jit(
                 partial(self._decode_impl, steps=steps, kv_len=kv_len,
-                        greedy=greedy),
+                        greedy=greedy, seeded=seeded),
                 donate_argnums=(1,))
-            self._decode_fns[(steps, kv_len, greedy)] = fn
+            self._decode_fns[(steps, kv_len, greedy, seeded)] = fn
         ids, lps, self._dec_tokens, self._dec_pos, self.cache = fn(
             self.params, self.cache, self._dec_tokens, self._dec_pos,
             sampling, self._next_key())

@@ -29,20 +29,35 @@ class SamplingParams(NamedTuple):
     top_p: jnp.ndarray        # fp32 in (0, 1]
     top_k: jnp.ndarray        # int32; 0 => disabled
     adapter: jnp.ndarray      # int32 adapter id; 0 => base model
+    seed: jnp.ndarray         # int32; 0 => unseeded (engine key stream)
 
     @staticmethod
-    def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0, adapter=0):
+    def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0, adapter=0,
+               seed=0):
         return SamplingParams(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
             adapter=jnp.full((batch,), adapter, jnp.int32),
+            seed=jnp.full((batch,), seed, jnp.int32),
         )
 
 
 def sample(logits: jnp.ndarray, params: SamplingParams,
-           key: jax.Array) -> jnp.ndarray:
-    """logits fp32 [B,V] -> token ids int32 [B]."""
+           key: jax.Array,
+           positions: jnp.ndarray = None) -> jnp.ndarray:
+    """logits fp32 [B,V] -> token ids int32 [B].
+
+    positions [B]: absolute position of the token being sampled. Rows
+    with params.seed > 0 draw their gumbel noise from a key derived
+    ONLY from (seed, position) — the same seeded request reproduces the
+    same tokens whatever else shares the batch or how the engine's key
+    stream has advanced. seed == 0 rows use the engine key stream (the
+    engine normalizes user seeds, 0/negative included, to nonzero —
+    engine.py _sync_sampling). Pass positions=None to skip the seeded
+    branch entirely (the decode hot loop does when no live row is
+    seeded, engine.py _dispatch_decode).
+    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -71,6 +86,12 @@ def sample(logits: jnp.ndarray, params: SamplingParams,
     masked = jnp.where(scaled >= threshold, scaled, _NEG_INF)
 
     gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
+    if positions is not None:
+        def row_noise(seed, pos):
+            k = jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+            return jax.random.gumbel(k, (V,), jnp.float32)
+        seeded = jax.vmap(row_noise)(params.seed, positions)
+        gumbel = jnp.where((params.seed > 0)[:, None], seeded, gumbel)
     sampled = jnp.argmax(masked + gumbel, axis=-1)
 
     return jnp.where(params.temperature <= _EPS, greedy, sampled).astype(

@@ -39,6 +39,9 @@ class SamplingOptions:
     stop_token_ids: List[int] = field(default_factory=list)
     ignore_eos: bool = False
     logprobs: bool = False
+    # > 0: reproducible sampling — gumbel noise derived from
+    # (seed, token position) only (engine/sampler.py)
+    seed: Optional[int] = None
 
 
 @dataclass

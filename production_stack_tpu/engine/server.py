@@ -50,7 +50,57 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         stop=stop,
         stop_token_ids=req.stop_token_ids or [],
         ignore_eos=req.ignore_eos,
+        seed=req.seed,
     )
+
+
+def _choice_options(options, i: int):
+    """Per-choice SamplingOptions: a seeded request varies the seed by
+    choice index, otherwise n identical seeds would return n identical
+    completions (noise depends only on (seed, position))."""
+    if i == 0 or options.seed is None:
+        return options
+    import dataclasses
+    return dataclasses.replace(options, seed=options.seed + i)
+
+
+def _merged_streams(engine, prompt_ids, options, model, n):
+    """Run n independent generations concurrently and yield
+    (choice_index, StepOutput) in completion order — the OpenAI n>1
+    streaming shape (each chunk carries its choice index). A pump
+    failure propagates to the consumer (and cancels its siblings via
+    the generator's finally); closing the generator cancels all pumps
+    and frees their slots."""
+    async def gen():
+        q: asyncio.Queue = asyncio.Queue()
+
+        async def pump(i):
+            try:
+                async with aclosing(engine.stream(
+                        list(prompt_ids), _choice_options(options, i),
+                        model=model)) as it:
+                    async for out in it:
+                        await q.put((i, out))
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                await q.put((i, e))
+                return
+            await q.put((i, None))
+
+        tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
+        try:
+            done = 0
+            while done < n:
+                i, out = await q.get()
+                if out is None:
+                    done += 1
+                    continue
+                if isinstance(out, BaseException):
+                    raise out
+                yield i, out
+        finally:
+            for t in tasks:
+                t.cancel()
+    return gen()
 
 
 async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
@@ -114,8 +164,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         req = proto.ChatCompletionRequest(**await request.json())
     except (ValidationError, json.JSONDecodeError) as e:
         return _error(400, f"invalid request: {e}")
-    if req.n != 1:
-        return _error(400, "n>1 is not supported yet")
+    if not 1 <= req.n <= 128:
+        return _error(400, "n must be between 1 and 128")
     try:
         engine.engine.resolve_model(req.model or None)
     except ValueError as e:
@@ -142,16 +192,21 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             # "usage": null until the final usage chunk; without it the
             # field is omitted entirely
             exclude = None if include_usage else {"usage"}
-            first = proto.ChatCompletionChunk(
-                id=rid, model=req.model,
-                choices=[proto.ChatCompletionChunkChoice(
-                    delta=proto.DeltaMessage(role="assistant", content=""))])
-            yield first.model_dump_json(exclude=exclude)
+            for i in range(req.n):
+                first = proto.ChatCompletionChunk(
+                    id=rid, model=req.model,
+                    choices=[proto.ChatCompletionChunkChoice(
+                        index=i,
+                        delta=proto.DeltaMessage(role="assistant",
+                                                 content=""))])
+                yield first.model_dump_json(exclude=exclude)
             num_tokens = 0
             # aclosing => a dropped consumer deterministically runs
-            # engine.stream's cleanup (slot abort), not at GC's leisure
-            async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
-                async for out in it:
+            # every stream's cleanup (slot aborts), not at GC's leisure
+            async with aclosing(_merged_streams(
+                    engine, prompt_ids, options, req.model or None,
+                    req.n)) as it:
+                async for i, out in it:
                     if out.new_token is not None:
                         num_tokens += 1
                     lp_block = None
@@ -168,6 +223,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                         chunk = proto.ChatCompletionChunk(
                             id=rid, model=req.model,
                             choices=[proto.ChatCompletionChunkChoice(
+                                index=i,
                                 delta=proto.DeltaMessage(
                                     content=out.text_delta or None),
                                 finish_reason=out.finish_reason if out.finished
@@ -185,29 +241,41 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
-    parts: List[str] = []
-    lp_entries: List = []
-    num_tokens = 0
-    finish_reason = None
-    async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
-        async for out in it:
-            parts.append(out.text_delta)
-            if out.new_token is not None:
-                num_tokens += 1
-                if req.logprobs and not _lp_skip(out):
-                    lp_entries.append(_chat_lp_entry(
-                        tok, out.new_token, out.logprob,
-                        bool(req.top_logprobs)))
-            if out.finished:
-                finish_reason = out.finish_reason
-    text = "".join(parts)
-    resp = proto.ChatCompletionResponse(
-        id=rid, model=req.model,
-        choices=[proto.ChatCompletionChoice(
-            message=proto.ChatChoiceMessage(content=text),
+    async def collect_one(i: int):
+        parts: List[str] = []
+        lp_entries: List = []
+        finish_reason = None
+        tokens = 0
+        async with aclosing(engine.stream(
+                list(prompt_ids), _choice_options(options, i),
+                model=req.model or None)) as it:
+            async for out in it:
+                parts.append(out.text_delta)
+                if out.new_token is not None:
+                    tokens += 1
+                    if req.logprobs and not _lp_skip(out):
+                        lp_entries.append(_chat_lp_entry(
+                            tok, out.new_token, out.logprob,
+                            bool(req.top_logprobs)))
+                if out.finished:
+                    finish_reason = out.finish_reason
+        choice = proto.ChatCompletionChoice(
+            index=i,
+            message=proto.ChatChoiceMessage(content="".join(parts)),
             finish_reason=finish_reason,
             logprobs=(proto.ChatLogprobs(content=lp_entries)
-                      if req.logprobs else None))],
+                      if req.logprobs else None))
+        return choice, tokens
+
+    # TaskGroup: one failing choice cancels its siblings so they free
+    # their engine slots instead of generating into a discarded response
+    async with asyncio.TaskGroup() as tg:
+        tasks = [tg.create_task(collect_one(i)) for i in range(req.n)]
+    results = [t.result() for t in tasks]
+    num_tokens = sum(t for _, t in results)
+    resp = proto.ChatCompletionResponse(
+        id=rid, model=req.model,
+        choices=[c for c, _ in results],
         usage=proto.UsageInfo(
             prompt_tokens=len(prompt_ids),
             completion_tokens=num_tokens,
@@ -221,8 +289,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
         req = proto.CompletionRequest(**await request.json())
     except (ValidationError, json.JSONDecodeError) as e:
         return _error(400, f"invalid request: {e}")
-    if req.n != 1:
-        return _error(400, "n>1 is not supported yet")
+    if not 1 <= req.n <= 128:
+        return _error(400, "n must be between 1 and 128")
     try:
         engine.engine.resolve_model(req.model or None)
     except ValueError as e:
@@ -253,8 +321,10 @@ async def completions(request: web.Request) -> web.StreamResponse:
         async def gen():
             exclude = None if include_usage else {"usage"}
             num_tokens = 0
-            async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
-                async for out in it:
+            async with aclosing(_merged_streams(
+                    engine, prompt_ids, options, req.model or None,
+                    req.n)) as it:
+                async for i, out in it:
                     if out.new_token is not None:
                         num_tokens += 1
                     lp_block = None
@@ -268,6 +338,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                         chunk = proto.CompletionChunk(
                             id=rid, model=req.model,
                             choices=[proto.CompletionChunkChoice(
+                                index=i,
                                 text=out.text_delta,
                                 finish_reason=out.finish_reason if out.finished
                                 else None,
@@ -283,28 +354,38 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
-    parts: List[str] = []
-    out_ids: List[int] = []
-    out_lps: List = []
-    num_tokens = 0
-    finish_reason = None
-    async with aclosing(engine.stream(prompt_ids, options, model=req.model or None)) as it:
-        async for out in it:
-            parts.append(out.text_delta)
-            if out.new_token is not None:
-                num_tokens += 1
-                if not _lp_skip(out):
-                    out_ids.append(out.new_token)
-                    out_lps.append(out.logprob)
-            if out.finished:
-                finish_reason = out.finish_reason
-    resp = proto.CompletionResponse(
-        id=rid, model=req.model,
-        choices=[proto.CompletionChoice(
-            text="".join(parts), finish_reason=finish_reason,
+    async def collect_one(i: int):
+        parts: List[str] = []
+        out_ids: List[int] = []
+        out_lps: List = []
+        tokens = 0
+        finish_reason = None
+        async with aclosing(engine.stream(
+                list(prompt_ids), _choice_options(options, i),
+                model=req.model or None)) as it:
+            async for out in it:
+                parts.append(out.text_delta)
+                if out.new_token is not None:
+                    tokens += 1
+                    if not _lp_skip(out):
+                        out_ids.append(out.new_token)
+                        out_lps.append(out.logprob)
+                if out.finished:
+                    finish_reason = out.finish_reason
+        choice = proto.CompletionChoice(
+            index=i, text="".join(parts), finish_reason=finish_reason,
             logprobs=(_completion_logprobs(tok, out_ids, out_lps,
                                            req.logprobs > 0)
-                      if req.logprobs is not None else None))],
+                      if req.logprobs is not None else None))
+        return choice, tokens
+
+    async with asyncio.TaskGroup() as tg:
+        tasks = [tg.create_task(collect_one(i)) for i in range(req.n)]
+    results = [t.result() for t in tasks]
+    num_tokens = sum(t for _, t in results)
+    resp = proto.CompletionResponse(
+        id=rid, model=req.model,
+        choices=[c for c, _ in results],
         usage=proto.UsageInfo(
             prompt_tokens=len(prompt_ids), completion_tokens=num_tokens,
             total_tokens=len(prompt_ids) + num_tokens))

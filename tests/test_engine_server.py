@@ -124,7 +124,7 @@ def test_bad_requests(engine):
                               headers={"Content-Type": "application/json"})
         assert r.status == 400
         r = await client.post("/v1/chat/completions", json={
-            "model": "debug-tiny", "n": 3,
+            "model": "debug-tiny", "n": 0,
             "messages": [{"role": "user", "content": "x"}]})
         assert r.status == 400
     _with_client(engine, body)
@@ -274,4 +274,55 @@ def test_stop_token_excluded_from_logprobs(engine):
         expected = tok_ids.index(tok_ids[-1])
         assert len(stopped) == expected
         assert stopped == base["logprobs"]["content"][:expected]
+    _with_client(engine, body)
+
+
+def test_n_greater_than_one(engine):
+    async def body(client):
+        r = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "pick"}],
+            "max_tokens": 4, "temperature": 0.0, "n": 3})
+        assert r.status == 200
+        data = await r.json()
+        choices = data["choices"]
+        assert [c["index"] for c in choices] == [0, 1, 2]
+        # greedy: all n identical by definition
+        assert len({c["message"]["content"] for c in choices}) == 1
+        assert data["usage"]["completion_tokens"] == 12
+
+        # streaming: chunks tagged with their choice index
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "pick", "max_tokens": 3,
+            "temperature": 0.0, "n": 2, "stream": True})
+        text = await r.text()
+        seen = set()
+        for line in text.splitlines():
+            if line.startswith("data: ") and line != "data: [DONE]":
+                for c in json.loads(line[6:]).get("choices", []):
+                    seen.add(c["index"])
+        assert seen == {0, 1}
+    _with_client(engine, body)
+
+
+def test_seeded_sampling_reproducible(engine):
+    """Same seed + same prompt + temperature>0 => identical output,
+    regardless of what else ran in between; different seed differs."""
+    async def ask(client, seed):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "seeded run",
+            "max_tokens": 12, "temperature": 1.0, "seed": seed})
+        assert r.status == 200
+        return (await r.json())["choices"][0]["text"]
+
+    async def body(client):
+        a1 = await ask(client, 7)
+        # interleave unrelated traffic so the engine key stream advances
+        await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "noise", "max_tokens": 5,
+            "temperature": 1.0})
+        a2 = await ask(client, 7)
+        b = await ask(client, 1234)
+        assert a1 == a2, "same seed must reproduce"
+        assert a1 != b, "different seeds should diverge"
     _with_client(engine, body)
