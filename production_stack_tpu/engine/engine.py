@@ -163,6 +163,12 @@ class LLMEngine:
         self._slot_top_k = np.zeros((B,), np.int32)
         self._slot_adapter = np.zeros((B,), np.int32)
         self._slot_seed = np.zeros((B,), np.int32)
+        # guided decoding: per-slot DFA-state host mirror (grammar row
+        # indices are rebuilt per dispatch from the sequences)
+        self._slot_gstate = np.zeros((B,), np.int32)
+        self._guided_key = None      # tuple of active patterns
+        self._guided_table = None    # device [G+1, S, V] int32
+        self._guided_gids = {}       # pattern -> row index
         # device-resident sampling params, re-uploaded only when a slot's
         # options change (admission/finish), never per decode window
         self._dev_sampling = None
@@ -208,6 +214,12 @@ class LLMEngine:
                        options=options or SamplingOptions(),
                        adapter_id=self.resolve_model(model),
                        detok=DetokenizeStream(self.tokenizer))
+        if seq.options.guided_regex:
+            from production_stack_tpu.engine import guided
+            # compiled per (pattern, tokenizer) with an LRU cache; a bad
+            # pattern raises here, on the caller's thread, as ValueError
+            seq.grammar = guided.compile_grammar(seq.options.guided_regex,
+                                                 self.tokenizer)
         if self.hbm_pool is not None:
             # chunk-key hashing only (cheap, caller thread); the device
             # copies happen at admission on the engine loop
@@ -294,9 +306,21 @@ class LLMEngine:
                 lengths[slot] = len(w.chunk)
                 kv_need = max(kv_need, w.start + bucket)
             kv_len = self.cfg.kv_bucket_for(min(kv_need, S))
+            gtable = gids = gstates = None
+            if any(w.seq.grammar is not None for w in group):
+                gtable, gid_map = self._ensure_guided_table()
+                gids = np.zeros((B,), np.int32)
+                gstates = np.zeros((B,), np.int32)
+                for w in group:
+                    if w.seq.grammar is not None:
+                        gids[w.seq.slot] = gid_map[w.seq.options.guided_regex]
+                        gstates[w.seq.slot] = w.seq.fsm_state
             ids_dev, lps_dev = self.runner.prefill(tokens, starts, lengths,
                                                    self._dev_sampling,
-                                                   kv_len)
+                                                   kv_len,
+                                                   guide_table=gtable,
+                                                   guide_ids=gids,
+                                                   guide_states=gstates)
             ids = lps = None
             for w in group:
                 self.scheduler.on_prefill_done(w)
@@ -333,6 +357,41 @@ class LLMEngine:
                 seed=jnp.asarray(self._slot_seed))
             self._sampling_dirty = False
 
+    def _ensure_guided_table(self):
+        """(Re)build the stacked guided-decoding table for the distinct
+        grammars among admitted sequences. Returns (device table
+        [G+1, S, V] or None, {pattern: row index}). Row 0 is the
+        unguided placeholder; vocab columns beyond a grammar's tokenizer
+        range stay forbidden."""
+        active = list(self.scheduler.running.values()) + list(
+            self.scheduler._prefilling.values())
+        pats = sorted({s.options.guided_regex for s in active
+                       if s.grammar is not None})
+        if not pats:
+            return None, {}
+        key = tuple(pats)
+        if key != self._guided_key:
+            from production_stack_tpu.engine import guided as guided_mod
+            grammars = [guided_mod.compile_grammar(p, self.tokenizer)
+                        for p in pats]
+            # pad S and G up to power-of-two buckets: the decode/prefill
+            # executables are keyed on the table shape, so raw sizes
+            # would recompile on every pattern-set change
+            S = max(g.n_states for g in grammars)
+            S = 1 << (S - 1).bit_length() if S > 1 else 1
+            G = len(pats) + 1
+            G = 1 << (G - 1).bit_length()
+            V = self.model_cfg.vocab_size
+            table = np.full((G, S, V), -1, np.int32)
+            for gi, g in enumerate(grammars, start=1):
+                s, v = g.token_next.shape
+                table[gi, :s, :min(v, V)] = g.token_next[:, :V]
+            self._guided_table = jnp.asarray(table)
+            self._guided_gids = {p: i + 1 for i, p in enumerate(pats)}
+            self._guided_key = key
+            self._decode_dirty = True   # gids/states must re-upload
+        return self._guided_table, self._guided_gids
+
     def _dispatch_decode(self, decode_seqs) -> None:
         """Launch one decode window (async dispatch; no host sync)."""
         W = self.cfg.decode_window
@@ -341,13 +400,23 @@ class LLMEngine:
             min(max_pos + W + 1, self.cfg.max_model_len))
         greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
         self._ensure_dev_sampling()
+        gtable = gids = None
+        if any(s.grammar is not None for s in decode_seqs):
+            gtable, gid_map = self._ensure_guided_table()
+            gids = np.zeros((len(self._slot_gstate),), np.int32)
+            for s in decode_seqs:
+                if s.grammar is not None:
+                    gids[s.slot] = gid_map[s.options.guided_regex]
         if self._decode_dirty:
-            self.runner.set_decode_state(self._slot_token, self._slot_pos)
+            self.runner.set_decode_state(self._slot_token, self._slot_pos,
+                                         self._slot_gstate)
             self._decode_dirty = False
         seeded = any(s.options.seed is not None for s in decode_seqs)
         ids_dev, lps_dev = self.runner.decode(self._dev_sampling, steps=W,
                                               kv_len=kv_len, greedy=greedy,
-                                              seeded=seeded)
+                                              seeded=seeded,
+                                              guide_table=gtable,
+                                              guide_ids=gids)
         self._inflight = (ids_dev, lps_dev, W, list(decode_seqs),
                           time.monotonic())
 
@@ -382,6 +451,12 @@ class LLMEngine:
                       logprob: Optional[float] = None) -> List[StepOutput]:
         seq.output_tokens.append(token)
         seq.output_logprobs.append(logprob)
+        if seq.grammar is not None:
+            # host mirror of the device-carried DFA state (re-uploaded on
+            # slot composition changes); DEAD can't be sampled, max() is
+            # pure defense
+            seq.fsm_state = max(
+                seq.grammar.next_state(seq.fsm_state, token), 0)
         self.metrics.generation_tokens.inc()
         delta = seq.detok.push(token)
         opt = seq.options
@@ -458,6 +533,7 @@ class LLMEngine:
         slot = seq.slot
         self._slot_token[slot] = seq.output_tokens[-1]
         self._slot_pos[slot] = seq.next_position
+        self._slot_gstate[slot] = seq.fsm_state
         self._sync_sampling(seq)
 
     def _sync_sampling(self, seq: Sequence) -> None:
@@ -485,6 +561,7 @@ class LLMEngine:
         if slot >= 0:
             self._slot_token[slot] = 0
             self._slot_pos[slot] = self.cfg.max_model_len
+            self._slot_gstate[slot] = 0
             self._decode_dirty = True
 
     def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:

@@ -27,6 +27,8 @@ is the TPU-native core the stack serves from.
 
 import time
 from functools import partial
+
+import numpy as np
 from typing import Optional, Tuple
 
 import jax
@@ -119,6 +121,7 @@ class ModelRunner:
         # refreshed from host mirrors only when the engine marks them stale
         self._dec_tokens = None
         self._dec_pos = None
+        self._dec_gstate = None   # guided-decoding DFA states [B]
 
         # executable caches: decode keyed (steps, kv_len, greedy, seeded),
         # prefill keyed (chunk bucket, kv bucket)
@@ -136,8 +139,11 @@ class ModelRunner:
 
     def _decode_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                      positions: jnp.ndarray, sampling: SamplingParams,
-                     key: jax.Array, *, steps: int, kv_len: int,
-                     greedy: bool, seeded: bool = False):
+                     key: jax.Array, guide_next: jnp.ndarray,
+                     guide_id: jnp.ndarray, guide_state: jnp.ndarray,
+                     *, steps: int, kv_len: int,
+                     greedy: bool, seeded: bool = False,
+                     guided: bool = False):
         """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
         tokens', positions', cache').
 
@@ -156,13 +162,19 @@ class ModelRunner:
         computed rather than forking the executable cache.
         """
         def body(carry, i):
-            cache, toks, pos = carry
+            cache, toks, pos, gstate = carry
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
                 cache, rope=self.rope, kv_len=kv_len, use_flash=False,
                 lora_params=self._lora, adapter_ids=sampling.adapter,
                 lora_scaling=self._lora_scaling)
             last = logits[:, 0, :]
+            if guided:
+                # one [B, V] gather per step: each guided row's next-state
+                # table masks forbidden tokens (engine/guided.py)
+                nxt_row = guide_next[guide_id, gstate, :]
+                is_g = (guide_id > 0)[:, None]
+                last = jnp.where(is_g & (nxt_row < 0), -jnp.inf, last)
             if greedy:
                 ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
             else:
@@ -172,19 +184,27 @@ class ModelRunner:
                 # skip the per-row PRNG work entirely
                 ids = sample(last, sampling, jax.random.fold_in(key, i),
                              positions=pos + 1 if seeded else None)
+            if guided:
+                adv = jnp.take_along_axis(nxt_row, ids[:, None],
+                                          axis=-1)[:, 0]
+                gstate = jnp.where(guide_id > 0,
+                                   jnp.maximum(adv, 0), gstate)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(last, axis=-1), ids[:, None],
                 axis=-1)[:, 0]
-            return (cache, ids, pos + 1), (ids, lp)
+            return (cache, ids, pos + 1, gstate), (ids, lp)
 
-        (cache, toks, pos), (ids, lps) = jax.lax.scan(
-            body, (cache, tokens, positions), jnp.arange(steps))
-        return ids.T, lps.T, toks, pos, cache  # ids/lps [B, steps]
+        (cache, toks, pos, gstate), (ids, lps) = jax.lax.scan(
+            body, (cache, tokens, positions, guide_state),
+            jnp.arange(steps))
+        return ids.T, lps.T, toks, pos, gstate, cache  # ids/lps [B, steps]
 
     def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                       starts: jnp.ndarray, lengths: jnp.ndarray,
-                      sampling: SamplingParams, key: jax.Array, *,
-                      kv_len: int):
+                      sampling: SamplingParams, key: jax.Array,
+                      guide_next: jnp.ndarray, guide_id: jnp.ndarray,
+                      guide_state: jnp.ndarray, *,
+                      kv_len: int, guided: bool = False):
         """Full-batch chunk prefill. tokens [B, Tb], starts/lengths [B].
 
         Every row writes its chunk at its own offset (idle rows are
@@ -209,6 +229,11 @@ class ModelRunner:
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
+        if guided:
+            # first output token: mask from each guided row's start state
+            nxt_row = guide_next[guide_id, guide_state, :]
+            is_g = (guide_id > 0)[:, None]
+            last = jnp.where(is_g & (nxt_row < 0), -jnp.inf, last)
         ids = sample(last, sampling, key,
                      positions=starts + jnp.maximum(lengths, 1))
         lp = jnp.take_along_axis(
@@ -223,37 +248,56 @@ class ModelRunner:
         self._key, sub = jax.random.split(self._key)
         return sub
 
-    def set_decode_state(self, tokens, positions) -> None:
+    def set_decode_state(self, tokens, positions,
+                         guide_states=None) -> None:
         """Upload fresh decode inputs (host mirrors -> device carry)."""
         self._dec_tokens = jnp.asarray(tokens, jnp.int32)
         self._dec_pos = jnp.asarray(positions, jnp.int32)
+        self._dec_gstate = (jnp.zeros_like(self._dec_tokens)
+                            if guide_states is None
+                            else jnp.asarray(guide_states, jnp.int32))
 
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
-               seeded: bool = False):
+               seeded: bool = False, guide_table=None, guide_ids=None):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
         (ids, logprobs), each [B, steps] (np-convertible; the first
-        np.asarray() is the window's single sync)."""
+        np.asarray() is the window's single sync).
+
+        guide_table [G, S, V] device int32 + guide_ids [B] activate
+        constrained sampling (engine/guided.py); the per-row DFA state
+        rides the device carry like tokens/positions."""
         kv_len = kv_len or self.engine_cfg.max_model_len
         seeded = seeded and not greedy
-        fn = self._decode_fns.get((steps, kv_len, greedy, seeded))
+        guided = guide_table is not None
+        gshape = guide_table.shape if guided else (1, 1, 1)
+        cache_key = (steps, kv_len, greedy, seeded, guided, gshape)
+        fn = self._decode_fns.get(cache_key)
         if fn is None:
             logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
-                        "%s)", steps, kv_len, greedy,
-                        " seeded" if seeded else "")
+                        "%s%s)", steps, kv_len, greedy,
+                        " seeded" if seeded else "",
+                        " guided" if guided else "")
             fn = jax.jit(
                 partial(self._decode_impl, steps=steps, kv_len=kv_len,
-                        greedy=greedy, seeded=seeded),
+                        greedy=greedy, seeded=seeded, guided=guided),
                 donate_argnums=(1,))
-            self._decode_fns[(steps, kv_len, greedy, seeded)] = fn
-        ids, lps, self._dec_tokens, self._dec_pos, self.cache = fn(
+            self._decode_fns[cache_key] = fn
+        B = self.engine_cfg.max_num_seqs
+        if not guided:
+            guide_table = jnp.zeros((1, 1, 1), jnp.int32)
+            guide_ids = jnp.zeros((B,), jnp.int32)
+        (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
+         self.cache) = fn(
             self.params, self.cache, self._dec_tokens, self._dec_pos,
-            sampling, self._next_key())
+            sampling, self._next_key(), guide_table,
+            jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
         return ids, lps
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
-                kv_len: int):
+                kv_len: int, guide_table=None, guide_ids=None,
+                guide_states=None):
         """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
         int32 np; starts/lengths [B]. Returns device (ids, logprobs),
         each [B].
@@ -268,13 +312,22 @@ class ModelRunner:
         (retrying it would re-pass a donated, deleted cache buffer).
         """
         Tb = tokens.shape[1]
+        guided = guide_table is not None
+        B = self.engine_cfg.max_num_seqs
+        if not guided:
+            guide_table = jnp.zeros((1, 1, 1), jnp.int32)
+            guide_ids = np.zeros((B,), np.int32)
+            guide_states = np.zeros((B,), np.int32)
         args = (self.params, self.cache, jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(starts, jnp.int32),
-                jnp.asarray(lengths, jnp.int32), sampling, self._next_key())
-        fn = self._prefill_fns.get((Tb, kv_len))
+                jnp.asarray(lengths, jnp.int32), sampling, self._next_key(),
+                guide_table, jnp.asarray(guide_ids, jnp.int32),
+                jnp.asarray(guide_states, jnp.int32))
+        gshape = guide_table.shape if guided else None
+        fn = self._prefill_fns.get((Tb, kv_len, guided, gshape))
         if fn is None:
             try:
-                fn = self._compile_prefill(Tb, kv_len, args)
+                fn = self._compile_prefill(Tb, kv_len, guided, gshape, args)
             except Exception:
                 from production_stack_tpu.ops import pallas_attention
                 if (self.mesh is not None
@@ -285,16 +338,19 @@ class ModelRunner:
                     "falling back to the jnp attention path", Tb, kv_len)
                 pallas_attention.set_flash_enabled(False)
                 self._prefill_fns.clear()
-                fn = self._compile_prefill(Tb, kv_len, args)
+                fn = self._compile_prefill(Tb, kv_len, guided, gshape, args)
         ids, lps, self.cache = fn(*args)
         return ids, lps
 
-    def _compile_prefill(self, Tb: int, kv_len: int, args):
-        logger.info("compiling prefill (chunk=%d kv=%d)", Tb, kv_len)
-        fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len),
+    def _compile_prefill(self, Tb: int, kv_len: int, guided: bool,
+                         gshape, args):
+        logger.info("compiling prefill (chunk=%d kv=%d%s)", Tb, kv_len,
+                    " guided" if guided else "")
+        fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len,
+                             guided=guided),
                      donate_argnums=(1,))
         fn.lower(*args).compile()   # donation applies at execution only
-        self._prefill_fns[(Tb, kv_len)] = fn
+        self._prefill_fns[(Tb, kv_len, guided, gshape)] = fn
         return fn
 
     def embed(self, tokens, lengths):

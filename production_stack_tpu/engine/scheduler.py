@@ -42,6 +42,9 @@ class SamplingOptions:
     # > 0: reproducible sampling — gumbel noise derived from
     # (seed, token position) only (engine/sampler.py)
     seed: Optional[int] = None
+    # constrain generation to this regex (engine/guided.py); the server
+    # maps guided_choice onto it
+    guided_regex: Optional[str] = None
 
 
 @dataclass
@@ -68,6 +71,10 @@ class Sequence:
     # in-HBM prefix-pool match ([pool rows], covered_tokens) computed at
     # add time (kvcache/hbm_pool.py); consumed at admission
     hbm_match: object = None
+    # guided decoding (engine/guided.py): compiled grammar + current
+    # DFA state (host mirror of the device-carried state)
+    grammar: object = None
+    fsm_state: int = 0
     # incremental detokenization state (owned by LLMEngine)
     output_text: str = ""       # stable decoded text, stop-truncated
     chars_emitted: int = 0      # prefix of output_text already delivered

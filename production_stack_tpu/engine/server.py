@@ -51,7 +51,18 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         stop_token_ids=req.stop_token_ids or [],
         ignore_eos=req.ignore_eos,
         seed=req.seed,
+        guided_regex=_guided_pattern(req),
     )
+
+
+def _guided_pattern(req) -> Optional[str]:
+    """vLLM-style guided decoding knobs -> one regex (or None)."""
+    if getattr(req, "guided_regex", None):
+        return req.guided_regex
+    if getattr(req, "guided_choice", None):
+        from production_stack_tpu.engine import guided
+        return guided.choice_regex(req.guided_choice)
+    return None
 
 
 def _choice_options(options, i: int):
@@ -62,6 +73,19 @@ def _choice_options(options, i: int):
         return options
     import dataclasses
     return dataclasses.replace(options, seed=options.seed + i)
+
+
+async def _gather_cancelling(coros):
+    """gather() where one failure cancels the siblings so they free
+    their engine slots instead of generating into a discarded response
+    (asyncio.TaskGroup semantics, but available on Python 3.10)."""
+    tasks = [asyncio.ensure_future(c) for c in coros]
+    try:
+        return await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        raise
 
 
 def _merged_streams(engine, prompt_ids, options, model, n):
@@ -180,7 +204,15 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                            f"exceeds max_model_len "
                            f"{engine.engine.cfg.max_model_len}")
     max_tokens = req.max_completion_tokens or req.max_tokens
-    options = _sampling_options(req, max_tokens)
+    try:
+        options = _sampling_options(req, max_tokens)
+        if options.guided_regex:
+            from production_stack_tpu.engine import guided
+            # compile (LRU-cached) now so a bad pattern is a 400 here,
+            # not a 500 mid-stream
+            guided.compile_grammar(options.guided_regex, engine.tokenizer)
+    except ValueError as e:
+        return _error(400, f"invalid guided decoding constraint: {e}")
     rid = proto._gen_id("chatcmpl")
 
     if req.stream:
@@ -267,11 +299,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                       if req.logprobs else None))
         return choice, tokens
 
-    # TaskGroup: one failing choice cancels its siblings so they free
-    # their engine slots instead of generating into a discarded response
-    async with asyncio.TaskGroup() as tg:
-        tasks = [tg.create_task(collect_one(i)) for i in range(req.n)]
-    results = [t.result() for t in tasks]
+    results = await _gather_cancelling(
+        [collect_one(i) for i in range(req.n)])
     num_tokens = sum(t for _, t in results)
     resp = proto.ChatCompletionResponse(
         id=rid, model=req.model,
@@ -311,7 +340,13 @@ async def completions(request: web.Request) -> web.StreamResponse:
         return _error(400, f"prompt has {len(prompt_ids)} tokens, which "
                            f"exceeds max_model_len "
                            f"{engine.engine.cfg.max_model_len}")
-    options = _sampling_options(req, req.max_tokens)
+    try:
+        options = _sampling_options(req, req.max_tokens)
+        if options.guided_regex:
+            from production_stack_tpu.engine import guided
+            guided.compile_grammar(options.guided_regex, engine.tokenizer)
+    except ValueError as e:
+        return _error(400, f"invalid guided decoding constraint: {e}")
     rid = proto._gen_id("cmpl")
 
     if req.stream:
@@ -379,9 +414,8 @@ async def completions(request: web.Request) -> web.StreamResponse:
                       if req.logprobs is not None else None))
         return choice, tokens
 
-    async with asyncio.TaskGroup() as tg:
-        tasks = [tg.create_task(collect_one(i)) for i in range(req.n)]
-    results = [t.result() for t in tasks]
+    results = await _gather_cancelling(
+        [collect_one(i) for i in range(req.n)])
     num_tokens = sum(t for _, t in results)
     resp = proto.CompletionResponse(
         id=rid, model=req.model,

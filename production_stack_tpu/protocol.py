@@ -43,6 +43,9 @@ class CompletionRequest(OpenAIBase):
     echo: bool = False
     logprobs: Optional[int] = None      # legacy: N requests logprobs
     seed: Optional[int] = None
+    # vLLM guided-decoding extensions (engine/guided.py)
+    guided_regex: Optional[str] = None
+    guided_choice: Optional[List[str]] = None
     user: Optional[str] = None
 
 
@@ -72,6 +75,9 @@ class ChatCompletionRequest(OpenAIBase):
     logprobs: Optional[bool] = False
     top_logprobs: Optional[int] = None
     seed: Optional[int] = None
+    # vLLM guided-decoding extensions (engine/guided.py)
+    guided_regex: Optional[str] = None
+    guided_choice: Optional[List[str]] = None
     user: Optional[str] = None
 
 

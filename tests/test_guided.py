@@ -1,0 +1,165 @@
+"""Guided decoding (engine/guided.py): regex DFA correctness, token
+lifting, constrained generation through the engine, and the server
+surface. CPU, debug-tiny (byte tokenizer: ids are bytes, so the
+byte-DFA/token-DFA relationship is exact and easy to reason about)."""
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine import guided
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingOptions
+
+
+def test_regex_dfa_agrees_with_re():
+    import random, string
+    patterns = [r"(yes|no|maybe)", r"[a-f0-9]{8}", r"-?\d+(\.\d+)?",
+                r"(foo)+bar?", r"[^x]*x", r"a{2,4}b*",
+                r'"[a-z ]{1,10}"', r"\w+@\w+\.(com|org)"]
+    rng = random.Random(0)
+    alphabet = string.ascii_lowercase + string.digits + ' ."@-x'
+    for pat in patterns:
+        dfa = guided.compile_regex(pat)
+        py = re.compile(pat)
+        for _ in range(1500):
+            s = "".join(rng.choice(alphabet)
+                        for _ in range(rng.randint(0, 12)))
+            assert dfa.matches(s.encode()) == bool(py.fullmatch(s)), (pat, s)
+
+
+def test_regex_dfa_directed_cases():
+    cases = {
+        r"(yes|no|maybe)": (["yes", "no", "maybe"], ["", "yess", "y"]),
+        r"[a-f0-9]{8}": (["deadbeef"], ["deadbee", "deadbeeg"]),
+        r"(foo)+bar?": (["foobar", "foofooba"], ["bar", "foob"]),
+        r"a{2,4}b*": (["aa", "aaaab"], ["a", "aaaaa", "ab"]),
+        r"\w+@\w+\.(com|org)": (["a@b.com"], ["a@b.net", "@b.com"]),
+        "héllo": (["héllo"], ["hello"]),
+    }
+    for pat, (pos, neg) in cases.items():
+        dfa = guided.compile_regex(pat)
+        for s in pos:
+            assert dfa.matches(s.encode()), (pat, s)
+        for s in neg:
+            assert not dfa.matches(s.encode()), (pat, s)
+
+
+def test_regex_errors():
+    for bad in ["(", "a{5,2}", "[z-a]", "*a", "a{,", "[abc"]:
+        with pytest.raises(ValueError):
+            guided.compile_regex(bad)
+    # a** is tolerated (idempotent star), unlike python re
+    assert guided.compile_regex("a**").matches(b"aaa")
+
+
+def test_choice_regex_escapes():
+    pat = guided.choice_regex(["a.b", "c|d", "x*"])
+    dfa = guided.compile_regex(pat)
+    for s in ("a.b", "c|d", "x*"):
+        assert dfa.matches(s.encode())
+    assert not dfa.matches(b"axb")
+    with pytest.raises(ValueError):
+        guided.choice_regex([])
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = LLMEngine(EngineConfig(model="debug-tiny", max_model_len=128,
+                                 max_num_seqs=2, prefill_chunk=32,
+                                 prefill_buckets=(16, 32),
+                                 decode_window=4))
+    return eng
+
+
+def _generate(eng, prompt, **opts):
+    sid = eng.add_request(eng.tokenizer.encode(prompt),
+                          SamplingOptions(**opts))
+    done = False
+    while not done:
+        for out in eng.step():
+            if out.seq_id == sid and out.finished:
+                done = True
+    return eng.seqs[sid]
+
+
+def test_engine_guided_regex(engine):
+    """Constrained generation must produce a full match of the pattern
+    and stop exactly at the match (EOS only in accepting states)."""
+    pat = r"(red|green|blue)"
+    seq = _generate(engine, "color?", temperature=1.0, max_tokens=16,
+                    guided_regex=pat)
+    assert seq.finish_reason == "stop"
+    assert re.fullmatch(pat, seq.output_text), seq.output_text
+
+
+def test_engine_guided_digits(engine):
+    seq = _generate(engine, "number:", temperature=0.8, max_tokens=16,
+                    guided_regex=r"\d{3}")
+    assert re.fullmatch(r"\d{3}", seq.output_text), seq.output_text
+
+
+def test_engine_guided_mixed_batch(engine):
+    """A guided and an unguided request sharing a decode window: the
+    guided one matches, the unguided one is unconstrained."""
+    opts_g = SamplingOptions(temperature=1.0, max_tokens=12,
+                             guided_regex=r"(aa|bb)")
+    opts_u = SamplingOptions(temperature=0.0, max_tokens=6,
+                             ignore_eos=True)
+    g = engine.add_request(engine.tokenizer.encode("pick"), opts_g)
+    u = engine.add_request(engine.tokenizer.encode("pick"), opts_u)
+    pending = {g, u}
+    while pending:
+        for out in engine.step():
+            if out.finished:
+                pending.discard(out.seq_id)
+    assert engine.seqs[g].output_text in ("aa", "bb")
+    assert len(engine.seqs[u].output_tokens) == 6
+
+
+def test_engine_guided_greedy(engine):
+    seq = _generate(engine, "greedy", temperature=0.0, max_tokens=10,
+                    guided_regex=r"(one|two|three)")
+    assert seq.output_text in ("one", "two", "three")
+
+
+def test_server_guided_choice_and_errors(engine):
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.server import build_app
+
+    async def run():
+        eng = AsyncLLMEngine(engine.cfg)
+        app = build_app(eng)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "pick"}],
+                "max_tokens": 12, "temperature": 1.0,
+                "guided_choice": ["alpha", "beta"]})
+            assert r.status == 200
+            text = (await r.json())["choices"][0]["message"]["content"]
+            assert text in ("alpha", "beta"), text
+            # bad pattern is a 400, not a 500
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "x", "max_tokens": 4,
+                "guided_regex": "(unclosed"})
+            assert r.status == 400
+            assert "guided" in (await r.json())["error"]["message"]
+    asyncio.run(run())
+
+
+def test_regex_anchors():
+    """Leading ^ / trailing $ strip (full-match is implicit); anchors
+    elsewhere and zero-width escapes are rejected, never literals."""
+    dfa = guided.compile_regex(r"^(yes|no)$")
+    assert dfa.matches(b"yes") and not dfa.matches(b"^yes$")
+    for bad in [r"a^b", r"a$b", r"\bword\b"]:
+        with pytest.raises(ValueError):
+            guided.compile_regex(bad)
+    # escaped $ stays a literal
+    assert guided.compile_regex(r"\$\d+").matches(b"$42")
