@@ -1,0 +1,60 @@
+"""Guided decoding through the OpenAI-compatible API.
+
+Constrain generation to a literal choice set or a regex — the engine
+compiles the pattern to a token DFA and masks logits on-device inside
+its fused decode window (docs/engine.md), so a guided response is
+always a complete match.
+
+Run an engine first (CPU works):
+    JAX_PLATFORMS=cpu python -m production_stack_tpu.engine.server \
+        --model debug-tiny --port 8100
+
+Then: python examples/guided_decoding.py [base_url]
+"""
+
+import json
+import sys
+import urllib.request
+
+BASE = sys.argv[1] if len(sys.argv) > 1 else "http://localhost:8100"
+
+
+def post(path, payload):
+    req = urllib.request.Request(
+        BASE + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        return json.load(r)
+
+
+# 1. choice: the answer is exactly one of the options
+out = post("/v1/chat/completions", {
+    "model": "debug-tiny",
+    "messages": [{"role": "user", "content": "Is the sky blue?"}],
+    "max_tokens": 8,
+    "guided_choice": ["yes", "no", "unsure"],
+})
+print("choice:", out["choices"][0]["message"]["content"])
+
+# 2. regex: force a shaped value (full-match semantics; leading ^ /
+# trailing $ are accepted and stripped)
+out = post("/v1/completions", {
+    "model": "debug-tiny",
+    "prompt": "order id: ",
+    "max_tokens": 24,
+    "guided_regex": r"ORD-[0-9]{6}",
+})
+print("regex:", out["choices"][0]["text"])
+
+# 3. schema-shaped JSON: constrain to YOUR payload's exact shape, with
+# bounded field lengths so the match completes within max_tokens.
+# (Unbounded nested JSON needs more DFA states than the engine's
+# budget — a schema-specific pattern like this is the reliable form.)
+SCHEMA = r'\{"name": "[a-z]{1,8}", "count": \d{1,3}\}'
+out = post("/v1/completions", {
+    "model": "debug-tiny",
+    "prompt": "reply with a json object: ",
+    "max_tokens": 48,
+    "guided_regex": SCHEMA,
+})
+print("json:", out["choices"][0]["text"])

@@ -122,8 +122,9 @@ class _Parser:
         if self.peek() != ord("}"):
             raise RegexError("unterminated {m,n}")
         self.next()
-        if hi is not None and (hi < lo or hi > 256):
-            raise RegexError(f"bad repetition bounds {{{lo},{hi}}}")
+        if lo > 256 or (hi is not None and (hi < lo or hi > 256)):
+            raise RegexError(f"bad repetition bounds {{{lo},{hi}}}: "
+                             f"counts are capped at 256")
         parts = [node] * lo
         if hi is None:
             parts.append(("star", node))
@@ -298,8 +299,12 @@ def compile_regex(pattern: str) -> ByteDFA:
     literals."""
     if pattern.startswith("^"):
         pattern = pattern[1:]
-    if pattern.endswith("$") and not pattern.endswith("\\$"):
-        pattern = pattern[:-1]
+    if pattern.endswith("$"):
+        # the $ is an anchor only if preceded by an EVEN number of
+        # backslashes (an odd count escapes it into a literal)
+        slashes = len(pattern) - 1 - len(pattern[:-1].rstrip("\\"))
+        if slashes % 2 == 0:
+            pattern = pattern[:-1]
     nfa = {"eps": [], "edges": []}
     start = _new_state(nfa)
     accept_pos = _build_nfa(_Parser(pattern).parse(), nfa, start)
@@ -389,7 +394,9 @@ def _compile_cached(pattern: str, tok_key: int):
     # walk every token's bytes from every state, fully vectorized over
     # states: cur [n_states] advances one byte at a time (dead rows
     # stay dead via a guarded gather)
-    specials = {tokenizer.bos_token_id, tokenizer.pad_token_id}
+    specials = set(getattr(tokenizer, "special_token_ids", None)
+                   or (tokenizer.bos_token_id, tokenizer.pad_token_id))
+    specials |= {tokenizer.bos_token_id, tokenizer.pad_token_id}
     eos = tokenizer.eos_token_id
     tok_bytes = _token_bytes(tokenizer, vocab)
     base = np.arange(dfa.n_states, dtype=np.int32)

@@ -55,6 +55,19 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
     )
 
 
+async def _precompile_guided(engine, options) -> None:
+    """Compile the request's grammar (LRU-cached) BEFORE streaming, in
+    a thread: a bad pattern becomes a 400 here instead of a 500
+    mid-stream, and a first-time compile (a full-vocab token lift,
+    seconds on large vocabularies) never blocks the event loop."""
+    if not options.guided_regex:
+        return
+    from production_stack_tpu.engine import guided
+    await asyncio.get_running_loop().run_in_executor(
+        None, guided.compile_grammar, options.guided_regex,
+        engine.tokenizer)
+
+
 def _guided_pattern(req) -> Optional[str]:
     """vLLM-style guided decoding knobs -> one regex (or None)."""
     if getattr(req, "guided_regex", None):
@@ -85,6 +98,10 @@ async def _gather_cancelling(coros):
     except BaseException:
         for t in tasks:
             t.cancel()
+        # wait for the cancellations to land (TaskGroup semantics):
+        # siblings must have freed their engine slots before the error
+        # response goes out, and their exceptions must be retrieved
+        await asyncio.gather(*tasks, return_exceptions=True)
         raise
 
 
@@ -206,11 +223,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     max_tokens = req.max_completion_tokens or req.max_tokens
     try:
         options = _sampling_options(req, max_tokens)
-        if options.guided_regex:
-            from production_stack_tpu.engine import guided
-            # compile (LRU-cached) now so a bad pattern is a 400 here,
-            # not a 500 mid-stream
-            guided.compile_grammar(options.guided_regex, engine.tokenizer)
+        await _precompile_guided(engine, options)
     except ValueError as e:
         return _error(400, f"invalid guided decoding constraint: {e}")
     rid = proto._gen_id("chatcmpl")
@@ -342,9 +355,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                            f"{engine.engine.cfg.max_model_len}")
     try:
         options = _sampling_options(req, req.max_tokens)
-        if options.guided_regex:
-            from production_stack_tpu.engine import guided
-            guided.compile_grammar(options.guided_regex, engine.tokenizer)
+        await _precompile_guided(engine, options)
     except ValueError as e:
         return _error(400, f"invalid guided decoding constraint: {e}")
     rid = proto._gen_id("cmpl")

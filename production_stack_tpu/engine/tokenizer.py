@@ -38,6 +38,11 @@ class ByteTokenizer:
             token_id, f"<unk:{token_id}>")
         return name, list(name.encode("utf-8"))
 
+    @property
+    def special_token_ids(self):
+        # everything past the byte range: specials + unmapped ids
+        return list(range(256, self.vocab_size))
+
     def apply_chat_template(self, messages: List[dict]) -> str:
         parts = [f"<|{m.get('role', 'user')}|>\n{_content_text(m)}\n"
                  for m in messages]
@@ -64,20 +69,35 @@ class HFTokenizer:
         return self._tok.decode(ids, skip_special_tokens=True)
 
     def id_to_token(self, token_id: int):
-        """(token string, raw bytes) for logprobs reporting. Uses the
-        tokenizer's own token representation (convert_ids_to_tokens),
-        NOT decode([id]) — decoding a multi-byte-split piece in
-        isolation collapses distinct tokens to the replacement char and
-        loses the bytes clients need to reassemble UTF-8."""
+        """(token string, raw bytes) for logprobs reporting and the
+        guided-decoding token lift. Uses the tokenizer's own token
+        representation (convert_ids_to_tokens), NOT decode([id]) —
+        decoding a multi-byte-split piece in isolation collapses
+        distinct tokens to the replacement char and loses the bytes
+        clients need to reassemble UTF-8.
+
+        The raw bytes preserve the piece's leading-space semantics:
+        SentencePiece's ▁ and byte-level BPE's Ġ/Ċ markers map to the
+        actual space/newline (convert_tokens_to_string would STRIP a
+        leading space on a lone piece, which breaks guided matching),
+        and <0xHH> byte-fallback pieces map to their exact byte."""
         piece = self._tok.convert_ids_to_tokens(token_id)
         if piece is None:
             piece = f"<unk:{token_id}>"
-        try:
-            raw = self._tok.convert_tokens_to_string([piece]).encode(
-                "utf-8")
-        except Exception:
-            raw = piece.encode("utf-8")
-        return piece, list(raw)
+        if (len(piece) == 6 and piece.startswith("<0x")
+                and piece.endswith(">")):
+            try:
+                return piece, [int(piece[3:5], 16)]
+            except ValueError:
+                pass
+        text = (piece.replace("▁", " ")     # SPM word boundary
+                     .replace("Ġ", " ")     # GPT-2 byte-BPE space
+                     .replace("Ċ", "\n"))   # GPT-2 byte-BPE newline
+        return piece, list(text.encode("utf-8"))
+
+    @property
+    def special_token_ids(self):
+        return list(getattr(self._tok, "all_special_ids", []) or [])
 
     def apply_chat_template(self, messages: List[dict]) -> str:
         if getattr(self._tok, "chat_template", None):

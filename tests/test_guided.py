@@ -163,3 +163,34 @@ def test_regex_anchors():
             guided.compile_regex(bad)
     # escaped $ stays a literal
     assert guided.compile_regex(r"\$\d+").matches(b"$42")
+
+
+def test_repetition_bounds_rejected():
+    """Huge {m,} lower bounds must be rejected before AST expansion
+    (remote DoS guard)."""
+    for bad in [r"a{999999999,}", r"a{300}", r"a{257,}"]:
+        with pytest.raises(ValueError, match="capped"):
+            guided.compile_regex(bad)
+    assert guided.compile_regex(r"a{256}") is not None
+
+
+def test_hf_piece_byte_lift():
+    """SPM/byte-BPE piece markers must lift to their REAL bytes: a lone
+    piece's leading space is exactly what guided matching needs (and
+    what convert_tokens_to_string strips)."""
+    from production_stack_tpu.engine.tokenizer import HFTokenizer
+
+    class FakeHF:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, tid):
+            return {0: "<s>", 1: "▁red", 2: "<0xE4>",
+                    3: "Ġblue", 4: "Ċ"}[tid]
+
+    ht = HFTokenizer.__new__(HFTokenizer)
+    ht._tok = FakeHF()
+    assert ht.id_to_token(1) == ("▁red", list(b" red"))
+    assert ht.id_to_token(2) == ("<0xE4>", [0xE4])
+    assert ht.id_to_token(3) == ("Ġblue", list(b" blue"))
+    assert ht.id_to_token(4) == ("Ċ", list(b"\n"))
+    assert ht.special_token_ids == [0]
