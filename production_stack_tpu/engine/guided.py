@@ -394,9 +394,8 @@ def _compile_cached(pattern: str, tok_key: int):
     # walk every token's bytes from every state, fully vectorized over
     # states: cur [n_states] advances one byte at a time (dead rows
     # stay dead via a guarded gather)
-    specials = set(getattr(tokenizer, "special_token_ids", None)
-                   or (tokenizer.bos_token_id, tokenizer.pad_token_id))
-    specials |= {tokenizer.bos_token_id, tokenizer.pad_token_id}
+    specials = (set(getattr(tokenizer, "special_token_ids", None) or ())
+                | {tokenizer.bos_token_id, tokenizer.pad_token_id})
     eos = tokenizer.eos_token_id
     tok_bytes = _token_bytes(tokenizer, vocab)
     base = np.arange(dfa.n_states, dtype=np.int32)

@@ -49,6 +49,30 @@ class ByteTokenizer:
         return "".join(parts) + "<|assistant|>\n"
 
 
+_BYTE_DECODER = None
+
+
+def _byte_decoder():
+    """The standard byte-level-BPE bytes↔unicode table (GPT-2's
+    bytes_to_unicode), inverted: printable char -> original byte.
+    Covers ALL 256 bytes, so a piece made entirely of these chars is a
+    byte-level piece and inverts exactly."""
+    global _BYTE_DECODER
+    if _BYTE_DECODER is None:
+        bs = (list(range(ord("!"), ord("~") + 1))
+              + list(range(ord("¡"), ord("¬") + 1))
+              + list(range(ord("®"), ord("ÿ") + 1)))
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _BYTE_DECODER = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTE_DECODER
+
+
 class HFTokenizer:
     """Wraps a transformers tokenizer loaded from a checkpoint path."""
 
@@ -61,6 +85,7 @@ class HFTokenizer:
         self.pad_token_id = self._tok.pad_token_id or self._tok.eos_token_id
         self.bos_token = self._tok.bos_token or ""
         self.eos_token = self._tok.eos_token or ""
+        self._byte_level = None   # lazily detected (see _is_byte_level)
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
         return self._tok.encode(text, add_special_tokens=add_bos)
@@ -76,11 +101,14 @@ class HFTokenizer:
         distinct tokens to the replacement char and loses the bytes
         clients need to reassemble UTF-8.
 
-        The raw bytes preserve the piece's leading-space semantics:
-        SentencePiece's ▁ and byte-level BPE's Ġ/Ċ markers map to the
-        actual space/newline (convert_tokens_to_string would STRIP a
-        leading space on a lone piece, which breaks guided matching),
-        and <0xHH> byte-fallback pieces map to their exact byte."""
+        Raw bytes come from the piece's own encoding scheme: byte-level
+        BPE pieces (GPT-2/Llama-3/Qwen style — every char is in the
+        256-entry bytes↔unicode table) invert that table exactly, so a
+        token for "é" lifts as [0xC3, 0xA9], not the mojibake piece's
+        UTF-8; SentencePiece pieces map ▁ to a real space (a lone
+        piece's leading space is load-bearing for guided matching —
+        convert_tokens_to_string would strip it) and <0xHH>
+        byte-fallbacks to their exact byte."""
         piece = self._tok.convert_ids_to_tokens(token_id)
         if piece is None:
             piece = f"<unk:{token_id}>"
@@ -90,10 +118,26 @@ class HFTokenizer:
                 return piece, [int(piece[3:5], 16)]
             except ValueError:
                 pass
-        text = (piece.replace("▁", " ")     # SPM word boundary
-                     .replace("Ġ", " ")     # GPT-2 byte-BPE space
-                     .replace("Ċ", "\n"))   # GPT-2 byte-BPE newline
+        if self._is_byte_level():
+            bd = _byte_decoder()
+            if piece and all(c in bd for c in piece):
+                return piece, [bd[c] for c in piece]
+        text = piece.replace("▁", " ")      # SPM word boundary
         return piece, list(text.encode("utf-8"))
+
+    def _is_byte_level(self) -> bool:
+        """Byte-level BPE (GPT-2/Llama-3/Qwen) vs SentencePiece: decided
+        per TOKENIZER, not per piece — SPM vocabularies also contain
+        chars that happen to be in the byte table (é), which must lift
+        as UTF-8, while in a byte-level vocab the same char IS a byte.
+        The Ġ space marker only exists in byte-level vocabs."""
+        if self._byte_level is None:
+            try:
+                vocab = self._tok.get_vocab()
+                self._byte_level = any("Ġ" in k for k in vocab)
+            except Exception:
+                self._byte_level = False
+        return self._byte_level
 
     @property
     def special_token_ids(self):

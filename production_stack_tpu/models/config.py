@@ -34,11 +34,19 @@ class ModelConfig:
     activation: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
     rms_norm_offset: bool = False   # Gemma: y *= (1 + w), not w
     embed_scale: bool = False       # Gemma: embeddings *= sqrt(hidden)
-    # Mixtral-style MoE: 0 experts = dense MLP. capacity_factor tunes the
-    # prefill dispatch's drop tradeoff (ops/moe.py); decode is exact.
+    # MoE (Mixtral / Qwen2-MoE): 0 experts = dense MLP. capacity_factor
+    # tunes the prefill dispatch's drop tradeoff (ops/moe.py); decode is
+    # exact. Mixtral renormalizes the top-k weights (norm_topk_prob) and
+    # has no shared expert; Qwen2-MoE keeps raw softmax weights, uses a
+    # narrower per-expert FFN (moe_intermediate_size), and adds an
+    # always-on shared expert with a sigmoid gate.
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 2.0
+    norm_topk_prob: bool = True
+    moe_intermediate_size: Optional[int] = None   # default: intermediate
+    shared_expert_size: int = 0                   # 0 = no shared expert
+    moe_naming: str = "mixtral"   # HF weight naming: "mixtral" | "qwen2" 
     dtype: Any = jnp.bfloat16
 
     @property
@@ -50,7 +58,13 @@ class ModelConfig:
         h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
         hd = self.head_dim_
         E = self.num_experts
-        mlp = 3 * h * i * E + h * E if E else 3 * h * i
+        if E:
+            mi = self.moe_intermediate_size or i
+            mlp = 3 * h * mi * E + h * E
+            if self.shared_expert_size:
+                mlp += 3 * h * self.shared_expert_size + h
+        else:
+            mlp = 3 * h * i
         per_layer = (
             h * (self.num_heads * hd)            # q
             + 2 * h * (self.num_kv_heads * hd)   # k, v
@@ -80,14 +94,23 @@ class ModelConfig:
         is_gemma = model_type == "gemma" or arch == "GemmaForCausalLM"
         is_mixtral = (model_type == "mixtral"
                       or arch == "MixtralForCausalLM")
+        is_qwen2_moe = (model_type == "qwen2_moe"
+                        or arch == "Qwen2MoeForCausalLM")
         is_llama_like = (model_type in ("llama", "mistral") or arch in
                          ("LlamaForCausalLM", "MistralForCausalLM"))
-        if not (is_qwen2 or is_gemma or is_mixtral
+        if not (is_qwen2 or is_gemma or is_mixtral or is_qwen2_moe
                 or is_llama_like) and (model_type or arch):
             raise ValueError(
                 f"unsupported model family (model_type={model_type!r}, "
                 f"architecture={arch!r}); supported: llama, mistral, "
-                f"qwen2, gemma, mixtral")
+                f"qwen2, gemma, mixtral, qwen2_moe")
+        if is_qwen2_moe:
+            if (cfg.get("decoder_sparse_step", 1) != 1
+                    or cfg.get("mlp_only_layers")):
+                raise ValueError(
+                    "qwen2_moe with dense interleaving "
+                    "(decoder_sparse_step != 1 or mlp_only_layers) is "
+                    "not supported: every layer must be sparse")
         hidden_act = cfg.get("hidden_act") or cfg.get(
             "hidden_activation") or ("gelu_tanh" if is_gemma else "silu")
         return ModelConfig(
@@ -103,12 +126,24 @@ class ModelConfig:
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
-            attention_bias=cfg.get("attention_bias", is_qwen2),
+            attention_bias=cfg.get("attention_bias",
+                                   is_qwen2 or is_qwen2_moe),
             activation="gelu_tanh" if "gelu" in hidden_act else "silu",
             rms_norm_offset=is_gemma,
             embed_scale=is_gemma,
-            num_experts=cfg.get("num_local_experts", 0) if is_mixtral else 0,
+            num_experts=(cfg.get("num_local_experts", 0) if is_mixtral
+                         else cfg.get("num_experts", 0) if is_qwen2_moe
+                         else 0),
             num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
+            # HF Qwen2MoeConfig defaults norm_topk_prob to FALSE — a
+            # missing key must not flip routing to Mixtral semantics
+            norm_topk_prob=cfg.get("norm_topk_prob", False)
+            if is_qwen2_moe else True,
+            moe_intermediate_size=cfg.get("moe_intermediate_size")
+            if is_qwen2_moe else None,
+            shared_expert_size=cfg.get("shared_expert_intermediate_size",
+                                       0) if is_qwen2_moe else 0,
+            moe_naming="qwen2" if is_qwen2_moe else "mixtral",
             dtype=dtype,
         )
 
@@ -175,6 +210,17 @@ PRESETS: Dict[str, ModelConfig] = {
         max_position_embeddings=32768, num_experts=8,
         num_experts_per_tok=2,
     ),
+    # Qwen1.5-MoE-A2.7B: 60 experts top-4 (raw softmax weights) + an
+    # always-on shared expert behind a sigmoid gate
+    "qwen1.5-moe-a2.7b": ModelConfig(
+        name="qwen1.5-moe-a2.7b", vocab_size=151936, hidden_size=2048,
+        intermediate_size=5632, num_layers=24, num_heads=16,
+        num_kv_heads=16, rope_theta=1000000.0,
+        max_position_embeddings=8192, attention_bias=True,
+        num_experts=60, num_experts_per_tok=4, norm_topk_prob=False,
+        moe_intermediate_size=1408, shared_expert_size=5632,
+        moe_naming="qwen2",
+    ),
     "gemma-7b": ModelConfig(
         name="gemma-7b", vocab_size=256000, hidden_size=3072,
         intermediate_size=24576, num_layers=28, num_heads=16,
@@ -210,6 +256,8 @@ HF_ALIASES: Dict[str, str] = {
     "Qwen/Qwen2.5-7B-Instruct": "qwen2.5-7b",
     "mistralai/Mixtral-8x7B-v0.1": "mixtral-8x7b",
     "mistralai/Mixtral-8x7B-Instruct-v0.1": "mixtral-8x7b",
+    "Qwen/Qwen1.5-MoE-A2.7B": "qwen1.5-moe-a2.7b",
+    "Qwen/Qwen1.5-MoE-A2.7B-Chat": "qwen1.5-moe-a2.7b",
     "google/gemma-2b": "gemma-2b",
     "google/gemma-2b-it": "gemma-2b",
     "google/gemma-7b": "gemma-7b",

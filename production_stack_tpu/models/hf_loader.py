@@ -75,19 +75,36 @@ def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
             stacked = np.swapaxes(stacked, -1, -2)
         layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
     if cfg.num_experts:
-        moe_map = {"gate": "w1", "up": "w3", "down": "w2"}
+        # Mixtral: block_sparse_moe.{gate,experts.N.w1/w3/w2};
+        # Qwen2-MoE: mlp.{gate,experts.N.gate_proj/up_proj/down_proj}
+        # + an always-on shared expert
+        qwen_moe = cfg.moe_naming == "qwen2"
+        prefix = "mlp" if qwen_moe else "block_sparse_moe"
+        moe_map = ({"gate": "gate_proj", "up": "up_proj",
+                    "down": "down_proj"} if qwen_moe
+                   else {"gate": "w1", "up": "w3", "down": "w2"})
         for ours, hf in moe_map.items():
             stacked = np.stack([
                 np.stack([
-                    get(f"layers.{i}.block_sparse_moe.experts.{e}."
-                        f"{hf}.weight").T
+                    get(f"layers.{i}.{prefix}.experts.{e}.{hf}.weight").T
                     for e in range(cfg.num_experts)])
                 for i in range(cfg.num_layers)])     # [L, E, in, out]
             layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
         router = np.stack(
-            [get(f"layers.{i}.block_sparse_moe.gate.weight").T
+            [get(f"layers.{i}.{prefix}.gate.weight").T
              for i in range(cfg.num_layers)])        # [L, h, E]
         layers["router"] = jnp.asarray(router, dtype=cfg.dtype)
+        if qwen_moe and cfg.shared_expert_size:
+            for ours, hf in (("s_gate", "gate_proj"), ("s_up", "up_proj"),
+                             ("s_down", "down_proj")):
+                stacked = np.stack([
+                    get(f"layers.{i}.mlp.shared_expert.{hf}.weight").T
+                    for i in range(cfg.num_layers)])
+                layers[ours] = jnp.asarray(stacked, dtype=cfg.dtype)
+            sg = np.stack(
+                [get(f"layers.{i}.mlp.shared_expert_gate.weight").T
+                 for i in range(cfg.num_layers)])    # [L, h, 1]
+            layers["s_gate_w"] = jnp.asarray(sg, dtype=cfg.dtype)
 
     params = {
         "embed": cast(get("embed_tokens.weight"), False),

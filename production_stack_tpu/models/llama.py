@@ -59,12 +59,21 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     # key order matters: dense models must draw gate/up/down from the
     # same key positions as before MoE existed (seeded tests pin outputs)
     if E:
+        mi = cfg.moe_intermediate_size or i
         params["layers"].update({
-            "gate": w(next(keys), (L, E, h, i)),
-            "up": w(next(keys), (L, E, h, i)),
-            "down": w(next(keys), (L, E, i, h)),
+            "gate": w(next(keys), (L, E, h, mi)),
+            "up": w(next(keys), (L, E, h, mi)),
+            "down": w(next(keys), (L, E, mi, h)),
             "router": w(next(keys), (L, h, E)),
         })
+        if cfg.shared_expert_size:
+            si = cfg.shared_expert_size
+            params["layers"].update({
+                "s_gate": w(next(keys), (L, h, si)),
+                "s_up": w(next(keys), (L, h, si)),
+                "s_down": w(next(keys), (L, si, h)),
+                "s_gate_w": w(next(keys), (L, h, 1)),
+            })
     else:
         params["layers"].update({
             "gate": w(next(keys), (L, h, i)),
@@ -158,10 +167,22 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             capacity_factor=cfg.moe_capacity_factor, act=act,
             valid=None if token_valid is None
             else token_valid.reshape(B * T),
+            renormalize=cfg.norm_topk_prob,
             # decode (T == 1) must be exact: a dropped token would
             # corrupt a live sequence's residual stream mid-generation
             exact=True if T == 1 else None)
-        x = x + y.reshape(B, T, H)
+        if cfg.shared_expert_size:
+            # Qwen2-MoE: an always-on shared expert, scaled by a
+            # per-token sigmoid gate
+            shared = quant.dequant_matmul(
+                act(quant.dequant_matmul(hidden, lp["s_gate"]))
+                * quant.dequant_matmul(hidden, lp["s_up"]),
+                lp["s_down"])
+            y = y.reshape(B, T, H) + jax.nn.sigmoid(
+                hidden @ lp["s_gate_w"]) * shared
+            x = x + y
+        else:
+            x = x + y.reshape(B, T, H)
     else:
         gated = act(proj(hidden, "gate")) * proj(hidden, "up")
         x = x + proj(gated, "down")

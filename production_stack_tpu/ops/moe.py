@@ -58,14 +58,18 @@ def capacity_for(n_tokens: int, num_experts: int, top_k: int,
     return max(8, min(cap, n_tokens))
 
 
-def route(x: jnp.ndarray, router_w: jnp.ndarray, top_k: int):
-    """Mixtral routing. x [N, h], router_w [h, E] ->
-    (weights [N, k] fp32 summing to 1, expert ids [N, k] int32)."""
+def route(x: jnp.ndarray, router_w: jnp.ndarray, top_k: int,
+          renormalize: bool = True):
+    """Top-k routing. x [N, h], router_w [h, E] ->
+    (weights [N, k] fp32, expert ids [N, k] int32). renormalize=True is
+    Mixtral semantics (selected weights re-sum to 1); False keeps the
+    raw softmax probabilities (Qwen2-MoE's norm_topk_prob=False)."""
     logits = jnp.einsum("nh,he->ne", x, router_w,
                         preferred_element_type=jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     top_p, top_i = jax.lax.top_k(probs, top_k)
-    top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+    if renormalize:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
     return top_p, top_i.astype(jnp.int32)
 
 
@@ -152,7 +156,7 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate: jnp.ndarray,
             up: jnp.ndarray, down: jnp.ndarray, *, top_k: int,
             capacity_factor: float = 2.0, dense_threshold: int = 64,
             act: Callable = jax.nn.silu, valid=None,
-            exact=None) -> jnp.ndarray:
+            exact=None, renormalize: bool = True) -> jnp.ndarray:
     """MoE feed-forward. x [N, h]; router_w [h, E]; gate/up [E, h, i];
     down [E, i, h]. Returns [N, h] in x.dtype.
 
@@ -164,7 +168,7 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, gate: jnp.ndarray,
     """
     N = x.shape[0]
     E = _wshape(gate)[0]
-    top_p, top_i = route(x, router_w, top_k)
+    top_p, top_i = route(x, router_w, top_k, renormalize=renormalize)
     if valid is not None:
         top_p = top_p * valid.astype(top_p.dtype)[:, None]
     capacity = capacity_for(N, E, top_k, capacity_factor)

@@ -46,6 +46,12 @@ _MOE_SPECS: Dict[str, P] = {
     "gate": P(None, "ep", None, "tp"),
     "up": P(None, "ep", None, "tp"),
     "down": P(None, "ep", "tp", None),
+    # Qwen2-MoE shared expert: an ordinary dense MLP, megatron-sharded
+    # over tp; its scalar sigmoid gate is replicated
+    "s_gate": P(None, None, "tp"),
+    "s_up": P(None, None, "tp"),
+    "s_down": P(None, "tp", None),
+    "s_gate_w": P(None, None, None),
 }
 
 

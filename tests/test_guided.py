@@ -185,12 +185,29 @@ def test_hf_piece_byte_lift():
 
         def convert_ids_to_tokens(self, tid):
             return {0: "<s>", 1: "▁red", 2: "<0xE4>",
-                    3: "Ġblue", 4: "Ċ"}[tid]
+                    3: "Ġblue", 4: "Ċ", 5: "Ã©"}[tid]
+
+        def get_vocab(self):   # contains Ġ => byte-level detection
+            return {"Ġblue": 3}
 
     ht = HFTokenizer.__new__(HFTokenizer)
     ht._tok = FakeHF()
+    ht._byte_level = None
     assert ht.id_to_token(1) == ("▁red", list(b" red"))
     assert ht.id_to_token(2) == ("<0xE4>", [0xE4])
     assert ht.id_to_token(3) == ("Ġblue", list(b" blue"))
     assert ht.id_to_token(4) == ("Ċ", list(b"\n"))
+    # byte-level piece for "é": inverts the bytes↔unicode table exactly
+    assert ht.id_to_token(5) == ("Ã©", [0xC3, 0xA9])
     assert ht.special_token_ids == [0]
+    # an SPM-style tokenizer (no Ġ in vocab) lifts é as UTF-8 instead
+    class FakeSPM(FakeHF):
+        def get_vocab(self):
+            return {"▁red": 1}
+
+        def convert_ids_to_tokens(self, tid):
+            return {1: "café"}[tid]
+    ht2 = HFTokenizer.__new__(HFTokenizer)
+    ht2._tok = FakeSPM()
+    ht2._byte_level = None
+    assert ht2.id_to_token(1) == ("café", list("café".encode("utf-8")))

@@ -222,3 +222,69 @@ def test_mixtral_incremental_decode_matches_full(tiny_mixtral_pair):
         outs.append(np.asarray(logits)[:, 0])
     np.testing.assert_allclose(np.stack(outs, axis=1), full,
                                atol=1e-3, rtol=0)
+
+
+@pytest.fixture(scope="module")
+def tiny_qwen2_moe_pair():
+    hf_cfg = transformers.Qwen2MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    hf_model = transformers.Qwen2MoeForCausalLM(hf_cfg).eval().to(
+        torch.float32)
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(),
+                                     name="tiny-qwen2-moe",
+                                     dtype=jnp.float32)
+    assert cfg.num_experts == 4 and not cfg.norm_topk_prob
+    assert cfg.moe_intermediate_size == 48
+    assert cfg.shared_expert_size == 96 and cfg.attention_bias
+    params = params_from_state_dict(cfg, hf_model.state_dict())
+    return cfg, params, hf_model
+
+
+def test_qwen2_moe_forward_matches_hf(tiny_qwen2_moe_pair):
+    """Qwen2-MoE family: raw (non-renormalized) top-k routing weights,
+    narrow per-expert FFN, and a sigmoid-gated always-on shared
+    expert."""
+    cfg, params, hf_model = tiny_qwen2_moe_pair
+    rng = np.random.default_rng(6)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 20))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(toks)).logits.numpy()
+    ours = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
+    np.testing.assert_allclose(ours, ref, atol=1e-2, rtol=0)
+
+
+def test_qwen2_moe_dense_interleaving_rejected():
+    with pytest.raises(ValueError, match="sparse"):
+        ModelConfig.from_hf_config({
+            "model_type": "qwen2_moe", "vocab_size": 64,
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 4, "num_attention_heads": 2,
+            "num_experts": 4, "decoder_sparse_step": 2,
+        })
+
+
+def test_qwen2_moe_incremental_decode_matches_full(tiny_qwen2_moe_pair):
+    """The exact T==1 decode path (shared expert + raw top-k weights)
+    under the KV-cache forward — what production serving runs."""
+    cfg, params, hf_model = tiny_qwen2_moe_pair
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, cfg.vocab_size, size=(1, 12))
+    full = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
+    cache = make_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
+                       cfg.head_dim_, dtype=jnp.float32)
+    outs = []
+    for t in range(toks.shape[1]):
+        logits, cache = llama.forward(
+            params, cfg, jnp.asarray(toks[:, t:t + 1]),
+            jnp.asarray([[t]]), cache)
+        outs.append(np.asarray(logits)[:, 0])
+    np.testing.assert_allclose(np.stack(outs, axis=1), full,
+                               atol=1e-3, rtol=0)
