@@ -132,6 +132,8 @@ class ModelRunner:
         self._inject_fns = {}
         # embeddings path, cached per (batch, padded length)
         self._embed_fns = {}
+        # prompt-logprobs (echo) path, cached per (batch, padded length)
+        self._prompt_lp_fns = {}
 
     # ------------------------------------------------------------------
     # jitted impls (pure)
@@ -377,6 +379,59 @@ class ModelRunner:
             fn = self._embed_fns[(N, Tb)] = jax.jit(_impl)
         return fn(self.params, jnp.asarray(tokens, jnp.int32),
                   jnp.asarray(lengths, jnp.int32))
+
+    def prompt_logprobs(self, tokens):
+        """Teacher-forced logprobs of a prompt batch.
+
+        tokens [N, T] int32 np -> fp32 [N, Tb-1] where Tb is T padded
+        to a power-of-two bucket (bounded compile count; callers slice
+        their row to [:len-1] — entry t is log p(tokens[t+1] |
+        tokens[:t+1]) under the raw model distribution, position 0 has
+        none, and entries past a row's real length are padding
+        garbage). The LM head runs in 256-token chunks so only a
+        [N, 256, vocab] fp32 slab materializes — an 8k echo prompt on a
+        150k vocab would otherwise spike ~5 GB of HBM. Like embed(),
+        cache-free and nothing donated: safe to dispatch from the
+        server thread next to the engine loop."""
+        N, T = tokens.shape
+        Tb = max(16, 1 << (T - 1).bit_length())
+        Tb = min(Tb, self.engine_cfg.max_model_len)
+        if Tb < T:
+            raise ValueError(f"prompt length {T} exceeds max_model_len")
+        pad = np.zeros((N, Tb), np.int32)
+        pad[:, :T] = tokens
+        fn = self._prompt_lp_fns.get((N, Tb))
+        if fn is None:
+            logger.info("compiling prompt-logprobs (batch=%d len=%d)",
+                        N, Tb)
+            C = min(256, Tb)
+            n_chunks = -(-(Tb - 1) // C)
+
+            def _impl(params, toks):
+                h = llama.encode(params, self.model_cfg, toks,
+                                 rope=self.rope)
+                hh = h[:, :-1]
+                tg = toks[:, 1:]
+                padded = n_chunks * C
+                hh = jnp.pad(hh, ((0, 0), (0, padded - (Tb - 1)),
+                                  (0, 0)))
+                tg = jnp.pad(tg, ((0, 0), (0, padded - (Tb - 1))))
+                hh = hh.reshape(N, n_chunks, C, -1).transpose(1, 0, 2, 3)
+                tg = tg.reshape(N, n_chunks, C).transpose(1, 0, 2)
+
+                def body(_, xs):
+                    hc, tc = xs
+                    logits = llama._lm_head(params, self.model_cfg, hc)
+                    lse = jax.nn.logsumexp(logits, axis=-1)
+                    tgt = jnp.take_along_axis(
+                        logits, tc[..., None], axis=-1)[..., 0]
+                    return None, tgt - lse
+
+                _, lps = jax.lax.scan(body, None, (hh, tg))
+                return lps.transpose(1, 0, 2).reshape(N, -1)[:, :Tb - 1]
+
+            fn = self._prompt_lp_fns[(N, Tb)] = jax.jit(_impl)
+        return fn(self.params, jnp.asarray(pad, jnp.int32))
 
     def extract_chunk(self, slot: int, start: int, size: int):
         """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the

@@ -199,6 +199,47 @@ def _completion_logprobs(tok, token_ids, logprobs,
                                     top_logprobs=top)
 
 
+async def _prompt_echo(engine, tok, prompt_ids, req):
+    """(prompt_text, CompletionLogprobs-or-None) for legacy echo=true:
+    the prompt text prefixes the completion; with logprobs requested,
+    teacher-forced prompt logprobs are computed in a thread (position 0
+    reports null, OpenAI format). Shared across the n choices."""
+    import numpy as np
+    prompt_text = tok.decode(prompt_ids)
+    if req.logprobs is None:
+        return prompt_text, None
+    runner = engine.engine.runner
+    arr = np.asarray([prompt_ids], np.int32)
+
+    def compute():
+        # result is padded to a length bucket: slice to the real len-1
+        return np.asarray(runner.prompt_logprobs(arr))[
+            0, :len(prompt_ids) - 1].tolist()
+
+    lps = await asyncio.get_running_loop().run_in_executor(None, compute)
+    texts = [tok.id_to_token(t)[0] for t in prompt_ids]
+    token_lps = [None] + [float(v) for v in lps]
+    top = None
+    if req.logprobs > 0:
+        top = [None] + [{text: lp} for text, lp in
+                        zip(texts[1:], token_lps[1:])]
+    return prompt_text, proto.CompletionLogprobs(
+        tokens=texts, token_logprobs=token_lps, top_logprobs=top)
+
+
+def _merge_echo_lp(echo_lp, lp_block):
+    """Prepend the prompt's logprobs block to a completion's."""
+    if echo_lp is None:
+        return lp_block
+    merged = proto.CompletionLogprobs(
+        tokens=echo_lp.tokens + lp_block.tokens,
+        token_logprobs=echo_lp.token_logprobs + lp_block.token_logprobs,
+        top_logprobs=(echo_lp.top_logprobs + lp_block.top_logprobs
+                      if echo_lp.top_logprobs is not None
+                      and lp_block.top_logprobs is not None else None))
+    return merged
+
+
 async def chat_completions(request: web.Request) -> web.StreamResponse:
     engine = request.app[ENGINE_KEY]
     try:
@@ -366,6 +407,15 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
         async def gen():
             exclude = None if include_usage else {"usage"}
+            if req.echo:
+                echo_text, echo_lp = await _prompt_echo(
+                    engine, tok, prompt_ids, req)
+                for i in range(req.n):
+                    chunk = proto.CompletionChunk(
+                        id=rid, model=req.model,
+                        choices=[proto.CompletionChunkChoice(
+                            index=i, text=echo_text, logprobs=echo_lp)])
+                    yield chunk.model_dump_json(exclude=exclude)
             num_tokens = 0
             async with aclosing(_merged_streams(
                     engine, prompt_ids, options, req.model or None,
@@ -400,6 +450,11 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
+    echo_text, echo_lp = ("", None)
+    if req.echo:
+        echo_text, echo_lp = await _prompt_echo(engine, tok, prompt_ids,
+                                                req)
+
     async def collect_one(i: int):
         parts: List[str] = []
         out_ids: List[int] = []
@@ -418,11 +473,17 @@ async def completions(request: web.Request) -> web.StreamResponse:
                         out_lps.append(out.logprob)
                 if out.finished:
                     finish_reason = out.finish_reason
+        lp_block = (_completion_logprobs(tok, out_ids, out_lps,
+                                         req.logprobs > 0)
+                    if req.logprobs is not None else None)
+        if req.echo:
+            lp_block = (_merge_echo_lp(echo_lp, lp_block)
+                        if lp_block is not None else None)
         choice = proto.CompletionChoice(
-            index=i, text="".join(parts), finish_reason=finish_reason,
-            logprobs=(_completion_logprobs(tok, out_ids, out_lps,
-                                           req.logprobs > 0)
-                      if req.logprobs is not None else None))
+            index=i,
+            text=(echo_text if req.echo else "") + "".join(parts),
+            finish_reason=finish_reason,
+            logprobs=lp_block)
         return choice, tokens
 
     results = await _gather_cancelling(

@@ -326,3 +326,31 @@ def test_seeded_sampling_reproducible(engine):
         assert a1 == a2, "same seed must reproduce"
         assert a1 != b, "different seeds should diverge"
     _with_client(engine, body)
+
+
+def test_completions_echo_with_prompt_logprobs(engine):
+    """Legacy echo=true: the prompt text prefixes the completion, and
+    with logprobs the prompt's teacher-forced logprobs are prepended
+    (first token null, OpenAI format)."""
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "echo me", "max_tokens": 3,
+            "temperature": 0.0, "echo": True, "logprobs": 0})
+        assert r.status == 200
+        choice = (await r.json())["choices"][0]
+        assert choice["text"].startswith("echo me")
+        lp = choice["logprobs"]
+        n_prompt = len((await (await client.post(
+            "/tokenize", json={"prompt": "echo me"})).json())["tokens"])
+        assert len(lp["tokens"]) == n_prompt + 3
+        assert lp["token_logprobs"][0] is None          # position 0
+        assert all(v is not None and v <= 0.0
+                   for v in lp["token_logprobs"][1:])
+        # echo without logprobs: just the text prefix
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": "echo me", "max_tokens": 2,
+            "temperature": 0.0, "echo": True})
+        choice = (await r.json())["choices"][0]
+        assert choice["text"].startswith("echo me")
+        assert choice["logprobs"] is None
+    _with_client(engine, body)
