@@ -105,32 +105,39 @@ async def _gather_cancelling(coros):
         raise
 
 
-def _merged_streams(engine, prompt_ids, options, model, n):
-    """Run n independent generations concurrently and yield
-    (choice_index, StepOutput) in completion order — the OpenAI n>1
-    streaming shape (each chunk carries its choice index). A pump
-    failure propagates to the consumer (and cancels its siblings via
-    the generator's finally); closing the generator cancels all pumps
-    and frees their slots."""
+def _choice_jobs(prompts, options, n):
+    """The OpenAI choice grid: every (prompt, sample) pair gets a
+    choice index prompt_idx * n + sample_idx. Returns
+    [(index, prompt_ids, per-choice options)]."""
+    return [(p * n + j, pids, _choice_options(options, j))
+            for p, pids in enumerate(prompts) for j in range(n)]
+
+
+def _merged_streams(engine, jobs, model):
+    """Run the jobs [(choice_index, prompt_ids, options)] concurrently
+    and yield (choice_index, StepOutput) in completion order — the
+    OpenAI n>1 / batched-prompt streaming shape (each chunk carries its
+    choice index). A pump failure propagates to the consumer (and
+    cancels its siblings via the generator's finally); closing the
+    generator cancels all pumps and frees their slots."""
     async def gen():
         q: asyncio.Queue = asyncio.Queue()
 
-        async def pump(i):
+        async def pump(idx, pids, opts):
             try:
                 async with aclosing(engine.stream(
-                        list(prompt_ids), _choice_options(options, i),
-                        model=model)) as it:
+                        list(pids), opts, model=model)) as it:
                     async for out in it:
-                        await q.put((i, out))
+                        await q.put((idx, out))
             except BaseException as e:  # noqa: BLE001 — re-raised below
-                await q.put((i, e))
+                await q.put((idx, e))
                 return
-            await q.put((i, None))
+            await q.put((idx, None))
 
-        tasks = [asyncio.ensure_future(pump(i)) for i in range(n)]
+        tasks = [asyncio.ensure_future(pump(*job)) for job in jobs]
         try:
             done = 0
-            while done < n:
+            while done < len(jobs):
                 i, out = await q.get()
                 if out is None:
                     done += 1
@@ -199,32 +206,41 @@ def _completion_logprobs(tok, token_ids, logprobs,
                                     top_logprobs=top)
 
 
-async def _prompt_echo(engine, tok, prompt_ids, req):
-    """(prompt_text, CompletionLogprobs-or-None) for legacy echo=true:
-    the prompt text prefixes the completion; with logprobs requested,
-    teacher-forced prompt logprobs are computed in a thread (position 0
-    reports null, OpenAI format). Shared across the n choices."""
+async def _prompt_echo_blocks(engine, tok, prompts, req):
+    """[(prompt_text, CompletionLogprobs-or-None)] per prompt for
+    legacy echo=true: the prompt text prefixes the completion; with
+    logprobs requested, teacher-forced prompt logprobs for ALL prompts
+    are computed in ONE padded batched device call (position 0 reports
+    null, OpenAI format). Each block is shared by its n choices."""
     import numpy as np
-    prompt_text = tok.decode(prompt_ids)
+    texts = [tok.decode(p) for p in prompts]
     if req.logprobs is None:
-        return prompt_text, None
+        return [(t, None) for t in texts]
     runner = engine.engine.runner
-    arr = np.asarray([prompt_ids], np.int32)
+    T = max(len(p) for p in prompts)
+    arr = np.zeros((len(prompts), T), np.int32)
+    for r, p in enumerate(prompts):
+        arr[r, :len(p)] = p
 
     def compute():
-        # result is padded to a length bucket: slice to the real len-1
-        return np.asarray(runner.prompt_logprobs(arr))[
-            0, :len(prompt_ids) - 1].tolist()
+        # rows are padded to a shared bucket: slice each to its len-1
+        out = np.asarray(runner.prompt_logprobs(arr))
+        return [out[r, :len(p) - 1].tolist()
+                for r, p in enumerate(prompts)]
 
-    lps = await asyncio.get_running_loop().run_in_executor(None, compute)
-    texts = [tok.id_to_token(t)[0] for t in prompt_ids]
-    token_lps = [None] + [float(v) for v in lps]
-    top = None
-    if req.logprobs > 0:
-        top = [None] + [{text: lp} for text, lp in
-                        zip(texts[1:], token_lps[1:])]
-    return prompt_text, proto.CompletionLogprobs(
-        tokens=texts, token_logprobs=token_lps, top_logprobs=top)
+    all_lps = await asyncio.get_running_loop().run_in_executor(
+        None, compute)
+    blocks = []
+    for text, pids, lps in zip(texts, prompts, all_lps):
+        pieces = [tok.id_to_token(t)[0] for t in pids]
+        token_lps = [None] + [float(v) for v in lps]
+        top = None
+        if req.logprobs > 0:
+            top = [None] + [{pc: lp} for pc, lp in
+                            zip(pieces[1:], token_lps[1:])]
+        blocks.append((text, proto.CompletionLogprobs(
+            tokens=pieces, token_logprobs=token_lps, top_logprobs=top)))
+    return blocks
 
 
 def _merge_echo_lp(echo_lp, lp_block):
@@ -290,8 +306,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             # aclosing => a dropped consumer deterministically runs
             # every stream's cleanup (slot aborts), not at GC's leisure
             async with aclosing(_merged_streams(
-                    engine, prompt_ids, options, req.model or None,
-                    req.n)) as it:
+                    engine, _choice_jobs([prompt_ids], options, req.n),
+                    req.model or None)) as it:
                 async for i, out in it:
                     if out.new_token is not None:
                         num_tokens += 1
@@ -381,19 +397,25 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
     tok = engine.tokenizer
     prompt = req.prompt
-    if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
-        prompt_ids = list(prompt)
-    elif isinstance(prompt, str):
-        prompt_ids = tok.encode(prompt)
-    elif isinstance(prompt, list) and len(prompt) == 1 and isinstance(
-            prompt[0], str):
-        prompt_ids = tok.encode(prompt[0])
-    else:
-        return _error(400, "batched prompts are not supported yet")
-    if len(prompt_ids) >= engine.engine.cfg.max_model_len:
-        return _error(400, f"prompt has {len(prompt_ids)} tokens, which "
-                           f"exceeds max_model_len "
-                           f"{engine.engine.cfg.max_model_len}")
+    # cap the choice grid BEFORE tokenizing a potentially huge batch on
+    # the event loop ([int] prompts are one prompt, not a batch)
+    if (isinstance(prompt, list) and prompt
+            and isinstance(prompt[0], (str, list))
+            and len(prompt) * req.n > 128):
+        return _error(400, "len(prompt) * n must be <= 128")
+    try:
+        prompts = _as_token_lists(engine, prompt)
+    except ValueError as e:
+        return _error(400, str(e))
+    if not prompts or any(not p for p in prompts):
+        return _error(400, "prompt must not be (or contain) empty input")
+    if len(prompts) * req.n > 128:
+        return _error(400, "len(prompt) * n must be <= 128")
+    for pids in prompts:
+        if len(pids) >= engine.engine.cfg.max_model_len:
+            return _error(400, f"prompt has {len(pids)} tokens, which "
+                               f"exceeds max_model_len "
+                               f"{engine.engine.cfg.max_model_len}")
     try:
         options = _sampling_options(req, req.max_tokens)
         await _precompile_guided(engine, options)
@@ -401,25 +423,31 @@ async def completions(request: web.Request) -> web.StreamResponse:
         return _error(400, f"invalid guided decoding constraint: {e}")
     rid = proto._gen_id("cmpl")
 
+    # echo blocks are computed BEFORE any response starts: first-time
+    # compiles and failures become a clean 500/400 here instead of a
+    # truncated SSE stream (same policy as _precompile_guided)
+    echo_blocks = []
+    if req.echo:
+        echo_blocks = await _prompt_echo_blocks(engine, tok, prompts, req)
+
     if req.stream:
         include_usage = bool(req.stream_options
                              and req.stream_options.include_usage)
 
         async def gen():
             exclude = None if include_usage else {"usage"}
-            if req.echo:
-                echo_text, echo_lp = await _prompt_echo(
-                    engine, tok, prompt_ids, req)
-                for i in range(req.n):
+            for p, (echo_text, echo_lp) in enumerate(echo_blocks):
+                for j in range(req.n):
                     chunk = proto.CompletionChunk(
                         id=rid, model=req.model,
                         choices=[proto.CompletionChunkChoice(
-                            index=i, text=echo_text, logprobs=echo_lp)])
+                            index=p * req.n + j, text=echo_text,
+                            logprobs=echo_lp)])
                     yield chunk.model_dump_json(exclude=exclude)
             num_tokens = 0
             async with aclosing(_merged_streams(
-                    engine, prompt_ids, options, req.model or None,
-                    req.n)) as it:
+                    engine, _choice_jobs(prompts, options, req.n),
+                    req.model or None)) as it:
                 async for i, out in it:
                     if out.new_token is not None:
                         num_tokens += 1
@@ -441,29 +469,24 @@ async def completions(request: web.Request) -> web.StreamResponse:
                                 logprobs=lp_block)])
                         yield chunk.model_dump_json(exclude=exclude)
             if include_usage:
+                n_prompt = sum(len(p) for p in prompts)
                 tail = proto.CompletionChunk(
                     id=rid, model=req.model, choices=[],
                     usage=proto.UsageInfo(
-                        prompt_tokens=len(prompt_ids),
+                        prompt_tokens=n_prompt,
                         completion_tokens=num_tokens,
-                        total_tokens=len(prompt_ids) + num_tokens))
+                        total_tokens=n_prompt + num_tokens))
                 yield tail.model_dump_json()
         return await _sse_stream(request, gen())
 
-    echo_text, echo_lp = ("", None)
-    if req.echo:
-        echo_text, echo_lp = await _prompt_echo(engine, tok, prompt_ids,
-                                                req)
-
-    async def collect_one(i: int):
+    async def collect_one(idx: int, pids, opts):
         parts: List[str] = []
         out_ids: List[int] = []
         out_lps: List = []
         tokens = 0
         finish_reason = None
         async with aclosing(engine.stream(
-                list(prompt_ids), _choice_options(options, i),
-                model=req.model or None)) as it:
+                list(pids), opts, model=req.model or None)) as it:
             async for out in it:
                 parts.append(out.text_delta)
                 if out.new_token is not None:
@@ -476,25 +499,29 @@ async def completions(request: web.Request) -> web.StreamResponse:
         lp_block = (_completion_logprobs(tok, out_ids, out_lps,
                                          req.logprobs > 0)
                     if req.logprobs is not None else None)
+        echo_text = ""
         if req.echo:
+            echo_text, echo_lp = echo_blocks[idx // req.n]
             lp_block = (_merge_echo_lp(echo_lp, lp_block)
                         if lp_block is not None else None)
         choice = proto.CompletionChoice(
-            index=i,
-            text=(echo_text if req.echo else "") + "".join(parts),
+            index=idx,
+            text=echo_text + "".join(parts),
             finish_reason=finish_reason,
             logprobs=lp_block)
         return choice, tokens
 
     results = await _gather_cancelling(
-        [collect_one(i) for i in range(req.n)])
+        [collect_one(*job)
+         for job in _choice_jobs(prompts, options, req.n)])
     num_tokens = sum(t for _, t in results)
+    n_prompt = sum(len(p) for p in prompts)
     resp = proto.CompletionResponse(
         id=rid, model=req.model,
         choices=[c for c, _ in results],
         usage=proto.UsageInfo(
-            prompt_tokens=len(prompt_ids), completion_tokens=num_tokens,
-            total_tokens=len(prompt_ids) + num_tokens))
+            prompt_tokens=n_prompt, completion_tokens=num_tokens,
+            total_tokens=n_prompt + num_tokens))
     return web.json_response(resp.model_dump())
 
 

@@ -354,3 +354,37 @@ def test_completions_echo_with_prompt_logprobs(engine):
         assert choice["text"].startswith("echo me")
         assert choice["logprobs"] is None
     _with_client(engine, body)
+
+
+def test_completions_batched_prompts(engine):
+    """Legacy batched prompts: choices indexed prompt-major x n."""
+    async def body(client):
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": ["first", "second"],
+            "max_tokens": 3, "temperature": 0.0, "n": 2})
+        assert r.status == 200
+        data = await r.json()
+        assert [c["index"] for c in data["choices"]] == [0, 1, 2, 3]
+        assert data["usage"]["completion_tokens"] == 12
+        # greedy: both samples of one prompt agree; prompts may differ
+        assert data["choices"][0]["text"] == data["choices"][1]["text"]
+        assert data["choices"][2]["text"] == data["choices"][3]["text"]
+
+        # echo with a batch: each choice carries its OWN prompt
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": ["alpha", "bravo"],
+            "max_tokens": 2, "temperature": 0.0, "echo": True})
+        choices = (await r.json())["choices"]
+        assert choices[0]["text"].startswith("alpha")
+        assert choices[1]["text"].startswith("bravo")
+
+        r = await client.post("/v1/completions", json={
+            "model": "debug-tiny", "prompt": ["x"] * 100, "n": 2,
+            "max_tokens": 1})
+        assert r.status == 400   # len(prompt) * n cap
+        # empty prompts (top-level or nested) are rejected, not hung
+        for bad in ([], [[]], [[1, 2], []]):
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": bad, "max_tokens": 1})
+            assert r.status == 400, bad
+    _with_client(engine, body)
