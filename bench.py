@@ -24,6 +24,14 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 REF_PATH = os.path.join(REPO, "BENCH_REF.json")
 
+# Make JAX_PLATFORMS authoritative before backend init (no-op when the
+# env var is unset, i.e. on the driver's real-TPU run): with the TPU
+# tunnel wedged, the sitecustomize-registered plugin can hang even a
+# JAX_PLATFORMS=cpu run at backend discovery unless the config is
+# pinned first — same call every server entry point makes.
+from production_stack_tpu.utils import honor_platform_env  # noqa: E402
+honor_platform_env()
+
 
 def run_bench(small: bool) -> dict:
     from production_stack_tpu.engine.config import EngineConfig
