@@ -196,3 +196,67 @@ def test_dynamic_config_hot_reload(tmp_path):
         for s in servers:
             await s.close()
     asyncio.run(body())
+
+
+def test_round3_api_surface_through_router():
+    """The round-3 request extensions (guided decoding, logprobs, n>1,
+    seed, echo) pass through the router's streaming proxy unchanged and
+    come back with their full response shapes."""
+    import asyncio
+    import re as re_mod
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.server import (
+        build_app as build_engine_app)
+    from production_stack_tpu.router.app import (
+        build_app as build_router_app, parse_args)
+
+    async_eng = AsyncLLMEngine(EngineConfig(
+        model="debug-tiny", max_model_len=128, max_num_seqs=2,
+        prefill_chunk=32, prefill_buckets=(16, 32)))
+
+    async def body():
+        engine_server = TestServer(build_engine_app(async_eng))
+        await engine_server.start_server()
+        url = f"http://127.0.0.1:{engine_server.port}"
+        router_app = build_router_app(parse_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "debug-tiny"]))
+        async with TestClient(TestServer(router_app)) as client:
+            # guided choice + logprobs, via the router
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "pick"}],
+                "max_tokens": 12, "temperature": 1.0, "logprobs": True,
+                "guided_choice": ["left", "right"]})
+            assert r.status == 200, await r.text()
+            choice = (await r.json())["choices"][0]
+            assert choice["message"]["content"] in ("left", "right")
+            assert choice["logprobs"]["content"]
+
+            # n>1 + seed + guided regex on completions
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "id", "max_tokens": 10,
+                "temperature": 1.0, "n": 2, "seed": 5,
+                "guided_regex": r"[0-9]{2}"})
+            assert r.status == 200, await r.text()
+            choices = (await r.json())["choices"]
+            assert [c["index"] for c in choices] == [0, 1]
+            for c in choices:
+                assert re_mod.fullmatch(r"[0-9]{2}", c["text"]), c
+
+            # echo + prompt logprobs
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "router echo",
+                "max_tokens": 2, "temperature": 0.0, "echo": True,
+                "logprobs": 0})
+            assert r.status == 200, await r.text()
+            c = (await r.json())["choices"][0]
+            assert c["text"].startswith("router echo")
+            assert c["logprobs"]["token_logprobs"][0] is None
+        await engine_server.close()
+
+    asyncio.run(body())
