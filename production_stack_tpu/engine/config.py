@@ -46,6 +46,11 @@ class EngineConfig:
     # weight-only int8 (models/quant.py): halves decode weight-streaming
     # HBM traffic; None serves in --dtype precision
     quantization: Optional[str] = None
+    # n-gram (prompt-lookup) speculative decoding: draft length per
+    # macro-step (0 = off). Activates only on all-greedy, unguided
+    # decode windows, where argmax verification is exact; other windows
+    # silently run the normal path (engine/runner.py).
+    speculative_ngram_tokens: int = 0
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     # in-HBM prefix cache (kvcache/hbm_pool.py): finished sequences'
@@ -87,6 +92,8 @@ class EngineConfig:
                 "replicaCount across slices")
         if self.expert_parallel_size < 1:
             raise ValueError("expert_parallel_size must be >= 1")
+        if not 0 <= self.speculative_ngram_tokens <= 16:
+            raise ValueError("speculative_ngram_tokens must be in 0..16")
         if self.quantization not in (None, "int8"):
             raise ValueError(
                 f"quantization={self.quantization!r} unsupported: only "

@@ -177,6 +177,11 @@ class LLMEngine:
         # host re-uploads its mirrors only when this is set (admission,
         # finish, abort — any slot-composition change)
         self._decode_dirty = True
+        # speculative-ngram history re-upload flag: tracked separately
+        # because the history matrix is only (re)built for windows that
+        # actually speculate — a stale device history can only degrade
+        # DRAFT quality, never correctness (verification ignores it)
+        self._hist_dirty = True
         # one decode window kept in flight between step() calls: the next
         # window is dispatched right after the previous one is processed,
         # so the device (and the host<->TPU tunnel) works while outputs
@@ -345,6 +350,7 @@ class LLMEngine:
                     seq, int(ids[seq.slot]), float(lps[seq.slot])))
         # prefill changed slot contents/positions: refresh decode carry
         self._decode_dirty = True
+        self._hist_dirty = True
         return outputs
 
     def _ensure_dev_sampling(self) -> None:
@@ -396,8 +402,6 @@ class LLMEngine:
         """Launch one decode window (async dispatch; no host sync)."""
         W = self.cfg.decode_window
         max_pos = max(s.next_position for s in decode_seqs)
-        kv_len = self.cfg.kv_bucket_for(
-            min(max_pos + W + 1, self.cfg.max_model_len))
         greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
         self._ensure_dev_sampling()
         gtable = gids = None
@@ -407,18 +411,32 @@ class LLMEngine:
             for s in decode_seqs:
                 if s.grammar is not None:
                     gids[s.slot] = gid_map[s.options.guided_regex]
-        if self._decode_dirty:
+        # n-gram speculation: greedy-only (argmax verify is exact) and
+        # never with guided rows (drafts would bypass the DFA mask)
+        spec = (self.cfg.speculative_ngram_tokens
+                if greedy and gtable is None else 0)
+        kv_len = self.cfg.kv_bucket_for(
+            min(max_pos + W * (spec + 1) + 1, self.cfg.max_model_len))
+        hist = None
+        if spec and (self._hist_dirty or self._decode_dirty):
+            # only built for windows that will actually read it; spec=0
+            # windows skip the [B, S] host build + upload entirely
+            hist = np.zeros((self.cfg.max_num_seqs,
+                             self.cfg.max_model_len), np.int32)
+            for s in decode_seqs:
+                row = s.prompt_tokens + s.output_tokens
+                hist[s.slot, :len(row)] = row
+            self._hist_dirty = False
+        if self._decode_dirty or hist is not None:
             self.runner.set_decode_state(self._slot_token, self._slot_pos,
-                                         self._slot_gstate)
+                                         self._slot_gstate, hist)
             self._decode_dirty = False
         seeded = any(s.options.seed is not None for s in decode_seqs)
-        ids_dev, lps_dev = self.runner.decode(self._dev_sampling, steps=W,
-                                              kv_len=kv_len, greedy=greedy,
-                                              seeded=seeded,
-                                              guide_table=gtable,
-                                              guide_ids=gids)
-        self._inflight = (ids_dev, lps_dev, W, list(decode_seqs),
-                          time.monotonic())
+        ids_dev, lps_dev, counts_dev = self.runner.decode(
+            self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
+            seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec)
+        self._inflight = (ids_dev, lps_dev, counts_dev, W,
+                          list(decode_seqs), time.monotonic())
 
     def _drain_decode(self) -> List[StepOutput]:
         """Sync + process the in-flight window, if any. A sequence that
@@ -426,21 +444,42 @@ class LLMEngine:
         (its slot is parked and the decode carry marked dirty)."""
         if self._inflight is None:
             return []
-        ids_dev, lps_dev, W, seqs, t0 = self._inflight
+        ids_dev, lps_dev, counts_dev, W, seqs, t0 = self._inflight
         self._inflight = None
-        ids = np.asarray(ids_dev)  # [B, W] — the window's single sync
+        ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
+        counts = None if counts_dev is None else np.asarray(counts_dev)
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
+        # per-token latency: under speculation a macro-step emits several
+        # verified tokens, so divide the window wall by tokens EMITTED
+        if counts is None or not alive:
+            per_tok_dt = dt / W
+        else:
+            emitted = int(sum(counts[s.slot].sum() for s in alive))
+            per_tok_dt = dt / max(1, emitted)
         for j in range(W):
             still = []
             for seq in alive:
-                self.metrics.per_token.observe(dt / W)
-                outs = self._accept_token(seq, int(ids[seq.slot, j]),
-                                          float(lps[seq.slot, j]))
-                outputs.extend(outs)
-                if not outs[-1].finished:
+                if counts is None:
+                    row = [(int(ids[seq.slot, j]),
+                            float(lps[seq.slot, j]))]
+                else:
+                    # speculative macro-step: 1..spec+1 verified tokens
+                    c = int(counts[seq.slot, j])
+                    row = [(int(ids[seq.slot, j, t]),
+                            float(lps[seq.slot, j, t]))
+                           for t in range(c)]
+                finished = False
+                for token, lp in row:
+                    self.metrics.per_token.observe(per_tok_dt)
+                    outs = self._accept_token(seq, token, lp)
+                    outputs.extend(outs)
+                    if outs[-1].finished:
+                        finished = True
+                        break
+                if not finished:
                     still.append(seq)
             alive = still
             if not alive:
@@ -563,6 +602,7 @@ class LLMEngine:
             self._slot_pos[slot] = self.cfg.max_model_len
             self._slot_gstate[slot] = 0
             self._decode_dirty = True
+            self._hist_dirty = True
 
     def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
         """Mean-pooled prompt embeddings [n, H] fp32 (the /v1/embeddings

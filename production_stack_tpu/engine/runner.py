@@ -201,6 +201,79 @@ class ModelRunner:
             jnp.arange(steps))
         return ids.T, lps.T, toks, pos, gstate, cache  # ids/lps [B, steps]
 
+    def _decode_spec_impl(self, params, cache: KVCache,
+                          tokens: jnp.ndarray, positions: jnp.ndarray,
+                          history: jnp.ndarray,
+                          sampling: SamplingParams, *, steps: int,
+                          kv_len: int, spec: int):
+        """GREEDY decode window with n-gram (prompt-lookup) speculation.
+
+        tokens/positions [B]; history [B, S] device-resident token ids
+        (hist[b, t] = sequence b's token at position t, live through
+        `positions[b]`). Each of the `steps` macro-steps drafts `spec`
+        tokens by copying what followed the most recent PRIOR occurrence
+        of the current bigram in the history, verifies all spec+1
+        positions in one forward, and emits the agreeing prefix plus the
+        bonus token — between 1 and spec+1 tokens per macro-step, exact
+        greedy semantics by construction (every emitted token is an
+        argmax given the true prefix).
+
+        Returns (ids [B, steps, spec+1], logprobs same, counts
+        [B, steps] valid-token counts, tokens', positions', history',
+        cache'). Rejected draft positions hold garbage K/V past the
+        live length; the write-then-attend invariant (models/kv.py)
+        makes them unobservable, exactly like window tail waste.
+        """
+        B = tokens.shape[0]
+        S = history.shape[1]
+        K = spec
+
+        def draft_row(hist, pos):
+            # latest i < pos with (hist[i-1], hist[i]) == current bigram
+            a = hist[jnp.maximum(pos - 1, 0)]
+            c = hist[pos]
+            idx = jnp.arange(S)
+            m = ((idx >= 1) & (idx < pos)
+                 & (jnp.roll(hist, 1) == a) & (hist == c))
+            j = jnp.max(jnp.where(m, idx, 0))     # 0 = no match
+            return jax.lax.dynamic_slice(hist, (j + 1,), (K,))
+
+        def body(carry, _):
+            cache, toks, pos, hist = carry
+            draft = jax.vmap(draft_row)(hist, pos)          # [B, K]
+            step_toks = jnp.concatenate([toks[:, None], draft], axis=1)
+            step_pos = pos[:, None] + jnp.arange(K + 1)[None, :]
+            logits, cache = llama.forward(
+                params, self.model_cfg, step_toks, step_pos, cache,
+                rope=self.rope, kv_len=kv_len, use_flash=False,
+                lora_params=self._lora, adapter_ids=sampling.adapter,
+                lora_scaling=self._lora_scaling)
+            expected = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
+                expected[..., None], axis=-1)[..., 0]       # [B, K+1]
+            agree = (draft == expected[:, :K])
+            accepted = jnp.sum(jnp.cumprod(
+                agree.astype(jnp.int32), axis=1), axis=1)   # [B] in 0..K
+            count = accepted + 1                            # emitted
+            new_pos = pos + count
+            new_toks = jnp.take_along_axis(
+                expected, (count - 1)[:, None], axis=1)[:, 0]
+
+            def write_row(h, p, emitted):
+                return jax.lax.dynamic_update_slice(h, emitted,
+                                                    (p + 1,))
+            hist = jax.vmap(write_row)(hist, pos, expected)
+            return (cache, new_toks, new_pos, hist), (expected, lp,
+                                                      count)
+
+        (cache, toks, pos, hist), (ids, lps, counts) = jax.lax.scan(
+            body, (cache, tokens, positions, history),
+            jnp.arange(steps))
+        # scan stacks on axis 0: -> [B, steps, K+1] / [B, steps]
+        return (ids.transpose(1, 0, 2), lps.transpose(1, 0, 2),
+                counts.T, toks, pos, hist, cache)
+
     def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
                       starts: jnp.ndarray, lengths: jnp.ndarray,
                       sampling: SamplingParams, key: jax.Array,
@@ -251,26 +324,51 @@ class ModelRunner:
         return sub
 
     def set_decode_state(self, tokens, positions,
-                         guide_states=None) -> None:
-        """Upload fresh decode inputs (host mirrors -> device carry)."""
+                         guide_states=None, history=None) -> None:
+        """Upload fresh decode inputs (host mirrors -> device carry).
+        history [B, S] token ids (speculative n-gram drafting) is only
+        uploaded when the engine runs with speculation enabled."""
         self._dec_tokens = jnp.asarray(tokens, jnp.int32)
         self._dec_pos = jnp.asarray(positions, jnp.int32)
         self._dec_gstate = (jnp.zeros_like(self._dec_tokens)
                             if guide_states is None
                             else jnp.asarray(guide_states, jnp.int32))
+        self._dec_hist = (None if history is None
+                          else jnp.asarray(history, jnp.int32))
 
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
-               seeded: bool = False, guide_table=None, guide_ids=None):
+               seeded: bool = False, guide_table=None, guide_ids=None,
+               spec: int = 0):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
-        (ids, logprobs), each [B, steps] (np-convertible; the first
-        np.asarray() is the window's single sync).
+        (ids, logprobs, counts): without speculation ids/logprobs are
+        [B, steps] and counts is None; with spec > 0 (greedy, unguided
+        windows only) they are [B, steps, spec+1] plus counts [B, steps]
+        of valid tokens per macro-step (_decode_spec_impl). The first
+        np.asarray() is the window's single sync.
 
         guide_table [G, S, V] device int32 + guide_ids [B] activate
         constrained sampling (engine/guided.py); the per-row DFA state
         rides the device carry like tokens/positions."""
         kv_len = kv_len or self.engine_cfg.max_model_len
+        if spec:
+            assert greedy and guide_table is None
+            fn = self._decode_fns.get(("spec", steps, kv_len, spec))
+            if fn is None:
+                logger.info("compiling speculative decode window "
+                            "(steps=%d kv=%d draft=%d)", steps, kv_len,
+                            spec)
+                fn = jax.jit(
+                    partial(self._decode_spec_impl, steps=steps,
+                            kv_len=kv_len, spec=spec),
+                    donate_argnums=(1,))
+                self._decode_fns[("spec", steps, kv_len, spec)] = fn
+            (ids, lps, counts, self._dec_tokens, self._dec_pos,
+             self._dec_hist, self.cache) = fn(
+                self.params, self.cache, self._dec_tokens,
+                self._dec_pos, self._dec_hist, sampling)
+            return ids, lps, counts
         seeded = seeded and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
@@ -295,7 +393,7 @@ class ModelRunner:
             self.params, self.cache, self._dec_tokens, self._dec_pos,
             sampling, self._next_key(), guide_table,
             jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
-        return ids, lps
+        return ids, lps, None
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int, guide_table=None, guide_ids=None,
@@ -488,6 +586,17 @@ class ModelRunner:
                               np.full((B,), S, np.int32))
         # both decode variants: greedy AND sampled (the API default is
         # temperature=1.0, so sampled is the common serving case)
+        if cfg.speculative_ngram_tokens:
+            # spec-enabled greedy windows use the speculative executable,
+            # not the plain greedy one — compile the real hot path
+            self.set_decode_state(
+                np.zeros((B,), np.int32), np.full((B,), S, np.int32),
+                history=np.zeros((B, S), np.int32))
+            self.decode(sampling, steps=cfg.decode_window,
+                        kv_len=cfg.kv_len_buckets[0], greedy=True,
+                        spec=cfg.speculative_ngram_tokens)
+            self.set_decode_state(np.zeros((B,), np.int32),
+                                  np.full((B,), S, np.int32))
         self.decode(sampling, steps=cfg.decode_window,
                     kv_len=cfg.kv_len_buckets[0], greedy=True)
         self.set_decode_state(np.zeros((B,), np.int32),

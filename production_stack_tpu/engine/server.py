@@ -783,6 +783,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="shard a MoE model's experts over the mesh's ep "
                         "axis (must divide num_experts; composes with "
                         "--tensor-parallel-size)")
+    p.add_argument("--speculative-ngram-tokens", type=int, default=0,
+                   help="n-gram (prompt-lookup) speculative decoding "
+                        "draft length; greedy requests emit up to N+1 "
+                        "verified tokens per decode step (0 = off)")
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="weight-only int8: halves decode weight-"
                         "streaming HBM traffic (norms/biases/router "
@@ -844,7 +848,9 @@ def main(argv=None) -> None:
         pipeline_parallel_size=args.pipeline_parallel_size,
         expert_parallel_size=args.expert_parallel_size,
         moe_capacity_factor=args.moe_capacity_factor,
-        quantization=args.quantization, seed=args.seed,
+        quantization=args.quantization,
+        speculative_ngram_tokens=args.speculative_ngram_tokens,
+        seed=args.seed,
         kv_transfer_config=kv_transfer,
         lora_adapters=dict(pair.split("=", 1)
                            for pair in args.lora_adapters.split(","))
