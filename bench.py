@@ -5,18 +5,34 @@ tokens/sec) of the TPU-native engine on a TinyLlama-1.1B-geometry model
 (random weights — throughput is weight-value-independent), batch 8,
 128-token prompts, 128 generated tokens per request, greedy.
 
+Failure model (this harness must produce a verifiable number in EVERY
+world — two of the first three rounds lost their perf record to a wedged
+TPU tunnel that hangs `jax.devices()` forever):
+- the parent process NEVER imports jax. All backend work happens in
+  child processes with hard timeouts.
+- TPU liveness is probed in a subprocess (bounded retries). Only a
+  passing probe admits a TPU attempt; a hung probe is killed, not waited
+  on.
+- the TPU bench run itself has a hard timeout and one retry; any
+  failure falls back to a CPU run (JAX_PLATFORMS=cpu, --small model)
+  recording platform "cpu" and "tpu_unavailable": true.
+- if even CPU fails, a JSON line with "value": 0 and the error is
+  printed. Exit code is 0 in every path.
+
 vs_baseline: ratio against the value recorded in BENCH_REF.json for this
 (mode, platform) pair — first run of a pair records the baseline (ratio
 1.0); later rounds show the improvement factor. The reference repo
 publishes no absolute numbers (see BASELINE.md), so the trajectory is
 measured against ourselves.
 
-Usage: python bench.py [--small]
+Usage: python bench.py [--small] [--batch N] [--gen-len N]
+                       [--quantization int8] [--spec N] [--kv-pool-frac F]
 """
 
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -24,32 +40,68 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 REF_PATH = os.path.join(REPO, "BENCH_REF.json")
 
-# Make JAX_PLATFORMS authoritative before backend init (no-op when the
-# env var is unset, i.e. on the driver's real-TPU run): with the TPU
-# tunnel wedged, the sitecustomize-registered plugin can hang even a
-# JAX_PLATFORMS=cpu run at backend discovery unless the config is
-# pinned first — same call every server entry point makes.
-from production_stack_tpu.utils import honor_platform_env  # noqa: E402
-honor_platform_env()
+PROBE_TIMEOUT_S = 90        # one jax.devices() probe
+PROBE_TRIES = 3             # bounded probe window: <= ~5 min total
+PROBE_GAP_S = 20
+TPU_RUN_TIMEOUT_S = 1500    # full bench incl. first-compile (~20-40s/exe)
+CPU_RUN_TIMEOUT_S = 900
 
 
-def run_bench(small: bool) -> dict:
+def parse_cli(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true",
+                    help="tiny model (CPU-viable quick check)")
+    ap.add_argument("--child", action="store_true",
+                    help="internal: run the bench in-process (no "
+                         "supervision); used by the parent orchestrator")
+    ap.add_argument("--batch", type=int, default=8,
+                    help="concurrent batch slots (default 8)")
+    ap.add_argument("--gen-len", type=int, default=0,
+                    help="tokens generated per request (0 = mode default)")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="total requests (0 = 2x batch)")
+    ap.add_argument("--prompt-len", type=int, default=0,
+                    help="prompt tokens per request (0 = mode default)")
+    ap.add_argument("--quantization", choices=["int8"], default=None)
+    ap.add_argument("--spec", type=int, default=0,
+                    help="n-gram speculative draft length (0 = off)")
+    ap.add_argument("--kv-pool-frac", type=float, default=1.0,
+                    help="KV pool size as a fraction of the worst-case "
+                         "batch*max_model_len reservation (paged KV)")
+    return ap.parse_args(argv)
+
+
+def run_bench(args) -> dict:
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.scheduler import SamplingOptions
 
-    if small:
-        cfg = EngineConfig(model="debug-tiny", max_model_len=512,
-                           max_num_seqs=8, prefill_chunk=128,
-                           decode_window=16)
-        prompt_len, gen_len, n_requests = 64, 32, 16
+    batch = args.batch
+    if args.small:
+        cfg_kw = dict(model="debug-tiny", max_model_len=512,
+                      max_num_seqs=batch, prefill_chunk=128,
+                      decode_window=16)
+        prompt_len, gen_len = 64, 32
     else:
         # decode_window 32: one dispatch + one host sync per 32 tokens
         # per slot; 128-token answers pack into exactly 4 windows
-        cfg = EngineConfig(model="tinyllama-1.1b", max_model_len=1024,
-                           max_num_seqs=8, prefill_chunk=512,
-                           decode_window=32, prefill_buckets=(128, 512))
-        prompt_len, gen_len, n_requests = 128, 128, 16
+        cfg_kw = dict(model="tinyllama-1.1b", max_model_len=1024,
+                      max_num_seqs=batch, prefill_chunk=512,
+                      decode_window=32, prefill_buckets=(128, 512))
+        prompt_len, gen_len = 128, 128
+    if args.prompt_len:
+        prompt_len = args.prompt_len
+    if args.gen_len:
+        gen_len = args.gen_len
+    n_requests = args.requests or 2 * batch
+    if args.quantization:
+        cfg_kw["quantization"] = args.quantization
+    if args.spec:
+        cfg_kw["speculative_ngram_tokens"] = args.spec
+    if args.kv_pool_frac < 1.0:
+        worst = cfg_kw["max_num_seqs"] * cfg_kw["max_model_len"]
+        cfg_kw["kv_pool_tokens"] = int(worst * args.kv_pool_frac)
+    cfg = EngineConfig(**cfg_kw)
 
     eng = LLMEngine(cfg)
     compile_s = eng.runner.warmup()
@@ -78,19 +130,14 @@ def run_bench(small: bool) -> dict:
         "out_tokens": out_tokens,
         "model": cfg.model,
         "batch_slots": cfg.max_num_seqs,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
+        "quantization": cfg.quantization,
+        "speculative": cfg.speculative_ngram_tokens,
     }
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--small", action="store_true",
-                    help="tiny model (CPU-viable quick check)")
-    args = ap.parse_args()
-
-    import jax
-    platform = jax.devices()[0].platform
-    stats = run_bench(args.small)
-
+def record_line(args, stats: dict, platform: str) -> dict:
     value = round(stats["output_tokens_per_s"], 2)
     # baselines keyed by (mode, platform) so runs never clobber each other
     key = f"{'small' if args.small else 'full'}-{platform}"
@@ -102,14 +149,22 @@ def main() -> None:
         except (OSError, json.JSONDecodeError, ValueError):
             refs = {}
     ref = refs.get(key)
-    if ref is None:
+    standard = (args.batch == 8 and not args.quantization
+                and not args.spec and not args.gen_len
+                and not args.prompt_len and not args.requests
+                and args.kv_pool_frac == 1.0)
+    if ref is None and standard:
+        # only standard configs may set the baseline for a pair
         refs[key] = ref = value
-        with open(REF_PATH, "w") as f:
-            json.dump(refs, f)
-
-    print(json.dumps({
+        try:
+            with open(REF_PATH, "w") as f:
+                json.dump(refs, f)
+        except OSError:
+            pass
+    return {
         "metric": "engine decode throughput (TinyLlama-1.1B geometry, "
-                  "batch 8, 128+128 tok, single chip)"
+                  f"batch {args.batch}, {stats['prompt_len']}+"
+                  f"{stats['gen_len']} tok, single chip)"
         if not args.small else "engine decode throughput (debug-tiny)",
         "value": value,
         "unit": "out_tok/s",
@@ -117,6 +172,153 @@ def main() -> None:
         "platform": platform,
         "detail": {k: (round(v, 2) if isinstance(v, float) else v)
                    for k, v in stats.items()},
+    }
+
+
+def child_main(args) -> None:
+    # Make JAX_PLATFORMS authoritative before backend init: with the TPU
+    # tunnel wedged, the sitecustomize-registered plugin can hang even a
+    # JAX_PLATFORMS=cpu run at backend discovery unless the config is
+    # pinned first — same call every server entry point makes.
+    from production_stack_tpu.utils import honor_platform_env
+    honor_platform_env()
+    import jax
+    platform = jax.devices()[0].platform
+    stats = run_bench(args)
+    print(json.dumps(record_line(args, stats, platform)))
+
+
+# ----------------------------------------------------------------------
+# parent orchestration (no jax imports here, ever)
+# ----------------------------------------------------------------------
+
+# the probe must pin JAX_PLATFORMS before backend init, exactly like
+# utils.honor_platform_env(): the environment registers a TPU PJRT
+# plugin via sitecustomize that can hang even a JAX_PLATFORMS=cpu run
+# at backend discovery otherwise
+_PROBE_SRC = (
+    "import os, jax\n"
+    "w = os.environ.get('JAX_PLATFORMS')\n"
+    "if w: jax.config.update('jax_platforms', w)\n"
+    "d = jax.devices()\n"
+    "print('PLATFORM=' + d[0].platform)\n")
+
+
+def probe_platform(timeout_s: float) -> str:
+    """Backend liveness in a killable subprocess: 'tpu', 'cpu', or ''."""
+    try:
+        p = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return ""
+    if p.returncode != 0:
+        return ""
+    for line in p.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return ""
+
+
+def run_child(extra_args, env_over, timeout_s: float):
+    """Run `bench.py --child ...`; return its parsed JSON line or None."""
+    env = dict(os.environ, **env_over)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"]
+            + extra_args,
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO,
+            env=env)
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"bench child timed out after {timeout_s}s\n")
+        return None
+    if p.stderr:
+        sys.stderr.write(p.stderr[-4000:])
+    for line in reversed(p.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    sys.stderr.write(f"bench child rc={p.returncode}, no JSON line\n")
+    return None
+
+
+def forward_args(args) -> list:
+    out = []
+    if args.small:
+        out.append("--small")
+    if args.batch != 8:
+        out += ["--batch", str(args.batch)]
+    if args.gen_len:
+        out += ["--gen-len", str(args.gen_len)]
+    if args.prompt_len:
+        out += ["--prompt-len", str(args.prompt_len)]
+    if args.requests:
+        out += ["--requests", str(args.requests)]
+    if args.quantization:
+        out += ["--quantization", args.quantization]
+    if args.spec:
+        out += ["--spec", str(args.spec)]
+    if args.kv_pool_frac != 1.0:
+        out += ["--kv-pool-frac", str(args.kv_pool_frac)]
+    return out
+
+
+def main() -> None:
+    args = parse_cli()
+    if args.child:
+        child_main(args)
+        return
+
+    fwd = forward_args(args)
+
+    # 1) bounded TPU probe window
+    platform = ""
+    for i in range(PROBE_TRIES):
+        platform = probe_platform(PROBE_TIMEOUT_S)
+        if platform:
+            break
+        sys.stderr.write(f"backend probe {i + 1}/{PROBE_TRIES} failed\n")
+        if i + 1 < PROBE_TRIES:
+            time.sleep(PROBE_GAP_S)
+
+    # 2) probed backend attempt (TPU gets a retry: a live probe with a
+    #    failed run can be a transient tunnel stall)
+    if platform:
+        tries = 2 if platform == "tpu" else 1
+        timeout = (TPU_RUN_TIMEOUT_S if platform == "tpu"
+                   else CPU_RUN_TIMEOUT_S)
+        for _ in range(tries):
+            result = run_child(fwd, {}, timeout)
+            if result is not None:
+                print(json.dumps(result))
+                return
+            if platform == "tpu" and not probe_platform(PROBE_TIMEOUT_S):
+                break   # tunnel died mid-run; no point retrying
+
+    # 3) CPU fallback: tiny model, pinned CPU backend, flagged output
+    sys.stderr.write("falling back to CPU bench (--small)\n")
+    cpu_args = [a for a in fwd if a != "--small"]
+    result = run_child(["--small"] + cpu_args,
+                       {"JAX_PLATFORMS": "cpu"}, CPU_RUN_TIMEOUT_S)
+    if result is not None:
+        result["tpu_unavailable"] = True
+        result["metric"] += " [CPU FALLBACK: TPU unavailable]"
+        print(json.dumps(result))
+        return
+
+    # 4) last resort: still one parsable JSON line, rc 0
+    print(json.dumps({
+        "metric": "engine decode throughput",
+        "value": 0.0,
+        "unit": "out_tok/s",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "tpu_unavailable": True,
+        "error": "backend init failed on both TPU and CPU within the "
+                 "probe/run timeout budget",
     }))
 
 

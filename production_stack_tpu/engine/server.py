@@ -722,8 +722,51 @@ async def detokenize(request: web.Request) -> web.Response:
 
 # ---------------------------------------------------------------- app
 
-def build_app(engine: AsyncLLMEngine) -> web.Application:
-    app = web.Application(client_max_size=32 * 1024 * 1024)
+# probe/scrape endpoints stay open when an API key is enforced: K8s
+# probes and the Prometheus scraper carry no credentials (reference
+# parity: the stack's engines enforce VLLM_API_KEY on the OpenAI surface
+# while /health keeps answering probes,
+# helm/templates/deployment-vllm-multi.yaml:143-150 + probe blocks)
+AUTH_EXEMPT_PATHS = frozenset({"/health", "/metrics", "/version"})
+
+
+def _auth_middleware(api_key: str):
+    import secrets as _secrets
+
+    # compare bytes: compare_digest on str raises TypeError for
+    # non-ASCII input, which would turn a malformed credential into a
+    # 500 instead of a 401
+    expected = f"Bearer {api_key}".encode("utf-8", "surrogateescape")
+
+    @web.middleware
+    async def check_auth(request: web.Request, handler):
+        if request.path in AUTH_EXEMPT_PATHS:
+            return await handler(request)
+        provided = request.headers.get("Authorization", "").encode(
+            "utf-8", "surrogateescape")
+        if not _secrets.compare_digest(provided, expected):
+            return _error(401, "invalid or missing API key "
+                               "(Authorization: Bearer ...)")
+        return await handler(request)
+
+    return check_auth
+
+
+def build_app(engine: AsyncLLMEngine,
+              api_key: Optional[str] = None) -> web.Application:
+    """api_key None reads ENGINE_API_KEY from the environment (the
+    chart's secret delivery, helm/templates/deployment-engine.yaml);
+    empty/unset disables enforcement."""
+    import os
+    if api_key is None:
+        api_key = os.environ.get("ENGINE_API_KEY", "")
+    middlewares = [_auth_middleware(api_key)] if api_key else []
+    if middlewares:
+        logger.info("API-key enforcement on: all endpoints require "
+                    "Bearer auth except %s",
+                    ", ".join(sorted(AUTH_EXEMPT_PATHS)))
+    app = web.Application(client_max_size=32 * 1024 * 1024,
+                          middlewares=middlewares)
     app[ENGINE_KEY] = engine
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)

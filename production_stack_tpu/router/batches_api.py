@@ -197,7 +197,10 @@ class BatchProcessor:
             endpoints, self.state["request_stats"].get(), {}, body)
         path = req.get("url", batch["endpoint"])
         session: aiohttp.ClientSession = self.state["client"]
-        async with session.post(f"{url}{path}", json=body) as resp:
+        from production_stack_tpu.router.service_discovery import (
+            engine_auth_headers)
+        async with session.post(f"{url}{path}", json=body,
+                                headers=engine_auth_headers()) as resp:
             try:
                 payload = await resp.json()
             except (aiohttp.ContentTypeError, json.JSONDecodeError):

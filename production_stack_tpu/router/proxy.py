@@ -35,8 +35,19 @@ CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
 
 
 def _forward_headers(request: web.Request) -> dict:
-    return {k: v for k, v in request.headers.items()
-            if k.lower() not in HOP_HEADERS}
+    headers = {k: v for k, v in request.headers.items()
+               if k.lower() not in HOP_HEADERS}
+    # membership test on the CIMultiDict (case-insensitive): a lowercase
+    # 'authorization' must suppress injection too, or the upstream
+    # request would carry both the client's and the router's Bearer
+    if "Authorization" not in request.headers:
+        # engines enforcing ENGINE_API_KEY (engine/server.py) accept the
+        # router's own key for clients trusted at the router boundary; a
+        # client-provided Bearer always passes through untouched
+        from production_stack_tpu.router.service_discovery import (
+            engine_auth_headers)
+        headers.update(engine_auth_headers())
+    return headers
 
 
 async def route_general_request(request: web.Request,
@@ -113,6 +124,10 @@ async def route_general_request(request: web.Request,
         if "Authorization" in request.headers:
             prefill_headers["Authorization"] = \
                 request.headers["Authorization"]
+        else:
+            from production_stack_tpu.router.service_discovery import (
+                engine_auth_headers)
+            prefill_headers.update(engine_auth_headers())
         await disagg.run_with_headstart(state["client"], endpoint_path,
                                         model, body,
                                         headers=prefill_headers)

@@ -57,11 +57,23 @@ class ServiceDiscovery(ABC):
         return True
 
 
+def engine_auth_headers() -> Dict[str, str]:
+    """Bearer header for engine pods when they enforce an API key.
+
+    Reads ENGINE_API_KEY — the same secret the chart delivers to engine
+    pods (reference parity: the stack's discovery queries pods with
+    VLLM_API_KEY, src/vllm_router/service_discovery.py:145-147).
+    """
+    key = os.environ.get("ENGINE_API_KEY", "")
+    return {"Authorization": f"Bearer {key}"} if key else {}
+
+
 async def probe_model_name(session: aiohttp.ClientSession,
                            url: str) -> Optional[List[str]]:
     """GET <url>/v1/models -> list of model ids, or None if unreachable."""
     try:
         async with session.get(f"{url}/v1/models",
+                               headers=engine_auth_headers(),
                                timeout=aiohttp.ClientTimeout(total=5)) as r:
             if r.status != 200:
                 return None

