@@ -1,0 +1,196 @@
+"""Host-side allocator + prefix cache for the paged KV pool.
+
+Pure bookkeeping over the block pool in models/kv.py — never touches the
+device. Called only under the engine lock (admission, decode-window
+extension, finish/abort), so it needs no locking of its own.
+
+Prefix caching here is block *sharing*: a finished sequence's full
+blocks stay in the pool, registered under chain hashes of their token
+content (kvcache/chunks.ChunkHasher — chunk i's key digests chunk i's
+tokens AND chunk i-1's key, so equal keys imply an identical full
+prefix). A new prompt that matches a chain of registered blocks simply
+points its block table at them (refcount++), paying zero copies and
+zero HBM — the reference's --enable-prefix-caching semantics
+(reference: helm/templates/deployment-vllm-multi.yaml:73-75) the way
+vLLM's own paged KV implements them, rebuilt for the static-shape TPU
+pool. This replaces the earlier HBMPrefixPool, which kept a separate
+pool buffer and *copied* matched prefixes into slots (doubling resident
+bytes for hot prefixes).
+
+Invariants:
+- Block 0 (trash) is never allocated.
+- A sequence writes only into blocks it exclusively owns: matching is
+  capped so shared blocks are always fully-written full blocks, and a
+  prompt always recomputes at least its final position (a sampled
+  token needs live logits).
+- Registered blocks with refcount 0 sit in an LRU; allocation prefers
+  the free list and evicts LRU-registered blocks only when it is empty.
+"""
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from production_stack_tpu.kvcache.chunks import ChunkHasher
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class BlockManager:
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = False,
+                 namespace: str = ""):
+        if num_blocks < 2:
+            raise ValueError("pool needs at least one non-trash block")
+        self.num_blocks = num_blocks          # includes trash block 0
+        self.block_size = block_size
+        self.hasher = (ChunkHasher(block_size, namespace="blk|" + namespace)
+                       if enable_prefix_caching else None)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}        # block -> refcount (>= 1)
+        self._by_key: Dict[bytes, int] = {}   # chain key -> block
+        self._key_of: Dict[int, bytes] = {}   # block -> chain key
+        # registered blocks with refcount 0, insertion order = LRU
+        self._evictable: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    # -- capacity --------------------------------------------------------
+
+    @property
+    def available(self) -> int:
+        """Blocks allocatable right now (free + evictable-cached)."""
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def active_blocks(self) -> int:
+        """Blocks held by live sequences."""
+        return len(self._ref)
+
+    @property
+    def usage(self) -> float:
+        return self.active_blocks / float(self.num_blocks - 1)
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    # -- allocation ------------------------------------------------------
+
+    def _take_one(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            blk, _ = self._evictable.popitem(last=False)   # LRU out
+            key = self._key_of.pop(blk)
+            del self._by_key[key]
+            return blk
+        return None
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh exclusive blocks (refcount 1), or None — all-or-
+        nothing, so a failed admission/extension never leaks blocks."""
+        if n < 0 or self.available < n:
+            return None
+        out = []
+        for _ in range(n):
+            blk = self._take_one()
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; refcount-0 registered blocks
+        become LRU-evictable (their KV stays valid in the pool), others
+        return to the free list."""
+        for blk in blocks:
+            r = self._ref.get(blk, 0) - 1
+            if r > 0:
+                self._ref[blk] = r
+                continue
+            self._ref.pop(blk, None)
+            if blk in self._key_of:
+                self._evictable[blk] = None    # MRU end
+            else:
+                self._free.append(blk)
+
+    # -- prefix sharing --------------------------------------------------
+
+    def prefix_keys(self, tokens: Sequence[int],
+                    salt: str = "") -> List[bytes]:
+        """Chain keys for the matchable prefix of a prompt: full blocks
+        covering at most len(tokens)-1 positions (the sequence never
+        writes into a shared block and always recomputes at least one
+        position). Deterministic — callers may cache per prompt to
+        avoid re-hashing on deferred admissions."""
+        if self.hasher is None or len(tokens) < 2:
+            return []
+        usable = (len(tokens) - 1) // self.block_size
+        if not usable:
+            return []
+        return self.hasher.chunk_keys(
+            list(tokens[:usable * self.block_size]), salt=salt)
+
+    def match_keys(self, keys: Sequence[bytes],
+                   record_stats: bool = True) -> Tuple[List[int], int]:
+        """Longest registered block chain along `keys` -> (pinned block
+        ids, covered token count). Matched blocks are pinned
+        (refcount++) — the caller owns them like alloc'd ones and must
+        free() them. record_stats=False skips the hit/miss counters
+        (retries of a deferred admission must count once, not once per
+        scheduler pass)."""
+        blocks: List[int] = []
+        for key in keys:
+            blk = self._by_key.get(key)
+            if blk is None:
+                break
+            blocks.append(blk)
+        if record_stats and self.hasher is not None:
+            if blocks:
+                self.hits += 1
+            else:
+                self.misses += 1
+        for blk in blocks:
+            r = self._ref.get(blk, 0)
+            if r == 0:
+                self._evictable.pop(blk, None)
+            self._ref[blk] = r + 1
+        return blocks, len(blocks) * self.block_size
+
+    def match_prefix(self, tokens: Sequence[int],
+                     salt: str = "") -> Tuple[List[int], int]:
+        """prefix_keys + match_keys in one call (tests, simple users)."""
+        if self.hasher is None or len(tokens) < 2:
+            return [], 0
+        return self.match_keys(self.prefix_keys(tokens, salt=salt))
+
+    def register(self, tokens: Sequence[int], blocks: Sequence[int],
+                 salt: str = "") -> int:
+        """Register a finished sequence's full blocks for sharing.
+        `tokens` must be exactly the WRITTEN positions' tokens
+        (prompt + output[:-1]); only blocks fully covered by them are
+        registered. Duplicate content (key already registered from
+        another sequence) keeps the existing block. Call BEFORE
+        free()ing the sequence's blocks. Returns blocks registered."""
+        if self.hasher is None:
+            return 0
+        n = min(len(tokens) // self.block_size, len(blocks))
+        if not n:
+            return 0
+        keys = self.hasher.chunk_keys(
+            list(tokens[:n * self.block_size]), salt=salt)
+        count = 0
+        for key, blk in zip(keys, blocks):
+            if key in self._by_key or blk in self._key_of:
+                # shared-prefix blocks re-register under their own key
+                # (skip), duplicates keep the first copy
+                continue
+            self._by_key[key] = blk
+            self._key_of[blk] = key
+            count += 1
+        return count

@@ -31,6 +31,14 @@ class EngineConfig:
     # the smallest bucket covering every live position — decode cost scales
     # with live context, not max_model_len. Auto-derived in __post_init__.
     kv_len_buckets: Tuple[int, ...] = ()
+    # paged KV (models/kv.py): pool block size in tokens, and the pool's
+    # total KV capacity in tokens (None = worst case max_num_seqs *
+    # max_model_len). A bounded pool admits a batch by its LIVE context
+    # rather than reserving worst case per slot, with recompute
+    # preemption (engine.py _preempt) as the pressure valve — so e.g.
+    # batch 32 x 8k-capable slots fit where 8 fully-reserved ones did.
+    kv_block_size: int = 64
+    kv_pool_tokens: Optional[int] = None
     dtype: str = "bfloat16"
     kv_dtype: str = "bfloat16"
     tensor_parallel_size: int = 1
@@ -53,12 +61,11 @@ class EngineConfig:
     speculative_ngram_tokens: int = 0
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
-    # in-HBM prefix cache (kvcache/hbm_pool.py): finished sequences'
-    # prompt+output KV chunks stay on device and re-inject without a
-    # host round trip (the reference's --enable-prefix-caching)
+    # in-HBM prefix cache (engine/block_manager.py): finished sequences'
+    # full KV blocks stay in the pool under chain-hash keys; matching
+    # prompts attach them by reference — zero copies, zero extra HBM
+    # (the reference's --enable-prefix-caching)
     enable_prefix_caching: bool = False
-    prefix_pool_chunks: int = 64          # pool rows (HBM budget)
-    prefix_pool_chunk_size: int = 256     # tokens per pool row
     max_top_k: int = 64                      # static top-k bound for sampler
     # KV tiering (the reference's --kv-transfer-config JSON; see
     # kvcache/connector.py). Keys: kv_role, chunk_size, local_cpu_gb,
@@ -98,6 +105,16 @@ class EngineConfig:
             raise ValueError(
                 f"quantization={self.quantization!r} unsupported: only "
                 f"weight-only 'int8' (models/quant.py) is implemented")
+        if self.kv_block_size < 8 or self.kv_block_size % 8:
+            raise ValueError(
+                f"kv_block_size={self.kv_block_size} must be a multiple "
+                f"of 8 (TPU minor-dim tiling of the [Bs, D] block panel)")
+        # blocks never need to exceed one sequence's worth of positions
+        self.kv_block_size = min(
+            self.kv_block_size,
+            max(8, (self.max_model_len + 7) // 8 * 8))
+        if self.kv_pool_tokens is not None and self.kv_pool_tokens <= 0:
+            raise ValueError("kv_pool_tokens must be positive")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)
@@ -128,6 +145,22 @@ class EngineConfig:
             if not buckets or buckets[-1] < self.max_model_len:
                 buckets.append(self.max_model_len)
             self.kv_len_buckets = tuple(buckets)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        """Block-table width MB: blocks covering max_model_len."""
+        return -(-self.max_model_len // self.kv_block_size)
+
+    @property
+    def num_kv_blocks(self) -> int:
+        """Pool size in blocks, INCLUDING trash block 0. Clamped to
+        [one full-length sequence, worst case for the whole batch]."""
+        worst = self.max_num_seqs * self.max_blocks_per_seq
+        if self.kv_pool_tokens is None:
+            n = worst
+        else:
+            n = -(-self.kv_pool_tokens // self.kv_block_size)
+        return min(max(n, self.max_blocks_per_seq), worst) + 1
 
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:

@@ -124,18 +124,26 @@ class LLMEngine:
                                    engine_cfg.max_model_len,
                                    engine_cfg.prefill_chunk)
         self.metrics = EngineMetrics(self.model_cfg.name)
+        # paged-KV block accounting (engine/block_manager.py): admission
+        # allocates each prompt's blocks, decode windows extend tables
+        # on demand, and prefix caching is refcounted block SHARING —
+        # zero-copy prefix hits (the reference's --enable-prefix-caching,
+        # helm/templates/deployment-vllm-multi.yaml:73-75)
+        from production_stack_tpu.engine.block_manager import BlockManager
+        from production_stack_tpu.kvcache.chunks import model_fingerprint
+        self.block_mgr = BlockManager(
+            self.runner.cache.num_blocks, engine_cfg.kv_block_size,
+            enable_prefix_caching=engine_cfg.enable_prefix_caching,
+            namespace=model_fingerprint(self.model_cfg,
+                                        engine_cfg.kv_dtype))
+        self._tables = np.zeros((engine_cfg.max_num_seqs,
+                                 engine_cfg.max_blocks_per_seq), np.int32)
+        self.scheduler.can_admit = self._try_admit
+        self.scheduler.on_admit = self._on_admit
         # KV tiering (HBM→host→disk→remote; kvcache/): the reference wires
         # the same capability through LMCache env + --kv-transfer-config
         # (reference: helm/templates/deployment-vllm-multi.yaml:94-99,154-178)
         self.connector = None
-        self.hbm_pool = None
-        if engine_cfg.enable_prefix_caching:
-            from production_stack_tpu.kvcache.hbm_pool import HBMPrefixPool
-            self.hbm_pool = HBMPrefixPool(
-                self.runner, self.model_cfg, engine_cfg,
-                num_chunks=engine_cfg.prefix_pool_chunks,
-                chunk_size=engine_cfg.prefix_pool_chunk_size)
-            self.scheduler.on_admit = self._on_admit
         if engine_cfg.kv_transfer_config:
             from production_stack_tpu.kvcache.connector import (
                 KVConnector, KVTransferConfig)
@@ -143,7 +151,6 @@ class LLMEngine:
             if tcfg.enabled:
                 self.connector = KVConnector(self.runner, self.model_cfg,
                                              engine_cfg, tcfg)
-                self.scheduler.on_admit = self._on_admit
         self.seqs: Dict[str, Sequence] = {}
         self._finished_order: List[str] = []
         self._id_counter = itertools.count()
@@ -225,11 +232,6 @@ class LLMEngine:
             # pattern raises here, on the caller's thread, as ValueError
             seq.grammar = guided.compile_grammar(seq.options.guided_regex,
                                                  self.tokenizer)
-        if self.hbm_pool is not None:
-            # chunk-key hashing only (cheap, caller thread); the device
-            # copies happen at admission on the engine loop
-            seq.hbm_match = self.hbm_pool.match(
-                seq.prompt_tokens, salt=self._adapter_salt(seq.adapter_id))
         if self.connector is not None:
             # tier lookup + D2H-side fetch runs here, on the caller's
             # thread — never on the engine loop
@@ -248,6 +250,8 @@ class LLMEngine:
             if ok:
                 self._park_slot(slot)
                 if seq is not None:
+                    self.block_mgr.free(seq.block_ids)
+                    seq.block_ids = []
                     self._remember(seq)
             self._refresh_gauges()
             return ok
@@ -337,12 +341,19 @@ class LLMEngine:
                         w.seq, salt=self._adapter_salt(w.seq.adapter_id))
                 if not w.is_last:
                     continue
+                seq = w.seq
+                if seq.output_tokens:
+                    # preemption-recompute resume: emitted output was
+                    # teacher-forced back in; the prefill's sampled id
+                    # is discarded (the last emitted token is the next
+                    # decode input — _sync_slot restores it)
+                    self._sync_slot(seq)
+                    continue
                 if ids is None:
                     ids = np.asarray(ids_dev)  # one sync per bucket group
                     lps = np.asarray(lps_dev)
                 # prompt fully prefilled: the sampled id is the first
                 # output token
-                seq = w.seq
                 seq.first_token_time = time.monotonic()
                 self.metrics.ttft.observe(
                     seq.first_token_time - seq.arrival_time)
@@ -401,6 +412,19 @@ class LLMEngine:
     def _dispatch_decode(self, decode_seqs) -> None:
         """Launch one decode window (async dispatch; no host sync)."""
         W = self.cfg.decode_window
+        # block coverage first: every live slot's table must span the
+        # whole window (worst case: speculation emits spec+1 per step).
+        # Pool pressure preempts youngest-first; a sequence that cannot
+        # be covered even then is preempted itself (recompute later).
+        horizon = W * (self.cfg.speculative_ngram_tokens + 1) + 1
+        for s in list(decode_seqs):
+            if s.status is not SeqStatus.RUNNING:
+                continue   # already preempted as a victim this pass
+            if not self._ensure_blocks(s, s.next_position + horizon):
+                self._preempt(s)
+        decode_seqs = list(self.scheduler.running.values())
+        if not decode_seqs:
+            return
         max_pos = max(s.next_position for s in decode_seqs)
         greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
         self._ensure_dev_sampling()
@@ -514,16 +538,21 @@ class LLMEngine:
         text_delta = seq.output_text[seq.chars_emitted:]
         seq.chars_emitted = len(seq.output_text)
         if reason is not None:
-            if self.hbm_pool is not None:
-                # device-to-device capture while the slot still holds
-                # this sequence's KV
-                self.hbm_pool.store(
-                    seq, salt=self._adapter_salt(seq.adapter_id))
             if self.connector is not None:
                 # extract while the slot still holds this sequence's KV —
                 # dispatched before scheduler.finish can recycle the slot
                 self.connector.on_finish(
                     seq, salt=self._adapter_salt(seq.adapter_id))
+            # prefix caching: the full blocks stay in the pool under
+            # their chain keys (zero-copy sharing); register BEFORE
+            # free so refcount-0 registered blocks land in the
+            # evictable LRU instead of the free list
+            self.block_mgr.register(
+                (seq.prompt_tokens + seq.output_tokens)[:-1],
+                seq.block_ids,
+                salt=self._adapter_salt(seq.adapter_id))
+            self.block_mgr.free(seq.block_ids)
+            seq.block_ids = []
             slot = seq.slot
             self.scheduler.finish(seq, reason)
             self._park_slot(slot)
@@ -631,46 +660,125 @@ class LLMEngine:
             self._refresh_gauges()
         return self.metrics.render()
 
+    # ---------------------------------------------------- paged-KV host
+
+    def _try_admit(self, seq: Sequence) -> bool:
+        """Scheduler admission gate: claim KV blocks for the whole
+        prompt (+1 position for the first sampled token). Registered
+        prefix blocks are attached by reference (zero copies); the rest
+        are allocated fresh. Returns False — deferring admission —
+        when the pool cannot cover the remainder."""
+        toks = seq.prefill_tokens
+        salt = self._adapter_salt(seq.adapter_id)
+        # hash the prompt once per (salt, length): deferred admissions
+        # retry every scheduler pass and must not re-hash or re-count
+        state = seq.prefix_state
+        first_try = state is None or state[0] != (salt, len(toks))
+        if first_try:
+            keys = self.block_mgr.prefix_keys(toks, salt=salt)
+            seq.prefix_state = ((salt, len(toks)), keys)
+        else:
+            keys = state[1]
+        shared, covered = self.block_mgr.match_keys(
+            keys, record_stats=first_try)
+        need = self.block_mgr.blocks_for(len(toks) + 1) - len(shared)
+        fresh = self.block_mgr.alloc(max(need, 0))
+        if fresh is None:
+            self.block_mgr.free(shared)   # unpin; retry next iteration
+            return False
+        seq.block_ids = shared + fresh
+        seq.num_prefilled = covered       # capped at len-1, full blocks
+        return True
+
     def _on_admit(self, seq: Sequence) -> None:
-        """Scheduler hook: inject a cached KV prefix into the slot —
-        from whichever source covers more: the in-HBM pool
-        (device-to-device, no host traffic) or the host/disk/remote
-        tiers' prefetch."""
+        """Scheduler hook (slot now assigned): point the slot's table
+        row at the sequence's blocks, then let the KV tiers inject any
+        deeper cached prefix (host/disk/remote, kvcache/connector.py)."""
+        self._set_table_row(seq.slot, seq.block_ids)
         pf = seq.kv_prefetch
         seq.kv_prefetch = None   # release host buffers either way
-        keys, pool_covered = getattr(seq, "hbm_match", None) or ([], 0)
-        seq.hbm_match = None
-        conn_covered = pf.cached_tokens if pf is not None else 0
-        if pool_covered > 0 and pool_covered >= conn_covered:
-            # keys are re-resolved at injection: eviction between add
-            # and admission shrinks the injected prefix, never corrupts
-            injected = self.hbm_pool.inject(keys, seq.slot, pool_covered)
-            if injected >= conn_covered or pf is None:
-                seq.num_prefilled = injected
-                if pf is not None:
-                    # the tier already holds these chunks: skip the
-                    # device->host re-extract at finish
-                    self.connector.mark_seen(pf.keys)
-                return
-        if pf is not None:
+        if pf is None:
+            return
+        conn_covered = pf.cached_tokens
+        if conn_covered > seq.num_prefilled:
+            # the injected range may overlap prefix-shared blocks; the
+            # bytes are identical by key construction, so concurrent
+            # sharers read the same values
             self.connector.inject(pf, seq.slot)
             seq.num_prefilled = conn_covered
+        else:
+            # block sharing already covers at least as much: the tier
+            # holds these chunks, skip the device->host re-extract at
+            # finish
+            self.connector.mark_seen(pf.keys)
+
+    def _set_table_row(self, slot: int, block_ids) -> None:
+        self._tables[slot, :] = 0
+        if block_ids:
+            self._tables[slot, :len(block_ids)] = block_ids
+        self.runner.set_block_tables(self._tables)
+
+    def _ensure_blocks(self, seq: Sequence, upto_tokens: int) -> bool:
+        """Grow a live sequence's block list to cover positions
+        < min(upto_tokens, max_model_len), preempting younger sequences
+        under pool pressure. False = could not cover even after
+        preemption (caller preempts `seq` itself)."""
+        need = self.block_mgr.blocks_for(
+            min(upto_tokens, self.cfg.max_model_len))
+        while len(seq.block_ids) < need:
+            fresh = self.block_mgr.alloc(need - len(seq.block_ids))
+            if fresh is not None:
+                seq.block_ids.extend(fresh)
+                self._set_table_row(seq.slot, seq.block_ids)
+                return True
+            if not self._preempt_youngest(requester=seq):
+                return False
+        return True
+
+    def _preempt_youngest(self, requester: Sequence) -> bool:
+        """Free pool pressure by preempting the most recently arrived
+        live sequence (recompute flavor). If the REQUESTER is itself
+        the youngest, returns False so the caller preempts it rather
+        than letting a new arrival serially evict older sequences
+        (youngest-first must hold globally, not just among victims)."""
+        candidates = list(self.scheduler.running.values()) \
+            + list(self.scheduler._prefilling.values())
+        if requester not in candidates:
+            candidates.append(requester)
+        victim = max(candidates, key=lambda s: s.arrival_time)
+        if victim is requester or len(candidates) == 1:
+            return False
+        self._preempt(victim)
+        return True
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.warning(
+            "preempting %s (KV pool pressure): %d blocks freed, "
+            "%d tokens will recompute", seq.seq_id, len(seq.block_ids),
+            seq.num_tokens)
+        slot = seq.slot
+        self.block_mgr.free(seq.block_ids)
+        seq.block_ids = []
+        self.scheduler.preempt(seq)
+        self._park_slot(slot)
+        self._set_table_row(slot, [])
+        self.metrics.preemptions.inc()
 
     def _refresh_gauges(self) -> None:
         self.metrics.num_running.set(self.scheduler.num_running)
         self.metrics.num_waiting.set(self.scheduler.num_waiting)
-        usage = self.scheduler.kv_usage
+        usage = self.block_mgr.usage
         self.metrics.kv_usage.set(usage)
         self.metrics.hbm_kv_usage.set(usage)
-        # two distinct gauges: the pool's (per-request, in-HBM) and the
-        # tiers' (token-weighted) hit rates have different semantics —
-        # shadowing one with the other would silently skew dashboards
-        if self.hbm_pool is not None:
-            self.metrics.hbm_prefix_hit_rate.set(self.hbm_pool.hit_rate)
+        # two distinct gauges: the block pool's (per-request, in-HBM)
+        # and the tiers' (token-weighted) hit rates have different
+        # semantics — shadowing one with the other would skew dashboards
+        if self.cfg.enable_prefix_caching:
+            self.metrics.hbm_prefix_hit_rate.set(self.block_mgr.hit_rate)
         if self.connector is not None:
             self.metrics.prefix_hit_rate.set(self.connector.hit_rate)
-        elif self.hbm_pool is not None:
-            self.metrics.prefix_hit_rate.set(self.hbm_pool.hit_rate)
+        elif self.cfg.enable_prefix_caching:
+            self.metrics.prefix_hit_rate.set(self.block_mgr.hit_rate)
 
     def close(self) -> None:
         """Flush the KV writer and release tier connections."""

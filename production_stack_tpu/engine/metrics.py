@@ -46,6 +46,9 @@ class EngineMetrics:
         self.hbm_prefix_hit_rate = gauge(
             "tpu:hbm_prefix_cache_hit_rate",
             "In-HBM prefix pool hit rate (0-1, per request)")
+        self.preemptions = counter(
+            "vllm:num_preemptions_total",
+            "Sequences preempted (KV pool pressure) for recompute")
         self.prompt_tokens = counter("vllm:prompt_tokens_total",
                                      "Prefilled prompt tokens")
         self.generation_tokens = counter("vllm:generation_tokens_total",

@@ -76,12 +76,26 @@ class ModelRunner:
             params = jax.jit(quant.quantize_params,
                              donate_argnums=0)(params)
         self.params = params
+        # paged pool [L, N, Bs, Hkv, D] + per-slot block tables [B, MB]
+        # (models/kv.py); the tables device array is refreshed by the
+        # engine whenever its allocator changes a row. Under a mesh the
+        # block axis shards over dp (parallel/sharding.cache_pspec), so
+        # N is padded up to a dp multiple — the extra blocks are simply
+        # allocatable (the engine sizes its BlockManager from
+        # cache.num_blocks, not the config).
+        n_blocks = engine_cfg.num_kv_blocks
+        if mesh is not None:
+            dp_size = mesh.shape.get("dp", 1)
+            n_blocks = -(-n_blocks // dp_size) * dp_size
         self.cache: KVCache = make_cache(
-            model_cfg.num_layers, engine_cfg.max_num_seqs,
-            engine_cfg.max_model_len, model_cfg.num_kv_heads,
+            model_cfg.num_layers, n_blocks,
+            engine_cfg.kv_block_size, model_cfg.num_kv_heads,
             model_cfg.head_dim_,
             dtype=jnp.bfloat16 if engine_cfg.kv_dtype == "bfloat16"
             else jnp.float32)
+        self._tables = jnp.zeros(
+            (engine_cfg.max_num_seqs, engine_cfg.max_blocks_per_seq),
+            jnp.int32)
         if mesh is not None:
             # tensor-parallel serving: weights/cache sharded over the
             # slice's chips; XLA derives all ICI collectives from here
@@ -111,11 +125,17 @@ class ModelRunner:
             cache_sh = NamedSharding(mesh, cache_pspec())
             self.cache = KVCache(jax.device_put(self.cache.k, cache_sh),
                                  jax.device_put(self.cache.v, cache_sh))
+            from jax.sharding import PartitionSpec as _P
+            self._tables_sharding = NamedSharding(mesh, _P())
+            self._tables = jax.device_put(self._tables,
+                                          self._tables_sharding)
             if self._lora is not None:
                 # adapters are small (rank << hidden): replicate
                 from jax.sharding import PartitionSpec
                 self._lora = jax.device_put(
                     self._lora, NamedSharding(mesh, PartitionSpec()))
+        else:
+            self._tables_sharding = None
         self._key = jax.random.PRNGKey(engine_cfg.seed ^ 0x5EED)
         # device-carried decode inputs: (tokens [B], positions [B]);
         # refreshed from host mirrors only when the engine marks them stale
@@ -139,7 +159,8 @@ class ModelRunner:
     # jitted impls (pure)
     # ------------------------------------------------------------------
 
-    def _decode_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
+    def _decode_impl(self, params, cache: KVCache, tables: jnp.ndarray,
+                     tokens: jnp.ndarray,
                      positions: jnp.ndarray, sampling: SamplingParams,
                      key: jax.Array, guide_next: jnp.ndarray,
                      guide_id: jnp.ndarray, guide_state: jnp.ndarray,
@@ -152,24 +173,30 @@ class ModelRunner:
         `steps` forwards are fused via lax.scan; each step feeds its
         sampled ids back as the next step's tokens, and the final
         (tokens, positions) come back as device arrays to carry into the
-        next window without a host round-trip. K/V writes go to the full
-        cache (DUS clamps out-of-range padding rows onto S-1, which is
-        rewritten before any query can attend to it); attention reads
-        only cache[:, :kv_len]. Host guarantees every live position
-        stays < kv_len for the whole window.
+        next window without a host round-trip. K/V writes go through the
+        block tables; rows whose position has reached max_model_len
+        (parked rows, finished windows' tails) are masked invalid and
+        write to the trash block. Attention reads the first
+        ceil(kv_len/Bs) blocks of every slot; the host guarantees every
+        live position stays < kv_len AND its table row covers the whole
+        window (engine._ensure_blocks).
 
         logprobs are the chosen tokens' log p under the raw (pre-
         temperature) model distribution — one [B, V] log_softmax per
         step, noise next to the weight streaming, so they're always
         computed rather than forking the executable cache.
         """
+        S = self.engine_cfg.max_model_len
+
         def body(carry, i):
             cache, toks, pos, gstate = carry
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
-                cache, rope=self.rope, kv_len=kv_len, use_flash=False,
+                cache, block_tables=tables,
+                rope=self.rope, kv_len=kv_len, use_flash=False,
                 lora_params=self._lora, adapter_ids=sampling.adapter,
-                lora_scaling=self._lora_scaling)
+                lora_scaling=self._lora_scaling,
+                token_valid=(pos < S)[:, None])
             last = logits[:, 0, :]
             if guided:
                 # one [B, V] gather per step: each guided row's next-state
@@ -202,6 +229,7 @@ class ModelRunner:
         return ids.T, lps.T, toks, pos, gstate, cache  # ids/lps [B, steps]
 
     def _decode_spec_impl(self, params, cache: KVCache,
+                          tables: jnp.ndarray,
                           tokens: jnp.ndarray, positions: jnp.ndarray,
                           history: jnp.ndarray,
                           sampling: SamplingParams, *, steps: int,
@@ -227,6 +255,7 @@ class ModelRunner:
         B = tokens.shape[0]
         S = history.shape[1]
         K = spec
+        S_max = self.engine_cfg.max_model_len
 
         def draft_row(hist, pos):
             # latest i < pos with (hist[i-1], hist[i]) == current bigram
@@ -245,9 +274,11 @@ class ModelRunner:
             step_pos = pos[:, None] + jnp.arange(K + 1)[None, :]
             logits, cache = llama.forward(
                 params, self.model_cfg, step_toks, step_pos, cache,
+                block_tables=tables,
                 rope=self.rope, kv_len=kv_len, use_flash=False,
                 lora_params=self._lora, adapter_ids=sampling.adapter,
-                lora_scaling=self._lora_scaling)
+                lora_scaling=self._lora_scaling,
+                token_valid=step_pos < S_max)
             expected = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
@@ -274,7 +305,8 @@ class ModelRunner:
         return (ids.transpose(1, 0, 2), lps.transpose(1, 0, 2),
                 counts.T, toks, pos, hist, cache)
 
-    def _prefill_impl(self, params, cache: KVCache, tokens: jnp.ndarray,
+    def _prefill_impl(self, params, cache: KVCache, tables: jnp.ndarray,
+                      tokens: jnp.ndarray,
                       starts: jnp.ndarray, lengths: jnp.ndarray,
                       sampling: SamplingParams, key: jax.Array,
                       guide_next: jnp.ndarray, guide_id: jnp.ndarray,
@@ -282,21 +314,26 @@ class ModelRunner:
                       kv_len: int, guided: bool = False):
         """Full-batch chunk prefill. tokens [B, Tb], starts/lengths [B].
 
-        Every row writes its chunk at its own offset (idle rows are
-        parked at start S: write_chunk's scatter clips them onto S-1,
-        which no live query can attend — see models/kv.py). Attention
-        reads cache[:, :kv_len]; host guarantees start + Tb <= kv_len
-        for every participating row (or kv_len == S).
+        Every row writes its chunk at its own offset through its block
+        table; idle rows (parked at start S) and right-padding tokens
+        are masked invalid and write to the trash block. Attention
+        reads the first ceil(kv_len/Bs) blocks; host guarantees
+        start + real chunk length <= kv_len for every participating
+        row, whose table covers its whole chunk (blocks are allocated
+        for the full prompt at admission).
         Returns (sampled id of each row's last real token [B], its
         logprob [B], cache').
         """
         Tb = tokens.shape[1]
+        S = self.engine_cfg.max_model_len
         positions = starts[:, None] + jnp.arange(Tb)[None, :]
-        # real tokens per row: right-padding and idle rows (lengths 0)
-        # must not route in MoE layers or steal expert capacity
-        token_valid = jnp.arange(Tb)[None, :] < lengths[:, None]
+        # real tokens per row: right-padding and idle rows must not
+        # write K/V, route in MoE layers, or steal expert capacity
+        token_valid = ((jnp.arange(Tb)[None, :] < lengths[:, None])
+                       & (starts < S)[:, None])
         logits, cache = llama.forward(
             params, self.model_cfg, tokens, positions, cache,
+            block_tables=tables,
             rope=self.rope, kv_len=kv_len,
             use_flash=None if self.mesh is None else False,
             lora_params=self._lora, adapter_ids=sampling.adapter,
@@ -322,6 +359,14 @@ class ModelRunner:
     def _next_key(self) -> jax.Array:
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def set_block_tables(self, tables) -> None:
+        """Upload the host block-table mirror [B, MB] int32 (engine
+        calls this whenever its allocator changes any row)."""
+        t = jnp.asarray(tables, jnp.int32)
+        if self._tables_sharding is not None:
+            t = jax.device_put(t, self._tables_sharding)
+        self._tables = t
 
     def set_decode_state(self, tokens, positions,
                          guide_states=None, history=None) -> None:
@@ -366,7 +411,7 @@ class ModelRunner:
                 self._decode_fns[("spec", steps, kv_len, spec)] = fn
             (ids, lps, counts, self._dec_tokens, self._dec_pos,
              self._dec_hist, self.cache) = fn(
-                self.params, self.cache, self._dec_tokens,
+                self.params, self.cache, self._tables, self._dec_tokens,
                 self._dec_pos, self._dec_hist, sampling)
             return ids, lps, counts
         seeded = seeded and not greedy
@@ -390,7 +435,8 @@ class ModelRunner:
             guide_ids = jnp.zeros((B,), jnp.int32)
         (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
          self.cache) = fn(
-            self.params, self.cache, self._dec_tokens, self._dec_pos,
+            self.params, self.cache, self._tables, self._dec_tokens,
+            self._dec_pos,
             sampling, self._next_key(), guide_table,
             jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
         return ids, lps, None
@@ -418,7 +464,8 @@ class ModelRunner:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = np.zeros((B,), np.int32)
             guide_states = np.zeros((B,), np.int32)
-        args = (self.params, self.cache, jnp.asarray(tokens, jnp.int32),
+        args = (self.params, self.cache, self._tables,
+                jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(starts, jnp.int32),
                 jnp.asarray(lengths, jnp.int32), sampling, self._next_key(),
                 guide_table, jnp.asarray(guide_ids, jnp.int32),
@@ -531,42 +578,56 @@ class ModelRunner:
             fn = self._prompt_lp_fns[(N, Tb)] = jax.jit(_impl)
         return fn(self.params, jnp.asarray(pad, jnp.int32))
 
+    def _slot_flat_indices(self, tables, slot, start, size: int):
+        """Pool-flat indices [size] for a slot's virtual positions
+        start..start+size-1 (through its block table row)."""
+        Bs = self.engine_cfg.kv_block_size
+        MB = self.engine_cfg.max_blocks_per_seq
+        pos = start + jnp.arange(size)
+        row = jnp.take(tables, slot, axis=0)                  # [MB]
+        blk = jnp.take(row, jnp.clip(pos // Bs, 0, MB - 1))   # [size]
+        return blk * Bs + pos % Bs
+
     def extract_chunk(self, slot: int, start: int, size: int):
-        """Slice [L, size, Hkv, D] k/v out of a slot (no donation; the
-        result is an independent buffer, safe to D2H after later steps
-        donate the cache). Dispatch is async — np.asarray() later blocks."""
+        """Gather [L, size, Hkv, D] k/v out of a slot's blocks (no
+        donation; the result is an independent buffer, safe to D2H after
+        later steps donate the cache). Dispatch is async —
+        np.asarray() later blocks."""
         fn = self._extract_fns.get(size)
         if fn is None:
-            L = self.model_cfg.num_layers
-            Hkv, D = self.model_cfg.num_kv_heads, self.model_cfg.head_dim_
-
-            def _impl(cache: KVCache, slot, start):
-                k = jax.lax.dynamic_slice(cache.k, (0, slot, start, 0, 0),
-                                          (L, 1, size, Hkv, D))[:, 0]
-                v = jax.lax.dynamic_slice(cache.v, (0, slot, start, 0, 0),
-                                          (L, 1, size, Hkv, D))[:, 0]
-                return k, v
+            def _impl(cache: KVCache, tables, slot, start):
+                idx = self._slot_flat_indices(tables, slot, start, size)
+                kf = cache.k.reshape((cache.k.shape[0], -1)
+                                     + cache.k.shape[3:])
+                vf = cache.v.reshape((cache.v.shape[0], -1)
+                                     + cache.v.shape[3:])
+                return kf[:, idx], vf[:, idx]
 
             fn = self._extract_fns[size] = jax.jit(_impl)
-        return fn(self.cache, jnp.int32(slot), jnp.int32(start))
+        return fn(self.cache, self._tables, jnp.int32(slot),
+                  jnp.int32(start))
 
     def inject_chunk(self, slot: int, start: int, k_chunk, v_chunk) -> None:
-        """Write host [L, size, Hkv, D] k/v into a slot (donates cache —
-        in-place HBM update)."""
+        """Scatter host [L, size, Hkv, D] k/v into a slot's blocks
+        (donates cache — in-place HBM update). The slot's table must
+        already cover start+size positions (admission allocates the
+        full prompt's blocks before tier injection runs)."""
         size = k_chunk.shape[1]
         fn = self._inject_fns.get(size)
         if fn is None:
-            def _impl(cache: KVCache, k_chunk, v_chunk, slot, start):
-                idx = (0, slot, start, 0, 0)
-                new_k = jax.lax.dynamic_update_slice(
-                    cache.k, k_chunk[:, None], idx)
-                new_v = jax.lax.dynamic_update_slice(
-                    cache.v, v_chunk[:, None], idx)
-                return KVCache(new_k, new_v)
+            def _impl(cache: KVCache, tables, k_chunk, v_chunk, slot,
+                      start):
+                idx = self._slot_flat_indices(tables, slot, start, size)
+                shape_k = cache.k.shape
+                kf = cache.k.reshape((shape_k[0], -1) + shape_k[3:])
+                vf = cache.v.reshape((shape_k[0], -1) + shape_k[3:])
+                kf = kf.at[:, idx].set(k_chunk.astype(kf.dtype))
+                vf = vf.at[:, idx].set(v_chunk.astype(vf.dtype))
+                return KVCache(kf.reshape(shape_k), vf.reshape(shape_k))
 
             fn = self._inject_fns[size] = jax.jit(_impl,
                                                   donate_argnums=(0,))
-        self.cache = fn(self.cache, jnp.asarray(k_chunk),
+        self.cache = fn(self.cache, self._tables, jnp.asarray(k_chunk),
                         jnp.asarray(v_chunk), jnp.int32(slot),
                         jnp.int32(start))
 

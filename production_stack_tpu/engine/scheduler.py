@@ -55,6 +55,9 @@ class Sequence:
     status: SeqStatus = SeqStatus.WAITING
     slot: int = -1
     adapter_id: int = 0      # LoRA adapter (0 = base model, models/lora.py)
+    # paged-KV blocks this sequence owns, table order (engine/
+    # block_manager.py); prefix-shared blocks lead, exclusive ones follow
+    block_ids: List[int] = field(default_factory=list)
     output_tokens: List[int] = field(default_factory=list)
     # per output token: chosen-token logprob (raw model distribution)
     output_logprobs: List[Optional[float]] = field(default_factory=list)
@@ -68,9 +71,10 @@ class Sequence:
     # incremental chunk-key chain state for progressive KV publish
     # (kvcache/connector.py _publish)
     kv_publish_state: object = None
-    # in-HBM prefix-pool match ([pool rows], covered_tokens) computed at
-    # add time (kvcache/hbm_pool.py); consumed at admission
-    hbm_match: object = None
+    # cached prefix-cache chain keys: (salt, prefill_len, keys) — an
+    # admission deferred by pool pressure retries every scheduler pass
+    # and must not re-hash the prompt (or re-count hit/miss) each time
+    prefix_state: object = None
     # guided decoding (engine/guided.py): compiled grammar + current
     # DFA state (host mirror of the device-carried state)
     grammar: object = None
@@ -87,6 +91,16 @@ class Sequence:
     @property
     def next_position(self) -> int:
         return self.num_tokens - 1
+
+    @property
+    def prefill_tokens(self) -> List[int]:
+        """Tokens to prefill when (re)building this sequence's KV: the
+        prompt, plus — after a preemption-recompute — the already-
+        emitted output teacher-forced back in (all but the last emitted
+        token, which becomes the decode input again)."""
+        if self.output_tokens:
+            return self.prompt_tokens + self.output_tokens[:-1]
+        return self.prompt_tokens
 
 
 @dataclass
@@ -110,6 +124,10 @@ class Scheduler:
         # invoked right after a slot is assigned, before the first prefill
         # chunk is cut — may rewind seq.num_prefilled past a cached prefix
         self.on_admit: Optional[object] = None
+        # admission gate: called with the head-of-queue sequence BEFORE a
+        # slot is taken; returning False defers admission (the engine's
+        # KV block allocator uses this — engine.py _try_admit)
+        self.can_admit: Optional[object] = None
 
     # ------------------------------------------------------------------
 
@@ -154,7 +172,10 @@ class Scheduler:
         """
         works = [self._chunk_of(seq) for seq in self._prefilling.values()]
         while self.waiting and self.free_slots:
-            seq = self.waiting.popleft()
+            seq = self.waiting[0]
+            if self.can_admit is not None and not self.can_admit(seq):
+                break   # KV pool pressure: keep FIFO order, retry later
+            self.waiting.popleft()
             seq.slot = self.free_slots.pop()
             seq.status = SeqStatus.PREFILLING
             self._prefilling[seq.slot] = seq
@@ -164,10 +185,11 @@ class Scheduler:
         return works, list(self.running.values())
 
     def _chunk_of(self, seq: Sequence) -> PrefillWork:
+        toks = seq.prefill_tokens
         start = seq.num_prefilled
-        end = min(start + self.prefill_chunk, len(seq.prompt_tokens))
-        return PrefillWork(seq=seq, chunk=seq.prompt_tokens[start:end],
-                           start=start, is_last=end == len(seq.prompt_tokens))
+        end = min(start + self.prefill_chunk, len(toks))
+        return PrefillWork(seq=seq, chunk=toks[start:end],
+                           start=start, is_last=end == len(toks))
 
     def on_prefill_done(self, work: PrefillWork) -> None:
         seq = work.seq
@@ -176,6 +198,22 @@ class Scheduler:
             seq.status = SeqStatus.RUNNING
             self._prefilling.pop(seq.slot, None)
             self.running[seq.slot] = seq
+
+    def preempt(self, seq: Sequence) -> None:
+        """KV-pressure preemption (recompute flavor): drop the sequence
+        back to the FRONT of the waiting queue; its next admission
+        re-prefills prefill_tokens (prompt + emitted output, teacher-
+        forced) into freshly allocated blocks. The engine frees the
+        blocks and parks the slot (engine.py _preempt)."""
+        slot = seq.slot
+        self.running.pop(slot, None)
+        self._prefilling.pop(slot, None)
+        if slot >= 0:
+            self.free_slots.append(slot)
+        seq.slot = -1
+        seq.status = SeqStatus.WAITING
+        seq.num_prefilled = 0
+        self.waiting.appendleft(seq)
 
     def finish(self, seq: Sequence, reason: str) -> None:
         self._release(seq.slot, seq, reason)

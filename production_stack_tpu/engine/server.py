@@ -844,11 +844,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--chat-template", default=None,
                    help="Jinja file overriding the tokenizer chat template")
     p.add_argument("--enable-prefix-caching", action="store_true",
-                   help="keep finished sequences' KV chunks in HBM and "
-                        "re-inject shared prefixes device-to-device "
+                   help="retain finished sequences' full KV blocks in "
+                        "the paged pool and attach them to matching "
+                        "prompts by reference — zero-copy prefix hits "
                         "(the reference's --enable-prefix-caching)")
-    p.add_argument("--prefix-pool-chunks", type=int, default=64)
-    p.add_argument("--prefix-pool-chunk-size", type=int, default=256)
+    p.add_argument("--kv-block-size", type=int, default=64,
+                   help="paged-KV block size in tokens (models/kv.py)")
+    p.add_argument("--kv-pool-tokens", type=int, default=None,
+                   help="total KV pool capacity in tokens (default: "
+                        "max-num-seqs * max-model-len worst case). A "
+                        "smaller pool admits by LIVE context and "
+                        "preempts under pressure — more concurrent "
+                        "long-context slots in the same HBM")
     p.add_argument("--lora-adapters", default=None,
                    help="comma-separated name=source pairs; source is an "
                         ".npz adapter checkpoint (models/lora.py) or "
@@ -885,8 +892,8 @@ def main(argv=None) -> None:
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),
         enable_prefix_caching=args.enable_prefix_caching,
-        prefix_pool_chunks=args.prefix_pool_chunks,
-        prefix_pool_chunk_size=args.prefix_pool_chunk_size,
+        kv_block_size=args.kv_block_size,
+        kv_pool_tokens=args.kv_pool_tokens,
         tensor_parallel_size=args.tensor_parallel_size,
         pipeline_parallel_size=args.pipeline_parallel_size,
         expert_parallel_size=args.expert_parallel_size,

@@ -1,5 +1,7 @@
 from production_stack_tpu.models.config import ModelConfig, PRESETS, get_config
-from production_stack_tpu.models.kv import KVCache, make_cache
+from production_stack_tpu.models.kv import (KVCache, make_cache,
+                                             make_slot_cache)
 from production_stack_tpu.models import llama
 
-__all__ = ["ModelConfig", "PRESETS", "get_config", "KVCache", "make_cache", "llama"]
+__all__ = ["ModelConfig", "PRESETS", "get_config", "KVCache", "make_cache",
+           "make_slot_cache", "llama"]

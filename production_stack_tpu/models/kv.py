@@ -1,77 +1,132 @@
-"""KV cache container shared by models/ and engine/.
+"""Paged KV cache: a global block pool + per-slot block tables.
 
-Slot-based, statically-shaped cache: each running sequence owns one batch
-slot of a preallocated [L, B, S, Hkv, D] buffer. Static shapes keep every
-decode step a single cached XLA executable; per-sequence lengths are data
-(positions/masks), not shapes.
+Layout: ``k, v [L, N, Bs, Hkv, D]`` — N fixed-size blocks of Bs token
+positions each, shared by every sequence. A sequence owns an ordered
+list of blocks; its *block table* row maps virtual position ``p`` to
+pool location ``(table[p // Bs], p % Bs)``. HBM is sized by
+``EngineConfig.kv_pool_tokens``, not ``max_num_seqs × max_model_len``:
+batch capacity scales with *live* context, and prefix caching is block
+*sharing* (refcounts in engine/block_manager.py) instead of copies.
+
+TPU-first invariants:
+- Static shapes everywhere: the pool, the tables [B, MB], and the
+  attention view are all fixed-size; block allocation is pure host
+  bookkeeping and never recompiles anything.
+- **Block 0 is the trash block.** It is never allocated; writes from
+  parked rows, padding tokens, and beyond-capacity window tails are
+  routed to it via the ``valid`` mask. This replaces the S-1
+  DUS-clamping scheme of the earlier contiguous cache with something
+  simpler to reason about: invalid writes all land in a block no table
+  references.
+- Reads go through a *gathered view* (``gather_view``): the first
+  ``nb`` table entries pull [B, nb*Bs, Hkv, D] out of the pool, on
+  which the existing position-masked attention (ops/attention.py) and
+  the Pallas flash kernel run unchanged. View index s IS virtual
+  position s, so the causal position mask also hides any stale/garbage
+  block contents: a query at position p only attends s <= p, and every
+  position <= p of a live row has been written by construction.
+- Sharding: the pool keeps the slot cache's spec shape — heads over
+  tp, block axis over dp (parallel/sharding.py cache_pspec). Under a
+  tp-only serving mesh the table gather is local to every shard
+  (indices replicated, gathered axis unsharded): no extra collectives.
 
 The reference stack's KV management is configuration around LMCache env
-vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178); the
-actual in-engine cache is external to it. Here the cache is a first-class
-functional object so tiering (kvcache/connector.py) can snapshot/restore
-slots via the runner's extract_chunk/inject_chunk primitives.
+vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178) and
+its engine's paged KV lives inside vLLM (the stack passes
+--enable-prefix-caching, deployment-vllm-multi.yaml:73-75); this module
+is the TPU-native equivalent of that engine layer.
 """
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # [L, B, S, Hkv, D]
-    v: jnp.ndarray  # [L, B, S, Hkv, D]
+    k: jnp.ndarray  # [L, N, Bs, Hkv, D]
+    v: jnp.ndarray  # [L, N, Bs, Hkv, D]
 
     @property
-    def num_slots(self) -> int:
+    def num_blocks(self) -> int:
         return self.k.shape[1]
 
     @property
-    def max_len(self) -> int:
+    def block_size(self) -> int:
         return self.k.shape[2]
 
 
-def make_cache(num_layers: int, num_slots: int, max_len: int,
-               num_kv_heads: int, head_dim: int, dtype=jnp.bfloat16) -> KVCache:
-    shape = (num_layers, num_slots, max_len, num_kv_heads, head_dim)
+def make_cache(num_layers: int, num_blocks: int, block_size: int,
+               num_kv_heads: int, head_dim: int,
+               dtype=jnp.bfloat16) -> KVCache:
+    """Block pool. num_blocks INCLUDES the reserved trash block 0."""
+    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
+def linear_tables(num_slots: int, max_len: int,
+                  block_size: int) -> jnp.ndarray:
+    """Identity block tables [B, MB]: slot b owns blocks
+    1 + b*MB .. 1 + (b+1)*MB - 1 (block 0 stays trash). With a pool of
+    num_slots*MB + 1 blocks this reproduces the contiguous per-slot
+    cache — the simple configuration for tests and single-sequence
+    use (models/__init__.make_slot_cache)."""
+    mb = -(-max_len // block_size)
+    return (1 + jnp.arange(num_slots * mb, dtype=jnp.int32)
+            ).reshape(num_slots, mb)
+
+
+def make_slot_cache(num_layers: int, num_slots: int, max_len: int,
+                    num_kv_heads: int, head_dim: int,
+                    dtype=jnp.bfloat16, block_size: int = 64,
+                    ) -> Tuple[KVCache, jnp.ndarray]:
+    """(pool, tables) equivalent to the old per-slot contiguous cache."""
+    block_size = min(block_size, max(8, max_len))
+    mb = -(-max_len // block_size)
+    cache = make_cache(num_layers, num_slots * mb + 1, block_size,
+                       num_kv_heads, head_dim, dtype)
+    return cache, linear_tables(num_slots, max_len, block_size)
+
+
 def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
-                starts: jnp.ndarray) -> jnp.ndarray:
-    """Write new [B,T,Hkv,D] into cache_layer [B,S,Hkv,D] at per-row starts [B].
+                tables: jnp.ndarray, positions: jnp.ndarray,
+                valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Scatter new [B,T,Hkv,D] into the pool layer [N,Bs,Hkv,D].
 
-    T == 1 (decode): contiguous dynamic-update-slice per batch row — lowers
-    to an in-place DUS on TPU when the buffer is donated. DUS start
-    clamping is LOAD-BEARING here: the engine parks free/prefilling rows
-    at position S (engine.py _park_slot), so their per-window writes
-    arrive with s >= S and must clamp onto S-1 — a position outside every
-    live kv bucket that is rewritten with real K/V (earlier in the same
-    forward) before any query could attend it. Do not replace the DUS
-    with an unclamped scatter.
-
-    T > 1 (prefill): per-row scatter with clipped indices. A prefill chunk
-    is right-padded to its length bucket, so start+T can exceed S near the
-    end of the cache; DUS would *clamp the start* and silently overwrite
-    valid earlier entries with padding K/V. Scatter clips only the padding
-    rows onto index S-1 (real prompt rows never reach S-1 because prompts
-    are capped below max_model_len), and that slot is rewritten with real
-    K/V by the decode step that reaches position S-1 before any query can
-    attend to it.
+    positions [B,T] are virtual positions; tables [B,MB] map them to
+    blocks. Tokens with valid == False (padding, parked rows, window
+    tails past capacity) are routed to trash block 0 — collisions
+    there are irrelevant by construction. Callers on the serving path
+    MUST pass valid; None (tests, single-sequence loops) treats every
+    in-range token as real, which is only safe when positions never
+    exceed the virtual capacity MB*Bs.
     """
-    # the cache may be narrower than the compute dtype (fp32 model with a
-    # bf16 KV cache); DUS/scatter require matching dtypes
     new = new.astype(cache_layer.dtype)
-    if new.shape[1] == 1:
-        def _one(c, x, s):
-            return jax.lax.dynamic_update_slice(c, x, (s, 0, 0))
-        return jax.vmap(_one)(cache_layer, new, starts)
+    Bs = cache_layer.shape[1]
+    B, T = positions.shape
+    MB = tables.shape[1]
+    bi = jnp.clip(positions // Bs, 0, MB - 1)
+    blk = jnp.take_along_axis(tables, bi, axis=1)           # [B, T]
+    idx = blk * Bs + positions % Bs
+    # beyond-capacity positions can only reach here masked or in test
+    # paths; clamp them onto trash rather than wrapping into a block
+    oob = (positions < 0) | (positions >= MB * Bs)
+    if valid is not None:
+        oob = oob | ~valid
+    idx = jnp.where(oob, positions % Bs, idx)               # block 0
+    flat = cache_layer.reshape((-1,) + cache_layer.shape[2:])
+    flat = flat.at[idx.reshape(-1)].set(
+        new.reshape((B * T,) + new.shape[2:]))
+    return flat.reshape(cache_layer.shape)
 
-    S = cache_layer.shape[1]
-    T = new.shape[1]
 
-    def _scatter(c, x, s):
-        idx = jnp.clip(s + jnp.arange(T), 0, S - 1)
-        return c.at[idx].set(x)
-
-    return jax.vmap(_scatter)(cache_layer, new, starts)
+def gather_view(cache_layer: jnp.ndarray, tables: jnp.ndarray,
+                nb: int) -> jnp.ndarray:
+    """Materialize the first nb blocks of every slot as a contiguous
+    [B, nb*Bs, Hkv, D] view; view index s is virtual position s.
+    Unallocated table entries read trash block 0 — garbage that the
+    causal position mask always hides (a query at position p only
+    attends positions <= p, all of which are allocated and written)."""
+    Bs = cache_layer.shape[1]
+    t = tables[:, :nb]                                       # [B, nb]
+    g = cache_layer[t]                                       # [B,nb,Bs,..]
+    return g.reshape((t.shape[0], nb * Bs) + cache_layer.shape[2:])

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from production_stack_tpu.models import lora, quant
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.models.kv import KVCache, write_chunk
+from production_stack_tpu.models.kv import KVCache, gather_view, write_chunk
 from production_stack_tpu.ops import moe, pallas_attention
 from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
 from production_stack_tpu.ops.norms import rms_norm
@@ -98,15 +98,22 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 use_flash: bool = False, lora_layer=None,
                 adapter_ids: Optional[jnp.ndarray] = None,
                 lora_scaling: float = 1.0,
-                token_valid: Optional[jnp.ndarray] = None):
-    """One transformer block. x [B,T,H]; kv = (k_cache, v_cache) [B,S,Hkv,D].
+                token_valid: Optional[jnp.ndarray] = None,
+                block_tables: Optional[jnp.ndarray] = None):
+    """One transformer block. x [B,T,H]; kv = this layer's paged pool
+    (k, v) [N,Bs,Hkv,D] addressed through block_tables [B,MB]
+    (models/kv.py).
 
     attention_fn(q, k, v) overrides the no-cache attention — used to swap
     in ring attention when the sequence dim is sharded (parallel/train.py).
-    kv_len (static) bounds attention to the cache prefix [:kv_len] — K/V
-    writes still target the full cache, but score/value matmuls scale with
-    the live context instead of max_model_len. Caller guarantees every
-    real query position is < kv_len.
+    kv_len (static) bounds attention to the first ceil(kv_len/Bs) blocks
+    of every slot: K/V writes target the pool via the tables, and
+    score/value matmuls scale with the live context instead of
+    max_model_len. Caller guarantees every real query position is
+    < kv_len.
+    token_valid [B,T] marks real tokens: invalid tokens' K/V writes are
+    routed to the trash block and (on MoE models) they are kept out of
+    expert-capacity competition.
     lora_layer: this layer's stacked adapters {proj: {a, b}} + per-row
     adapter_ids [B] (models/lora.py) — batched multi-LoRA.
     """
@@ -139,15 +146,21 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             attn = causal_attention(q, k, v, scale=hd ** -0.5)
         new_kv = None
     else:
-        k_cache = write_chunk(kv[0], k, starts)
-        v_cache = write_chunk(kv[1], v, starts)
-        k_att = k_cache if kv_len is None else k_cache[:, :kv_len]
-        v_att = v_cache if kv_len is None else v_cache[:, :kv_len]
+        k_cache = write_chunk(kv[0], k, block_tables, positions,
+                              valid=token_valid)
+        v_cache = write_chunk(kv[1], v, block_tables, positions,
+                              valid=token_valid)
+        Bs = k_cache.shape[1]
+        MB = block_tables.shape[1]
+        nb = MB if kv_len is None else min(-(-kv_len // Bs), MB)
+        k_att = gather_view(k_cache, block_tables, nb)
+        v_att = gather_view(v_cache, block_tables, nb)
         if (use_flash and T > 1
                 and pallas_attention.flash_viable(
                     k_att.shape[1], hd, jnp.dtype(k_att.dtype).itemsize)):
-            # prefill chunks hit the pallas flash kernel: no [T, S] score
-            # materialization, causal block skipping over the cache
+            # prefill chunks hit the pallas flash kernel on the gathered
+            # view: no [T, S] score materialization, causal block
+            # skipping over the live prefix
             attn = pallas_attention.flash_attention_with_cache(
                 q, k_att, v_att, starts,
                 interpret=pallas_attention.needs_interpret())
@@ -196,6 +209,7 @@ def _gelu_tanh(x: jnp.ndarray) -> jnp.ndarray:
 
 def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             positions: jnp.ndarray, cache: KVCache,
+            block_tables: Optional[jnp.ndarray] = None,
             rope: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None,
             kv_len: Optional[int] = None,
             use_flash: Optional[bool] = None,
@@ -205,23 +219,34 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             ) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
-    positions[b] must be contiguous starting at the sequence's current
-    length; the new K/V chunk is written at that offset in slot b.
-    kv_len (static) bounds attention to cache[:, :kv_len] — see _layer_body.
+    cache is the paged block pool (models/kv.py); block_tables [B, MB]
+    map each row's virtual positions to pool blocks (None = identity
+    tables for a pool built by make_slot_cache, i.e. the contiguous
+    per-slot layout). positions[b] must be contiguous starting at the
+    sequence's current length; the new K/V chunk is written at that
+    offset through the tables.
+    kv_len (static) bounds attention to the first ceil(kv_len/Bs)
+    blocks — see _layer_body.
     use_flash: None = auto (pallas flash prefill when the runtime gate is
     on); pass False on sharded executables — pallas_call has no GSPMD
     partitioning rule (see ops/pallas_attention.py).
     lora_params: layer-leading stacked adapters (models/lora.layer_slice)
     + adapter_ids [B] selecting each row's adapter (0 = base).
-    token_valid [B,T] bool marks real (non-padding) tokens — MoE models
-    use it to keep padding rows out of expert-capacity competition
-    (ops/moe.py); dense models ignore it.
+    token_valid [B,T] bool marks real (non-padding) tokens — their K/V
+    writes are routed to the trash block, and MoE models keep them out
+    of expert-capacity competition (ops/moe.py).
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
                           cfg.rope_theta)
     if use_flash is None:
         use_flash = pallas_attention.flash_enabled()
+    if block_tables is None:
+        from production_stack_tpu.models.kv import linear_tables
+        B = tokens.shape[0]
+        Bs = cache.k.shape[2]
+        n_per = (cache.k.shape[1] - 1) // B
+        block_tables = linear_tables(B, n_per * Bs, Bs)
     starts = positions[:, 0]
     x = _embed(params, cfg, tokens)
 
@@ -233,7 +258,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                       use_flash=use_flash, lora_layer=ll,
                                       adapter_ids=adapter_ids,
                                       lora_scaling=lora_scaling,
-                                      token_valid=token_valid)
+                                      token_valid=token_valid,
+                                      block_tables=block_tables)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -245,7 +271,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
                                       lp, (k_c, v_c), kv_len=kv_len,
                                       use_flash=use_flash,
-                                      token_valid=token_valid)
+                                      token_valid=token_valid,
+                                      block_tables=block_tables)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(

@@ -11,7 +11,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from production_stack_tpu.models import ModelConfig, llama, make_cache
+from production_stack_tpu.models import ModelConfig, llama, make_slot_cache
 from production_stack_tpu.models.hf_loader import params_from_state_dict
 
 torch = pytest.importorskip("torch")
@@ -52,7 +52,7 @@ def test_incremental_decode_matches_hf(tiny_pair):
     rng = np.random.default_rng(1)
     prompt = rng.integers(0, cfg.vocab_size, size=(1, 10))
 
-    cache = make_cache(cfg.num_layers, 1, 64, cfg.num_kv_heads, cfg.head_dim_,
+    cache, tables = make_slot_cache(cfg.num_layers, 1, 64, cfg.num_kv_heads, cfg.head_dim_,
                        dtype=jnp.float32)
     pos = jnp.arange(10)[None, :]
     logits, cache = llama.forward(params, cfg, jnp.asarray(prompt), pos, cache)
@@ -129,7 +129,7 @@ def test_qwen2_incremental_decode_matches_full(tiny_qwen2_pair):
     rng = np.random.default_rng(2)
     toks = rng.integers(0, cfg.vocab_size, size=(1, 16))
     full = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
-    cache = make_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
+    cache, tables = make_slot_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
                        cfg.head_dim_, dtype=jnp.float32)
     outs = []
     for t in range(toks.shape[1]):
@@ -212,7 +212,7 @@ def test_mixtral_incremental_decode_matches_full(tiny_mixtral_pair):
     rng = np.random.default_rng(5)
     toks = rng.integers(0, cfg.vocab_size, size=(1, 12))
     full = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
-    cache = make_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
+    cache, tables = make_slot_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
                        cfg.head_dim_, dtype=jnp.float32)
     outs = []
     for t in range(toks.shape[1]):
@@ -278,7 +278,7 @@ def test_qwen2_moe_incremental_decode_matches_full(tiny_qwen2_moe_pair):
     rng = np.random.default_rng(7)
     toks = rng.integers(0, cfg.vocab_size, size=(1, 12))
     full = np.asarray(llama.forward_train(params, cfg, jnp.asarray(toks)))
-    cache = make_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
+    cache, tables = make_slot_cache(cfg.num_layers, 1, 32, cfg.num_kv_heads,
                        cfg.head_dim_, dtype=jnp.float32)
     outs = []
     for t in range(toks.shape[1]):

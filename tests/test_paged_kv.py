@@ -1,0 +1,194 @@
+"""Paged KV cache tests: block manager semantics, zero-copy prefix
+sharing, recompute preemption, and live-context capacity.
+
+The capability under test is the engine-side idea the reference
+ecosystem is named after (vLLM's paged KV; the stack passes
+--enable-prefix-caching, reference:
+helm/templates/deployment-vllm-multi.yaml:73-75): KV HBM is sized by
+kv_pool_tokens, admission claims blocks for the LIVE context only, and
+prefix hits attach existing blocks by reference.
+"""
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import LLMEngine
+from production_stack_tpu.engine.scheduler import SamplingOptions
+
+
+# ---------------------------------------------------------------- unit
+
+def test_alloc_free_refcount():
+    mgr = BlockManager(num_blocks=5, block_size=16)   # 4 usable
+    a = mgr.alloc(3)
+    assert len(a) == 3 and 0 not in a
+    assert mgr.available == 1
+    assert mgr.alloc(2) is None          # all-or-nothing
+    assert mgr.available == 1            # failed alloc leaks nothing
+    mgr.free(a[:1])
+    assert mgr.available == 2
+    b = mgr.alloc(2)
+    assert len(b) == 2
+    assert mgr.usage == pytest.approx(1.0)
+
+
+def test_prefix_match_register_and_eviction():
+    mgr = BlockManager(num_blocks=8, block_size=4,
+                       enable_prefix_caching=True, namespace="t")
+    toks = list(range(1, 11))            # 10 tokens -> 2 full blocks
+    blocks = mgr.alloc(3)
+    assert mgr.register(toks[:9], blocks, salt="") == 2   # 9 written -> 2 full
+    mgr.free(blocks)
+    # full blocks are evictable-cached, the partial tail went free
+    assert mgr.available == 7
+
+    # same prompt matches both full blocks, pinned
+    matched, covered = mgr.match_prefix(toks, salt="")
+    assert covered == 8 and matched == blocks[:2]
+    assert mgr.hits == 1
+    # matching capped at len-1: an 8-token prompt must keep its final
+    # position to prefill, so only the first block is shared
+    m2, c2 = mgr.match_prefix(toks[:8], salt="")
+    assert c2 == 4 and m2 == blocks[:1]
+    mgr.free(matched)
+    mgr.free(m2)
+
+    # salt separates adapter-colored KV
+    m3, c3 = mgr.match_prefix(toks, salt="lora:x")
+    assert c3 == 0 and mgr.misses >= 1
+
+    # pool pressure evicts LRU-registered blocks and drops their keys
+    grabbed = mgr.alloc(7)
+    assert grabbed is not None
+    m4, c4 = mgr.match_prefix(toks, salt="")
+    assert c4 == 0
+
+
+def test_match_never_covers_partial_block():
+    mgr = BlockManager(num_blocks=8, block_size=4,
+                       enable_prefix_caching=True, namespace="t")
+    blocks = mgr.alloc(2)
+    mgr.register(list(range(8)), blocks, salt="")
+    mgr.free(blocks)
+    # a 6-token prompt: only the first FULL block may be shared — the
+    # sequence must never write into a shared block
+    m, c = mgr.match_prefix(list(range(8))[:6], salt="")
+    assert c == 4 and len(m) == 1
+
+
+# -------------------------------------------------------------- engine
+
+def _cfg(**kw):
+    base = dict(model="debug-tiny", max_model_len=128, max_num_seqs=2,
+                prefill_chunk=32, prefill_buckets=(32,), decode_window=4,
+                kv_block_size=16)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _run_all(eng, prompts, max_tokens=12):
+    opts = SamplingOptions(temperature=0.0, max_tokens=max_tokens,
+                           ignore_eos=True)
+    ids = [eng.add_request(list(p), opts) for p in prompts]
+    pending = set(ids)
+    guard = 0
+    while pending:
+        pending -= {o.seq_id for o in eng.step() if o.finished}
+        guard += 1
+        assert guard < 2000, "engine did not converge"
+    return [list(eng.seqs[i].output_tokens) for i in ids]
+
+
+def test_live_context_capacity_beyond_worst_case():
+    """8 concurrent slots complete inside a pool that worst-case
+    reservation would size for only 2 — the paged pool admits by LIVE
+    context (VERDICT r3 next-step #2's 'batch 32 x 8k where 8 x 8k fit'
+    criterion, scaled down)."""
+    cfg = _cfg(max_num_seqs=8,
+               kv_pool_tokens=2 * 128)    # worst case would need 8*128
+    assert cfg.num_kv_blocks - 1 == 16    # 256 tokens / 16
+    eng = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, 250, size=20)) for _ in range(8)]
+    outs = _run_all(eng, prompts, max_tokens=8)
+    assert all(len(o) == 8 for o in outs)
+    # pool pressure stayed inside capacity the whole run
+    assert eng.block_mgr.active_blocks == 0     # all released at finish
+
+
+def test_preemption_recompute_is_greedy_deterministic():
+    """A pool too small for every admitted sequence forces recompute
+    preemption; greedy outputs must match an unconstrained run exactly
+    (teacher-forced replay)."""
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(1, 250, size=40)) for _ in range(4)]
+
+    ample = LLMEngine(_cfg(max_num_seqs=4))
+    want = _run_all(ample, prompts, max_tokens=24)
+
+    tight = LLMEngine(_cfg(max_num_seqs=4, kv_pool_tokens=160))
+    got = _run_all(tight, prompts, max_tokens=24)
+    assert got == want
+    # the tight pool must actually have exercised the preemption path
+    assert tight.metrics.preemptions._value.get() > 0
+
+
+def test_prefix_sharing_zero_copy_and_parity():
+    """Second identical prompt attaches the finished first request's
+    blocks by REFERENCE (ids shared, coverage > 0) and generates
+    identical greedy tokens."""
+    cfg = _cfg(enable_prefix_caching=True)
+    eng = LLMEngine(cfg)
+    prompt = list(range(5, 55))           # 50 tokens -> 3 full blocks
+
+    first = _run_all(eng, [prompt], max_tokens=10)[0]
+    assert eng.block_mgr.hit_rate <= 0.5  # first pass missed
+
+    # capture the registered block ids before the second request
+    registered = dict(eng.block_mgr._by_key)
+    assert len(registered) >= 3           # prompt blocks cached
+
+    opts = SamplingOptions(temperature=0.0, max_tokens=10, ignore_eos=True)
+    sid = eng.add_request(list(prompt), opts)
+    # drive one schedule step so admission happens, then inspect
+    eng.step()
+    seq = eng.seqs[sid]
+    shared = [b for b in seq.block_ids if b in registered.values()]
+    assert len(shared) >= 3               # attached by reference
+    assert seq.num_prefilled >= 3 * cfg.kv_block_size
+
+    while not eng.seqs[sid].finish_reason:
+        eng.step()
+    assert list(eng.seqs[sid].output_tokens) == first
+    assert eng.block_mgr.hits >= 1
+
+
+def test_prefix_sharing_write_isolation():
+    """Two divergent prompts sharing a prefix: the second must not
+    corrupt the first's shared blocks (strictly: shared blocks are
+    immutable; both continuations match unshared runs)."""
+    base = list(range(10, 42))            # 32 tokens = 2 full blocks
+    p1 = base + [7, 8, 9]
+    p2 = base + [3, 4, 5]
+
+    plain1 = _run_all(LLMEngine(_cfg()), [p1], max_tokens=10)[0]
+    plain2 = _run_all(LLMEngine(_cfg()), [p2], max_tokens=10)[0]
+
+    eng = LLMEngine(_cfg(enable_prefix_caching=True))
+    assert _run_all(eng, [p1], max_tokens=10)[0] == plain1
+    assert _run_all(eng, [p2], max_tokens=10)[0] == plain2
+    # and replaying p1 (now fully cached incl. its output prefix) again
+    assert _run_all(eng, [p1], max_tokens=10)[0] == plain1
+
+
+def test_pool_gauge_tracks_blocks():
+    eng = LLMEngine(_cfg())
+    opts = SamplingOptions(temperature=0.0, max_tokens=4, ignore_eos=True)
+    sid = eng.add_request(list(range(1, 40)), opts)
+    eng.step()
+    assert eng.block_mgr.usage > 0
+    while not eng.seqs[sid].finish_reason:
+        eng.step()
+    assert eng.block_mgr.active_blocks == 0
