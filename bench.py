@@ -298,11 +298,19 @@ def main() -> None:
             if platform == "tpu" and not probe_platform(PROBE_TIMEOUT_S):
                 break   # tunnel died mid-run; no point retrying
 
-    # 3) CPU fallback: tiny model, pinned CPU backend, flagged output
+    # 3) CPU fallback: tiny model, pinned CPU backend, flagged output.
+    # Strip PYTHONPATH entries that inject a sitecustomize module: a
+    # wedged PJRT-plugin tunnel registered that way hangs backend
+    # discovery even under JAX_PLATFORMS=cpu, which would turn the CPU
+    # fallback into a timeout instead of a number.
     sys.stderr.write("falling back to CPU bench (--small)\n")
+    clean_pp = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and not os.path.exists(os.path.join(p, "sitecustomize.py")))
     cpu_args = [a for a in fwd if a != "--small"]
     result = run_child(["--small"] + cpu_args,
-                       {"JAX_PLATFORMS": "cpu"}, CPU_RUN_TIMEOUT_S)
+                       {"JAX_PLATFORMS": "cpu", "PYTHONPATH": clean_pp},
+                       CPU_RUN_TIMEOUT_S)
     if result is not None:
         result["tpu_unavailable"] = True
         result["metric"] += " [CPU FALLBACK: TPU unavailable]"

@@ -282,14 +282,53 @@ class LLMEngine:
             if decode_seqs or self._inflight is not None:
                 if self._inflight is None:
                     self._dispatch_decode(decode_seqs)
-                outputs.extend(self._drain_decode())
-                # pipeline: put the next window in flight before handing
-                # outputs back, so the device works during host I/O
-                decode_seqs = list(self.scheduler.running.values())
-                if decode_seqs:
-                    self._dispatch_decode(decode_seqs)
+                # optimistic pipelining: sync the in-flight window's
+                # arrays, then put the NEXT window in flight BEFORE the
+                # host walks tokens (detok, stop checks, callbacks) —
+                # the device decodes while the host processes. Valid
+                # because decode inputs are device-carried: the next
+                # window continues from the in-flight window's final
+                # tokens/positions regardless of what the host decides;
+                # rows whose sequence turns out to have finished are
+                # discarded at the next drain (their writes only touch
+                # blocks still owned by the finished sequence — never
+                # registered-prefix blocks, which are always full).
+                # only when the device carry is self-contained: a dirty
+                # decode/sampling state means the next dispatch must
+                # upload host mirrors, and mid-processing mirrors lag
+                # the device by one window (uploading them would rewind
+                # live rows and duplicate tokens).
+                synced = self._sync_inflight()
+                if (synced is not None and not self._decode_dirty
+                        and not self._sampling_dirty
+                        and not (self.cfg.speculative_ngram_tokens
+                                 and self._hist_dirty)
+                        and self._worth_dispatch_ahead()):
+                    self._dispatch_decode(
+                        list(self.scheduler.running.values()),
+                        ahead=synced[3])
+                outputs.extend(self._process_window(synced))
+                if self._inflight is None:
+                    decode_seqs = list(self.scheduler.running.values())
+                    if decode_seqs:
+                        self._dispatch_decode(decode_seqs)
             self._refresh_gauges()
             return outputs
+
+    def _worth_dispatch_ahead(self) -> bool:
+        """Skip the optimistic window when every live sequence could
+        reach its token budget within the already-synced window — then
+        the whole dispatch would likely be discarded work (and would
+        delay the next admission wave by one window)."""
+        W = self.cfg.decode_window
+        live = [s for s in self.scheduler.running.values()
+                if s.status is SeqStatus.RUNNING]
+        if not live:
+            return False
+        return any(
+            s.options.max_tokens is None
+            or s.options.max_tokens - len(s.output_tokens) > W
+            for s in live)
 
     def _do_prefill(self, works) -> List[StepOutput]:
         """Batch-prefill every scheduled chunk: one device dispatch per
@@ -409,22 +448,38 @@ class LLMEngine:
             self._decode_dirty = True   # gids/states must re-upload
         return self._guided_table, self._guided_gids
 
-    def _dispatch_decode(self, decode_seqs) -> None:
-        """Launch one decode window (async dispatch; no host sync)."""
+    def _dispatch_decode(self, decode_seqs, ahead: int = 0) -> bool:
+        """Launch one decode window (async dispatch; no host sync).
+
+        ahead > 0 = optimistic dispatch while the previous window's
+        tokens are still unprocessed on the host: device positions are
+        `ahead` steps past the host mirrors, so block coverage and the
+        kv bucket are computed from position + ahead. An optimistic
+        dispatch must leave host state untouched by the device's view:
+        it returns False WITHOUT dispatching if it would have to
+        preempt (parking rewrites the decode carry) or upload host
+        mirrors (they lag the device by `ahead` steps until the synced
+        window is processed) — the caller then falls back to the
+        ordinary process-first path."""
         W = self.cfg.decode_window
         # block coverage first: every live slot's table must span the
         # whole window (worst case: speculation emits spec+1 per step).
         # Pool pressure preempts youngest-first; a sequence that cannot
         # be covered even then is preempted itself (recompute later).
-        horizon = W * (self.cfg.speculative_ngram_tokens + 1) + 1
+        spec_w = self.cfg.speculative_ngram_tokens + 1
+        horizon = (W + ahead) * spec_w + 1
         for s in list(decode_seqs):
             if s.status is not SeqStatus.RUNNING:
                 continue   # already preempted as a victim this pass
-            if not self._ensure_blocks(s, s.next_position + horizon):
+            covered = self._ensure_blocks(s, s.next_position + horizon,
+                                          allow_preempt=ahead == 0)
+            if not covered:
+                if ahead:
+                    return False   # pool pressure: no optimistic window
                 self._preempt(s)
         decode_seqs = list(self.scheduler.running.values())
         if not decode_seqs:
-            return
+            return False
         max_pos = max(s.next_position for s in decode_seqs)
         greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
         self._ensure_dev_sampling()
@@ -440,7 +495,14 @@ class LLMEngine:
         spec = (self.cfg.speculative_ngram_tokens
                 if greedy and gtable is None else 0)
         kv_len = self.cfg.kv_bucket_for(
-            min(max_pos + W * (spec + 1) + 1, self.cfg.max_model_len))
+            min(max_pos + (W + ahead) * (spec + 1) + 1,
+                self.cfg.max_model_len))
+        if ahead and (self._decode_dirty or self._sampling_dirty):
+            # the guided-table rebuild (or any path above) dirtied the
+            # carry: uploading mid-processing mirrors would rewind the
+            # device — bail, the normal path re-dispatches after
+            # processing
+            return False
         hist = None
         if spec and (self._hist_dirty or self._decode_dirty):
             # only built for windows that will actually read it; spec=0
@@ -461,18 +523,30 @@ class LLMEngine:
             seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec)
         self._inflight = (ids_dev, lps_dev, counts_dev, W,
                           list(decode_seqs), time.monotonic())
+        return True
 
     def _drain_decode(self) -> List[StepOutput]:
         """Sync + process the in-flight window, if any. A sequence that
         finished or aborted after dispatch simply has its rows discarded
         (its slot is parked and the decode carry marked dirty)."""
+        return self._process_window(self._sync_inflight())
+
+    def _sync_inflight(self):
+        """Device->host sync of the in-flight window's arrays (no token
+        processing): (ids, lps, counts, W, seqs, t0) or None."""
         if self._inflight is None:
-            return []
+            return None
         ids_dev, lps_dev, counts_dev, W, seqs, t0 = self._inflight
         self._inflight = None
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
         counts = None if counts_dev is None else np.asarray(counts_dev)
+        return ids, lps, counts, W, seqs, t0
+
+    def _process_window(self, synced) -> List[StepOutput]:
+        if synced is None:
+            return []
+        ids, lps, counts, W, seqs, t0 = synced
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
@@ -718,11 +792,13 @@ class LLMEngine:
             self._tables[slot, :len(block_ids)] = block_ids
         self.runner.set_block_tables(self._tables)
 
-    def _ensure_blocks(self, seq: Sequence, upto_tokens: int) -> bool:
+    def _ensure_blocks(self, seq: Sequence, upto_tokens: int,
+                       allow_preempt: bool = True) -> bool:
         """Grow a live sequence's block list to cover positions
         < min(upto_tokens, max_model_len), preempting younger sequences
         under pool pressure. False = could not cover even after
-        preemption (caller preempts `seq` itself)."""
+        preemption (caller preempts `seq` itself). allow_preempt=False
+        (optimistic dispatch) fails fast instead of evicting anyone."""
         need = self.block_mgr.blocks_for(
             min(upto_tokens, self.cfg.max_model_len))
         while len(seq.block_ids) < need:
@@ -731,6 +807,8 @@ class LLMEngine:
                 seq.block_ids.extend(fresh)
                 self._set_table_row(seq.slot, seq.block_ids)
                 return True
+            if not allow_preempt:
+                return False
             if not self._preempt_youngest(requester=seq):
                 return False
         return True

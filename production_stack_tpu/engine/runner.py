@@ -96,6 +96,10 @@ class ModelRunner:
         self._tables = jnp.zeros(
             (engine_cfg.max_num_seqs, engine_cfg.max_blocks_per_seq),
             jnp.int32)
+        self._tables_host = np.zeros(
+            (engine_cfg.max_num_seqs, engine_cfg.max_blocks_per_seq),
+            np.int32)
+        self._tables_dirty = False
         if mesh is not None:
             # tensor-parallel serving: weights/cache sharded over the
             # slice's chips; XLA derives all ICI collectives from here
@@ -361,12 +365,26 @@ class ModelRunner:
         return sub
 
     def set_block_tables(self, tables) -> None:
-        """Upload the host block-table mirror [B, MB] int32 (engine
-        calls this whenever its allocator changes any row)."""
-        t = jnp.asarray(tables, jnp.int32)
-        if self._tables_sharding is not None:
-            t = jax.device_put(t, self._tables_sharding)
-        self._tables = t
+        """Note a change to the host block-table mirror [B, MB] int32.
+
+        The upload is DEFERRED to the next dispatch that reads the
+        tables (`_dev_tables`): the engine touches table rows several
+        times per window (per-sequence block growth, admission,
+        parking), and eager uploads would pay one host->device transfer
+        per touch — each a full round-trip when the chip sits behind a
+        high-latency tunnel. Deferral coalesces them into at most one
+        upload per dispatch."""
+        self._tables_host = tables
+        self._tables_dirty = True
+
+    def _dev_tables(self) -> jnp.ndarray:
+        if self._tables_dirty:
+            t = jnp.asarray(self._tables_host, jnp.int32)
+            if self._tables_sharding is not None:
+                t = jax.device_put(t, self._tables_sharding)
+            self._tables = t
+            self._tables_dirty = False
+        return self._tables
 
     def set_decode_state(self, tokens, positions,
                          guide_states=None, history=None) -> None:
@@ -411,7 +429,7 @@ class ModelRunner:
                 self._decode_fns[("spec", steps, kv_len, spec)] = fn
             (ids, lps, counts, self._dec_tokens, self._dec_pos,
              self._dec_hist, self.cache) = fn(
-                self.params, self.cache, self._tables, self._dec_tokens,
+                self.params, self.cache, self._dev_tables(), self._dec_tokens,
                 self._dec_pos, self._dec_hist, sampling)
             return ids, lps, counts
         seeded = seeded and not greedy
@@ -435,7 +453,7 @@ class ModelRunner:
             guide_ids = jnp.zeros((B,), jnp.int32)
         (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
          self.cache) = fn(
-            self.params, self.cache, self._tables, self._dec_tokens,
+            self.params, self.cache, self._dev_tables(), self._dec_tokens,
             self._dec_pos,
             sampling, self._next_key(), guide_table,
             jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
@@ -464,7 +482,7 @@ class ModelRunner:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = np.zeros((B,), np.int32)
             guide_states = np.zeros((B,), np.int32)
-        args = (self.params, self.cache, self._tables,
+        args = (self.params, self.cache, self._dev_tables(),
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(starts, jnp.int32),
                 jnp.asarray(lengths, jnp.int32), sampling, self._next_key(),
@@ -604,7 +622,7 @@ class ModelRunner:
                 return kf[:, idx], vf[:, idx]
 
             fn = self._extract_fns[size] = jax.jit(_impl)
-        return fn(self.cache, self._tables, jnp.int32(slot),
+        return fn(self.cache, self._dev_tables(), jnp.int32(slot),
                   jnp.int32(start))
 
     def inject_chunk(self, slot: int, start: int, k_chunk, v_chunk) -> None:
@@ -627,7 +645,7 @@ class ModelRunner:
 
             fn = self._inject_fns[size] = jax.jit(_impl,
                                                   donate_argnums=(0,))
-        self.cache = fn(self.cache, self._tables, jnp.asarray(k_chunk),
+        self.cache = fn(self.cache, self._dev_tables(), jnp.asarray(k_chunk),
                         jnp.asarray(v_chunk), jnp.int32(slot),
                         jnp.int32(start))
 

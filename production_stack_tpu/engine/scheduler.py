@@ -241,9 +241,3 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.waiting or self.running or self._prefilling)
 
-    @property
-    def kv_usage(self) -> float:
-        """Fraction of KV slot-tokens in use (the TPU HBM KV gauge)."""
-        used = sum(s.num_tokens for s in self.running.values())
-        used += sum(s.num_prefilled for s in self._prefilling.values())
-        return used / float(self.max_num_seqs * self.max_model_len)
