@@ -76,7 +76,7 @@ class ModelRunner:
             params = jax.jit(quant.quantize_params,
                              donate_argnums=0)(params)
         self.params = params
-        # paged pool [L, N, Bs, Hkv, D] + per-slot block tables [B, MB]
+        # paged pool [L, N, Hkv, Bs, D] + per-slot block tables [B, MB]
         # (models/kv.py); the tables device array is refreshed by the
         # engine whenever its allocator changes a row. Under a mesh the
         # block axis shards over dp (parallel/sharding.cache_pspec), so
@@ -197,7 +197,8 @@ class ModelRunner:
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
                 cache, block_tables=tables,
-                rope=self.rope, kv_len=kv_len, use_flash=False,
+                rope=self.rope, kv_len=kv_len, use_flash=None,
+                mesh=self.mesh,
                 lora_params=self._lora, adapter_ids=sampling.adapter,
                 lora_scaling=self._lora_scaling,
                 token_valid=(pos < S)[:, None])
@@ -279,7 +280,8 @@ class ModelRunner:
             logits, cache = llama.forward(
                 params, self.model_cfg, step_toks, step_pos, cache,
                 block_tables=tables,
-                rope=self.rope, kv_len=kv_len, use_flash=False,
+                rope=self.rope, kv_len=kv_len, use_flash=None,
+                mesh=self.mesh,
                 lora_params=self._lora, adapter_ids=sampling.adapter,
                 lora_scaling=self._lora_scaling,
                 token_valid=step_pos < S_max)
@@ -339,7 +341,7 @@ class ModelRunner:
             params, self.model_cfg, tokens, positions, cache,
             block_tables=tables,
             rope=self.rope, kv_len=kv_len,
-            use_flash=None if self.mesh is None else False,
+            use_flash=None, mesh=self.mesh,
             lora_params=self._lora, adapter_ids=sampling.adapter,
             lora_scaling=self._lora_scaling, token_valid=token_valid)
         last = jnp.take_along_axis(
@@ -417,47 +419,83 @@ class ModelRunner:
         kv_len = kv_len or self.engine_cfg.max_model_len
         if spec:
             assert greedy and guide_table is None
-            fn = self._decode_fns.get(("spec", steps, kv_len, spec))
-            if fn is None:
+            args = (self.params, self.cache, self._dev_tables(),
+                    self._dec_tokens, self._dec_pos, self._dec_hist,
+                    sampling)
+            key = ("spec", steps, kv_len, spec)
+
+            def make_spec():
                 logger.info("compiling speculative decode window "
                             "(steps=%d kv=%d draft=%d)", steps, kv_len,
                             spec)
-                fn = jax.jit(
+                return jax.jit(
                     partial(self._decode_spec_impl, steps=steps,
                             kv_len=kv_len, spec=spec),
                     donate_argnums=(1,))
-                self._decode_fns[("spec", steps, kv_len, spec)] = fn
+
+            fn = self._compile_with_fallback(self._decode_fns, key,
+                                             make_spec, args)
             (ids, lps, counts, self._dec_tokens, self._dec_pos,
-             self._dec_hist, self.cache) = fn(
-                self.params, self.cache, self._dev_tables(), self._dec_tokens,
-                self._dec_pos, self._dec_hist, sampling)
+             self._dec_hist, self.cache) = fn(*args)
             return ids, lps, counts
         seeded = seeded and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
         cache_key = (steps, kv_len, greedy, seeded, guided, gshape)
-        fn = self._decode_fns.get(cache_key)
-        if fn is None:
-            logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
-                        "%s%s)", steps, kv_len, greedy,
-                        " seeded" if seeded else "",
-                        " guided" if guided else "")
-            fn = jax.jit(
-                partial(self._decode_impl, steps=steps, kv_len=kv_len,
-                        greedy=greedy, seeded=seeded, guided=guided),
-                donate_argnums=(1,))
-            self._decode_fns[cache_key] = fn
         B = self.engine_cfg.max_num_seqs
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = jnp.zeros((B,), jnp.int32)
+        args = (self.params, self.cache, self._dev_tables(),
+                self._dec_tokens, self._dec_pos,
+                sampling, self._next_key(), guide_table,
+                jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
+
+        def make_decode():
+            logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
+                        "%s%s)", steps, kv_len, greedy,
+                        " seeded" if seeded else "",
+                        " guided" if guided else "")
+            return jax.jit(
+                partial(self._decode_impl, steps=steps, kv_len=kv_len,
+                        greedy=greedy, seeded=seeded, guided=guided),
+                donate_argnums=(1,))
+
+        fn = self._compile_with_fallback(self._decode_fns, cache_key,
+                                         make_decode, args)
         (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
-         self.cache) = fn(
-            self.params, self.cache, self._dev_tables(), self._dec_tokens,
-            self._dec_pos,
-            sampling, self._next_key(), guide_table,
-            jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
+         self.cache) = fn(*args)
         return ids, lps, None
+
+    def _compile_with_fallback(self, cache: dict, key, make_fn, args):
+        """Fetch-or-compile an executable; if the pallas paged kernel
+        fails to BUILD for this combination (backend or VMEM limits
+        beyond paged_viable's estimate), disable the kernel gate and
+        recompile on the jnp attention path — once, for the whole
+        process. Compilation is an explicit lower+compile BEFORE any
+        buffers are donated, so a runtime failure of a working
+        executable propagates unchanged (retrying it would re-pass a
+        donated, deleted cache buffer)."""
+        fn = cache.get(key)
+        if fn is not None:
+            return fn
+        from production_stack_tpu.ops import pallas_attention
+        try:
+            fn = make_fn()
+            fn.lower(*args).compile()   # donation applies at execution
+        except Exception:
+            if not pallas_attention.flash_enabled():
+                raise
+            logger.exception(
+                "pallas paged attention failed to compile for %r; "
+                "falling back to the jnp attention path", key)
+            pallas_attention.set_flash_enabled(False)
+            self._decode_fns.clear()
+            self._prefill_fns.clear()
+            fn = make_fn()
+            fn.lower(*args).compile()
+        cache[key] = fn
+        return fn
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int, guide_table=None, guide_ids=None,
@@ -489,34 +527,19 @@ class ModelRunner:
                 guide_table, jnp.asarray(guide_ids, jnp.int32),
                 jnp.asarray(guide_states, jnp.int32))
         gshape = guide_table.shape if guided else None
-        fn = self._prefill_fns.get((Tb, kv_len, guided, gshape))
-        if fn is None:
-            try:
-                fn = self._compile_prefill(Tb, kv_len, guided, gshape, args)
-            except Exception:
-                from production_stack_tpu.ops import pallas_attention
-                if (self.mesh is not None
-                        or not pallas_attention.flash_enabled()):
-                    raise
-                logger.exception(
-                    "flash prefill (chunk=%d kv=%d) failed to compile; "
-                    "falling back to the jnp attention path", Tb, kv_len)
-                pallas_attention.set_flash_enabled(False)
-                self._prefill_fns.clear()
-                fn = self._compile_prefill(Tb, kv_len, guided, gshape, args)
+
+        def make_prefill():
+            logger.info("compiling prefill (chunk=%d kv=%d%s)", Tb,
+                        kv_len, " guided" if guided else "")
+            return jax.jit(partial(self._prefill_impl, kv_len=kv_len,
+                                   guided=guided),
+                           donate_argnums=(1,))
+
+        fn = self._compile_with_fallback(
+            self._prefill_fns, (Tb, kv_len, guided, gshape),
+            make_prefill, args)
         ids, lps, self.cache = fn(*args)
         return ids, lps
-
-    def _compile_prefill(self, Tb: int, kv_len: int, guided: bool,
-                         gshape, args):
-        logger.info("compiling prefill (chunk=%d kv=%d%s)", Tb, kv_len,
-                    " guided" if guided else "")
-        fn = jax.jit(partial(self._prefill_impl, kv_len=kv_len,
-                             guided=guided),
-                     donate_argnums=(1,))
-        fn.lower(*args).compile()   # donation applies at execution only
-        self._prefill_fns[(Tb, kv_len, guided, gshape)] = fn
-        return fn
 
     def embed(self, tokens, lengths):
         """Mean-pooled final hidden states for padded prompts.
@@ -596,15 +619,15 @@ class ModelRunner:
             fn = self._prompt_lp_fns[(N, Tb)] = jax.jit(_impl)
         return fn(self.params, jnp.asarray(pad, jnp.int32))
 
-    def _slot_flat_indices(self, tables, slot, start, size: int):
-        """Pool-flat indices [size] for a slot's virtual positions
-        start..start+size-1 (through its block table row)."""
+    def _slot_block_offsets(self, tables, slot, start, size: int):
+        """(block ids [size], intra-block offsets [size]) for a slot's
+        virtual positions start..start+size-1 (through its table row)."""
         Bs = self.engine_cfg.kv_block_size
         MB = self.engine_cfg.max_blocks_per_seq
         pos = start + jnp.arange(size)
         row = jnp.take(tables, slot, axis=0)                  # [MB]
         blk = jnp.take(row, jnp.clip(pos // Bs, 0, MB - 1))   # [size]
-        return blk * Bs + pos % Bs
+        return blk, pos % Bs
 
     def extract_chunk(self, slot: int, start: int, size: int):
         """Gather [L, size, Hkv, D] k/v out of a slot's blocks (no
@@ -614,12 +637,13 @@ class ModelRunner:
         fn = self._extract_fns.get(size)
         if fn is None:
             def _impl(cache: KVCache, tables, slot, start):
-                idx = self._slot_flat_indices(tables, slot, start, size)
-                kf = cache.k.reshape((cache.k.shape[0], -1)
-                                     + cache.k.shape[3:])
-                vf = cache.v.reshape((cache.v.shape[0], -1)
-                                     + cache.v.shape[3:])
-                return kf[:, idx], vf[:, idx]
+                blk, off = self._slot_block_offsets(tables, slot, start,
+                                                    size)
+                # advanced indices (block, offset) put [size] first:
+                # [size, L, Hkv, D] -> chunk layout [L, size, Hkv, D]
+                k = cache.k[:, blk, :, off, :].transpose(1, 0, 2, 3)
+                v = cache.v[:, blk, :, off, :].transpose(1, 0, 2, 3)
+                return k, v
 
             fn = self._extract_fns[size] = jax.jit(_impl)
         return fn(self.cache, self._dev_tables(), jnp.int32(slot),
@@ -635,13 +659,13 @@ class ModelRunner:
         if fn is None:
             def _impl(cache: KVCache, tables, k_chunk, v_chunk, slot,
                       start):
-                idx = self._slot_flat_indices(tables, slot, start, size)
-                shape_k = cache.k.shape
-                kf = cache.k.reshape((shape_k[0], -1) + shape_k[3:])
-                vf = cache.v.reshape((shape_k[0], -1) + shape_k[3:])
-                kf = kf.at[:, idx].set(k_chunk.astype(kf.dtype))
-                vf = vf.at[:, idx].set(v_chunk.astype(vf.dtype))
-                return KVCache(kf.reshape(shape_k), vf.reshape(shape_k))
+                blk, off = self._slot_block_offsets(tables, slot, start,
+                                                    size)
+                kc = k_chunk.astype(cache.k.dtype).transpose(1, 0, 2, 3)
+                vc = v_chunk.astype(cache.v.dtype).transpose(1, 0, 2, 3)
+                k = cache.k.at[:, blk, :, off, :].set(kc)
+                v = cache.v.at[:, blk, :, off, :].set(vc)
+                return KVCache(k, v)
 
             fn = self._inject_fns[size] = jax.jit(_impl,
                                                   donate_argnums=(0,))

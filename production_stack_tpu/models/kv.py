@@ -1,12 +1,16 @@
 """Paged KV cache: a global block pool + per-slot block tables.
 
-Layout: ``k, v [L, N, Bs, Hkv, D]`` — N fixed-size blocks of Bs token
-positions each, shared by every sequence. A sequence owns an ordered
-list of blocks; its *block table* row maps virtual position ``p`` to
-pool location ``(table[p // Bs], p % Bs)``. HBM is sized by
-``EngineConfig.kv_pool_tokens``, not ``max_num_seqs × max_model_len``:
-batch capacity scales with *live* context, and prefix caching is block
-*sharing* (refcounts in engine/block_manager.py) instead of copies.
+Layout: ``k, v [L, N, Hkv, Bs, D]`` — N fixed-size blocks of Bs token
+positions each, shared by every sequence, with blocks stored
+HEAD-MAJOR: a (block, kv-head) panel is a contiguous ``[Bs, D]`` tile,
+the shape both the XLA gather path and the Pallas paged-attention
+kernel (ops/pallas_paged.py) want as their minor dims on TPU. A
+sequence owns an ordered list of blocks; its *block table* row maps
+virtual position ``p`` to pool location ``(table[p // Bs], p % Bs)``.
+HBM is sized by ``EngineConfig.kv_pool_tokens``, not
+``max_num_seqs × max_model_len``: batch capacity scales with *live*
+context, and prefix caching is block *sharing* (refcounts in
+engine/block_manager.py) instead of copies.
 
 TPU-first invariants:
 - Static shapes everywhere: the pool, the tables [B, MB], and the
@@ -14,21 +18,22 @@ TPU-first invariants:
   bookkeeping and never recompiles anything.
 - **Block 0 is the trash block.** It is never allocated; writes from
   parked rows, padding tokens, and beyond-capacity window tails are
-  routed to it via the ``valid`` mask. This replaces the S-1
-  DUS-clamping scheme of the earlier contiguous cache with something
-  simpler to reason about: invalid writes all land in a block no table
-  references.
-- Reads go through a *gathered view* (``gather_view``): the first
-  ``nb`` table entries pull [B, nb*Bs, Hkv, D] out of the pool, on
-  which the existing position-masked attention (ops/attention.py) and
-  the Pallas flash kernel run unchanged. View index s IS virtual
-  position s, so the causal position mask also hides any stale/garbage
-  block contents: a query at position p only attends s <= p, and every
+  routed to it via the ``valid`` mask. Invalid writes all land in a
+  block no table references.
+- Reads go through the Pallas paged kernel (blocks streamed straight
+  from the pool through scalar-prefetched tables — each KV byte read
+  once) or, on backends/meshes the kernel does not cover, a *gathered
+  view* (``gather_view``): the first ``nb`` table entries pull
+  [B, nb*Bs, Hkv, D] out of the pool for the position-masked jnp
+  attention (ops/attention.py). View index s IS virtual position s,
+  so the causal position mask also hides any stale/garbage block
+  contents: a query at position p only attends s <= p, and every
   position <= p of a live row has been written by construction.
-- Sharding: the pool keeps the slot cache's spec shape — heads over
-  tp, block axis over dp (parallel/sharding.py cache_pspec). Under a
-  tp-only serving mesh the table gather is local to every shard
-  (indices replicated, gathered axis unsharded): no extra collectives.
+- Sharding: heads over tp, block axis over dp
+  (parallel/sharding.py cache_pspec). Under a tp-only serving mesh
+  both the kernel (shard_map over the head axis) and the gather
+  (indices replicated, gathered axis unsharded) are shard-local: no
+  extra collectives.
 
 The reference stack's KV management is configuration around LMCache env
 vars (reference: helm/templates/deployment-vllm-multi.yaml:154-178) and
@@ -43,8 +48,8 @@ import jax.numpy as jnp
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # [L, N, Bs, Hkv, D]
-    v: jnp.ndarray  # [L, N, Bs, Hkv, D]
+    k: jnp.ndarray  # [L, N, Hkv, Bs, D]
+    v: jnp.ndarray  # [L, N, Hkv, Bs, D]
 
     @property
     def num_blocks(self) -> int:
@@ -52,14 +57,14 @@ class KVCache(NamedTuple):
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def make_cache(num_layers: int, num_blocks: int, block_size: int,
                num_kv_heads: int, head_dim: int,
                dtype=jnp.bfloat16) -> KVCache:
     """Block pool. num_blocks INCLUDES the reserved trash block 0."""
-    shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+    shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -90,7 +95,7 @@ def make_slot_cache(num_layers: int, num_slots: int, max_len: int,
 def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
                 tables: jnp.ndarray, positions: jnp.ndarray,
                 valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """Scatter new [B,T,Hkv,D] into the pool layer [N,Bs,Hkv,D].
+    """Scatter new [B,T,Hkv,D] into the pool layer [N,Hkv,Bs,D].
 
     positions [B,T] are virtual positions; tables [B,MB] map them to
     blocks. Tokens with valid == False (padding, parked rows, window
@@ -101,22 +106,22 @@ def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
     exceed the virtual capacity MB*Bs.
     """
     new = new.astype(cache_layer.dtype)
-    Bs = cache_layer.shape[1]
+    Bs = cache_layer.shape[2]
     B, T = positions.shape
     MB = tables.shape[1]
     bi = jnp.clip(positions // Bs, 0, MB - 1)
     blk = jnp.take_along_axis(tables, bi, axis=1)           # [B, T]
-    idx = blk * Bs + positions % Bs
+    off = positions % Bs
     # beyond-capacity positions can only reach here masked or in test
     # paths; clamp them onto trash rather than wrapping into a block
     oob = (positions < 0) | (positions >= MB * Bs)
     if valid is not None:
         oob = oob | ~valid
-    idx = jnp.where(oob, positions % Bs, idx)               # block 0
-    flat = cache_layer.reshape((-1,) + cache_layer.shape[2:])
-    flat = flat.at[idx.reshape(-1)].set(
+    blk = jnp.where(oob, 0, blk)                            # block 0
+    # advanced indices on the block and offset axes land the [Hkv, D]
+    # slab of every token at its (block, head-major row) home
+    return cache_layer.at[blk.reshape(-1), :, off.reshape(-1), :].set(
         new.reshape((B * T,) + new.shape[2:]))
-    return flat.reshape(cache_layer.shape)
 
 
 def gather_view(cache_layer: jnp.ndarray, tables: jnp.ndarray,
@@ -126,7 +131,9 @@ def gather_view(cache_layer: jnp.ndarray, tables: jnp.ndarray,
     Unallocated table entries read trash block 0 — garbage that the
     causal position mask always hides (a query at position p only
     attends positions <= p, all of which are allocated and written)."""
-    Bs = cache_layer.shape[1]
+    Hkv, Bs = cache_layer.shape[1], cache_layer.shape[2]
     t = tables[:, :nb]                                       # [B, nb]
-    g = cache_layer[t]                                       # [B,nb,Bs,..]
-    return g.reshape((t.shape[0], nb * Bs) + cache_layer.shape[2:])
+    g = cache_layer[t]                                       # [B,nb,Hkv,Bs,D]
+    g = g.transpose(0, 1, 3, 2, 4)                           # [B,nb,Bs,Hkv,D]
+    return g.reshape(t.shape[0], nb * Bs, Hkv,
+                     cache_layer.shape[-1])

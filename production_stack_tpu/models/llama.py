@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from production_stack_tpu.models import lora, quant
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, gather_view, write_chunk
-from production_stack_tpu.ops import moe, pallas_attention
+from production_stack_tpu.ops import moe, pallas_attention, pallas_paged
 from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
 from production_stack_tpu.ops.norms import rms_norm
 from production_stack_tpu.ops.rope import apply_rope, rope_table
@@ -99,7 +99,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 adapter_ids: Optional[jnp.ndarray] = None,
                 lora_scaling: float = 1.0,
                 token_valid: Optional[jnp.ndarray] = None,
-                block_tables: Optional[jnp.ndarray] = None):
+                block_tables: Optional[jnp.ndarray] = None,
+                mesh=None):
     """One transformer block. x [B,T,H]; kv = this layer's paged pool
     (k, v) [N,Bs,Hkv,D] addressed through block_tables [B,MB]
     (models/kv.py).
@@ -150,21 +151,29 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                               valid=token_valid)
         v_cache = write_chunk(kv[1], v, block_tables, positions,
                               valid=token_valid)
-        Bs = k_cache.shape[1]
+        Bs = k_cache.shape[2]
         MB = block_tables.shape[1]
         nb = MB if kv_len is None else min(-(-kv_len // Bs), MB)
-        k_att = gather_view(k_cache, block_tables, nb)
-        v_att = gather_view(v_cache, block_tables, nb)
-        if (use_flash and T > 1
-                and pallas_attention.flash_viable(
-                    k_att.shape[1], hd, jnp.dtype(k_att.dtype).itemsize)):
-            # prefill chunks hit the pallas flash kernel on the gathered
-            # view: no [T, S] score materialization, causal block
-            # skipping over the live prefix
-            attn = pallas_attention.flash_attention_with_cache(
-                q, k_att, v_att, starts,
-                interpret=pallas_attention.needs_interpret())
+        if (use_flash
+                and pallas_paged.paged_viable(T, nh // nkv, hd, Bs)
+                and (mesh is None or pallas_paged.mesh_tp_only(mesh))):
+            # paged flash kernel: K/V blocks streamed straight from the
+            # pool through the tables — no gathered copy, no [T, S]
+            # score materialization, per-row causal block skipping.
+            # Covers prefill chunks AND decode/spec windows; under a
+            # tp-only mesh it runs shard-local per head via shard_map.
+            interp = pallas_attention.needs_interpret()
+            if mesh is None:
+                attn = pallas_paged.paged_attention(
+                    q, k_cache, v_cache, block_tables, starts, nb=nb,
+                    interpret=interp)
+            else:
+                attn = pallas_paged.paged_attention_sharded(
+                    q, k_cache, v_cache, block_tables, starts, mesh,
+                    nb=nb, interpret=interp)
         else:
+            k_att = gather_view(k_cache, block_tables, nb)
+            v_att = gather_view(v_cache, block_tables, nb)
             attn = attention_with_cache(q, k_att, v_att, positions,
                                         scale=hd ** -0.5)
         new_kv = (k_cache, v_cache)
@@ -215,8 +224,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
             use_flash: Optional[bool] = None,
             lora_params=None, adapter_ids: Optional[jnp.ndarray] = None,
             lora_scaling: float = 1.0,
-            token_valid: Optional[jnp.ndarray] = None
-            ) -> Tuple[jnp.ndarray, KVCache]:
+            token_valid: Optional[jnp.ndarray] = None,
+            mesh=None) -> Tuple[jnp.ndarray, KVCache]:
     """Incremental forward. tokens/positions [B,T] -> (logits fp32 [B,T,V], cache').
 
     cache is the paged block pool (models/kv.py); block_tables [B, MB]
@@ -259,7 +268,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                       adapter_ids=adapter_ids,
                                       lora_scaling=lora_scaling,
                                       token_valid=token_valid,
-                                      block_tables=block_tables)
+                                      block_tables=block_tables,
+                                      mesh=mesh)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(
@@ -272,7 +282,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                       lp, (k_c, v_c), kv_len=kv_len,
                                       use_flash=use_flash,
                                       token_valid=token_valid,
-                                      block_tables=block_tables)
+                                      block_tables=block_tables,
+                                      mesh=mesh)
             return out, new_kv
 
         x, (new_k, new_v) = jax.lax.scan(

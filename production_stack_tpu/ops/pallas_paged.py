@@ -1,0 +1,246 @@
+"""Paged flash attention for TPU: block-table-aware online-softmax GQA.
+
+ONE kernel for both serving phases:
+
+- **prefill** chunks (T up to the chunk bucket) — replaces the
+  gather-view + flash path (ops/pallas_attention.py), deleting the
+  per-layer gathered K/V copy AND that kernel's head-major relayout
+  copy;
+- **decode / speculative windows** (T = 1 or draft+1, inside the
+  lax.scan of engine/runner.py) — replaces the gather-view + dense jnp
+  path, which materialized a [B, kv, Hkv, D] copy of the live cache
+  per layer per step: ~3x the minimal KV HBM traffic, the dominant
+  cost of long-context decode.
+
+K/V pool blocks ``[N, Hkv, Bs, D]`` (models/kv.py, head-major: the
+per-(block, head) panel is a contiguous [Bs, D] tile) are streamed
+straight from HBM through *scalar-prefetched* block tables: the grid's
+innermost dimension walks a row's blocks, the BlockSpec index map reads
+``tables[b, j]`` to point the next DMA at the right block, and each KV
+byte a row needs is read exactly once. Per-row causal skipping falls
+out of the index map: blocks past a row's last query position clamp to
+an already-resident index (Pallas elides the re-fetch) and their grid
+steps are `pl.when`-masked away, so decode cost scales with each row's
+LIVE prefix, not the kv bucket.
+
+Grid ``(B, Hkv, NQ, nb)``; per step the q block [BQ, G, D] for one kv
+head and one pool block's [Bs, D] K and V panels live in VMEM. Online
+(max, sum, acc) statistics persist in VMEM scratch across the
+``nb``-axis (sequential "arbitrary" dimension), initialized at j == 0
+and emitted at j == nb - 1 — the classic flash accumulation, with GQA
+rows flattened as t*G + g so K/V are never broadcast to query heads.
+
+Sharded serving: under a tp-only mesh the kernel runs inside
+``shard_map`` over the head axis (q heads and pool heads both shard by
+tp; tables/starts replicate) — embarrassingly parallel, no collectives.
+Meshes that shard the pool's block axis (dp > 1) keep the jnp gather
+path, whose collectives XLA inserts.
+
+The reference repo ships no kernels (attention lives in the external
+vLLM engine, SURVEY.md §2.9); this is TPU-first work. Numerics are
+pinned against the dense jnp path in tests/test_pallas_paged.py via
+interpret mode on CPU.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+# VMEM ceiling for the per-grid-step working set (q + acc + scores,
+# fp32): conservative slice of the ~16 MB/core budget, leaving room
+# for Pallas' double-buffered K/V panels and the output block.
+_VMEM_WORK_BYTES = 8 * 1024 * 1024
+
+
+def paged_viable(T: int, groups: int, head_dim: int,
+                 block_size: int) -> bool:
+    """Can a [T*G, D] q panel + accumulator + one [T*G, Bs] score
+    block hold in VMEM? (Decode windows always can; only very long
+    prefill chunks on wide-GQA models cannot.)"""
+    rows = max(T * groups, 8)
+    work = rows * head_dim * 4 * 2 + rows * block_size * 4 * 2 \
+        + rows * head_dim * 2
+    return work <= _VMEM_WORK_BYTES
+
+
+def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, out_ref,
+                  m_ref, l_ref, acc_ref, *, block_q: int, groups: int,
+                  block_size: int, nb: int, scale: float):
+    """One (batch row, kv head, q block, pool block) grid step.
+
+    tabs_ref   (SMEM) [B, MB]      block tables
+    starts_ref (SMEM) [B]          absolute position of q[:, 0]
+    q_ref   [1, BQ, 1, G, D]       this kv-head's query block
+    k_ref   [1, 1, Bs, D]          pool block tabs[b, min(j, jmax)]
+    v_ref   [1, 1, Bs, D]
+    out_ref [1, BQ, 1, G, D]
+    m/l/acc (VMEM scratch)         online softmax state across j
+    """
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    j = pl.program_id(3)
+    rows = block_q * groups
+    D = q_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = starts_ref[b]
+    # last block this q block can see (same formula as the index map's
+    # clamp): beyond it the DMA re-targets a resident block and the
+    # step is skipped entirely
+    max_pos = start + qi * block_q + (block_q - 1)
+    jmax = jax.lax.div(max_pos, block_size)
+
+    @pl.when(j <= jmax)
+    def _compute():
+        # absolute position of each q row (rows ordered t*G + g)
+        row_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (rows, 1), 0) // groups
+        q_pos = start + qi * block_q + row_ids                # [rows, 1]
+        q = q_ref[0].reshape(rows, D).astype(jnp.float32) * scale
+        k_blk = k_ref[0, 0].astype(jnp.float32)               # [Bs, D]
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [rows, Bs]
+        k_pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1)
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1,
+                                            keepdims=True))
+        p = jnp.exp(s - m_new)                                # [rows, Bs]
+        correction = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * correction + jnp.sum(
+            p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [rows, D]
+
+    @pl.when(j == nb - 1)
+    def _emit():
+        # fully-masked (padding/parked) rows have l == 0; keep finite
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        out_ref[0] = out.reshape(block_q, 1, groups, D).astype(
+            out_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nb", "block_q", "interpret"))
+def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
+                    block_q: int = 0, interpret: bool = False):
+    """Causal GQA over paged K/V, positions contiguous per row.
+
+    q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
+    starts [B] = absolute position of q[:, 0] (every call site —
+    prefill chunks, decode windows, speculative windows — queries
+    contiguous positions start..start+T-1). A query at position p
+    attends virtual positions <= p through its table row; the pool
+    must already contain the chunk's own K/V (write-then-attend, as
+    in models/kv.py). Rows parked at start >= MB*Bs return garbage
+    the caller discards, exactly like the jnp path.
+    """
+    B, T, H, D = q.shape
+    Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
+    G = H // Hkv
+    MB = tables.shape[1]
+    scale = D ** -0.5
+    if not block_q:
+        # whole chunk per q block while VMEM allows: K/V are streamed
+        # once per (batch, head) instead of once per q block
+        block_q = T
+        while block_q > 16 and not paged_viable(block_q, G, D, Bs):
+            block_q //= 2
+    block_q = min(block_q, T)
+    pad_t = (-T) % block_q
+    if pad_t:
+        q = jnp.pad(q, ((0, 0), (0, pad_t), (0, 0), (0, 0)))
+    Tp = T + pad_t
+    nq = Tp // block_q
+
+    # q as [B, Tp, Hkv, G, D]: BlockSpec carves per-(b, kv-head) panels
+    # out of the native layout, (G, D) minor
+    q5 = q.reshape(B, Tp, Hkv, G, D)
+
+    def kv_index(b, h, qi, j, tabs, sts):
+        # clamp past-causal blocks onto the last visible one: the index
+        # stops changing, so Pallas skips the DMA re-fetch and pl.when
+        # skips the compute
+        jmax = jax.lax.div(sts[b] + qi * block_q + (block_q - 1),
+                           Bs)
+        jj = jnp.minimum(jnp.minimum(j, jmax),
+                         jnp.int32(MB - 1))
+        jj = jnp.maximum(jj, 0)
+        return (tabs[b, jj], h, 0, 0)
+
+    grid = (B, Hkv, nq, nb)
+    kernel = functools.partial(
+        _paged_kernel, block_q=block_q, groups=G, block_size=Bs,
+        nb=nb, scale=scale)
+    rows = block_q * G
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, 1, G, D),
+                             lambda b, h, qi, j, tabs, sts:
+                             (b, qi, h, 0, 0)),
+                pl.BlockSpec((1, 1, Bs, D), kv_index),
+                pl.BlockSpec((1, 1, Bs, D), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, 1, G, D),
+                                   lambda b, h, qi, j, tabs, sts:
+                                   (b, qi, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Tp, Hkv, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(tables, jnp.int32), jnp.asarray(starts, jnp.int32),
+      q5, k_pool, v_pool)
+
+    return out.reshape(B, Tp, H, D)[:, :T]
+
+
+def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
+                            nb: int, interpret: bool = False):
+    """paged_attention under a tp-only mesh: shard_map over the head
+    axis (q heads and pool kv heads both shard by tp, tables/starts
+    replicated) — shard-local, no collectives. Caller guarantees the
+    mesh has no other axis of size > 1 (mesh_tp_only)."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    fn = functools.partial(paged_attention, nb=nb, interpret=interpret)
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, None, "tp", None),
+                  P(None, "tp", None, None),
+                  P(None, "tp", None, None), P(), P()),
+        out_specs=P(None, None, "tp", None),
+        check_rep=False)(q, k_pool, v_pool, tables, starts)
+
+
+def mesh_tp_only(mesh) -> bool:
+    """True when every mesh axis except 'tp' has size 1 — the
+    configuration where the kernel can run shard-local per head."""
+    return mesh is not None and all(
+        size == 1 for name, size in mesh.shape.items() if name != "tp")

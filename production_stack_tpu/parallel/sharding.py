@@ -96,8 +96,8 @@ def data_sharding(mesh: Mesh, sequence_parallel: bool = False):
 
 
 def cache_pspec() -> P:
-    """KV cache [L, B, S, Hkv, D]: slots over dp, kv heads over tp."""
-    return P(None, "dp", None, "tp", None)
+    """KV pool [L, N, Hkv, Bs, D]: blocks over dp, kv heads over tp."""
+    return P(None, "dp", "tp", None, None)
 
 
 def shard_params(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:

@@ -1,0 +1,187 @@
+"""Numerics parity of the paged flash kernel (ops/pallas_paged.py)
+against the dense jnp path (gather_view + attention_with_cache) —
+interpret mode on CPU, same harness style as test_pallas_attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from production_stack_tpu.models.kv import make_cache, write_chunk, gather_view
+from production_stack_tpu.ops.attention import attention_with_cache
+from production_stack_tpu.ops.pallas_paged import (
+    mesh_tp_only, paged_attention, paged_attention_sharded)
+
+
+def _random_paged(key, B, n_blocks, Bs, Hkv, D, lens, t_extra=8):
+    """A single-layer pool with SHUFFLED block assignment + tables."""
+    kk, kv, kt = jax.random.split(key, 3)
+    MB = max(-(-(int(max(lens)) + t_extra + 1) // Bs), 1) + 1
+    k_pool = jax.random.normal(kk, (n_blocks, Hkv, Bs, D), jnp.float32)
+    v_pool = jax.random.normal(kv, (n_blocks, Hkv, Bs, D), jnp.float32)
+    # each row gets MB distinct non-trash blocks, shuffled across rows
+    perm = np.asarray(
+        jax.random.permutation(kt, n_blocks - 1)[:B * MB]) + 1
+    tables = perm.reshape(B, MB).astype(np.int32)
+    return k_pool, v_pool, jnp.asarray(tables)
+
+
+def _reference(q, k_pool, v_pool, tables, starts, nb):
+    k_att = gather_view(k_pool, tables, nb)
+    v_att = gather_view(v_pool, tables, nb)
+    T = q.shape[1]
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    return attention_with_cache(q, k_att, v_att, positions)
+
+
+@pytest.mark.parametrize("T,G,Bs,D", [
+    (1, 4, 16, 32),      # decode window step, GQA
+    (1, 1, 16, 32),      # decode, MHA (G == 1)
+    (5, 4, 16, 32),      # speculative window (draft + 1)
+    (48, 2, 16, 64),     # prefill chunk, ragged block boundary
+])
+def test_paged_matches_dense(T, G, Bs, D):
+    B, Hkv = 3, 2
+    H = Hkv * G
+    key = jax.random.PRNGKey(T * 1000 + G)
+    lens = [70, 33, 51]
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=64, Bs=Bs, Hkv=Hkv, D=D, lens=lens, t_extra=T)
+    starts = jnp.asarray([l - 0 for l in lens], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 7),
+                          (B, T, H, D), jnp.float32)
+    # write the chunk's own K/V first (write-then-attend invariant)
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    newk = jax.random.normal(jax.random.fold_in(key, 8),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 9),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+
+    nb = -(-(max(lens) + T) // Bs)
+    got = paged_attention(q, k_pool, v_pool, tables, starts, nb=nb,
+                          interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_rows_independent_of_other_rows_length():
+    """A short row's output must not see long rows' kv blocks (per-row
+    causal clamp in the index map)."""
+    B, Hkv, G, Bs, D, T = 2, 2, 2, 16, 32, 1
+    H = Hkv * G
+    key = jax.random.PRNGKey(0)
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=32, Bs=Bs, Hkv=Hkv, D=D, lens=[90, 5])
+    starts = jnp.asarray([90, 5], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 1),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None]
+    newk = jax.random.normal(jax.random.fold_in(key, 2),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 3),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+    nb = -(-(90 + T) // Bs)
+    got = paged_attention(q, k_pool, v_pool, tables, starts, nb=nb,
+                          interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_small_block_q_splits():
+    """Forcing q-block splitting (block_q < T) keeps parity."""
+    B, Hkv, G, Bs, D, T = 2, 1, 2, 16, 32, 40
+    H = Hkv * G
+    key = jax.random.PRNGKey(3)
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=32, Bs=Bs, Hkv=Hkv, D=D, lens=[10, 60], t_extra=T)
+    starts = jnp.asarray([10, 60], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 4),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    newk = jax.random.normal(jax.random.fold_in(key, 5),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 6),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+    nb = -(-(60 + T) // Bs)
+    got = paged_attention(q, k_pool, v_pool, tables, starts, nb=nb,
+                          block_q=16, interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_sharded_tp_parity():
+    """shard_map over the head axis on the 8-device CPU mesh matches
+    the unsharded kernel."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("tp",))
+    assert mesh_tp_only(mesh)
+    B, Hkv, G, Bs, D, T = 2, 2, 2, 16, 32, 8
+    H = Hkv * G
+    key = jax.random.PRNGKey(5)
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=24, Bs=Bs, Hkv=Hkv, D=D, lens=[20, 44])
+    starts = jnp.asarray([20, 44], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 6),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    newk = jax.random.normal(jax.random.fold_in(key, 7),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 8),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+    nb = -(-(44 + T) // Bs)
+    got = paged_attention_sharded(q, k_pool, v_pool, tables, starts,
+                                  mesh, nb=nb, interpret=True)
+    want = paged_attention(q, k_pool, v_pool, tables, starts, nb=nb,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mesh_tp_only_gate():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4])
+    assert mesh_tp_only(Mesh(devs.reshape(4), ("tp",)))
+    assert mesh_tp_only(Mesh(devs.reshape(4, 1), ("tp", "dp")))
+    assert not mesh_tp_only(Mesh(devs.reshape(2, 2), ("tp", "dp")))
+    assert not mesh_tp_only(None)
+
+
+def test_engine_end_to_end_with_paged_kernel(monkeypatch):
+    """The full engine (prefill chunks + decode windows + slot
+    recycling) with the paged kernel FORCED on, in interpret mode on
+    CPU, must reproduce the jnp path's greedy outputs exactly-ish
+    (fp32 online softmax vs dense softmax: same tokens on a tiny
+    model)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    from production_stack_tpu.ops import pallas_attention
+
+    def run(force_flash):
+        pallas_attention.set_flash_enabled(force_flash)
+        try:
+            cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                               max_num_seqs=2, prefill_chunk=32,
+                               prefill_buckets=(16, 32), decode_window=4,
+                               kv_block_size=16)
+            eng = LLMEngine(cfg)
+            opts = SamplingOptions(temperature=0.0, max_tokens=8)
+            return [eng.generate(p, opts)
+                    for p in ("paged kernel probe", "second row")]
+        finally:
+            pallas_attention.set_flash_enabled(None)
+
+    assert run(True) == run(False)
