@@ -61,6 +61,11 @@ class EngineConfig:
     speculative_ngram_tokens: int = 0
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
+    # real embedding model for /v1/embeddings + rerank/score
+    # (models/encoder.py): an ENCODER_PRESETS name or a HF BertModel
+    # checkpoint dir. None keeps the causal-mean-pool approximation
+    # (flagged in responses as embedding_source=causal-mean-pool).
+    embedding_model: Optional[str] = None
     # in-HBM prefix cache (engine/block_manager.py): finished sequences'
     # full KV blocks stay in the pool under chain-hash keys; matching
     # prompts attach them by reference — zero copies, zero extra HBM

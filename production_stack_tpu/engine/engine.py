@@ -14,6 +14,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import EngineConfig
@@ -194,6 +195,15 @@ class LLMEngine:
         # so the device (and the host<->TPU tunnel) works while outputs
         # stream to clients. (ids_device, window, [seqs at dispatch], t0)
         self._inflight = None
+        # real embedding encoder (models/encoder.py), built EAGERLY:
+        # a lazy first-request load would run checkpoint reading on the
+        # server's event loop (stalling every in-flight stream) and
+        # race across executor threads; and a bad preset/checkpoint
+        # must fail at startup, not at first request
+        self._enc_params = None
+        self._embed_tok = None
+        if engine_cfg.embedding_model:
+            self._ensure_encoder()
 
     # ------------------------------------------------------------------
 
@@ -707,12 +717,111 @@ class LLMEngine:
             self._decode_dirty = True
             self._hist_dirty = True
 
+    @property
+    def embedding_source(self) -> str:
+        """What powers /v1/embeddings: 'encoder:<name>' when a real
+        bidirectional encoder is configured, else the documented
+        'causal-mean-pool' approximation (mean-pooled hidden states of
+        the causal chat model — API-shape parity, unvalidated
+        embedding quality)."""
+        if self.cfg.embedding_model:
+            return f"encoder:{self._encoder_cfg().name}"
+        return "causal-mean-pool"
+
+    def _encoder_cfg(self):
+        self._ensure_encoder()
+        return self._enc_cfg
+
+    @property
+    def embedding_tokenizer(self):
+        """Tokenizer for the embeddings path: the encoder checkpoint's
+        own (BERT vocabs differ from chat vocabs — loaded and
+        validated at startup by _ensure_encoder), else the serving
+        tokenizer."""
+        return self._embed_tok or self.tokenizer
+
+    @property
+    def max_embed_len(self) -> int:
+        """Length cap for pooling inputs: the encoder's position table
+        when one is configured, else the serving cache length."""
+        if self.cfg.embedding_model:
+            return self._encoder_cfg().max_position_embeddings
+        return self.cfg.max_model_len
+
+    def _ensure_encoder(self) -> None:
+        """Lazily build the embedding encoder (models/encoder.py):
+        a preset name (random weights — tests/demos) or a HF BertModel
+        checkpoint dir."""
+        if getattr(self, "_enc_params", None) is not None:
+            return
+        import os
+        from production_stack_tpu.models import encoder as enc
+        spec = self.cfg.embedding_model
+        if os.path.isdir(spec):
+            import json as _json
+            with open(os.path.join(spec, "config.json")) as f:
+                cfg = enc.config_from_hf_json(_json.load(f),
+                                              name=os.path.basename(spec))
+            params = enc.load_checkpoint(cfg, spec)
+            # string inputs MUST tokenize with the checkpoint's own
+            # vocab: the serving tokenizer's ids would gather-clamp
+            # into the encoder's smaller embedding table and return
+            # confidently wrong vectors. Missing tokenizer = startup
+            # error, never a silent fallback.
+            from production_stack_tpu.engine.tokenizer import load_tokenizer
+            tok = load_tokenizer(spec, None)
+            tok_vocab = getattr(tok, "vocab_size", None)
+            if tok_vocab is None or tok_vocab > cfg.vocab_size:
+                raise ValueError(
+                    f"embedding checkpoint {spec} has no usable "
+                    f"tokenizer (got vocab "
+                    f"{tok_vocab} vs encoder vocab {cfg.vocab_size}); "
+                    f"ship the model's tokenizer files in the "
+                    f"checkpoint dir")
+            self._embed_tok = tok
+        else:
+            cfg = enc.get_encoder_config(spec)
+            params = enc.init_params(cfg, jax.random.PRNGKey(
+                self.cfg.seed ^ 0xE9C0DE))
+            logger.info("random-initialized embedding encoder %s "
+                        "(preset; pass a checkpoint dir for real "
+                        "embeddings)", cfg.name)
+        self._enc_cfg, self._enc_params = cfg, params
+        self._enc_fns = {}
+
+    def _embed_batch(self, tokens: np.ndarray,
+                     lengths: np.ndarray) -> np.ndarray:
+        """One padded batch -> pooled [B, H] fp32, via the configured
+        encoder or the causal-mean-pool fallback."""
+        if not self.cfg.embedding_model:
+            return np.asarray(self.runner.embed(tokens, lengths))
+        self._ensure_encoder()
+        from production_stack_tpu.models import encoder as enc
+        key = tokens.shape
+        fn = self._enc_fns.get(key)
+        if fn is None:
+            fn = self._enc_fns[key] = jax.jit(
+                lambda p, t, ln: enc.encode(p, self._enc_cfg, t, ln))
+        return np.asarray(fn(self._enc_params,
+                             jnp.asarray(tokens, jnp.int32),
+                             jnp.asarray(lengths, jnp.int32)))
+
     def embed_tokens(self, token_lists: List[List[int]]) -> np.ndarray:
-        """Mean-pooled prompt embeddings [n, H] fp32 (the /v1/embeddings
+        """Pooled prompt embeddings [n, H] fp32 (the /v1/embeddings
         path; rerank and score pool on top of it). Length-bucketed and
         batch-padded to bound executable count; runs off the engine loop
         (read-only on params, nothing donated)."""
         B = self.cfg.max_num_seqs
+        if self.cfg.embedding_model:
+            # raw token-list inputs bypass the tokenizer: out-of-vocab
+            # ids would gather-clamp silently into the embedding table
+            V = self._encoder_cfg().vocab_size
+            for toks in token_lists:
+                bad = [t for t in toks if not 0 <= t < V]
+                if bad:
+                    raise ValueError(
+                        f"token id {bad[0]} out of range for the "
+                        f"embedding encoder vocab ({V})")
         buckets = sorted(set(self.cfg.prefill_buckets)
                          | set(self.cfg.kv_len_buckets))
         out: List[np.ndarray] = []
@@ -720,12 +829,16 @@ class LLMEngine:
             group = token_lists[i:i + B]
             need = max(len(t) for t in group)
             tb = next((b for b in buckets if b >= need), need)
+            if self.cfg.embedding_model:
+                # serving buckets can exceed the encoder's position
+                # table; callers are length-capped by max_embed_len
+                tb = min(tb, self.max_embed_len)
             tokens = np.zeros((B, tb), np.int32)
             lengths = np.ones((B,), np.int32)
             for j, toks in enumerate(group):
                 tokens[j, :len(toks)] = toks
                 lengths[j] = len(toks)
-            pooled = np.asarray(self.runner.embed(tokens, lengths))
+            pooled = self._embed_batch(tokens, lengths)
             out.append(pooled[:len(group)])
         return np.concatenate(out, axis=0)
 

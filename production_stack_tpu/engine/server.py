@@ -525,9 +525,12 @@ async def completions(request: web.Request) -> web.StreamResponse:
     return web.json_response(resp.model_dump())
 
 
-def _as_token_lists(engine, raw) -> List[List[int]]:
-    """OpenAI embeddings `input`: str | [str] | [int] | [[int]]."""
-    tok = engine.tokenizer
+def _as_token_lists(engine, raw, tok=None) -> List[List[int]]:
+    """OpenAI-style `input`/`prompt`: str | [str] | [int] | [[int]].
+    `tok` picks the tokenizer: completions pass the chat tokenizer
+    (default); pooling endpoints pass engine.embedding_tokenizer (the
+    encoder checkpoint's own when one is configured)."""
+    tok = tok or engine.tokenizer
     if isinstance(raw, str):
         return [tok.encode(raw)]
     if not isinstance(raw, list):
@@ -566,29 +569,36 @@ def _check_pool_model(engine, model) -> Optional[web.Response]:
 async def _pooled(request: web.Request, token_lists: List[List[int]]):
     """Run the embedding batch off the event loop (device-blocking)."""
     engine = request.app[ENGINE_KEY]
-    max_len = engine.engine.cfg.max_model_len
+    max_len = engine.engine.max_embed_len
     for toks in token_lists:
         if not toks:
             raise ValueError("empty input")
         if len(toks) > max_len:
             raise ValueError(f"input has {len(toks)} tokens, which "
-                             f"exceeds max_model_len {max_len}")
+                             f"exceeds the embedding length cap "
+                             f"{max_len}")
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(
         None, engine.engine.embed_tokens, token_lists)
 
 
 async def embeddings(request: web.Request) -> web.Response:
-    """OpenAI-compatible /v1/embeddings: mean-pooled final hidden states
-    (reference surface: src/vllm_router/routers/main_router.py:42-160
-    proxies this path to the engine)."""
+    """OpenAI-compatible /v1/embeddings (reference surface:
+    src/vllm_router/routers/main_router.py:42-160 proxies this path to
+    the engine). With --embedding-model, vectors come from a real
+    bidirectional encoder (models/encoder.py); otherwise they are
+    mean-pooled hidden states of the causal chat model — an API-shape
+    approximation whose quality is unvalidated, declared to clients via
+    the non-standard "embedding_source" field (docs/router.md)."""
     engine = request.app[ENGINE_KEY]
     try:
         body = await request.json()
         bad = _check_pool_model(engine, body.get("model"))
         if bad is not None:
             return bad
-        token_lists = _as_token_lists(engine, body.get("input"))
+        token_lists = _as_token_lists(
+            engine, body.get("input"),
+            tok=engine.engine.embedding_tokenizer)
         if not token_lists:
             return _error(400, "missing 'input'")
         vecs = await _pooled(request, token_lists)
@@ -598,6 +608,7 @@ async def embeddings(request: web.Request) -> web.Response:
     return web.json_response({
         "object": "list",
         "model": body.get("model") or engine.model_name,
+        "embedding_source": engine.engine.embedding_source,
         "data": [{"object": "embedding", "index": i,
                   "embedding": vec.tolist()}
                  for i, vec in enumerate(vecs)],
@@ -627,7 +638,9 @@ async def rerank(request: web.Request) -> web.Response:
                 or not docs or not all(isinstance(d, str) for d in docs):
             return _error(400, "need 'query' (str) and 'documents' "
                                "(non-empty list of str)")
-        token_lists = _as_token_lists(engine, [query] + list(docs))
+        token_lists = _as_token_lists(
+            engine, [query] + list(docs),
+            tok=engine.engine.embedding_tokenizer)
         vecs = await _pooled(request, token_lists)
     except (ValueError, TypeError, json.JSONDecodeError) as e:
         return _error(400, f"invalid request: {e}")
@@ -667,7 +680,9 @@ async def score(request: web.Request) -> web.Response:
         if not isinstance(t1, str) or texts is None:
             return _error(400, "need 'text_1' (str) and 'text_2' "
                                "(str or non-empty list of str)")
-        token_lists = _as_token_lists(engine, [t1] + texts)
+        token_lists = _as_token_lists(
+            engine, [t1] + texts,
+            tok=engine.engine.embedding_tokenizer)
         vecs = await _pooled(request, token_lists)
     except (ValueError, TypeError, json.JSONDecodeError) as e:
         return _error(400, f"invalid request: {e}")
@@ -839,6 +854,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "num_experts/top_k disables token dropping at "
                         "dense-compute cost; default keeps the model "
                         "family value")
+    p.add_argument("--embedding-model", default=None,
+                   help="real embedding model for /v1/embeddings + "
+                        "rerank/score (models/encoder.py): an encoder "
+                        "preset name or a HF BertModel checkpoint dir. "
+                        "Default: mean-pooled causal hidden states, "
+                        "flagged embedding_source=causal-mean-pool")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no-warmup", action="store_true")
     p.add_argument("--chat-template", default=None,
@@ -901,6 +922,7 @@ def main(argv=None) -> None:
         quantization=args.quantization,
         speculative_ngram_tokens=args.speculative_ngram_tokens,
         seed=args.seed,
+        embedding_model=args.embedding_model,
         kv_transfer_config=kv_transfer,
         lora_adapters=dict(pair.split("=", 1)
                            for pair in args.lora_adapters.split(","))

@@ -1,0 +1,233 @@
+"""BERT-family bidirectional text encoder: REAL embeddings for
+/v1/embeddings (and the rerank/score endpoints built on it).
+
+The causal chat model's mean-pooled hidden states are a *shape*
+approximation of an embedding API, not an embedding model (causal
+attention only mixes leftward; quality is unvalidated). This module is
+the honest path: a sentence-transformers-style encoder (BERT post-LN,
+bidirectional attention, mean pooling over valid tokens) served next to
+the causal model when ``--embedding-model`` is set
+(engine/config.py). The reference stack proxies /v1/embeddings to
+engines that serve embedding models the same way
+(reference: src/vllm_router/routers/main_router.py:87-117).
+
+TPU-first structure mirrors models/llama.py: all L layers stacked on a
+leading axis, one traced layer body under ``lax.scan``, matmuls in the
+model dtype with fp32 LayerNorm/softmax. Bidirectional attention is one
+dense [T, T] masked softmax — encoder inputs are short (<= 512) and
+prefill-shaped, squarely MXU territory; no KV cache, nothing donated,
+safe to dispatch from the server thread next to the engine loop.
+
+HF parity is pinned against transformers BertModel in
+tests/test_encoder.py (same harness as the causal families in
+tests/test_model_numerics.py).
+"""
+
+import dataclasses
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class EncoderConfig:
+    name: str = "debug-encoder"
+    vocab_size: int = 30522
+    hidden_size: int = 384
+    intermediate_size: int = 1536
+    num_layers: int = 6
+    num_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+ENCODER_PRESETS: Dict[str, EncoderConfig] = {
+    # debug geometry (tests, --embedding-model debug-encoder)
+    "debug-encoder": EncoderConfig(
+        name="debug-encoder", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4,
+        max_position_embeddings=128),
+    # sentence-transformers/all-MiniLM-L6-v2 geometry
+    "minilm-l6": EncoderConfig(
+        name="minilm-l6", vocab_size=30522, hidden_size=384,
+        intermediate_size=1536, num_layers=6, num_heads=12),
+    # BAAI/bge-base-en-v1.5 / bert-base geometry
+    "bert-base": EncoderConfig(
+        name="bert-base", vocab_size=30522, hidden_size=768,
+        intermediate_size=3072, num_layers=12, num_heads=12),
+}
+
+
+def get_encoder_config(name: str) -> EncoderConfig:
+    if name not in ENCODER_PRESETS:
+        raise ValueError(
+            f"unknown encoder preset {name!r}; known: "
+            f"{sorted(ENCODER_PRESETS)} (or pass a HF checkpoint dir)")
+    return ENCODER_PRESETS[name]
+
+
+def init_params(cfg: EncoderConfig, key: jax.Array) -> Params:
+    """Random init, stacked-layer layout (layer axis leading)."""
+    h, i, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    keys = iter(jax.random.split(key, 12))
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(
+            cfg.dtype)
+
+    def zeros(shape):
+        return jnp.zeros(shape, cfg.dtype)
+
+    def ones(shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    return {
+        "word_emb": w(next(keys), (cfg.vocab_size, h)),
+        "pos_emb": w(next(keys), (cfg.max_position_embeddings, h)),
+        "type_emb": w(next(keys), (cfg.type_vocab_size, h)),
+        "emb_ln_w": ones((h,)), "emb_ln_b": zeros((h,)),
+        "layers": {
+            "q": w(next(keys), (L, h, h)), "q_b": zeros((L, h)),
+            "k": w(next(keys), (L, h, h)), "k_b": zeros((L, h)),
+            "v": w(next(keys), (L, h, h)), "v_b": zeros((L, h)),
+            "o": w(next(keys), (L, h, h)), "o_b": zeros((L, h)),
+            "attn_ln_w": ones((L, h)), "attn_ln_b": zeros((L, h)),
+            "up": w(next(keys), (L, h, i)), "up_b": zeros((L, i)),
+            "down": w(next(keys), (L, i, h)), "down_b": zeros((L, h)),
+            "out_ln_w": ones((L, h)), "out_ln_b": zeros((L, h)),
+        },
+    }
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def encode(params: Params, cfg: EncoderConfig, tokens: jnp.ndarray,
+           lengths: jnp.ndarray) -> jnp.ndarray:
+    """tokens [N, T] int32 (right-padded), lengths [N] ->
+    mean-pooled embeddings fp32 [N, H] (sentence-transformers mean
+    pooling: sum of valid hidden states / count)."""
+    N, T = tokens.shape
+    mask = jnp.arange(T)[None, :] < lengths[:, None]          # [N, T]
+    x = (params["word_emb"][tokens]
+         + params["pos_emb"][None, :T]
+         + params["type_emb"][0][None, None])
+    x = _layer_norm(x, params["emb_ln_w"], params["emb_ln_b"],
+                    cfg.layer_norm_eps)
+    nh, hd = cfg.num_heads, cfg.head_dim
+    # padding keys are masked out of every softmax; padding queries
+    # produce garbage rows the pooling mask drops
+    bias = jnp.where(mask, 0.0, -1e30)[:, None, None, :]      # [N,1,1,T]
+
+    def layer(x, lp):
+        def lin(h, name):
+            return h @ lp[name] + lp[name + "_b"]
+
+        q = lin(x, "q").reshape(N, T, nh, hd)
+        k = lin(x, "k").reshape(N, T, nh, hd)
+        v = lin(x, "v").reshape(N, T, nh, hd)
+        s = jnp.einsum("bthd,bshd->bhts", q, k,
+                       preferred_element_type=jnp.float32)
+        s = s * (hd ** -0.5) + bias
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", p, v).reshape(N, T, -1)
+        x = _layer_norm(x + lin(attn, "o"), lp["attn_ln_w"],
+                        lp["attn_ln_b"], cfg.layer_norm_eps)
+        ff = lin(jax.nn.gelu(lin(x, "up"), approximate=False), "down")
+        x = _layer_norm(x + ff, lp["out_ln_w"], lp["out_ln_b"],
+                        cfg.layer_norm_eps)
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    pooled = jnp.sum(x.astype(jnp.float32) * mask[:, :, None], axis=1)
+    return pooled / jnp.maximum(lengths, 1)[:, None]
+
+
+def params_from_state_dict(cfg: EncoderConfig,
+                           sd: Mapping[str, Any]) -> Params:
+    """Map a HF BertModel state dict (optionally prefixed 'bert.') to
+    the stacked layout. torch Linear weights are [out, in] ->
+    transposed."""
+    def np_(t):
+        return t.detach().cpu().numpy() if hasattr(t, "detach") else \
+            np.asarray(t)
+
+    def get(name):
+        for pfx in ("", "bert.", "model."):
+            if pfx + name in sd:
+                return np_(sd[pfx + name])
+        raise KeyError(name)
+
+    def stack(fmt, transpose=False):
+        mats = [get(fmt.format(i)) for i in range(cfg.num_layers)]
+        a = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(a, cfg.dtype)
+
+    e = "embeddings."
+    l = "encoder.layer.{}."
+    return {
+        "word_emb": jnp.asarray(get(e + "word_embeddings.weight"),
+                                cfg.dtype),
+        "pos_emb": jnp.asarray(get(e + "position_embeddings.weight"),
+                               cfg.dtype),
+        "type_emb": jnp.asarray(get(e + "token_type_embeddings.weight"),
+                                cfg.dtype),
+        "emb_ln_w": jnp.asarray(get(e + "LayerNorm.weight"), cfg.dtype),
+        "emb_ln_b": jnp.asarray(get(e + "LayerNorm.bias"), cfg.dtype),
+        "layers": {
+            "q": stack(l + "attention.self.query.weight", True),
+            "q_b": stack(l + "attention.self.query.bias"),
+            "k": stack(l + "attention.self.key.weight", True),
+            "k_b": stack(l + "attention.self.key.bias"),
+            "v": stack(l + "attention.self.value.weight", True),
+            "v_b": stack(l + "attention.self.value.bias"),
+            "o": stack(l + "attention.output.dense.weight", True),
+            "o_b": stack(l + "attention.output.dense.bias"),
+            "attn_ln_w": stack(l + "attention.output.LayerNorm.weight"),
+            "attn_ln_b": stack(l + "attention.output.LayerNorm.bias"),
+            "up": stack(l + "intermediate.dense.weight", True),
+            "up_b": stack(l + "intermediate.dense.bias"),
+            "down": stack(l + "output.dense.weight", True),
+            "down_b": stack(l + "output.dense.bias"),
+            "out_ln_w": stack(l + "output.LayerNorm.weight"),
+            "out_ln_b": stack(l + "output.LayerNorm.bias"),
+        },
+    }
+
+
+def load_checkpoint(cfg: EncoderConfig, path: str) -> Params:
+    """Load a HF BertModel checkpoint dir (safetensors or torch .bin),
+    reusing the causal loader's file handling."""
+    from production_stack_tpu.models import hf_loader
+    sd = hf_loader.read_state_dict(path)
+    return params_from_state_dict(cfg, sd)
+
+
+def config_from_hf_json(d: Mapping[str, Any],
+                        name: str = "") -> EncoderConfig:
+    """EncoderConfig from a HF BERT config.json dict."""
+    return EncoderConfig(
+        name=name or d.get("_name_or_path", "hf-encoder"),
+        vocab_size=d["vocab_size"],
+        hidden_size=d["hidden_size"],
+        intermediate_size=d["intermediate_size"],
+        num_layers=d["num_hidden_layers"],
+        num_heads=d["num_attention_heads"],
+        max_position_embeddings=d.get("max_position_embeddings", 512),
+        type_vocab_size=d.get("type_vocab_size", 2),
+        layer_norm_eps=d.get("layer_norm_eps", 1e-12),
+    )

@@ -125,8 +125,8 @@ def _lookup(sd: Mapping[str, Any], name: str, bare: bool = False) -> Any:
     raise KeyError(f"missing weight {name!r}")
 
 
-def load_checkpoint(cfg: ModelConfig, path: str) -> Dict:
-    """Load params from an HF checkpoint directory on disk."""
+def read_state_dict(path: str) -> Dict[str, Any]:
+    """Raw tensors from an HF checkpoint dir (safetensors or .bin)."""
     st_files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
     sd: Dict[str, Any] = {}
     if st_files:
@@ -140,4 +140,9 @@ def load_checkpoint(cfg: ModelConfig, path: str) -> Dict:
     if not sd:
         raise FileNotFoundError(f"no weights (*.safetensors|*.bin) in {path}")
     logger.info("loaded %d tensors from %s", len(sd), path)
-    return params_from_state_dict(cfg, sd)
+    return sd
+
+
+def load_checkpoint(cfg: ModelConfig, path: str) -> Dict:
+    """Load params from an HF checkpoint directory on disk."""
+    return params_from_state_dict(cfg, read_state_dict(path))
