@@ -68,6 +68,9 @@ def parse_cli(argv=None):
     ap.add_argument("--kv-pool-frac", type=float, default=1.0,
                     help="KV pool size as a fraction of the worst-case "
                          "batch*max_model_len reservation (paged KV)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked-prefill chunk size (0 = mode default; "
+                         "long-context TTFT sweeps)")
     return ap.parse_args(argv)
 
 
@@ -93,6 +96,14 @@ def run_bench(args) -> dict:
         prompt_len = args.prompt_len
     if args.gen_len:
         gen_len = args.gen_len
+    # the cache must hold prompt + generation; grow it to the covering
+    # power of two for long-context / long-generation sweeps
+    need = 1 << (prompt_len + gen_len - 1).bit_length()
+    if need > cfg_kw["max_model_len"]:
+        cfg_kw["max_model_len"] = need
+    if args.prefill_chunk:
+        cfg_kw["prefill_chunk"] = args.prefill_chunk
+        cfg_kw["prefill_buckets"] = (args.prefill_chunk,)
     n_requests = args.requests or 2 * batch
     if args.quantization:
         cfg_kw["quantization"] = args.quantization
@@ -152,6 +163,7 @@ def record_line(args, stats: dict, platform: str) -> dict:
     standard = (args.batch == 8 and not args.quantization
                 and not args.spec and not args.gen_len
                 and not args.prompt_len and not args.requests
+                and not args.prefill_chunk
                 and args.kv_pool_frac == 1.0)
     if ref is None and standard:
         # only standard configs may set the baseline for a pair
@@ -263,6 +275,8 @@ def forward_args(args) -> list:
         out += ["--spec", str(args.spec)]
     if args.kv_pool_frac != 1.0:
         out += ["--kv-pool-frac", str(args.kv_pool_frac)]
+    if args.prefill_chunk:
+        out += ["--prefill-chunk", str(args.prefill_chunk)]
     return out
 
 
