@@ -528,9 +528,14 @@ class LLMEngine:
                                          self._slot_gstate, hist)
             self._decode_dirty = False
         seeded = any(s.options.seed is not None for s in decode_seqs)
+        # the API-default sampling shape (top_p=1, top_k=0) needs no
+        # [B, V] sort — a separate executable skips it (sampler.py)
+        plain = all(s.options.top_p >= 1.0 and not s.options.top_k
+                    for s in decode_seqs)
         ids_dev, lps_dev, counts_dev = self.runner.decode(
             self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
-            seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec)
+            seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec,
+            plain=plain)
         self._inflight = (ids_dev, lps_dev, counts_dev, W,
                           list(decode_seqs), time.monotonic())
         return True

@@ -170,7 +170,7 @@ class ModelRunner:
                      guide_id: jnp.ndarray, guide_state: jnp.ndarray,
                      *, steps: int, kv_len: int,
                      greedy: bool, seeded: bool = False,
-                     guided: bool = False):
+                     guided: bool = False, plain: bool = False):
         """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
         tokens', positions', cache').
 
@@ -217,7 +217,8 @@ class ModelRunner:
                 # seeded forks the executable so all-unseeded batches
                 # skip the per-row PRNG work entirely
                 ids = sample(last, sampling, jax.random.fold_in(key, i),
-                             positions=pos + 1 if seeded else None)
+                             positions=pos + 1 if seeded else None,
+                             plain=plain)
             if guided:
                 adv = jnp.take_along_axis(nxt_row, ids[:, None],
                                           axis=-1)[:, 0]
@@ -404,7 +405,7 @@ class ModelRunner:
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
                seeded: bool = False, guide_table=None, guide_ids=None,
-               spec: int = 0):
+               spec: int = 0, plain: bool = False):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
         (ids, logprobs, counts): without speculation ids/logprobs are
@@ -439,9 +440,10 @@ class ModelRunner:
              self._dec_hist, self.cache) = fn(*args)
             return ids, lps, counts
         seeded = seeded and not greedy
+        plain = plain and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
-        cache_key = (steps, kv_len, greedy, seeded, guided, gshape)
+        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain)
         B = self.engine_cfg.max_num_seqs
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
@@ -458,7 +460,8 @@ class ModelRunner:
                         " guided" if guided else "")
             return jax.jit(
                 partial(self._decode_impl, steps=steps, kv_len=kv_len,
-                        greedy=greedy, seeded=seeded, guided=guided),
+                        greedy=greedy, seeded=seeded, guided=guided,
+                        plain=plain),
                 donate_argnums=(1,))
 
         fn = self._compile_with_fallback(self._decode_fns, cache_key,
@@ -687,8 +690,9 @@ class ModelRunner:
         # park every row at S: warmup writes only clamp onto S-1
         self.set_decode_state(np.zeros((B,), np.int32),
                               np.full((B,), S, np.int32))
-        # both decode variants: greedy AND sampled (the API default is
-        # temperature=1.0, so sampled is the common serving case)
+        # all three decode variants: greedy, plain-sampled, and
+        # full-sort sampled (the API default is temperature=1.0, so
+        # plain-sampled is the common serving case)
         if cfg.speculative_ngram_tokens:
             # spec-enabled greedy windows use the speculative executable,
             # not the plain greedy one — compile the real hot path
@@ -702,6 +706,15 @@ class ModelRunner:
                                   np.full((B,), S, np.int32))
         self.decode(sampling, steps=cfg.decode_window,
                     kv_len=cfg.kv_len_buckets[0], greedy=True)
+        self.set_decode_state(np.zeros((B,), np.int32),
+                              np.full((B,), S, np.int32))
+        # the API default (temperature=1, top_p=1, top_k=0) runs the
+        # sort-free plain variant; truncated sampling (top_p<1 / top_k)
+        # runs the full-sort one — warm BOTH so neither first request
+        # pays a mid-serving compile
+        self.decode(sampling, steps=cfg.decode_window,
+                    kv_len=cfg.kv_len_buckets[0], greedy=False,
+                    plain=True)
         self.set_decode_state(np.zeros((B,), np.int32),
                               np.full((B,), S, np.int32))
         self.decode(sampling, steps=cfg.decode_window,

@@ -45,7 +45,8 @@ class SamplingParams(NamedTuple):
 
 def sample(logits: jnp.ndarray, params: SamplingParams,
            key: jax.Array,
-           positions: jnp.ndarray = None) -> jnp.ndarray:
+           positions: jnp.ndarray = None,
+           plain: bool = False) -> jnp.ndarray:
     """logits fp32 [B,V] -> token ids int32 [B].
 
     positions [B]: absolute position of the token being sampled. Rows
@@ -57,6 +58,14 @@ def sample(logits: jnp.ndarray, params: SamplingParams,
     engine.py _sync_sampling). Pass positions=None to skip the seeded
     branch entirely (the decode hot loop does when no live row is
     seeded, engine.py _dispatch_decode).
+
+    plain=True (STATIC; the engine sets it when every live row has
+    top_p >= 1 and top_k == 0 — the API default) skips the [B, V]
+    descending sort + cumsum entirely: pure temperature/gumbel
+    sampling needs no threshold. For untruncated rows the two paths
+    are mathematically identical (the threshold keeps the whole
+    distribution), so mixing plain and full windows across a
+    sequence's lifetime cannot change its distribution.
     """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
@@ -64,26 +73,29 @@ def sample(logits: jnp.ndarray, params: SamplingParams,
     temp = jnp.maximum(params.temperature, _EPS)[:, None]
     scaled = logits / temp
 
-    # One sort serves top-k and top-p. [B,V] descending.
-    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
-    ranks = jnp.arange(V)[None, :]
+    if plain:
+        masked = scaled
+    else:
+        # One sort serves top-k and top-p. [B,V] descending.
+        sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
 
-    # top-k threshold: value of the k-th largest (disabled => keep all)
-    k = jnp.where(params.top_k > 0, params.top_k, V).astype(jnp.int32)
-    kth = jnp.take_along_axis(sorted_logits,
-                              jnp.clip(k[:, None] - 1, 0, V - 1), axis=-1)
+        # top-k threshold: value of the k-th largest (disabled => all)
+        k = jnp.where(params.top_k > 0, params.top_k, V).astype(jnp.int32)
+        kth = jnp.take_along_axis(
+            sorted_logits, jnp.clip(k[:, None] - 1, 0, V - 1), axis=-1)
 
-    # top-p: smallest prefix of the sorted distribution with mass >= p.
-    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    # keep ranks whose cumulative mass *before* them is < p
-    keep_sorted = (cum - probs_sorted) < params.top_p[:, None]
-    # threshold = smallest kept logit value
-    p_thresh = jnp.min(
-        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        # top-p: smallest prefix of the sorted distribution w/ mass >= p
+        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs_sorted, axis=-1)
+        # keep ranks whose cumulative mass *before* them is < p
+        keep_sorted = (cum - probs_sorted) < params.top_p[:, None]
+        # threshold = smallest kept logit value
+        p_thresh = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True)
 
-    threshold = jnp.maximum(kth, p_thresh)
-    masked = jnp.where(scaled >= threshold, scaled, _NEG_INF)
+        threshold = jnp.maximum(kth, p_thresh)
+        masked = jnp.where(scaled >= threshold, scaled, _NEG_INF)
 
     gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
     if positions is not None:

@@ -192,3 +192,33 @@ def test_pool_gauge_tracks_blocks():
     while not eng.seqs[sid].finish_reason:
         eng.step()
     assert eng.block_mgr.active_blocks == 0
+
+
+def test_32k_class_config_serves_with_bounded_pool():
+    """A 32k-context configuration must admit and serve with a pool a
+    fraction of the worst case: paged KV means HBM scales with LIVE
+    context, and executables stay at the smallest kv bucket for short
+    prompts (no shape blowup from max_model_len)."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    cfg = EngineConfig(model="debug-tiny", max_model_len=32768,
+                       max_num_seqs=4, prefill_chunk=64,
+                       prefill_buckets=(64,), decode_window=4,
+                       kv_block_size=64,
+                       kv_pool_tokens=4 * 1024)   # 3% of worst case
+    eng = LLMEngine(cfg)
+    # pool sized by kv_pool_tokens (clamped up to the documented floor
+    # of ONE full-length sequence), not max_num_seqs * max_model_len
+    assert eng.runner.cache.num_blocks == cfg.max_blocks_per_seq + 1
+    opts = SamplingOptions(temperature=0.0, max_tokens=8, ignore_eos=True)
+    sids = [eng.add_request(list(range(10 + i, 100 + i)), opts)
+            for i in range(4)]
+    done = set()
+    guard = 0
+    while len(done) < len(sids):
+        done |= {o.seq_id for o in eng.step() if o.finished}
+        guard += 1
+        assert guard < 500
+    assert all(len(eng.seqs[s].output_tokens) == 8 for s in sids)
