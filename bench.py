@@ -43,8 +43,9 @@ REF_PATH = os.path.join(REPO, "BENCH_REF.json")
 PROBE_TIMEOUT_S = 90        # one jax.devices() probe
 PROBE_TRIES = 3             # bounded probe window: <= ~5 min total
 PROBE_GAP_S = 20
-TPU_RUN_TIMEOUT_S = 1500    # full bench incl. first-compile (~20-40s/exe)
-CPU_RUN_TIMEOUT_S = 900
+TPU_RUN_TIMEOUT_S = 2700    # full bench incl. first-compile (~20-40s/exe)
+CPU_RUN_TIMEOUT_S = 1500    # both cover the default untimed warm pass,
+                            # which roughly doubles post-compile wall
 
 
 def parse_cli(argv=None):
@@ -71,6 +72,14 @@ def parse_cli(argv=None):
     ap.add_argument("--prefill-chunk", type=int, default=0,
                     help="chunked-prefill chunk size (0 = mode default; "
                          "long-context TTFT sweeps)")
+    ap.add_argument("--window", type=int, default=0,
+                    help="fused decode-window length (0 = mode default; "
+                         "per window the host pays one dispatch + one "
+                         "sync, so longer windows amortize tunnel/"
+                         "dispatch latency)")
+    ap.add_argument("--cold", action="store_true",
+                    help="skip the untimed warm pass (measure a cold "
+                         "engine, lazy compiles land in the timed region)")
     return ap.parse_args(argv)
 
 
@@ -97,13 +106,19 @@ def run_bench(args) -> dict:
     if args.gen_len:
         gen_len = args.gen_len
     # the cache must hold prompt + generation; grow it to the covering
-    # power of two for long-context / long-generation sweeps
-    need = 1 << (prompt_len + gen_len - 1).bit_length()
-    if need > cfg_kw["max_model_len"]:
-        cfg_kw["max_model_len"] = need
+    # multiple of 256 for long-context / long-generation sweeps. A
+    # power-of-two covering doubles the KV pool for just-past-a-bucket
+    # spans (8320 -> 16384 pins ~3 GB of pool instead of ~1.5 and blew
+    # HBM at batch 8 x 8k bf16); the top kv bucket lands on
+    # max_model_len either way, so attention cost stays ~ live prefix.
+    span = prompt_len + gen_len
+    if span > cfg_kw["max_model_len"]:
+        cfg_kw["max_model_len"] = -(-span // 256) * 256
     if args.prefill_chunk:
         cfg_kw["prefill_chunk"] = args.prefill_chunk
         cfg_kw["prefill_buckets"] = (args.prefill_chunk,)
+    if args.window:
+        cfg_kw["decode_window"] = args.window
     n_requests = args.requests or 2 * batch
     if args.quantization:
         cfg_kw["quantization"] = args.quantization
@@ -122,13 +137,27 @@ def run_bench(args) -> dict:
     rng_tokens = [[(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
                   for i in range(n_requests)]
 
+    def run_pass():
+        ids = [eng.add_request(toks, opts) for toks in rng_tokens]
+        done = set()
+        while len(done) < len(ids):
+            for out in eng.step():
+                if out.finished:
+                    done.add(out.seq_id)
+        return ids
+
+    warm_s = 0.0
+    if not args.cold:
+        # untimed warm pass over the exact workload: warmup() compiles
+        # the hot executables, but sweep configs (long-context kv
+        # buckets, spec/guided variants) can still compile lazily —
+        # that belongs to warm_s, not the measurement
+        t0 = time.time()
+        run_pass()
+        warm_s = time.time() - t0
+
     t0 = time.time()
-    ids = [eng.add_request(toks, opts) for toks in rng_tokens]
-    done = set()
-    while len(done) < len(ids):
-        for out in eng.step():
-            if out.finished:
-                done.add(out.seq_id)
+    ids = run_pass()
     wall = time.time() - t0
 
     out_tokens = sum(len(eng.seqs[i].output_tokens) for i in ids)
@@ -138,6 +167,11 @@ def run_bench(args) -> dict:
         "total_tokens_per_s": (out_tokens + in_tokens) / wall,
         "wall_s": wall,
         "compile_s": compile_s,
+        "warm_s": warm_s,
+        # pre-r4 baselines were recorded cold (lazy compiles could land
+        # in the timed region); compare vs_baseline across methodologies
+        # with that in mind
+        "methodology": "cold" if args.cold else "warm",
         "out_tokens": out_tokens,
         "model": cfg.model,
         "batch_slots": cfg.max_num_seqs,
@@ -145,6 +179,7 @@ def run_bench(args) -> dict:
         "gen_len": gen_len,
         "quantization": cfg.quantization,
         "speculative": cfg.speculative_ngram_tokens,
+        "decode_window": cfg.decode_window,
     }
 
 
@@ -163,8 +198,8 @@ def record_line(args, stats: dict, platform: str) -> dict:
     standard = (args.batch == 8 and not args.quantization
                 and not args.spec and not args.gen_len
                 and not args.prompt_len and not args.requests
-                and not args.prefill_chunk
-                and args.kv_pool_frac == 1.0)
+                and not args.prefill_chunk and not args.cold
+                and not args.window and args.kv_pool_frac == 1.0)
     if ref is None and standard:
         # only standard configs may set the baseline for a pair
         refs[key] = ref = value
@@ -277,6 +312,10 @@ def forward_args(args) -> list:
         out += ["--kv-pool-frac", str(args.kv_pool_frac)]
     if args.prefill_chunk:
         out += ["--prefill-chunk", str(args.prefill_chunk)]
+    if args.window:
+        out += ["--window", str(args.window)]
+    if args.cold:
+        out.append("--cold")
     return out
 
 

@@ -473,12 +473,14 @@ class ModelRunner:
     def _compile_with_fallback(self, cache: dict, key, make_fn, args):
         """Fetch-or-compile an executable; if the pallas paged kernel
         fails to BUILD for this combination (backend or VMEM limits
-        beyond paged_viable's estimate), disable the kernel gate and
-        recompile on the jnp attention path — once, for the whole
-        process. Compilation is an explicit lower+compile BEFORE any
-        buffers are donated, so a runtime failure of a working
-        executable propagates unchanged (retrying it would re-pass a
-        donated, deleted cache buffer)."""
+        beyond paged_viable's estimate), recompile THIS key on the jnp
+        attention path and cache that. The fallback is per-executable:
+        kernel build failures are per-geometry (one chunk size missing
+        a VMEM budget says nothing about the others), so combinations
+        that already compiled — or will — keep the kernel. Compilation
+        is an explicit lower+compile BEFORE any buffers are donated, so
+        a runtime failure of a working executable propagates unchanged
+        (retrying it would re-pass a donated, deleted cache buffer)."""
         fn = cache.get(key)
         if fn is not None:
             return fn
@@ -491,12 +493,11 @@ class ModelRunner:
                 raise
             logger.exception(
                 "pallas paged attention failed to compile for %r; "
-                "falling back to the jnp attention path", key)
-            pallas_attention.set_flash_enabled(False)
-            self._decode_fns.clear()
-            self._prefill_fns.clear()
-            fn = make_fn()
-            fn.lower(*args).compile()
+                "recompiling this executable on the jnp attention path",
+                key)
+            with pallas_attention.force_jnp():
+                fn = make_fn()
+                fn.lower(*args).compile()
         cache[key] = fn
         return fn
 
@@ -509,9 +510,10 @@ class ModelRunner:
 
         Prefill executables compile lazily per (chunk, kv bucket); if the
         pallas flash kernel fails to BUILD for a combination (backend or
-        VMEM limits beyond flash_viable's estimate), the jnp attention
-        path is compiled instead — once, for the whole process. The
-        fallback is compile-scoped: compilation happens via an explicit
+        VMEM limits beyond flash_viable's estimate), that combination —
+        and only that combination — is recompiled and cached on the jnp
+        attention path (_compile_with_fallback). The fallback is
+        compile-scoped: compilation happens via an explicit
         lower+compile before any buffers are donated, so a runtime
         failure of an already-working executable propagates unchanged
         (retrying it would re-pass a donated, deleted cache buffer).

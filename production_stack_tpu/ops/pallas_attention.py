@@ -42,6 +42,7 @@ which runs the same kernel in interpret mode on CPU.
 
 import functools
 import os
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +54,14 @@ _NEG_INF = -1e30
 # VMEM budget for the per-grid-step K + V panels ([S, D] each, bf16):
 # stay well under the ~16 MB/core so q/acc/scratch fit too.
 _VMEM_PANEL_BYTES = 4 * 1024 * 1024
+
+# Per-kernel scoped-VMEM budget (shared by pallas_paged.py): XLA may
+# place a chunk-sized kernel OUTPUT on the scoped-VMEM stack (a batch-8
+# 512-chunk bf16 output is ~17 MB), and the default 16 MiB budget then
+# fails the compile even though the kernel's own working set is small.
+# v5e/v5p cores carry 128 MiB VMEM — raise the budget so chunk-sized
+# outputs may live on-chip; outputs too big for it simply land in HBM.
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024
 
 # runtime gate: PSTPU_FLASH=1/0 forces; "auto" (default) enables the
 # compiled kernel on TPU and leaves CPU/other backends on the jnp path
@@ -68,6 +77,8 @@ def set_flash_enabled(value) -> None:
 
 
 def flash_enabled() -> bool:
+    if _force_jnp_depth:
+        return False
     if _override is not None:
         return _override
     env = os.environ.get("PSTPU_FLASH", "auto").lower()
@@ -76,6 +87,24 @@ def flash_enabled() -> bool:
     if env in ("0", "false", "off"):
         return False
     return jax.default_backend() == "tpu"
+
+
+# scoped override: the runner retries a SINGLE failed executable on the
+# jnp path without disabling the kernel for every other (shape, bucket)
+# combination — compilation failures are per-geometry (e.g. a VMEM
+# budget miss at one chunk size), not per-backend
+_force_jnp_depth = 0
+
+
+@contextmanager
+def force_jnp():
+    """Scoped flash_enabled() == False, for per-executable fallback."""
+    global _force_jnp_depth
+    _force_jnp_depth += 1
+    try:
+        yield
+    finally:
+        _force_jnp_depth -= 1
 
 
 def flash_viable(S: int, D: int, itemsize: int = 2) -> bool:
@@ -209,6 +238,8 @@ def flash_attention_with_cache(q, k_cache, v_cache, starts, *,
         out_specs=pl.BlockSpec((1, block_q, 1, G, D),
                                lambda b, h, i: (b, i, h, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Tp, Hkv, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(jnp.asarray(starts, jnp.int32), q5, k_hm, v_hm)
 

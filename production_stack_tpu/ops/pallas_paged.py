@@ -49,6 +49,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from production_stack_tpu.ops.pallas_attention import VMEM_LIMIT_BYTES
+
 _NEG_INF = -1e30
 
 # VMEM ceiling for the per-grid-step working set (q + acc + scores,
@@ -212,7 +214,9 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
         out_shape=jax.ShapeDtypeStruct((B, Tp, Hkv, G, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
-                                 "arbitrary")),
+                                 "arbitrary"),
+            # see pallas_attention.VMEM_LIMIT_BYTES for the rationale
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(starts, jnp.int32),
       q5, k_pool, v_pool)
