@@ -55,7 +55,12 @@ def main() -> None:
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.scheduler import SamplingOptions
 
-    span = args.ctx + args.window * (args.iters + 2)
+    # +4 windows of slack: priming leaves up to engine._PIPELINE_DEPTH
+    # optimistic windows in flight past the processed tokens, plus the
+    # warm window and the host-side rounding of the priming loop —
+    # under-covering would clamp the tail windows' KV writes onto the
+    # trash block and make their reads artificially cache-hot
+    span = args.ctx + args.window * (args.iters + 4)
     need = -(-span // 256) * 256    # covering multiple of 256
     cfg_kw = dict(model=args.model, max_model_len=max(512, need),
                   max_num_seqs=args.batch, prefill_chunk=512,
@@ -84,10 +89,11 @@ def main() -> None:
     # timed span up front — otherwise KV writes past coverage alias
     # trash block 0 and the measured reads are artificially cache-hot
     for i in ids:
-        assert eng._ensure_blocks(eng.seqs[i], span), "KV pool too small"
+        if not eng._ensure_blocks(eng.seqs[i], span):
+            raise SystemExit("KV pool too small for the timed span")
     from production_stack_tpu.engine.sampler import SamplingParams
     sampling = SamplingParams.filled(args.batch, temperature=0.0)
-    kv_len = cfg.kv_bucket_for(args.ctx + args.window * (args.iters + 2))
+    kv_len = cfg.kv_bucket_for(span)
     dec = dict(steps=args.window, kv_len=kv_len, greedy=True)
     if args.spec:
         dec["spec"] = args.spec

@@ -46,6 +46,13 @@ class StepOutput:
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
 _FINISHED_RETENTION = 1024
 
+# decode windows queued on the device at once (engine.step pipelining).
+# 2 keeps the device saturated: window N+1 is queued while N runs, and
+# the host processes N's tokens during N+1. Deeper queues add latency
+# to composition changes (admission waits behind every queued window)
+# for no extra overlap.
+_PIPELINE_DEPTH = 2
+
 
 class LLMEngine:
     def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
@@ -190,11 +197,16 @@ class LLMEngine:
         # actually speculate — a stale device history can only degrade
         # DRAFT quality, never correctness (verification ignores it)
         self._hist_dirty = True
-        # one decode window kept in flight between step() calls: the next
-        # window is dispatched right after the previous one is processed,
-        # so the device (and the host<->TPU tunnel) works while outputs
-        # stream to clients. (ids_device, window, [seqs at dispatch], t0)
-        self._inflight = None
+        # decode windows kept in flight between step() calls (FIFO of
+        # (ids_device, lps, counts, window, [seqs at dispatch], t0)).
+        # Up to _PIPELINE_DEPTH windows ride the device queue at once:
+        # window N+1 is dispatched BEFORE window N's results are synced,
+        # so the device starts N+1 the instant N retires instead of
+        # idling one host round-trip (which dominates when the chip sits
+        # behind a high-RTT tunnel). Valid because decode inputs are
+        # device-carried; the host only has to stay out of the way
+        # (no mirror uploads) until every queued window is processed.
+        self._inflight: List[tuple] = []
         # real embedding encoder (models/encoder.py), built EAGERLY:
         # a lazy first-request load would run checkpoint reading on the
         # server's event loop (stalling every in-flight stream) and
@@ -289,55 +301,66 @@ class LLMEngine:
                 # the device generates tokens for every live row, and a
                 # row the host skipped would desync the device carry
                 decode_seqs = list(self.scheduler.running.values())
-            if decode_seqs or self._inflight is not None:
-                if self._inflight is None:
+            if decode_seqs or self._inflight:
+                if not self._inflight:
                     self._dispatch_decode(decode_seqs)
-                # optimistic pipelining: sync the in-flight window's
-                # arrays, then put the NEXT window in flight BEFORE the
-                # host walks tokens (detok, stop checks, callbacks) —
-                # the device decodes while the host processes. Valid
-                # because decode inputs are device-carried: the next
-                # window continues from the in-flight window's final
-                # tokens/positions regardless of what the host decides;
-                # rows whose sequence turns out to have finished are
-                # discarded at the next drain (their writes only touch
-                # blocks still owned by the finished sequence — never
-                # registered-prefix blocks, which are always full).
-                # only when the device carry is self-contained: a dirty
-                # decode/sampling state means the next dispatch must
-                # upload host mirrors, and mid-processing mirrors lag
-                # the device by one window (uploading them would rewind
-                # live rows and duplicate tokens).
-                synced = self._sync_inflight()
-                if (synced is not None and not self._decode_dirty
-                        and not self._sampling_dirty
-                        and not (self.cfg.speculative_ngram_tokens
-                                 and self._hist_dirty)
-                        and self._worth_dispatch_ahead()):
-                    self._dispatch_decode(
-                        list(self.scheduler.running.values()),
-                        ahead=synced[3])
-                outputs.extend(self._process_window(synced))
-                if self._inflight is None:
+                # optimistic pipelining: top the device queue up to
+                # _PIPELINE_DEPTH windows BEFORE blocking on the front
+                # window's sync — with window N+1 already queued behind
+                # N, the device starts N+1 the instant N retires instead
+                # of idling one host round-trip (the dominant per-window
+                # cost when the chip sits behind a high-RTT tunnel), and
+                # it keeps decoding while the host walks tokens (detok,
+                # stop checks, callbacks). Valid because decode inputs
+                # are device-carried: each window continues from its
+                # predecessor's final tokens/positions regardless of
+                # what the host decides; rows whose sequence turns out
+                # to have finished are discarded at the next drain
+                # (their writes only touch blocks still owned by the
+                # finished sequence — never registered-prefix blocks,
+                # which are always full). Only when the device carry is
+                # self-contained: a dirty decode/sampling state means
+                # the next dispatch must upload host mirrors, and
+                # mid-processing mirrors lag the device (uploading them
+                # would rewind live rows and duplicate tokens).
+                self._top_up_pipeline()
+                outputs.extend(self._process_window(self._sync_inflight()))
+                if not self._inflight:
                     decode_seqs = list(self.scheduler.running.values())
                     if decode_seqs:
                         self._dispatch_decode(decode_seqs)
             self._refresh_gauges()
             return outputs
 
+    def _top_up_pipeline(self) -> None:
+        """Queue optimistic decode windows behind the in-flight one(s)
+        up to _PIPELINE_DEPTH, provided the device carry is
+        self-contained (no pending mirror uploads) and the extra window
+        is unlikely to be pure discarded work."""
+        while (self._inflight
+               and len(self._inflight) < _PIPELINE_DEPTH
+               and not self._decode_dirty and not self._sampling_dirty
+               and not (self.cfg.speculative_ngram_tokens
+                        and self._hist_dirty)
+               and self._worth_dispatch_ahead()):
+            ahead = sum(w[3] for w in self._inflight)
+            if not self._dispatch_decode(
+                    list(self.scheduler.running.values()), ahead=ahead):
+                break
+
     def _worth_dispatch_ahead(self) -> bool:
         """Skip the optimistic window when every live sequence could
-        reach its token budget within the already-synced window — then
-        the whole dispatch would likely be discarded work (and would
-        delay the next admission wave by one window)."""
-        W = self.cfg.decode_window
+        reach its token budget within the windows already in flight —
+        then the whole dispatch would likely be discarded work (and
+        would delay the next admission wave by one window)."""
+        inflight_steps = sum(w[3] for w in self._inflight)
         live = [s for s in self.scheduler.running.values()
                 if s.status is SeqStatus.RUNNING]
         if not live:
             return False
         return any(
             s.options.max_tokens is None
-            or s.options.max_tokens - len(s.output_tokens) > W
+            or s.options.max_tokens - len(s.output_tokens) > inflight_steps
             for s in live)
 
     def _do_prefill(self, works) -> List[StepOutput]:
@@ -536,26 +559,32 @@ class LLMEngine:
             self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
             seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec,
             plain=plain)
-        self._inflight = (ids_dev, lps_dev, counts_dev, W,
-                          list(decode_seqs), time.monotonic())
+        self._inflight.append((ids_dev, lps_dev, counts_dev, W,
+                               list(decode_seqs), time.monotonic()))
         return True
 
     def _drain_decode(self) -> List[StepOutput]:
-        """Sync + process the in-flight window, if any. A sequence that
+        """Sync + process every in-flight window. A sequence that
         finished or aborted after dispatch simply has its rows discarded
         (its slot is parked and the decode carry marked dirty)."""
-        return self._process_window(self._sync_inflight())
+        outputs: List[StepOutput] = []
+        while self._inflight:
+            outputs.extend(self._process_window(self._sync_inflight()))
+        return outputs
 
     def _sync_inflight(self):
-        """Device->host sync of the in-flight window's arrays (no token
-        processing): (ids, lps, counts, W, seqs, t0) or None."""
-        if self._inflight is None:
+        """Device->host sync of the OLDEST in-flight window's arrays (no
+        token processing): (ids, lps, counts, W, seqs, t0) or None. t0
+        is clamped to the previous sync's completion so pipelined
+        windows report per-window wall, not time-since-dispatch."""
+        if not self._inflight:
             return None
-        ids_dev, lps_dev, counts_dev, W, seqs, t0 = self._inflight
-        self._inflight = None
+        ids_dev, lps_dev, counts_dev, W, seqs, t0 = self._inflight.pop(0)
+        t0 = max(t0, getattr(self, "_last_sync_t", 0.0))
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
         counts = None if counts_dev is None else np.asarray(counts_dev)
+        self._last_sync_t = time.monotonic()
         return ids, lps, counts, W, seqs, t0
 
     def _process_window(self, synced) -> List[StepOutput]:

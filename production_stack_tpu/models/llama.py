@@ -164,7 +164,13 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             # tp-only mesh it runs shard-local per head via shard_map.
             interp = pallas_attention.needs_interpret()
             if mesh is None:
-                attn = pallas_paged.paged_attention(
+                # short windows (decode / speculative verify) take the
+                # wide kernel: all kv heads + several pool blocks per
+                # grid step, ~16x fewer grid steps than the general one
+                paged_fn = (pallas_paged.paged_decode_attention
+                            if T <= pallas_paged.DECODE_T_MAX
+                            else pallas_paged.paged_attention)
+                attn = paged_fn(
                     q, k_cache, v_cache, block_tables, starts, nb=nb,
                     interpret=interp)
             else:

@@ -224,16 +224,182 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     return out.reshape(B, Tp, H, D)[:, :T]
 
 
+# ---------------------------------------------------------------------
+# decode-specialized kernel: all kv heads + several pool blocks per
+# grid step.
+#
+# The general kernel's grid is (B, Hkv, NQ, nb) with ONE 64-token block
+# per step — for decode (T = 1) each step is a [G, D] x [D, Bs] dot,
+# so small that fixed per-grid-step cost (DMA issue, program dispatch)
+# dominates: at batch 32, kv 768, 22 layers that is ~34k grid steps per
+# decode step and the measured device time is ~3x the HBM floor. Here
+# the grid is (B, ceil(nb / R)): each step fetches one [Hkv, Bs, D]
+# K and V panel per sub-block (all kv heads ride one DMA — they are
+# contiguous in the pool's [N, Hkv, Bs, D] layout) and statically
+# unrolls Hkv x R small dots, cutting grid steps by Hkv*R (16x for
+# TinyLlama geometry) while reading exactly the same KV bytes.
+# ---------------------------------------------------------------------
+
+# decode/spec windows have T <= spec+1 << this; prefill chunks go to
+# the general kernel
+DECODE_T_MAX = 8
+_BLOCKS_PER_STEP = 4
+
+
+def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
+                         heads_kv: int, groups: int, block_size: int,
+                         ngrp: int, R: int, scale: float):
+    """One (batch row, block group) grid step.
+
+    tabs_ref   (SMEM) [B, MB]     block tables
+    starts_ref (SMEM) [B]         absolute position of q[:, 0]
+    q_ref   [1, Hkv, T*G, D]      all heads' queries (rows = t*G + g)
+    refs    R k panels [1, Hkv, Bs, D], R v panels, out
+            [1, Hkv, T*G, D], scratch m/l [Hkv*T*G, 1], acc
+            [Hkv*T*G, D] — online softmax state across the group axis.
+    """
+    k_refs = refs[:R]
+    v_refs = refs[R:2 * R]
+    out_ref = refs[2 * R]
+    m_ref, l_ref, acc_ref = refs[2 * R + 1:]
+    b = pl.program_id(0)
+    jg = pl.program_id(1)
+    rows = T * groups
+    D = q_ref.shape[-1]
+
+    @pl.when(jg == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = starts_ref[b]
+    jmax = jax.lax.div(start + (T - 1), block_size)
+
+    @pl.when(jg * R <= jmax)
+    def _compute():
+        # row r (within a head) queries position start + r // G
+        row_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, 1), 0) // groups
+        for h in range(heads_kv):
+            q = q_ref[0, h].astype(jnp.float32) * scale      # [rows, D]
+            sl = slice(h * rows, (h + 1) * rows)
+            m_prev = m_ref[sl]
+            l_prev = l_ref[sl]
+            acc_prev = acc_ref[sl]
+            for i in range(R):
+                j = jg * R + i
+                k_blk = k_refs[i][0, h].astype(jnp.float32)  # [Bs, D]
+                v_blk = v_refs[i][0, h].astype(jnp.float32)
+                s = jax.lax.dot_general(
+                    q, k_blk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # [rows, Bs]
+                k_pos = j * block_size + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_size), 1)
+                live = (k_pos <= row_pos) & (j <= jmax)
+                s = jnp.where(live, s, _NEG_INF)
+                m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1,
+                                                    keepdims=True))
+                p = jnp.exp(s - m_new)
+                corr = jnp.exp(m_prev - m_new)
+                l_prev = l_prev * corr + jnp.sum(p, axis=-1,
+                                                 keepdims=True)
+                acc_prev = acc_prev * corr + jax.lax.dot_general(
+                    p, v_blk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)      # [rows, D]
+                m_prev = m_new
+            m_ref[sl] = m_prev
+            l_ref[sl] = l_prev
+            acc_ref[sl] = acc_prev
+
+    @pl.when(jg == ngrp - 1)
+    def _emit():
+        out = acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        out_ref[0] = out.reshape(heads_kv, rows, D).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("nb", "interpret"))
+def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
+                           nb: int, interpret: bool = False):
+    """paged_attention specialized for short query windows (T <=
+    DECODE_T_MAX): same contract, same result, far fewer grid steps.
+
+    q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
+    starts [B]. See paged_attention for semantics.
+    """
+    B, T, H, D = q.shape
+    Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
+    G = H // Hkv
+    MB = tables.shape[1]
+    scale = D ** -0.5
+    R = min(_BLOCKS_PER_STEP, nb)
+    ngrp = -(-nb // R)
+    rows = T * G
+
+    # [B, T, Hkv, G, D] -> [B, Hkv, T*G, D]: rows ordered t*G + g per
+    # head, matching the kernel's row_pos formula
+    qh = q.reshape(B, T, Hkv, G, D).transpose(0, 2, 1, 3, 4)
+    qh = qh.reshape(B, Hkv, rows, D)
+
+    def kv_index(i):
+        def index(b, jg, tabs, sts):
+            jmax = jax.lax.div(sts[b] + (T - 1), jnp.int32(Bs))
+            jj = jnp.minimum(jnp.minimum(jg * R + i, jmax),
+                             jnp.int32(MB - 1))
+            return (tabs[b, jnp.maximum(jj, 0)], 0, 0, 0)
+        return index
+
+    kernel = functools.partial(
+        _paged_decode_kernel, T=T, heads_kv=Hkv, groups=G,
+        block_size=Bs, ngrp=ngrp, R=R, scale=scale)
+    kv_specs = [pl.BlockSpec((1, Hkv, Bs, D), kv_index(i))
+                for i in range(R)]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, ngrp),
+            in_specs=[
+                pl.BlockSpec((1, Hkv, rows, D),
+                             lambda b, jg, tabs, sts: (b, 0, 0, 0)),
+                *kv_specs, *kv_specs,
+            ],
+            out_specs=pl.BlockSpec((1, Hkv, rows, D),
+                                   lambda b, jg, tabs, sts:
+                                   (b, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((Hkv * rows, 1), jnp.float32),
+                pltpu.VMEM((Hkv * rows, 1), jnp.float32),
+                pltpu.VMEM((Hkv * rows, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
+        interpret=interpret,
+    )(jnp.asarray(tables, jnp.int32), jnp.asarray(starts, jnp.int32),
+      qh, *([k_pool] * R), *([v_pool] * R))
+
+    # [B, Hkv, T*G, D] -> [B, T, H, D]
+    out = out.reshape(B, Hkv, T, G, D).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, T, H, D)
+
+
 def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
                             nb: int, interpret: bool = False):
     """paged_attention under a tp-only mesh: shard_map over the head
     axis (q heads and pool kv heads both shard by tp, tables/starts
     replicated) — shard-local, no collectives. Caller guarantees the
-    mesh has no other axis of size > 1 (mesh_tp_only)."""
+    mesh has no other axis of size > 1 (mesh_tp_only). Short windows
+    (decode/spec) take the wide decode kernel, like the unsharded
+    path."""
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
-    fn = functools.partial(paged_attention, nb=nb, interpret=interpret)
+    base = (paged_decode_attention if q.shape[1] <= DECODE_T_MAX
+            else paged_attention)
+    fn = functools.partial(base, nb=nb, interpret=interpret)
     return shard_map(
         fn, mesh=mesh,
         in_specs=(P(None, None, "tp", None),

@@ -302,7 +302,7 @@ def test_abort_running_seq_with_inflight_window():
                                         ignore_eos=True))
     while len(eng.seqs[a].output_tokens) < 8:
         eng.step()   # leaves a window in flight
-    assert eng._inflight is not None
+    assert eng._inflight
     eng.abort(a)
     tokens_at_abort = len(eng.seqs[a].output_tokens)
     done = set()

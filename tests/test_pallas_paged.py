@@ -11,7 +11,8 @@ import jax.numpy as jnp
 from production_stack_tpu.models.kv import make_cache, write_chunk, gather_view
 from production_stack_tpu.ops.attention import attention_with_cache
 from production_stack_tpu.ops.pallas_paged import (
-    mesh_tp_only, paged_attention, paged_attention_sharded)
+    mesh_tp_only, paged_attention, paged_attention_sharded,
+    paged_decode_attention)
 
 
 def _random_paged(key, B, n_blocks, Bs, Hkv, D, lens, t_extra=8):
@@ -63,6 +64,97 @@ def test_paged_matches_dense(T, G, Bs, D):
     nb = -(-(max(lens) + T) // Bs)
     got = paged_attention(q, k_pool, v_pool, tables, starts, nb=nb,
                           interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("T,G,Bs,D", [
+    (1, 4, 16, 32),      # decode window step, GQA
+    (1, 1, 16, 32),      # decode, MHA (G == 1)
+    (5, 4, 16, 32),      # speculative window (draft + 1)
+    (8, 2, 16, 64),      # DECODE_T_MAX boundary
+])
+def test_paged_decode_matches_dense(T, G, Bs, D):
+    """The wide decode kernel (all kv heads + R blocks per grid step)
+    matches the dense jnp path on the same shuffled pools."""
+    B, Hkv = 3, 2
+    H = Hkv * G
+    key = jax.random.PRNGKey(T * 77 + G)
+    lens = [70, 33, 51]
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=64, Bs=Bs, Hkv=Hkv, D=D, lens=lens, t_extra=T)
+    starts = jnp.asarray(lens, jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 7),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    newk = jax.random.normal(jax.random.fold_in(key, 8),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 9),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+
+    # nb NOT a multiple of the kernel's blocks-per-step: the ragged
+    # last group must mask correctly
+    nb = -(-(max(lens) + T) // Bs)
+    got = paged_decode_attention(q, k_pool, v_pool, tables, starts,
+                                 nb=nb, interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_short_row_isolation():
+    """A short row must not read long rows' blocks through the group
+    clamp (per-row jmax in the decode kernel's index maps)."""
+    B, Hkv, G, Bs, D, T = 2, 2, 2, 16, 32, 1
+    H = Hkv * G
+    key = jax.random.PRNGKey(11)
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=32, Bs=Bs, Hkv=Hkv, D=D, lens=[90, 5])
+    starts = jnp.asarray([90, 5], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 1),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None]
+    newk = jax.random.normal(jax.random.fold_in(key, 2),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 3),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+    nb = -(-(90 + T) // Bs)
+    got = paged_decode_attention(q, k_pool, v_pool, tables, starts,
+                                 nb=nb, interpret=True)
+    want = _reference(q, k_pool, v_pool, tables, starts, nb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_sharded_tp_parity():
+    """paged_attention_sharded routes short windows through the decode
+    kernel; parity on a 2-device tp mesh."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("tp",))
+    B, Hkv, G, Bs, D, T = 2, 2, 2, 16, 32, 1
+    H = Hkv * G
+    key = jax.random.PRNGKey(13)
+    k_pool, v_pool, tables = _random_paged(
+        key, B, n_blocks=24, Bs=Bs, Hkv=Hkv, D=D, lens=[20, 44])
+    starts = jnp.asarray([20, 44], jnp.int32)
+    q = jax.random.normal(jax.random.fold_in(key, 6),
+                          (B, T, H, D), jnp.float32)
+    positions = starts[:, None] + jnp.arange(T)[None, :]
+    newk = jax.random.normal(jax.random.fold_in(key, 7),
+                             (B, T, Hkv, D), jnp.float32)
+    newv = jax.random.normal(jax.random.fold_in(key, 8),
+                             (B, T, Hkv, D), jnp.float32)
+    k_pool = write_chunk(k_pool, newk, tables, positions)
+    v_pool = write_chunk(v_pool, newv, tables, positions)
+    nb = -(-(44 + T) // Bs)
+    got = paged_attention_sharded(q, k_pool, v_pool, tables, starts,
+                                  mesh, nb=nb, interpret=True)
     want = _reference(q, k_pool, v_pool, tables, starts, nb)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
