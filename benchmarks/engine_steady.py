@@ -59,8 +59,10 @@ def main() -> None:
     # optimistic windows in flight past the processed tokens, plus the
     # warm window and the host-side rounding of the priming loop —
     # under-covering would clamp the tail windows' KV writes onto the
-    # trash block and make their reads artificially cache-hot
-    span = args.ctx + args.window * (args.iters + 4)
+    # trash block and make their reads artificially cache-hot. With
+    # speculation every macro-step emits up to spec+1 tokens (the same
+    # horizon factor the engine uses, engine._dispatch_decode).
+    span = args.ctx + args.window * (args.iters + 4) * (args.spec + 1)
     need = -(-span // 256) * 256    # covering multiple of 256
     cfg_kw = dict(model=args.model, max_model_len=max(512, need),
                   max_num_seqs=args.batch, prefill_chunk=512,

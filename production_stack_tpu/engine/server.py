@@ -935,7 +935,15 @@ def main(argv=None) -> None:
 
     async def _serve():
         app = build_app(engine)
-        runner = web.AppRunner(app)
+        # cancel handlers when the peer disconnects (aiohttp >= 3.9
+        # defaults this OFF): a request whose client has gone must
+        # abort its engine-side generation even if it is still QUEUED —
+        # without this, disconnects are only noticed at SSE write time,
+        # and a backlog of orphaned requests keeps the engine busy for
+        # clients that left minutes ago. Cancellation closes the stream
+        # generator, whose finally aborts the sequence
+        # (async_engine.stream).
+        runner = web.AppRunner(app, handler_cancellation=True)
         await runner.setup()
         site = web.TCPSite(runner, args.host, args.port)
         await site.start()

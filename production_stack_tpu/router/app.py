@@ -299,7 +299,12 @@ def main(argv=None) -> None:
     app = build_app(args)
 
     async def _serve():
-        runner = web.AppRunner(app)
+        # handler_cancellation: a client disconnect cancels the relay
+        # task, which closes the backend connection — propagating the
+        # disconnect to the engine so IT can abort the generation
+        # (aiohttp >= 3.9 defaults this off; without it an abandoned
+        # request is only noticed when the next token write fails)
+        runner = web.AppRunner(app, handler_cancellation=True)
         await runner.setup()
         site = web.TCPSite(runner, args.host, args.port)
         await site.start()

@@ -324,6 +324,35 @@ def test_abort_running_seq_with_inflight_window():
     assert eng.seqs[b].output_tokens == solo.seqs[s].output_tokens
 
 
+def test_pipelined_windows_match_unpipelined(monkeypatch):
+    """Depth-2 window pipelining (engine._PIPELINE_DEPTH) must not
+    change any stream: staggered budgets force mid-run slot recycling
+    while optimistic windows are in flight, and every sequence's
+    greedy output must match a depth-1 (no dispatch-ahead) run."""
+    from production_stack_tpu.engine import engine as engine_mod
+
+    def run(depth):
+        monkeypatch.setattr(engine_mod, "_PIPELINE_DEPTH", depth)
+        cfg = EngineConfig(model="debug-tiny", max_model_len=256,
+                           max_num_seqs=4, prefill_chunk=32,
+                           prefill_buckets=(32,), decode_window=4)
+        eng = LLMEngine(cfg)
+        ids = [eng.add_request(
+            list(range(5 + i, 15 + i)),
+            SamplingOptions(temperature=0.0, max_tokens=10 + 7 * i,
+                            ignore_eos=True))
+            for i in range(6)]   # 6 requests on 4 slots: admission waves
+        done = set()
+        steps = 0
+        while len(done) < len(ids):
+            done.update(o.seq_id for o in eng.step() if o.finished)
+            steps += 1
+            assert steps < 2000
+        return [eng.seqs[i].output_tokens for i in ids]
+
+    assert run(2) == run(1)
+
+
 def test_fp32_model_with_bf16_kv_cache():
     """--dtype float32 with the default bfloat16 KV cache must serve
     (the K/V write casts to the cache dtype; attention promotes)."""

@@ -447,3 +447,68 @@ def test_api_key_from_env(engine, monkeypatch):
             assert r.status == 200
     monkeypatch.setenv("ENGINE_API_KEY", "env-key")
     asyncio.run(runner())
+
+
+def test_client_disconnect_aborts_generation(engine):
+    """A client that vanishes mid-stream (or while queued) must have
+    its engine-side generation aborted — the server runs with
+    aiohttp handler_cancellation, so the disconnect cancels the
+    handler, closing the stream generator whose finally aborts the
+    sequence (async_engine.stream). Without it, orphaned requests
+    keep the engine busy for clients that left long ago."""
+    async def body(client):
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "never stops"}],
+            "max_tokens": 120, "temperature": 0.0, "stream": True,
+            "ignore_eos": True})
+        assert resp.status == 200
+        await resp.content.readany()   # generation is live
+        sched = engine.engine.scheduler
+        assert sched.num_running + sched.num_waiting >= 1
+        resp.close()                   # hard disconnect, no drain
+        for _ in range(200):
+            if sched.num_running == 0 and sched.num_waiting == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert sched.num_running == 0 and sched.num_waiting == 0
+    _with_client(engine, body)
+
+
+def test_disconnect_while_queued_aborts(engine):
+    """A request whose client disconnects while it is still WAITING
+    (both slots busy, no token ever written to it — so the SSE
+    write-failure path can never fire) must still be aborted via
+    handler cancellation."""
+    async def body(client):
+        sched = engine.engine.scheduler
+        busy = [await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": f"hold {i}"}],
+            "max_tokens": 200, "temperature": 0.0, "stream": True,
+            "ignore_eos": True}) for i in range(2)]   # fill both slots
+        for r in busy:
+            await r.content.readany()
+        queued = await client.post("/v1/chat/completions", json={
+            "model": "debug-tiny",
+            "messages": [{"role": "user", "content": "stuck in queue"}],
+            "max_tokens": 5, "temperature": 0.0, "stream": True})
+        for _ in range(100):
+            if sched.num_waiting >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert sched.num_waiting >= 1
+        queued.close()                 # leave while still queued
+        for _ in range(200):
+            if sched.num_waiting == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert sched.num_waiting == 0
+        for r in busy:                 # cleanup: abort the fillers
+            r.close()
+        for _ in range(200):
+            if sched.num_running == 0:
+                break
+            await asyncio.sleep(0.05)
+        assert sched.num_running == 0
+    _with_client(engine, body)
