@@ -64,6 +64,11 @@ def parse_cli(argv=None):
     ap.add_argument("--prompt-len", type=int, default=0,
                     help="prompt tokens per request (0 = mode default)")
     ap.add_argument("--quantization", choices=["int8"], default=None)
+    ap.add_argument("--kv-cache-dtype",
+                    choices=["bfloat16", "float32", "int8"],
+                    default=None,
+                    help="KV cache precision (int8 halves long-context "
+                         "decode KV HBM traffic)")
     ap.add_argument("--spec", type=int, default=0,
                     help="n-gram speculative draft length (0 = off)")
     ap.add_argument("--kv-pool-frac", type=float, default=1.0,
@@ -122,6 +127,8 @@ def run_bench(args) -> dict:
     n_requests = args.requests or 2 * batch
     if args.quantization:
         cfg_kw["quantization"] = args.quantization
+    if args.kv_cache_dtype:
+        cfg_kw["kv_dtype"] = args.kv_cache_dtype
     if args.spec:
         cfg_kw["speculative_ngram_tokens"] = args.spec
     if args.kv_pool_frac < 1.0:
@@ -178,6 +185,7 @@ def run_bench(args) -> dict:
         "prompt_len": prompt_len,
         "gen_len": gen_len,
         "quantization": cfg.quantization,
+        "kv_dtype": cfg.kv_dtype,
         "speculative": cfg.speculative_ngram_tokens,
         "decode_window": cfg.decode_window,
     }
@@ -196,6 +204,7 @@ def record_line(args, stats: dict, platform: str) -> dict:
             refs = {}
     ref = refs.get(key)
     standard = (args.batch == 8 and not args.quantization
+                and not args.kv_cache_dtype
                 and not args.spec and not args.gen_len
                 and not args.prompt_len and not args.requests
                 and not args.prefill_chunk and not args.cold
@@ -306,6 +315,8 @@ def forward_args(args) -> list:
         out += ["--requests", str(args.requests)]
     if args.quantization:
         out += ["--quantization", args.quantization]
+    if args.kv_cache_dtype:
+        out += ["--kv-cache-dtype", args.kv_cache_dtype]
     if args.spec:
         out += ["--spec", str(args.spec)]
     if args.kv_pool_frac != 1.0:

@@ -41,6 +41,8 @@ def main() -> None:
     ap.add_argument("--iters", type=int, default=8,
                     help="timed decode windows")
     ap.add_argument("--quantization", choices=["int8"], default=None)
+    ap.add_argument("--kv-cache-dtype",
+                    choices=["bfloat16", "float32", "int8"], default=None)
     ap.add_argument("--spec", type=int, default=0)
     ap.add_argument("--model", default="tinyllama-1.1b")
     ap.add_argument("--block", type=int, default=0,
@@ -69,6 +71,8 @@ def main() -> None:
                   decode_window=args.window,
                   quantization=args.quantization,
                   speculative_ngram_tokens=args.spec)
+    if args.kv_cache_dtype:
+        cfg_kw["kv_dtype"] = args.kv_cache_dtype
     if args.block:
         cfg_kw["kv_block_size"] = args.block
     cfg = EngineConfig(**cfg_kw)
@@ -124,6 +128,7 @@ def main() -> None:
         "batch": args.batch, "window": args.window, "ctx": args.ctx,
         "kv_bucket": kv_len, "iters": args.iters,
         "quantization": args.quantization, "spec": args.spec,
+        "kv_dtype": cfg.kv_dtype,
         "kv_block": cfg.kv_block_size,
         "compile_s": round(compile_s, 1),
     }))

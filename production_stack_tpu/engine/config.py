@@ -87,12 +87,15 @@ class EngineConfig:
     lora_targets: Tuple[str, ...] = ("q", "v")
 
     def __post_init__(self):
-        for field_name in ("dtype", "kv_dtype"):
-            val = getattr(self, field_name)
-            if val not in ("bfloat16", "float32"):
-                raise ValueError(
-                    f"{field_name}={val!r} unsupported: TPU serving runs "
-                    f"bfloat16 (MXU-native) or float32")
+        if self.dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"dtype={self.dtype!r} unsupported: TPU serving runs "
+                f"bfloat16 (MXU-native) or float32")
+        if self.kv_dtype not in ("bfloat16", "float32", "int8"):
+            raise ValueError(
+                f"kv_dtype={self.kv_dtype!r} unsupported: bfloat16, "
+                f"float32, or int8 (quantized cache — halves "
+                f"long-context decode HBM traffic, models/kv.py)")
         if self.pipeline_parallel_size != 1:
             raise NotImplementedError(
                 "pipeline-parallel SERVING is not implemented: decode "

@@ -87,12 +87,12 @@ class ModelRunner:
         if mesh is not None:
             dp_size = mesh.shape.get("dp", 1)
             n_blocks = -(-n_blocks // dp_size) * dp_size
+        kv_dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                 "int8": jnp.int8}[engine_cfg.kv_dtype]
         self.cache: KVCache = make_cache(
             model_cfg.num_layers, n_blocks,
             engine_cfg.kv_block_size, model_cfg.num_kv_heads,
-            model_cfg.head_dim_,
-            dtype=jnp.bfloat16 if engine_cfg.kv_dtype == "bfloat16"
-            else jnp.float32)
+            model_cfg.head_dim_, dtype=kv_dt)
         self._tables = jnp.zeros(
             (engine_cfg.max_num_seqs, engine_cfg.max_blocks_per_seq),
             jnp.int32)
@@ -127,8 +127,19 @@ class ModelRunner:
             self.params = jax.device_put(
                 self.params, param_shardings(mesh, self.params))
             cache_sh = NamedSharding(mesh, cache_pspec())
-            self.cache = KVCache(jax.device_put(self.cache.k, cache_sh),
-                                 jax.device_put(self.cache.v, cache_sh))
+            if self.cache.quantized:
+                from production_stack_tpu.parallel.sharding import (
+                    cache_scale_pspec)
+                scale_sh = NamedSharding(mesh, cache_scale_pspec())
+                self.cache = KVCache(
+                    jax.device_put(self.cache.k, cache_sh),
+                    jax.device_put(self.cache.v, cache_sh),
+                    jax.device_put(self.cache.ks, scale_sh),
+                    jax.device_put(self.cache.vs, scale_sh))
+            else:
+                self.cache = KVCache(
+                    jax.device_put(self.cache.k, cache_sh),
+                    jax.device_put(self.cache.v, cache_sh))
             from jax.sharding import PartitionSpec as _P
             self._tables_sharding = NamedSharding(mesh, _P())
             self._tables = jax.device_put(self._tables,
@@ -648,6 +659,15 @@ class ModelRunner:
                 # [size, L, Hkv, D] -> chunk layout [L, size, Hkv, D]
                 k = cache.k[:, blk, :, off, :].transpose(1, 0, 2, 3)
                 v = cache.v[:, blk, :, off, :].transpose(1, 0, 2, 3)
+                if cache.quantized:
+                    # tiers store full-precision chunks (portable across
+                    # kv_dtype configs of the same fingerprint namespace)
+                    ks = cache.ks[:, blk, :, off].transpose(1, 0, 2)
+                    vs = cache.vs[:, blk, :, off].transpose(1, 0, 2)
+                    k = k.astype(jnp.bfloat16) * ks[..., None].astype(
+                        jnp.bfloat16)
+                    v = v.astype(jnp.bfloat16) * vs[..., None].astype(
+                        jnp.bfloat16)
                 return k, v
 
             fn = self._extract_fns[size] = jax.jit(_impl)
@@ -666,6 +686,23 @@ class ModelRunner:
                       start):
                 blk, off = self._slot_block_offsets(tables, slot, start,
                                                     size)
+                if cache.quantized:
+                    # tier chunks are full precision; re-quantize on the
+                    # way in ([L, size, Hkv, D] vectors, same recipe as
+                    # serving writes — models/kv.quantize_chunk)
+                    from production_stack_tpu.models.kv import (
+                        quantize_chunk)
+                    kq, ksc = quantize_chunk(k_chunk)
+                    vq, vsc = quantize_chunk(v_chunk)
+                    k = cache.k.at[:, blk, :, off, :].set(
+                        kq.transpose(1, 0, 2, 3))
+                    v = cache.v.at[:, blk, :, off, :].set(
+                        vq.transpose(1, 0, 2, 3))
+                    ks = cache.ks.at[:, blk, :, off].set(
+                        ksc.transpose(1, 0, 2))
+                    vs = cache.vs.at[:, blk, :, off].set(
+                        vsc.transpose(1, 0, 2))
+                    return KVCache(k, v, ks, vs)
                 kc = k_chunk.astype(cache.k.dtype).transpose(1, 0, 2, 3)
                 vc = v_chunk.astype(cache.v.dtype).transpose(1, 0, 2, 3)
                 k = cache.k.at[:, blk, :, off, :].set(kc)

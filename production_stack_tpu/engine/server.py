@@ -849,6 +849,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="weight-only int8: halves decode weight-"
                         "streaming HBM traffic (norms/biases/router "
                         "stay in --dtype)")
+    p.add_argument("--kv-cache-dtype", choices=["bfloat16", "float32",
+                                                "int8"],
+                   default="bfloat16",
+                   help="KV cache precision; int8 stores per-(token, "
+                        "head)-scaled int8 blocks — halves long-context "
+                        "decode KV HBM traffic (models/kv.py)")
     p.add_argument("--moe-capacity-factor", type=float, default=None,
                    help="MoE prefill capacity factor (ops/moe.py): >= "
                         "num_experts/top_k disables token dropping at "
@@ -907,7 +913,7 @@ def main(argv=None) -> None:
         model=args.model, tokenizer=args.tokenizer,
         chat_template=args.chat_template,
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
-        dtype=args.dtype,
+        dtype=args.dtype, kv_dtype=args.kv_cache_dtype,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
         decode_window=args.decode_window,
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))

@@ -86,9 +86,15 @@ class KVConnector:
         self.runner = runner
         self.cfg = cfg
         self.chunk_size = cfg.chunk_size
+        # namespace by the WIRE dtype, not the pool dtype: an int8 pool
+        # extracts/injects full-precision (bf16) chunks, so int8 and
+        # bf16 engines of the same model share one tier namespace —
+        # the documented mixed-kvCacheDtype producer/consumer handoff
+        wire_dtype = ("bfloat16" if runner.cache.quantized
+                      else engine_cfg.kv_dtype)
         self.hasher = ChunkHasher(
             cfg.chunk_size,
-            namespace=model_fingerprint(model_cfg, engine_cfg.kv_dtype))
+            namespace=model_fingerprint(model_cfg, wire_dtype))
         self.store = store if store is not None else make_store(
             local_cpu_bytes=int(cfg.local_cpu_gb * (1 << 30)),
             local_disk_path=cfg.local_disk_path,
@@ -103,7 +109,11 @@ class KVConnector:
         import ml_dtypes
         dtype_map = {"bfloat16": np.dtype(ml_dtypes.bfloat16),
                      "float32": np.dtype(np.float32)}
-        kv_dtype = str(runner.cache.k.dtype)
+        # int8 pools extract/inject FULL-PRECISION chunks (the runner
+        # dequantizes out and re-quantizes in, runner.extract_chunk /
+        # inject_chunk) — tiers always hold portable bf16/f32 bytes
+        kv_dtype = ("bfloat16" if runner.cache.quantized
+                    else str(runner.cache.k.dtype))
         if kv_dtype not in dtype_map:
             raise ValueError(f"KV tiering does not support kv dtype "
                              f"{kv_dtype!r} (supported: {list(dtype_map)})")

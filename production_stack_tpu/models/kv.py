@@ -50,6 +50,11 @@ import jax.numpy as jnp
 class KVCache(NamedTuple):
     k: jnp.ndarray  # [L, N, Hkv, Bs, D]
     v: jnp.ndarray  # [L, N, Hkv, Bs, D]
+    # int8 KV mode only: symmetric per-(token, head) dequant scales
+    # (models/quant.py recipe applied to the cache): value = int8 *
+    # scale. None = full-precision cache.
+    ks: Optional[jnp.ndarray] = None  # [L, N, Hkv, Bs] f32
+    vs: Optional[jnp.ndarray] = None
 
     @property
     def num_blocks(self) -> int:
@@ -59,12 +64,27 @@ class KVCache(NamedTuple):
     def block_size(self) -> int:
         return self.k.shape[3]
 
+    @property
+    def quantized(self) -> bool:
+        return self.ks is not None
+
 
 def make_cache(num_layers: int, num_blocks: int, block_size: int,
                num_kv_heads: int, head_dim: int,
                dtype=jnp.bfloat16) -> KVCache:
-    """Block pool. num_blocks INCLUDES the reserved trash block 0."""
+    """Block pool. num_blocks INCLUDES the reserved trash block 0.
+
+    dtype jnp.int8 allocates the quantized pool: int8 payload plus
+    per-(token, head) fp32 scales — halving decode's KV HBM traffic
+    (the dominant long-context cost) for ~0.4% the scale overhead
+    (4 bytes per D=64..128 values)."""
     shape = (num_layers, num_blocks, num_kv_heads, block_size, head_dim)
+    if dtype == jnp.int8:
+        sshape = shape[:-1]
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       ks=jnp.zeros(sshape, jnp.float32),
+                       vs=jnp.zeros(sshape, jnp.float32))
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -92,6 +112,27 @@ def make_slot_cache(num_layers: int, num_slots: int, max_len: int,
     return cache, linear_tables(num_slots, max_len, block_size)
 
 
+def _chunk_addresses(tables: jnp.ndarray, positions: jnp.ndarray,
+                     block_size: int,
+                     valid: Optional[jnp.ndarray],
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(flat block ids, flat intra-block offsets) for a [B, T] chunk of
+    virtual positions — the ONE addressing contract every pool writer
+    shares: tables map position//Bs to a block; tokens that are invalid,
+    negative, or beyond the virtual capacity MB*Bs route to trash
+    block 0 (collisions there are irrelevant by construction)."""
+    Bs = block_size
+    MB = tables.shape[1]
+    bi = jnp.clip(positions // Bs, 0, MB - 1)
+    blk = jnp.take_along_axis(tables, bi, axis=1)           # [B, T]
+    off = positions % Bs
+    oob = (positions < 0) | (positions >= MB * Bs)
+    if valid is not None:
+        oob = oob | ~valid
+    blk = jnp.where(oob, 0, blk)                            # block 0
+    return blk.reshape(-1), off.reshape(-1)
+
+
 def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
                 tables: jnp.ndarray, positions: jnp.ndarray,
                 valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
@@ -99,29 +140,53 @@ def write_chunk(cache_layer: jnp.ndarray, new: jnp.ndarray,
 
     positions [B,T] are virtual positions; tables [B,MB] map them to
     blocks. Tokens with valid == False (padding, parked rows, window
-    tails past capacity) are routed to trash block 0 — collisions
-    there are irrelevant by construction. Callers on the serving path
-    MUST pass valid; None (tests, single-sequence loops) treats every
-    in-range token as real, which is only safe when positions never
-    exceed the virtual capacity MB*Bs.
+    tails past capacity) are routed to trash block 0. Callers on the
+    serving path MUST pass valid; None (tests, single-sequence loops)
+    treats every in-range token as real, which is only safe when
+    positions never exceed the virtual capacity MB*Bs.
     """
     new = new.astype(cache_layer.dtype)
-    Bs = cache_layer.shape[2]
     B, T = positions.shape
-    MB = tables.shape[1]
-    bi = jnp.clip(positions // Bs, 0, MB - 1)
-    blk = jnp.take_along_axis(tables, bi, axis=1)           # [B, T]
-    off = positions % Bs
-    # beyond-capacity positions can only reach here masked or in test
-    # paths; clamp them onto trash rather than wrapping into a block
-    oob = (positions < 0) | (positions >= MB * Bs)
-    if valid is not None:
-        oob = oob | ~valid
-    blk = jnp.where(oob, 0, blk)                            # block 0
+    blk, off = _chunk_addresses(tables, positions, cache_layer.shape[2],
+                                valid)
     # advanced indices on the block and offset axes land the [Hkv, D]
     # slab of every token at its (block, head-major row) home
-    return cache_layer.at[blk.reshape(-1), :, off.reshape(-1), :].set(
+    return cache_layer.at[blk, :, off, :].set(
         new.reshape((B * T,) + new.shape[2:]))
+
+
+def quantize_chunk(new: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-(token, head) int8 over the head dim.
+
+    new [B,T,Hkv,D] -> (int8 same shape, fp32 scale [B,T,Hkv]) with
+    value = int8 * scale. Mirrors models/quant.quantize_tensor's
+    recipe, with the channel axis per cached token (K/V vectors are
+    consumed whole per position, so one scale per vector loses
+    nothing to outlier columns)."""
+    f = new.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(f), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127
+                 ).astype(jnp.int8)
+    return q, scale
+
+
+def write_chunk_q(cache_layer: jnp.ndarray, scale_layer: jnp.ndarray,
+                  new: jnp.ndarray, tables: jnp.ndarray,
+                  positions: jnp.ndarray,
+                  valid: Optional[jnp.ndarray] = None,
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """write_chunk for the int8 pool: quantize new [B,T,Hkv,D] and
+    scatter payload + scales ([N,Hkv,Bs,D] int8, [N,Hkv,Bs] f32)
+    through the same (block, offset) addressing (_chunk_addresses)."""
+    q, scale = quantize_chunk(new)
+    B, T = positions.shape
+    blk, off = _chunk_addresses(tables, positions, cache_layer.shape[2],
+                                valid)
+    layer = cache_layer.at[blk, :, off, :].set(
+        q.reshape((B * T,) + q.shape[2:]))
+    scales = scale_layer.at[blk, :, off].set(
+        scale.reshape(B * T, -1))
+    return layer, scales
 
 
 def gather_view(cache_layer: jnp.ndarray, tables: jnp.ndarray,
@@ -137,3 +202,19 @@ def gather_view(cache_layer: jnp.ndarray, tables: jnp.ndarray,
     g = g.transpose(0, 1, 3, 2, 4)                           # [B,nb,Bs,Hkv,D]
     return g.reshape(t.shape[0], nb * Bs, Hkv,
                      cache_layer.shape[-1])
+
+
+def gather_view_q(cache_layer: jnp.ndarray, scale_layer: jnp.ndarray,
+                  tables: jnp.ndarray, nb: int,
+                  dtype=jnp.bfloat16) -> jnp.ndarray:
+    """gather_view for the int8 pool: dequantized [B, nb*Bs, Hkv, D]
+    in `dtype`. The HBM read is int8 + one scale per vector — half the
+    bf16 pool's traffic; the dequantized product is a fused temporary
+    feeding attention, never resident."""
+    Hkv, Bs = cache_layer.shape[1], cache_layer.shape[2]
+    t = tables[:, :nb]
+    g = cache_layer[t].astype(dtype)                  # [B,nb,Hkv,Bs,D]
+    s = scale_layer[t].astype(dtype)                  # [B,nb,Hkv,Bs]
+    g = g * s[..., None]
+    g = g.transpose(0, 1, 3, 2, 4)
+    return g.reshape(t.shape[0], nb * Bs, Hkv, cache_layer.shape[-1])

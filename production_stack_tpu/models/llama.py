@@ -24,7 +24,9 @@ import jax.numpy as jnp
 
 from production_stack_tpu.models import lora, quant
 from production_stack_tpu.models.config import ModelConfig
-from production_stack_tpu.models.kv import KVCache, gather_view, write_chunk
+from production_stack_tpu.models.kv import (KVCache, gather_view,
+                                            gather_view_q, write_chunk,
+                                            write_chunk_q)
 from production_stack_tpu.ops import moe, pallas_attention, pallas_paged
 from production_stack_tpu.ops.attention import attention_with_cache, causal_attention
 from production_stack_tpu.ops.norms import rms_norm
@@ -147,10 +149,19 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             attn = causal_attention(q, k, v, scale=hd ** -0.5)
         new_kv = None
     else:
-        k_cache = write_chunk(kv[0], k, block_tables, positions,
-                              valid=token_valid)
-        v_cache = write_chunk(kv[1], v, block_tables, positions,
-                              valid=token_valid)
+        quant_kv = len(kv) == 4   # (k, v, ks, vs): int8 pool + scales
+        if quant_kv:
+            k_cache, k_scales = write_chunk_q(
+                kv[0], kv[2], k, block_tables, positions,
+                valid=token_valid)
+            v_cache, v_scales = write_chunk_q(
+                kv[1], kv[3], v, block_tables, positions,
+                valid=token_valid)
+        else:
+            k_cache = write_chunk(kv[0], k, block_tables, positions,
+                                  valid=token_valid)
+            v_cache = write_chunk(kv[1], v, block_tables, positions,
+                                  valid=token_valid)
         Bs = k_cache.shape[2]
         MB = block_tables.shape[1]
         nb = MB if kv_len is None else min(-(-kv_len // Bs), MB)
@@ -163,6 +174,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             # Covers prefill chunks AND decode/spec windows; under a
             # tp-only mesh it runs shard-local per head via shard_map.
             interp = pallas_attention.needs_interpret()
+            sc = (dict(k_scales=k_scales, v_scales=v_scales)
+                  if quant_kv else {})
             if mesh is None:
                 # short windows (decode / speculative verify) take the
                 # wide kernel: all kv heads + several pool blocks per
@@ -172,17 +185,24 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                             else pallas_paged.paged_attention)
                 attn = paged_fn(
                     q, k_cache, v_cache, block_tables, starts, nb=nb,
-                    interpret=interp)
+                    interpret=interp, **sc)
             else:
                 attn = pallas_paged.paged_attention_sharded(
                     q, k_cache, v_cache, block_tables, starts, mesh,
-                    nb=nb, interpret=interp)
+                    nb=nb, interpret=interp, **sc)
         else:
-            k_att = gather_view(k_cache, block_tables, nb)
-            v_att = gather_view(v_cache, block_tables, nb)
+            if quant_kv:
+                k_att = gather_view_q(k_cache, k_scales, block_tables,
+                                      nb, dtype=q.dtype)
+                v_att = gather_view_q(v_cache, v_scales, block_tables,
+                                      nb, dtype=q.dtype)
+            else:
+                k_att = gather_view(k_cache, block_tables, nb)
+                v_att = gather_view(v_cache, block_tables, nb)
             attn = attention_with_cache(q, k_att, v_att, positions,
                                         scale=hd ** -0.5)
-        new_kv = (k_cache, v_cache)
+        new_kv = ((k_cache, v_cache, k_scales, v_scales) if quant_kv
+                  else (k_cache, v_cache))
     x = x + proj(attn.reshape(B, T, nh * hd), "o")
 
     hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset=offset)
@@ -265,39 +285,35 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     starts = positions[:, 0]
     x = _embed(params, cfg, tokens)
 
-    if lora_params is not None:
-        def scan_body(carry, xs):
-            lp, k_c, v_c, ll = xs
-            out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
-                                      lp, (k_c, v_c), kv_len=kv_len,
-                                      use_flash=use_flash, lora_layer=ll,
-                                      adapter_ids=adapter_ids,
-                                      lora_scaling=lora_scaling,
-                                      token_valid=token_valid,
-                                      block_tables=block_tables,
-                                      mesh=mesh)
-            return out, new_kv
+    quant_kv = cache.quantized
+    has_lora = lora_params is not None
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x,
-            (params["layers"], cache.k, cache.v, lora_params))
-    else:
-        def scan_body(carry, xs):
-            lp, k_c, v_c = xs
-            out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
-                                      lp, (k_c, v_c), kv_len=kv_len,
-                                      use_flash=use_flash,
-                                      token_valid=token_valid,
-                                      block_tables=block_tables,
-                                      mesh=mesh)
-            return out, new_kv
+    def scan_body(carry, xs):
+        lp = xs[0]
+        kv_tuple = xs[1:5] if quant_kv else xs[1:3]
+        ll = xs[-1] if has_lora else None
+        out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
+                                  lp, kv_tuple, kv_len=kv_len,
+                                  use_flash=use_flash, lora_layer=ll,
+                                  adapter_ids=adapter_ids,
+                                  lora_scaling=lora_scaling,
+                                  token_valid=token_valid,
+                                  block_tables=block_tables,
+                                  mesh=mesh)
+        return out, new_kv
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["layers"], cache.k, cache.v))
+    xs = (params["layers"], cache.k, cache.v)
+    if quant_kv:
+        xs = xs + (cache.ks, cache.vs)
+    if has_lora:
+        xs = xs + (lora_params,)
+    x, new = jax.lax.scan(scan_body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                  offset=1.0 if cfg.rms_norm_offset else 0.0)
     logits = _lm_head(params, cfg, x)
-    return logits, KVCache(k=new_k, v=new_v)
+    new_cache = (KVCache(k=new[0], v=new[1], ks=new[2], vs=new[3])
+                 if quant_kv else KVCache(k=new[0], v=new[1]))
+    return logits, new_cache
 
 
 def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,

@@ -70,9 +70,10 @@ def paged_viable(T: int, groups: int, head_dim: int,
     return work <= _VMEM_WORK_BYTES
 
 
-def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, out_ref,
-                  m_ref, l_ref, acc_ref, *, block_q: int, groups: int,
-                  block_size: int, nb: int, scale: float):
+def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
+                  block_q: int, groups: int,
+                  block_size: int, nb: int, scale: float,
+                  quant: bool = False):
     """One (batch row, kv head, q block, pool block) grid step.
 
     tabs_ref   (SMEM) [B, MB]      block tables
@@ -80,9 +81,14 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, out_ref,
     q_ref   [1, BQ, 1, G, D]       this kv-head's query block
     k_ref   [1, 1, Bs, D]          pool block tabs[b, min(j, jmax)]
     v_ref   [1, 1, Bs, D]
-    out_ref [1, BQ, 1, G, D]
-    m/l/acc (VMEM scratch)         online softmax state across j
+    refs    (quant only: ks/vs dequant scales [1, 1, Bs] fp32,)
+            out [1, BQ, 1, G, D], scratch m/l/acc (online softmax
+            state across j)
     """
+    if quant:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    out_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     qi = pl.program_id(2)
     j = pl.program_id(3)
@@ -111,6 +117,10 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, out_ref,
         q = q_ref[0].reshape(rows, D).astype(jnp.float32) * scale
         k_blk = k_ref[0, 0].astype(jnp.float32)               # [Bs, D]
         v_blk = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            # int8 pool: dequantize the panel in VMEM (per-token scale)
+            k_blk = k_blk * ks_ref[0, 0][:, None]
+            v_blk = v_blk * vs_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [rows, Bs]
@@ -140,7 +150,8 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, out_ref,
 @functools.partial(jax.jit,
                    static_argnames=("nb", "block_q", "interpret"))
 def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
-                    block_q: int = 0, interpret: bool = False):
+                    block_q: int = 0, interpret: bool = False,
+                    k_scales=None, v_scales=None):
     """Causal GQA over paged K/V, positions contiguous per row.
 
     q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
@@ -151,12 +162,17 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     must already contain the chunk's own K/V (write-then-attend, as
     in models/kv.py). Rows parked at start >= MB*Bs return garbage
     the caller discards, exactly like the jnp path.
+
+    k_scales/v_scales [N, Hkv, Bs] fp32 activate the int8-pool mode:
+    panels stream from HBM as int8 (half the bytes) and dequantize in
+    VMEM next to the dot.
     """
     B, T, H, D = q.shape
     Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
     G = H // Hkv
     MB = tables.shape[1]
     scale = D ** -0.5
+    quant = k_scales is not None
     if not block_q:
         # whole chunk per q block while VMEM allows: K/V are streamed
         # once per (batch, head) instead of once per q block
@@ -185,23 +201,32 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
         jj = jnp.maximum(jj, 0)
         return (tabs[b, jj], h, 0, 0)
 
+    def scale_index(b, h, qi, j, tabs, sts):
+        blk, hh, _, _ = kv_index(b, h, qi, j, tabs, sts)
+        return (blk, hh, 0)
+
     grid = (B, Hkv, nq, nb)
     kernel = functools.partial(
         _paged_kernel, block_q=block_q, groups=G, block_size=Bs,
-        nb=nb, scale=scale)
+        nb=nb, scale=scale, quant=quant)
     rows = block_q * G
+    in_specs = [
+        pl.BlockSpec((1, block_q, 1, G, D),
+                     lambda b, h, qi, j, tabs, sts:
+                     (b, qi, h, 0, 0)),
+        pl.BlockSpec((1, 1, Bs, D), kv_index),
+        pl.BlockSpec((1, 1, Bs, D), kv_index),
+    ]
+    operands = [q5, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, Bs), scale_index)] * 2
+        operands += [k_scales, v_scales]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, block_q, 1, G, D),
-                             lambda b, h, qi, j, tabs, sts:
-                             (b, qi, h, 0, 0)),
-                pl.BlockSpec((1, 1, Bs, D), kv_index),
-                pl.BlockSpec((1, 1, Bs, D), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, block_q, 1, G, D),
                                    lambda b, h, qi, j, tabs, sts:
                                    (b, qi, h, 0, 0)),
@@ -219,7 +244,7 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
             vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(starts, jnp.int32),
-      q5, k_pool, v_pool)
+      *operands)
 
     return out.reshape(B, Tp, H, D)[:, :T]
 
@@ -248,20 +273,26 @@ _BLOCKS_PER_STEP = 4
 
 def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                          heads_kv: int, groups: int, block_size: int,
-                         ngrp: int, R: int, scale: float):
+                         ngrp: int, R: int, scale: float,
+                         quant: bool = False):
     """One (batch row, block group) grid step.
 
     tabs_ref   (SMEM) [B, MB]     block tables
     starts_ref (SMEM) [B]         absolute position of q[:, 0]
     q_ref   [1, Hkv, T*G, D]      all heads' queries (rows = t*G + g)
-    refs    R k panels [1, Hkv, Bs, D], R v panels, out
+    refs    R k panels [1, Hkv, Bs, D], R v panels, (quant only:
+            R ks + R vs dequant scales [1, Hkv, Bs] fp32,) out
             [1, Hkv, T*G, D], scratch m/l [Hkv*T*G, 1], acc
             [Hkv*T*G, D] — online softmax state across the group axis.
     """
     k_refs = refs[:R]
     v_refs = refs[R:2 * R]
-    out_ref = refs[2 * R]
-    m_ref, l_ref, acc_ref = refs[2 * R + 1:]
+    refs = refs[2 * R:]
+    if quant:
+        ks_refs = refs[:R]
+        vs_refs = refs[R:2 * R]
+        refs = refs[2 * R:]
+    out_ref, m_ref, l_ref, acc_ref = refs
     b = pl.program_id(0)
     jg = pl.program_id(1)
     rows = T * groups
@@ -291,6 +322,9 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                 j = jg * R + i
                 k_blk = k_refs[i][0, h].astype(jnp.float32)  # [Bs, D]
                 v_blk = v_refs[i][0, h].astype(jnp.float32)
+                if quant:
+                    k_blk = k_blk * ks_refs[i][0, h][:, None]
+                    v_blk = v_blk * vs_refs[i][0, h][:, None]
                 s = jax.lax.dot_general(
                     q, k_blk, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)      # [rows, Bs]
@@ -320,18 +354,22 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
 
 @functools.partial(jax.jit, static_argnames=("nb", "interpret"))
 def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
-                           nb: int, interpret: bool = False):
+                           nb: int, interpret: bool = False,
+                           k_scales=None, v_scales=None):
     """paged_attention specialized for short query windows (T <=
     DECODE_T_MAX): same contract, same result, far fewer grid steps.
 
     q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
-    starts [B]. See paged_attention for semantics.
+    starts [B]. See paged_attention for semantics. k_scales/v_scales
+    [N, Hkv, Bs] fp32 activate the int8-pool mode (panels stream as
+    int8, dequantized in VMEM — half the KV bytes of the bf16 pool).
     """
     B, T, H, D = q.shape
     Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
     G = H // Hkv
     MB = tables.shape[1]
     scale = D ** -0.5
+    quant = k_scales is not None
     R = min(_BLOCKS_PER_STEP, nb)
     ngrp = -(-nb // R)
     rows = T * G
@@ -351,19 +389,34 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
 
     kernel = functools.partial(
         _paged_decode_kernel, T=T, heads_kv=Hkv, groups=G,
-        block_size=Bs, ngrp=ngrp, R=R, scale=scale)
+        block_size=Bs, ngrp=ngrp, R=R, scale=scale, quant=quant)
     kv_specs = [pl.BlockSpec((1, Hkv, Bs, D), kv_index(i))
                 for i in range(R)]
+    in_specs = [
+        pl.BlockSpec((1, Hkv, rows, D),
+                     lambda b, jg, tabs, sts: (b, 0, 0, 0)),
+        *kv_specs, *kv_specs,
+    ]
+    operands = [qh, *([k_pool] * R), *([v_pool] * R)]
+    if quant:
+        def sc_index(i):
+            ki = kv_index(i)
+
+            def index(b, jg, tabs, sts):
+                blk, _, _, _ = ki(b, jg, tabs, sts)
+                return (blk, 0, 0)
+            return index
+
+        sc_specs = [pl.BlockSpec((1, Hkv, Bs), sc_index(i))
+                    for i in range(R)]
+        in_specs += [*sc_specs, *sc_specs]
+        operands += [*([k_scales] * R), *([v_scales] * R)]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, ngrp),
-            in_specs=[
-                pl.BlockSpec((1, Hkv, rows, D),
-                             lambda b, jg, tabs, sts: (b, 0, 0, 0)),
-                *kv_specs, *kv_specs,
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, Hkv, rows, D),
                                    lambda b, jg, tabs, sts:
                                    (b, 0, 0, 0)),
@@ -379,7 +432,7 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
             vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(jnp.asarray(tables, jnp.int32), jnp.asarray(starts, jnp.int32),
-      qh, *([k_pool] * R), *([v_pool] * R))
+      qh, *operands[1:])
 
     # [B, Hkv, T*G, D] -> [B, T, H, D]
     out = out.reshape(B, Hkv, T, G, D).transpose(0, 2, 1, 3, 4)
@@ -387,26 +440,37 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
 
 
 def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
-                            nb: int, interpret: bool = False):
+                            nb: int, interpret: bool = False,
+                            k_scales=None, v_scales=None):
     """paged_attention under a tp-only mesh: shard_map over the head
     axis (q heads and pool kv heads both shard by tp, tables/starts
     replicated) — shard-local, no collectives. Caller guarantees the
     mesh has no other axis of size > 1 (mesh_tp_only). Short windows
     (decode/spec) take the wide decode kernel, like the unsharded
-    path."""
+    path. int8 pools pass their [N, Hkv, Bs] scales, sharded over the
+    same head axis."""
     from jax.sharding import PartitionSpec as P
     from jax.experimental.shard_map import shard_map
 
     base = (paged_decode_attention if q.shape[1] <= DECODE_T_MAX
             else paged_attention)
-    fn = functools.partial(base, nb=nb, interpret=interpret)
+    in_specs = (P(None, None, "tp", None),
+                P(None, "tp", None, None),
+                P(None, "tp", None, None), P(), P())
+    args = (q, k_pool, v_pool, tables, starts)
+    if k_scales is not None:
+        def fn(qq, kk, vv, tt, ss, ks, vs):
+            return base(qq, kk, vv, tt, ss, nb=nb, interpret=interpret,
+                        k_scales=ks, v_scales=vs)
+        in_specs = in_specs + (P(None, "tp", None), P(None, "tp", None))
+        args = args + (k_scales, v_scales)
+    else:
+        fn = functools.partial(base, nb=nb, interpret=interpret)
     return shard_map(
         fn, mesh=mesh,
-        in_specs=(P(None, None, "tp", None),
-                  P(None, "tp", None, None),
-                  P(None, "tp", None, None), P(), P()),
+        in_specs=in_specs,
         out_specs=P(None, None, "tp", None),
-        check_rep=False)(q, k_pool, v_pool, tables, starts)
+        check_rep=False)(*args)
 
 
 def mesh_tp_only(mesh) -> bool:

@@ -100,6 +100,12 @@ def cache_pspec() -> P:
     return P(None, "dp", "tp", None, None)
 
 
+def cache_scale_pspec() -> P:
+    """int8-KV dequant scales [L, N, Hkv, Bs]: same placement as the
+    pool minus the head-dim axis (models/kv.py)."""
+    return P(None, "dp", "tp", None)
+
+
 def shard_params(mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
     """Place an (unsharded) params pytree onto the mesh."""
     return jax.device_put(params, param_shardings(mesh, params))

@@ -149,6 +149,8 @@ def test_engine_argv_matches_cli():
                 value = "bfloat16"
             if flag == "--quantization":
                 value = "int8"
+            if flag == "--kv-cache-dtype":
+                value = "int8"
             if flag == "--lora-adapters":
                 value = "demo=random:7"
             if flag == "--lora-targets":
