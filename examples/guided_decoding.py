@@ -46,15 +46,18 @@ out = post("/v1/completions", {
 })
 print("regex:", out["choices"][0]["text"])
 
-# 3. schema-shaped JSON: constrain to YOUR payload's exact shape, with
-# bounded field lengths so the match completes within max_tokens.
-# (Unbounded nested JSON needs more DFA states than the engine's
-# budget — a schema-specific pattern like this is the reliable form.)
-SCHEMA = r'\{"name": "[a-z]{1,8}", "count": \d{1,3}\}'
+# 3. schema-constrained JSON (vLLM guided_json): pass a JSON-schema
+# subset and the engine compiles it to canonical JSON output — every
+# declared property in order, no stray whitespace, always parseable
 out = post("/v1/completions", {
     "model": "debug-tiny",
     "prompt": "reply with a json object: ",
-    "max_tokens": 48,
-    "guided_regex": SCHEMA,
+    "max_tokens": 64,
+    "guided_json": {"type": "object", "properties": {
+        "name": {"type": "string", "pattern": "[a-z]{1,8}"},
+        "count": {"type": "integer"},
+        "tags": {"type": "array", "items": {"enum": ["a", "b"]},
+                 "maxItems": 2},
+    }},
 })
 print("json:", out["choices"][0]["text"])

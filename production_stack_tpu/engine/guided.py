@@ -345,16 +345,122 @@ def choice_regex(choices: List[str]) -> str:
     """guided_choice sugar: alternation of escaped literals."""
     if not choices:
         raise RegexError("guided_choice requires at least one choice")
-    escaped = []
-    for c in choices:
-        out = []
-        for ch in c:
-            if ch in "\\.[](){}|*+?^$-":
-                out.append("\\" + ch)
+    return "(" + "|".join(_regex_literal(c) for c in choices) + ")"
+
+
+# ------------------------------------------------ JSON-schema -> regex
+
+# JSON primitive regexes (exact canonical formatting: no insignificant
+# whitespace inside values). String content follows RFC 8259: raw
+# control bytes (0x00-0x1F) are excluded — the class lists them as
+# literal members — and backslash escapes are restricted to the legal
+# set, so every accepted string is json.loads-parseable.
+_JSON_STRING = ('"([^"\\\\' + "".join(chr(c) for c in range(0x20))
+                + ']|\\\\(["\\\\/bfnrt]|u[0-9a-fA-F]{4}))*"')
+_JSON_INT = r"-?(0|[1-9]\d*)"
+_JSON_NUMBER = _JSON_INT + r"(\.\d+)?([eE][+-]?\d+)?"
+_JSON_BOOL = r"(true|false)"
+_JSON_NULL = r"null"
+
+
+def _regex_literal(s: str) -> str:
+    out = []
+    for ch in s:
+        if ch in "\\.[](){}|*+?^$-":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _json_value_regex(schema, depth: int) -> str:
+    if depth > 8:
+        raise RegexError("guided_json: schema nesting too deep (>8)")
+    if not isinstance(schema, dict):
+        raise RegexError("guided_json: each schema node must be an object")
+    if "enum" in schema:
+        import json as _json
+        # enum values render as their canonical JSON literal
+        return ("(" + "|".join(
+            _regex_literal(_json.dumps(v)) for v in schema["enum"]) + ")")
+    t = schema.get("type")
+    if t == "string":
+        pat = schema.get("pattern")
+        if pat is not None:
+            # user pattern constrains the string CONTENT (full-match
+            # semantics), grouped so alternations cannot escape the
+            # quotes. JSON validity of the content (no raw controls /
+            # stray backslashes) is the pattern author's contract.
+            return '"(' + pat + ')"'
+        return _JSON_STRING
+    if t == "integer":
+        return _JSON_INT
+    if t == "number":
+        return _JSON_NUMBER
+    if t == "boolean":
+        return _JSON_BOOL
+    if t == "null":
+        return _JSON_NULL
+    if t == "array":
+        item = _json_value_regex(schema.get("items", {"type": "string"}),
+                                 depth + 1)
+        lo = schema.get("minItems")
+        hi = schema.get("maxItems")
+        if lo is None and hi is None:
+            body = f"({item}(, {item})*)?"
+        else:
+            lo = int(lo or 0)
+            if hi is None:
+                # unbounded {m,} is not in the regex subset: emulate
+                # with m-1 required copies then *
+                tail = (f"(, {item})" * max(lo - 1, 0)
+                        + f"(, {item})*")
             else:
-                out.append(ch)
-        escaped.append("".join(out))
-    return "(" + "|".join(escaped) + ")"
+                hi = int(hi)
+                if hi < lo or hi < 0:
+                    raise RegexError("guided_json: bad min/maxItems")
+                if hi == 0:
+                    return r"\[\]"
+                tail = (f"(, {item})" * max(lo - 1, 0)
+                        + f"(, {item})?" * (hi - max(lo, 1)))
+            body = f"{item}{tail}"
+            if lo == 0:
+                body = f"({body})?"
+        return r"\[" + body + r"\]"
+    if t == "object" or "properties" in schema:
+        props = schema.get("properties", {})
+        if not props:
+            raise RegexError(
+                "guided_json: object schemas need non-empty 'properties' "
+                "(a regex DFA cannot express arbitrary-depth free-form "
+                "JSON)")
+        import json as _json
+        parts = []
+        for name, sub in props.items():   # declaration order
+            # json.dumps both quotes AND escapes the name (controls,
+            # quotes, backslashes), then the result is regex-escaped —
+            # same recipe as enum values
+            parts.append(_regex_literal(_json.dumps(name)) + ": "
+                         + _json_value_regex(sub, depth + 1))
+        return r"\{" + ", ".join(parts) + r"\}"
+    raise RegexError(f"guided_json: unsupported schema node {schema!r}")
+
+
+def json_schema_regex(schema) -> str:
+    """vLLM's ``guided_json``: compile a JSON-schema subset to a regex
+    for the byte-DFA engine. Output is CANONICAL JSON — every declared
+    property, in declaration order, separated by ", " with ": " after
+    keys and no other insignificant whitespace (DFA-friendly and
+    deterministic; the 'required' list is ignored because every
+    property is always emitted). Supported nodes: object/properties,
+    array (items, minItems/maxItems), string (optional content
+    'pattern'), integer, number, boolean, null, enum. Free-form
+    objects (no 'properties') are rejected — a finite automaton cannot
+    express unbounded-depth JSON."""
+    import json as _json
+    if isinstance(schema, str):
+        schema = _json.loads(schema)
+    return _json_value_regex(schema, 0)
 
 
 # --------------------------------------------------- token-level lifting

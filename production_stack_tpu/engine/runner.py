@@ -661,13 +661,16 @@ class ModelRunner:
                 v = cache.v[:, blk, :, off, :].transpose(1, 0, 2, 3)
                 if cache.quantized:
                     # tiers store full-precision chunks (portable across
-                    # kv_dtype configs of the same fingerprint namespace)
+                    # kv_dtype configs of the same fingerprint
+                    # namespace). Multiply in f32 — the same precision
+                    # the attention kernels dequantize at — THEN round
+                    # to the bf16 wire dtype
                     ks = cache.ks[:, blk, :, off].transpose(1, 0, 2)
                     vs = cache.vs[:, blk, :, off].transpose(1, 0, 2)
-                    k = k.astype(jnp.bfloat16) * ks[..., None].astype(
-                        jnp.bfloat16)
-                    v = v.astype(jnp.bfloat16) * vs[..., None].astype(
-                        jnp.bfloat16)
+                    k = (k.astype(jnp.float32)
+                         * ks[..., None]).astype(jnp.bfloat16)
+                    v = (v.astype(jnp.float32)
+                         * vs[..., None]).astype(jnp.bfloat16)
                 return k, v
 
             fn = self._extract_fns[size] = jax.jit(_impl)

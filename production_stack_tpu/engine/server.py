@@ -75,6 +75,10 @@ def _guided_pattern(req) -> Optional[str]:
     if getattr(req, "guided_choice", None):
         from production_stack_tpu.engine import guided
         return guided.choice_regex(req.guided_choice)
+    if getattr(req, "guided_json", None) is not None:
+        from production_stack_tpu.engine import guided
+        # schema errors surface as RegexError -> 400 at validation
+        return guided.json_schema_regex(req.guided_json)
     return None
 
 

@@ -46,6 +46,7 @@ class CompletionRequest(OpenAIBase):
     # vLLM guided-decoding extensions (engine/guided.py)
     guided_regex: Optional[str] = None
     guided_choice: Optional[List[str]] = None
+    guided_json: Optional[Union[str, dict]] = None
     user: Optional[str] = None
 
 
@@ -78,6 +79,7 @@ class ChatCompletionRequest(OpenAIBase):
     # vLLM guided-decoding extensions (engine/guided.py)
     guided_regex: Optional[str] = None
     guided_choice: Optional[List[str]] = None
+    guided_json: Optional[Union[str, dict]] = None
     user: Optional[str] = None
 
 
