@@ -178,6 +178,19 @@ class LLMEngine:
         self._slot_top_k = np.zeros((B,), np.int32)
         self._slot_adapter = np.zeros((B,), np.int32)
         self._slot_seed = np.zeros((B,), np.int32)
+        # OpenAI/vLLM logit-shaping mirrors (engine/sampler.py); all
+        # default-inert so unshaped batches compile the ordinary
+        # executables
+        from production_stack_tpu.engine.sampler import LOGIT_BIAS_K
+        self._slot_presence = np.zeros((B,), np.float32)
+        self._slot_frequency = np.zeros((B,), np.float32)
+        self._slot_repetition = np.ones((B,), np.float32)
+        self._slot_min_p = np.zeros((B,), np.float32)
+        self._slot_min_tokens = np.zeros((B,), np.int32)
+        self._slot_prompt_len = np.zeros((B,), np.int32)
+        self._slot_bias_ids = np.full((B, LOGIT_BIAS_K), -1, np.int32)
+        self._slot_bias_vals = np.zeros((B, LOGIT_BIAS_K), np.float32)
+        self.runner._eos_id = int(self.tokenizer.eos_token_id or 0)
         # guided decoding: per-slot DFA-state host mirror (grammar row
         # indices are rebuilt per dispatch from the sequences)
         self._slot_gstate = np.zeros((B,), np.int32)
@@ -244,8 +257,26 @@ class LLMEngine:
                     seq_id: Optional[str] = None,
                     model: Optional[str] = None) -> str:
         seq_id = seq_id or f"seq-{next(self._id_counter)}"
+        options = options or SamplingOptions()
+        if options.logit_bias:
+            # validate at the ENGINE boundary (callers' thread): a bad
+            # map must 400 here, not poison step() with an
+            # IndexError/OverflowError the engine loop would retry
+            # forever
+            from production_stack_tpu.engine.sampler import LOGIT_BIAS_K
+            if len(options.logit_bias) > LOGIT_BIAS_K:
+                raise ValueError(
+                    f"logit_bias supports at most {LOGIT_BIAS_K} "
+                    f"entries (got {len(options.logit_bias)})")
+            V = self.model_cfg.vocab_size
+            bad = [t for t in options.logit_bias
+                   if not 0 <= int(t) < V]
+            if bad:
+                raise ValueError(
+                    f"logit_bias token id {bad[0]} out of range for "
+                    f"vocab size {V}")
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
-                       options=options or SamplingOptions(),
+                       options=options,
                        adapter_id=self.resolve_model(model),
                        detok=DetokenizeStream(self.tokenizer))
         if seq.options.guided_regex:
@@ -396,12 +427,20 @@ class LLMEngine:
                     if w.seq.grammar is not None:
                         gids[w.seq.slot] = gid_map[w.seq.options.guided_regex]
                         gstates[w.seq.slot] = w.seq.fsm_state
+            penalized = any(w.seq.options.shaped for w in group
+                            if w.is_last)
+            if penalized:
+                # the group's last-chunk rows sample their first token
+                # with shaped logits; mirrors are current (all in-flight
+                # windows were drained before prefill)
+                self.runner.set_penalty_state(*self._penalty_arrays())
             ids_dev, lps_dev = self.runner.prefill(tokens, starts, lengths,
                                                    self._dev_sampling,
                                                    kv_len,
                                                    guide_table=gtable,
                                                    guide_ids=gids,
-                                                   guide_states=gstates)
+                                                   guide_states=gstates,
+                                                   penalized=penalized)
             ids = lps = None
             for w in group:
                 self.scheduler.on_prefill_done(w)
@@ -443,8 +482,37 @@ class LLMEngine:
                 top_p=jnp.asarray(self._slot_top_p),
                 top_k=jnp.asarray(self._slot_top_k),
                 adapter=jnp.asarray(self._slot_adapter),
-                seed=jnp.asarray(self._slot_seed))
+                seed=jnp.asarray(self._slot_seed),
+                presence=jnp.asarray(self._slot_presence),
+                frequency=jnp.asarray(self._slot_frequency),
+                repetition=jnp.asarray(self._slot_repetition),
+                min_p=jnp.asarray(self._slot_min_p),
+                min_tokens=jnp.asarray(self._slot_min_tokens),
+                prompt_len=jnp.asarray(self._slot_prompt_len),
+                bias_ids=jnp.asarray(self._slot_bias_ids),
+                bias_vals=jnp.asarray(self._slot_bias_vals))
             self._sampling_dirty = False
+
+    def _penalty_arrays(self):
+        """[B, V] generated-token counts + prompt membership for every
+        live slot, rebuilt from the sequences (composition changes
+        only; within windows the device carries counts itself)."""
+        B, V = self.cfg.max_num_seqs, self.model_cfg.vocab_size
+        counts = np.zeros((B, V), np.int32)
+        seen = np.zeros((B, V), bool)
+        live = list(self.scheduler.running.values()) + list(
+            self.scheduler._prefilling.values())
+        for s in live:
+            if s.slot < 0:
+                continue
+            if s.output_tokens:
+                out = np.asarray(s.output_tokens, np.int64)
+                np.add.at(counts[s.slot], np.clip(out, 0, V - 1), 1)
+            if s.prompt_tokens:
+                pt = np.clip(np.asarray(s.prompt_tokens, np.int64),
+                             0, V - 1)
+                seen[s.slot][pt] = True
+        return counts, seen
 
     def _ensure_guided_table(self):
         """(Re)build the stacked guided-decoding table for the distinct
@@ -523,10 +591,14 @@ class LLMEngine:
             for s in decode_seqs:
                 if s.grammar is not None:
                     gids[s.slot] = gid_map[s.options.guided_regex]
-        # n-gram speculation: greedy-only (argmax verify is exact) and
-        # never with guided rows (drafts would bypass the DFA mask)
+        # penalized windows carry [B, V] token counts and shape logits
+        # before sampling; unshaped batches keep the ordinary executables
+        penalized = any(s.options.shaped for s in decode_seqs)
+        # n-gram speculation: greedy-only (argmax verify is exact),
+        # never with guided rows (drafts would bypass the DFA mask) or
+        # shaped rows (draft verification ignores the adjusted logits)
         spec = (self.cfg.speculative_ngram_tokens
-                if greedy and gtable is None else 0)
+                if greedy and gtable is None and not penalized else 0)
         kv_len = self.cfg.kv_bucket_for(
             min(max_pos + (W + ahead) * (spec + 1) + 1,
                 self.cfg.max_model_len))
@@ -546,19 +618,26 @@ class LLMEngine:
                 row = s.prompt_tokens + s.output_tokens
                 hist[s.slot, :len(row)] = row
             self._hist_dirty = False
+        if penalized and self._decode_dirty:
+            # counts/prompt-membership upload rides the same trigger as
+            # the decode carry: any composition change. Within windows
+            # the device updates counts itself (runner._decode_impl)
+            self.runner.set_penalty_state(*self._penalty_arrays())
         if self._decode_dirty or hist is not None:
             self.runner.set_decode_state(self._slot_token, self._slot_pos,
                                          self._slot_gstate, hist)
             self._decode_dirty = False
         seeded = any(s.options.seed is not None for s in decode_seqs)
-        # the API-default sampling shape (top_p=1, top_k=0) needs no
-        # [B, V] sort — a separate executable skips it (sampler.py)
+        # the API-default sampling shape (top_p=1, top_k=0, min_p=0)
+        # needs no [B, V] sort — a separate executable skips it
+        # (sampler.py); min_p truncation lives on the sorted path
         plain = all(s.options.top_p >= 1.0 and not s.options.top_k
+                    and not s.options.min_p
                     for s in decode_seqs)
         ids_dev, lps_dev, counts_dev = self.runner.decode(
             self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
             seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec,
-            plain=plain)
+            plain=plain, penalized=penalized)
         self._inflight.append((ids_dev, lps_dev, counts_dev, W,
                                list(decode_seqs), time.monotonic()))
         return True
@@ -728,16 +807,40 @@ class LLMEngine:
         # into a nonzero int32: 0 stays the "unseeded" sentinel only for
         # requests that sent no seed at all
         seed = 0 if opt.seed is None else (opt.seed % 0x7FFFFFFE) + 1
+        plen = len(seq.prompt_tokens)
+        bias_ids = np.full((self._slot_bias_ids.shape[1],), -1, np.int32)
+        bias_vals = np.zeros_like(self._slot_bias_vals[slot])
+        if opt.logit_bias:
+            for i, (tid, val) in enumerate(sorted(opt.logit_bias.items())):
+                bias_ids[i] = tid
+                bias_vals[i] = val
         if (self._slot_temp[slot] != opt.temperature
                 or self._slot_top_p[slot] != opt.top_p
                 or self._slot_top_k[slot] != opt.top_k
                 or self._slot_adapter[slot] != seq.adapter_id
-                or self._slot_seed[slot] != seed):
+                or self._slot_seed[slot] != seed
+                or self._slot_presence[slot] != opt.presence_penalty
+                or self._slot_frequency[slot] != opt.frequency_penalty
+                or self._slot_repetition[slot] != opt.repetition_penalty
+                or self._slot_min_p[slot] != opt.min_p
+                or self._slot_min_tokens[slot] != opt.min_tokens
+                or self._slot_prompt_len[slot] != plen
+                or not np.array_equal(self._slot_bias_ids[slot], bias_ids)
+                or not np.array_equal(self._slot_bias_vals[slot],
+                                      bias_vals)):
             self._slot_temp[slot] = opt.temperature
             self._slot_top_p[slot] = opt.top_p
             self._slot_top_k[slot] = opt.top_k
             self._slot_adapter[slot] = seq.adapter_id
             self._slot_seed[slot] = seed
+            self._slot_presence[slot] = opt.presence_penalty
+            self._slot_frequency[slot] = opt.frequency_penalty
+            self._slot_repetition[slot] = opt.repetition_penalty
+            self._slot_min_p[slot] = opt.min_p
+            self._slot_min_tokens[slot] = opt.min_tokens
+            self._slot_prompt_len[slot] = plen
+            self._slot_bias_ids[slot] = bias_ids
+            self._slot_bias_vals[slot] = bias_vals
             self._sampling_dirty = True
 
     def _park_slot(self, slot: int) -> None:
@@ -748,6 +851,19 @@ class LLMEngine:
             self._slot_token[slot] = 0
             self._slot_pos[slot] = self.cfg.max_model_len
             self._slot_gstate[slot] = 0
+            if (self._slot_presence[slot] or self._slot_frequency[slot]
+                    or self._slot_repetition[slot] != 1.0
+                    or self._slot_min_tokens[slot]
+                    or self._slot_min_p[slot]
+                    or self._slot_bias_ids[slot, 0] >= 0):
+                self._slot_presence[slot] = 0.0
+                self._slot_frequency[slot] = 0.0
+                self._slot_repetition[slot] = 1.0
+                self._slot_min_p[slot] = 0.0
+                self._slot_min_tokens[slot] = 0
+                self._slot_bias_ids[slot, :] = -1
+                self._slot_bias_vals[slot, :] = 0.0
+                self._sampling_dirty = True
             self._decode_dirty = True
             self._hist_dirty = True
 

@@ -35,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 from production_stack_tpu.engine.config import EngineConfig
-from production_stack_tpu.engine.sampler import SamplingParams, sample
+from production_stack_tpu.engine.sampler import (SamplingParams,
+                                                 adjust_logits, sample)
 from production_stack_tpu.models.config import ModelConfig
 from production_stack_tpu.models.kv import KVCache, make_cache
 from production_stack_tpu.models import llama
@@ -157,6 +158,15 @@ class ModelRunner:
         self._dec_tokens = None
         self._dec_pos = None
         self._dec_gstate = None   # guided-decoding DFA states [B]
+        # penalty state (uploaded only when some live row uses OpenAI
+        # logit shaping — engine._dispatch_decode): generated-token
+        # counts [B, V] ride the decode carry; prompt membership [B, V]
+        # is per-window constant
+        self._dec_counts = None
+        self._dec_prompt_seen = None
+        # EOS id for min_tokens masking; the engine sets it from its
+        # tokenizer after construction (static per executable)
+        self._eos_id = 0
 
         # executable caches: decode keyed (steps, kv_len, greedy, seeded),
         # prefill keyed (chunk bucket, kv bucket)
@@ -179,9 +189,11 @@ class ModelRunner:
                      positions: jnp.ndarray, sampling: SamplingParams,
                      key: jax.Array, guide_next: jnp.ndarray,
                      guide_id: jnp.ndarray, guide_state: jnp.ndarray,
+                     out_counts: jnp.ndarray, prompt_seen: jnp.ndarray,
                      *, steps: int, kv_len: int,
                      greedy: bool, seeded: bool = False,
-                     guided: bool = False, plain: bool = False):
+                     guided: bool = False, plain: bool = False,
+                     penalized: bool = False, eos_id: int = 0):
         """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
         tokens', positions', cache').
 
@@ -202,9 +214,10 @@ class ModelRunner:
         computed rather than forking the executable cache.
         """
         S = self.engine_cfg.max_model_len
+        B = tokens.shape[0]
 
         def body(carry, i):
-            cache, toks, pos, gstate = carry
+            cache, toks, pos, gstate, counts = carry
             logits, cache = llama.forward(
                 params, self.model_cfg, toks[:, None], pos[:, None],
                 cache, block_tables=tables,
@@ -214,6 +227,13 @@ class ModelRunner:
                 lora_scaling=self._lora_scaling,
                 token_valid=(pos < S)[:, None])
             last = logits[:, 0, :]
+            if penalized:
+                # OpenAI logit shaping (sampler.adjust_logits): counts
+                # of generated tokens ride the scan carry; the token
+                # being sampled is output index pos + 1 - prompt_len
+                last = adjust_logits(last, sampling, counts, prompt_seen,
+                                     pos + 1 - sampling.prompt_len,
+                                     eos_id)
             if guided:
                 # one [B, V] gather per step: each guided row's next-state
                 # table masks forbidden tokens (engine/guided.py)
@@ -235,15 +255,18 @@ class ModelRunner:
                                           axis=-1)[:, 0]
                 gstate = jnp.where(guide_id > 0,
                                    jnp.maximum(adv, 0), gstate)
+            if penalized:
+                counts = counts.at[jnp.arange(B), ids].add(1)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(last, axis=-1), ids[:, None],
                 axis=-1)[:, 0]
-            return (cache, ids, pos + 1, gstate), (ids, lp)
+            return (cache, ids, pos + 1, gstate, counts), (ids, lp)
 
-        (cache, toks, pos, gstate), (ids, lps) = jax.lax.scan(
-            body, (cache, tokens, positions, guide_state),
+        (cache, toks, pos, gstate, counts), (ids, lps) = jax.lax.scan(
+            body, (cache, tokens, positions, guide_state, out_counts),
             jnp.arange(steps))
-        return ids.T, lps.T, toks, pos, gstate, cache  # ids/lps [B, steps]
+        return (ids.T, lps.T, toks, pos, gstate, counts,
+                cache)  # ids/lps [B, steps]
 
     def _decode_spec_impl(self, params, cache: KVCache,
                           tables: jnp.ndarray,
@@ -328,8 +351,10 @@ class ModelRunner:
                       starts: jnp.ndarray, lengths: jnp.ndarray,
                       sampling: SamplingParams, key: jax.Array,
                       guide_next: jnp.ndarray, guide_id: jnp.ndarray,
-                      guide_state: jnp.ndarray, *,
-                      kv_len: int, guided: bool = False):
+                      guide_state: jnp.ndarray,
+                      out_counts: jnp.ndarray, prompt_seen: jnp.ndarray,
+                      *, kv_len: int, guided: bool = False,
+                      penalized: bool = False, eos_id: int = 0):
         """Full-batch chunk prefill. tokens [B, Tb], starts/lengths [B].
 
         Every row writes its chunk at its own offset through its block
@@ -359,6 +384,12 @@ class ModelRunner:
         last = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
         )[:, 0, :]
+        if penalized:
+            # first sampled token: counts cover any already-emitted
+            # output (preemption-resume rows), prompt_seen the prompt
+            last = adjust_logits(
+                last, sampling, out_counts, prompt_seen,
+                starts + lengths - sampling.prompt_len, eos_id)
         if guided:
             # first output token: mask from each guided row's start state
             nxt_row = guide_next[guide_id, guide_state, :]
@@ -413,10 +444,19 @@ class ModelRunner:
         self._dec_hist = (None if history is None
                           else jnp.asarray(history, jnp.int32))
 
+    def set_penalty_state(self, out_counts, prompt_seen) -> None:
+        """Upload OpenAI logit-shaping state: generated-token counts
+        [B, V] int32 (rides the decode carry like tokens/positions) and
+        prompt membership [B, V] bool. Only called when some live row
+        uses penalties/min_tokens/logit_bias."""
+        self._dec_counts = jnp.asarray(out_counts, jnp.int32)
+        self._dec_prompt_seen = jnp.asarray(prompt_seen, bool)
+
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
                seeded: bool = False, guide_table=None, guide_ids=None,
-               spec: int = 0, plain: bool = False):
+               spec: int = 0, plain: bool = False,
+               penalized: bool = False):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
         (ids, logprobs, counts): without speculation ids/logprobs are
@@ -454,31 +494,44 @@ class ModelRunner:
         plain = plain and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
-        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain)
+        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain,
+                     penalized)
         B = self.engine_cfg.max_num_seqs
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = jnp.zeros((B,), jnp.int32)
+        if penalized:
+            counts, seen = self._dec_counts, self._dec_prompt_seen
+        else:
+            # dummy carries: the unpenalized executable never reads or
+            # writes them, so keep them tiny
+            counts = jnp.zeros((B, 1), jnp.int32)
+            seen = jnp.zeros((B, 1), bool)
         args = (self.params, self.cache, self._dev_tables(),
                 self._dec_tokens, self._dec_pos,
                 sampling, self._next_key(), guide_table,
-                jnp.asarray(guide_ids, jnp.int32), self._dec_gstate)
+                jnp.asarray(guide_ids, jnp.int32), self._dec_gstate,
+                counts, seen)
 
         def make_decode():
             logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
-                        "%s%s)", steps, kv_len, greedy,
+                        "%s%s%s)", steps, kv_len, greedy,
                         " seeded" if seeded else "",
-                        " guided" if guided else "")
+                        " guided" if guided else "",
+                        " penalized" if penalized else "")
             return jax.jit(
                 partial(self._decode_impl, steps=steps, kv_len=kv_len,
                         greedy=greedy, seeded=seeded, guided=guided,
-                        plain=plain),
+                        plain=plain, penalized=penalized,
+                        eos_id=self._eos_id),
                 donate_argnums=(1,))
 
         fn = self._compile_with_fallback(self._decode_fns, cache_key,
                                          make_decode, args)
         (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
-         self.cache) = fn(*args)
+         counts_out, self.cache) = fn(*args)
+        if penalized:
+            self._dec_counts = counts_out
         return ids, lps, None
 
     def _compile_with_fallback(self, cache: dict, key, make_fn, args):
@@ -514,7 +567,7 @@ class ModelRunner:
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int, guide_table=None, guide_ids=None,
-                guide_states=None):
+                guide_states=None, penalized: bool = False):
         """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
         int32 np; starts/lengths [B]. Returns device (ids, logprobs),
         each [B].
@@ -536,23 +589,30 @@ class ModelRunner:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = np.zeros((B,), np.int32)
             guide_states = np.zeros((B,), np.int32)
+        if penalized:
+            counts, seen = self._dec_counts, self._dec_prompt_seen
+        else:
+            counts = jnp.zeros((B, 1), jnp.int32)
+            seen = jnp.zeros((B, 1), bool)
         args = (self.params, self.cache, self._dev_tables(),
                 jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(starts, jnp.int32),
                 jnp.asarray(lengths, jnp.int32), sampling, self._next_key(),
                 guide_table, jnp.asarray(guide_ids, jnp.int32),
-                jnp.asarray(guide_states, jnp.int32))
+                jnp.asarray(guide_states, jnp.int32), counts, seen)
         gshape = guide_table.shape if guided else None
 
         def make_prefill():
-            logger.info("compiling prefill (chunk=%d kv=%d%s)", Tb,
-                        kv_len, " guided" if guided else "")
+            logger.info("compiling prefill (chunk=%d kv=%d%s%s)", Tb,
+                        kv_len, " guided" if guided else "",
+                        " penalized" if penalized else "")
             return jax.jit(partial(self._prefill_impl, kv_len=kv_len,
-                                   guided=guided),
+                                   guided=guided, penalized=penalized,
+                                   eos_id=self._eos_id),
                            donate_argnums=(1,))
 
         fn = self._compile_with_fallback(
-            self._prefill_fns, (Tb, kv_len, guided, gshape),
+            self._prefill_fns, (Tb, kv_len, guided, gshape, penalized),
             make_prefill, args)
         ids, lps, self.cache = fn(*args)
         return ids, lps

@@ -15,6 +15,11 @@ import jax.numpy as jnp
 _EPS = 1e-6
 _NEG_INF = -1e30
 
+# logit_bias entries accepted per request (OpenAI caps the map at 300;
+# a fixed small K keeps the device arrays tiny and the executable
+# static — the server rejects larger maps with a 400)
+LOGIT_BIAS_K = 32
+
 
 class SamplingParams(NamedTuple):
     """Per-sequence device-side request state, shape [B] each.
@@ -30,17 +35,72 @@ class SamplingParams(NamedTuple):
     top_k: jnp.ndarray        # int32; 0 => disabled
     adapter: jnp.ndarray      # int32 adapter id; 0 => base model
     seed: jnp.ndarray         # int32; 0 => unseeded (engine key stream)
+    # OpenAI/vLLM logit-shaping params (adjust_logits; all inert at
+    # their defaults, and the PENALIZED decode executable only compiles
+    # when some live row departs from them — engine._dispatch_decode)
+    presence: jnp.ndarray     # fp32; 0 => off (OpenAI presence_penalty)
+    frequency: jnp.ndarray    # fp32; 0 => off (OpenAI frequency_penalty)
+    repetition: jnp.ndarray   # fp32; 1 => off (HF/vLLM repetition_penalty)
+    min_p: jnp.ndarray        # fp32; 0 => off (vLLM min_p truncation)
+    min_tokens: jnp.ndarray   # int32; EOS forbidden below this many out
+    prompt_len: jnp.ndarray   # int32; output count = position+1 - this
+    bias_ids: jnp.ndarray     # int32 [B, K]; -1 => unused slot
+    bias_vals: jnp.ndarray    # fp32 [B, K] (OpenAI logit_bias)
 
     @staticmethod
     def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0, adapter=0,
-               seed=0):
+               seed=0, presence=0.0, frequency=0.0, repetition=1.0,
+               min_p=0.0, min_tokens=0, prompt_len=0, bias_k=LOGIT_BIAS_K):
         return SamplingParams(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
             top_k=jnp.full((batch,), top_k, jnp.int32),
             adapter=jnp.full((batch,), adapter, jnp.int32),
             seed=jnp.full((batch,), seed, jnp.int32),
+            presence=jnp.full((batch,), presence, jnp.float32),
+            frequency=jnp.full((batch,), frequency, jnp.float32),
+            repetition=jnp.full((batch,), repetition, jnp.float32),
+            min_p=jnp.full((batch,), min_p, jnp.float32),
+            min_tokens=jnp.full((batch,), min_tokens, jnp.int32),
+            prompt_len=jnp.full((batch,), prompt_len, jnp.int32),
+            bias_ids=jnp.full((batch, bias_k), -1, jnp.int32),
+            bias_vals=jnp.zeros((batch, bias_k), jnp.float32),
         )
+
+
+def adjust_logits(logits: jnp.ndarray, params: SamplingParams,
+                  out_counts: jnp.ndarray, prompt_seen: jnp.ndarray,
+                  out_len: jnp.ndarray, eos_id: int) -> jnp.ndarray:
+    """OpenAI/vLLM logit shaping, fused ahead of sampling.
+
+    logits fp32 [B, V]; out_counts int32 [B, V] = per-row counts of
+    GENERATED tokens (device-carried, engine/runner.py); prompt_seen
+    bool [B, V] marks tokens present in the prompt; out_len [B] =
+    tokens generated so far (the one being sampled is out index
+    out_len). Semantics match vLLM:
+
+    - logit_bias: additive, from the request's (id, value) pairs;
+    - repetition_penalty: divide positive / multiply negative logits of
+      every token seen in prompt OR output (HF convention);
+    - presence_penalty: subtract once for any generated token;
+    - frequency_penalty: subtract per occurrence generated;
+    - min_tokens: EOS forbidden while out_len < min_tokens.
+    """
+    B, V = logits.shape
+    valid = params.bias_ids >= 0
+    idx = jnp.maximum(params.bias_ids, 0)
+    logits = logits.at[jnp.arange(B)[:, None], idx].add(
+        jnp.where(valid, params.bias_vals, 0.0))
+    seen_out = out_counts > 0
+    rep = params.repetition[:, None]
+    seen_any = seen_out | prompt_seen
+    penal = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(seen_any, penal, logits)
+    logits = logits - params.presence[:, None] * seen_out
+    logits = logits - params.frequency[:, None] * out_counts
+    block_eos = (out_len < params.min_tokens)[:, None]
+    eos_col = (jnp.arange(V) == eos_id)[None, :]
+    return jnp.where(block_eos & eos_col, _NEG_INF, logits)
 
 
 def sample(logits: jnp.ndarray, params: SamplingParams,
@@ -96,6 +156,17 @@ def sample(logits: jnp.ndarray, params: SamplingParams,
 
         threshold = jnp.maximum(kth, p_thresh)
         masked = jnp.where(scaled >= threshold, scaled, _NEG_INF)
+
+        # min_p (vLLM): drop tokens whose prob < min_p * max prob.
+        # Softmax is monotone, so prob >= min_p * pmax is exactly
+        # scaled >= max_logit + log(min_p) — reuse the sort's top
+        # instead of materializing a second [B, V] softmax; log(0) is
+        # -inf, which keeps every token for min_p == 0 rows. The
+        # engine keeps a batch on the plain path only when every live
+        # row has min_p == 0
+        minp_thresh = sorted_logits[:, :1] + jnp.log(
+            jnp.clip(params.min_p[:, None], 0.0, 1.0))
+        masked = jnp.where(scaled >= minp_thresh, masked, _NEG_INF)
 
     gumbel = jax.random.gumbel(key, (B, V), jnp.float32)
     if positions is not None:

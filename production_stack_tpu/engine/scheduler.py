@@ -45,6 +45,22 @@ class SamplingOptions:
     # constrain generation to this regex (engine/guided.py); the server
     # maps guided_choice onto it
     guided_regex: Optional[str] = None
+    # OpenAI/vLLM logit shaping (engine/sampler.adjust_logits); all
+    # inert at their defaults — the penalized executable only compiles
+    # when a live row departs from them
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    min_p: float = 0.0
+    min_tokens: int = 0
+    logit_bias: Optional[Dict[int, float]] = None
+
+    @property
+    def shaped(self) -> bool:
+        """True when this request needs the penalized executable."""
+        return bool(self.presence_penalty or self.frequency_penalty
+                    or self.repetition_penalty != 1.0 or self.min_tokens
+                    or self.logit_bias)
 
 
 @dataclass

@@ -39,6 +39,24 @@ def _error(status: int, message: str) -> web.Response:
     return web.json_response(body.model_dump(), status=status)
 
 
+def _logit_bias(req) -> Optional[dict]:
+    """OpenAI logit_bias {token-id-string: bias} -> {int: float},
+    bounded by the device-side slot width (sampler.LOGIT_BIAS_K)."""
+    raw = getattr(req, "logit_bias", None)
+    if not raw:
+        return None
+    from production_stack_tpu.engine.sampler import LOGIT_BIAS_K
+    if len(raw) > LOGIT_BIAS_K:
+        raise ValueError(
+            f"logit_bias supports at most {LOGIT_BIAS_K} entries "
+            f"(got {len(raw)})")
+    try:
+        return {int(k): float(v) for k, v in raw.items()}
+    except (TypeError, ValueError):
+        raise ValueError("logit_bias keys must be token ids and values "
+                         "numbers")
+
+
 def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
     stop = req.stop if isinstance(req.stop, list) else (
         [req.stop] if req.stop else [])
@@ -52,6 +70,12 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         ignore_eos=req.ignore_eos,
         seed=req.seed,
         guided_regex=_guided_pattern(req),
+        presence_penalty=req.presence_penalty or 0.0,
+        frequency_penalty=req.frequency_penalty or 0.0,
+        repetition_penalty=req.repetition_penalty or 1.0,
+        min_p=req.min_p or 0.0,
+        min_tokens=req.min_tokens or 0,
+        logit_bias=_logit_bias(req),
     )
 
 
@@ -79,6 +103,22 @@ def _guided_pattern(req) -> Optional[str]:
         from production_stack_tpu.engine import guided
         # schema errors surface as RegexError -> 400 at validation
         return guided.json_schema_regex(req.guided_json)
+    rf = getattr(req, "response_format", None)
+    if rf:
+        kind = rf.get("type")
+        if kind == "json_schema":
+            from production_stack_tpu.engine import guided
+            spec = rf.get("json_schema") or {}
+            schema = spec.get("schema", spec)   # OpenAI nests .schema
+            return guided.json_schema_regex(schema)
+        if kind == "json_object":
+            raise ValueError(
+                "response_format json_object (free-form JSON) is not "
+                "supported: a DFA cannot express unbounded-depth JSON. "
+                "Use response_format json_schema or guided_json with a "
+                "schema.")
+        if kind not in (None, "text"):
+            raise ValueError(f"unsupported response_format type {kind!r}")
     return None
 
 

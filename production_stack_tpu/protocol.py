@@ -47,6 +47,16 @@ class CompletionRequest(OpenAIBase):
     guided_regex: Optional[str] = None
     guided_choice: Optional[List[str]] = None
     guided_json: Optional[Union[str, dict]] = None
+    # OpenAI structured outputs: {"type": "json_schema", "json_schema":
+    # {...}} maps onto guided_json; "json_object" is rejected (DFA)
+    response_format: Optional[Dict[str, Any]] = None
+    # OpenAI logit shaping + vLLM extensions (engine/sampler.py)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0    # vLLM extension (HF semantics)
+    min_p: float = 0.0                 # vLLM extension
+    min_tokens: int = 0                # vLLM extension
+    logit_bias: Optional[Dict[str, float]] = None
     user: Optional[str] = None
 
 
@@ -80,6 +90,16 @@ class ChatCompletionRequest(OpenAIBase):
     guided_regex: Optional[str] = None
     guided_choice: Optional[List[str]] = None
     guided_json: Optional[Union[str, dict]] = None
+    # OpenAI structured outputs: {"type": "json_schema", "json_schema":
+    # {...}} maps onto guided_json; "json_object" is rejected (DFA)
+    response_format: Optional[Dict[str, Any]] = None
+    # OpenAI logit shaping + vLLM extensions (engine/sampler.py)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0    # vLLM extension (HF semantics)
+    min_p: float = 0.0                 # vLLM extension
+    min_tokens: int = 0                # vLLM extension
+    logit_bias: Optional[Dict[str, float]] = None
     user: Optional[str] = None
 
 
