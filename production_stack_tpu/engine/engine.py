@@ -275,6 +275,23 @@ class LLMEngine:
                 raise ValueError(
                     f"logit_bias token id {bad[0]} out of range for "
                     f"vocab size {V}")
+        # penalty ranges (vLLM/OpenAI contracts): out-of-range values
+        # would silently produce garbage logits, not errors
+        if not options.repetition_penalty > 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0 "
+                f"(got {options.repetition_penalty})")
+        for fname in ("presence_penalty", "frequency_penalty"):
+            val = getattr(options, fname)
+            if not -2.0 <= val <= 2.0:
+                raise ValueError(
+                    f"{fname} must be in [-2, 2] (got {val})")
+        if not 0.0 <= options.min_p <= 1.0:
+            raise ValueError(f"min_p must be in [0, 1] "
+                             f"(got {options.min_p})")
+        if options.min_tokens < 0:
+            raise ValueError(f"min_tokens must be >= 0 "
+                             f"(got {options.min_tokens})")
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
                        options=options,
                        adapter_id=self.resolve_model(model),
@@ -432,7 +449,11 @@ class LLMEngine:
             if penalized:
                 # the group's last-chunk rows sample their first token
                 # with shaped logits; mirrors are current (all in-flight
-                # windows were drained before prefill)
+                # windows were drained before prefill). The next decode
+                # dispatch rebuilds AGAIN on the same step — not
+                # redundant: that rebuild includes the first tokens
+                # this very prefill samples, which prefill executables
+                # don't record device-side
                 self.runner.set_penalty_state(*self._penalty_arrays())
             ids_dev, lps_dev = self.runner.prefill(tokens, starts, lengths,
                                                    self._dev_sampling,

@@ -61,7 +61,8 @@ class ModelRunner:
         # rope table must cover the cache length, not just the model's
         # native max (see ops/rope.py clamping note)
         self.rope = rope_table(engine_cfg.max_model_len, model_cfg.head_dim_,
-                               model_cfg.rope_theta)
+                               model_cfg.rope_theta,
+                               scaling=model_cfg.rope_scaling)
         if params is None:
             t0 = time.time()
             params = llama.init_params(model_cfg, jax.random.PRNGKey(

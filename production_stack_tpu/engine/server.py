@@ -70,11 +70,11 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         ignore_eos=req.ignore_eos,
         seed=req.seed,
         guided_regex=_guided_pattern(req),
-        presence_penalty=req.presence_penalty or 0.0,
-        frequency_penalty=req.frequency_penalty or 0.0,
-        repetition_penalty=req.repetition_penalty or 1.0,
-        min_p=req.min_p or 0.0,
-        min_tokens=req.min_tokens or 0,
+        presence_penalty=req.presence_penalty,
+        frequency_penalty=req.frequency_penalty,
+        repetition_penalty=req.repetition_penalty,
+        min_p=req.min_p,
+        min_tokens=req.min_tokens,
         logit_bias=_logit_bias(req),
     )
 

@@ -15,6 +15,27 @@ from typing import Any, Dict, Optional
 import jax.numpy as jnp
 
 
+def _rope_scaling_spec(rs: Optional[dict]) -> Optional[tuple]:
+    """HF config.json rope_scaling dict -> the hashable spec
+    ops/rope.rope_table takes. Unsupported kinds raise (serving with
+    the wrong frequencies would be silently wrong logits)."""
+    if not rs:
+        return None
+    kind = rs.get("rope_type") or rs.get("type")
+    if kind in ("default", None):
+        return None
+    if kind == "linear":
+        return ("linear", float(rs["factor"]))
+    if kind == "llama3":
+        return ("llama3", float(rs["factor"]),
+                float(rs.get("low_freq_factor", 1.0)),
+                float(rs.get("high_freq_factor", 4.0)),
+                float(rs.get("original_max_position_embeddings", 8192)))
+    raise ValueError(
+        f"unsupported rope_scaling type {kind!r} (supported: linear, "
+        f"llama3)")
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     name: str = "debug-llama"
@@ -30,6 +51,15 @@ class ModelConfig:
     max_position_embeddings: int = 4096
     tie_word_embeddings: bool = False
     # family variations beyond the Llama/Mistral baseline:
+    # sliding-window attention (Mistral v0.1/0.2, Gemma-2 local
+    # layers): each query attends only the last `sliding_window`
+    # positions. None = full causal.
+    sliding_window: Optional[int] = None
+    # RoPE frequency scaling as a hashable spec (ops/rope.py):
+    # ("linear", factor) or ("llama3", factor, low_freq_factor,
+    # high_freq_factor, original_max_position_embeddings). None = none.
+    # Llama-3.1/3.2 checkpoints REQUIRE the llama3 warp.
+    rope_scaling: Optional[tuple] = None
     attention_bias: bool = False    # Qwen2: biases on q/k/v projections
     activation: str = "silu"        # "silu" | "gelu_tanh" (Gemma GeGLU)
     rms_norm_offset: bool = False   # Gemma: y *= (1 + w), not w
@@ -125,6 +155,12 @@ class ModelConfig:
             rope_theta=cfg.get("rope_theta", 10000.0),
             rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
             max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+            # Mistral v0.1/0.2 ship sliding_window in config.json; null
+            # (v0.3+) and absent both mean full causal. Mixtral configs
+            # carry the field but HF/vLLM ignore it for that family.
+            sliding_window=(cfg.get("sliding_window")
+                            if is_llama_like else None),
+            rope_scaling=_rope_scaling_spec(cfg.get("rope_scaling")),
             tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
             attention_bias=cfg.get("attention_bias",
                                    is_qwen2 or is_qwen2_moe),
@@ -174,15 +210,44 @@ PRESETS: Dict[str, ModelConfig] = {
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
         rope_theta=500000.0, max_position_embeddings=8192,
     ),
+    # Llama-3.1: same shapes as 3.0 but 128k context via the llama3
+    # rope warp (ops/rope.py)
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b", vocab_size=128256, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32,
+        num_kv_heads=8, rope_theta=500000.0,
+        max_position_embeddings=131072,
+        rope_scaling=("llama3", 8.0, 1.0, 4.0, 8192),
+    ),
     "llama-3-70b": ModelConfig(
         name="llama-3-70b", vocab_size=128256, hidden_size=8192,
         intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
         rope_theta=500000.0, max_position_embeddings=8192,
     ),
+    "llama-3.1-70b": ModelConfig(
+        name="llama-3.1-70b", vocab_size=128256, hidden_size=8192,
+        intermediate_size=28672, num_layers=80, num_heads=64,
+        num_kv_heads=8, rope_theta=500000.0,
+        max_position_embeddings=131072,
+        rope_scaling=("llama3", 8.0, 1.0, 4.0, 8192),
+    ),
     "mistral-7b": ModelConfig(
         name="mistral-7b", vocab_size=32000, hidden_size=4096,
         intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
         max_position_embeddings=32768,
+    ),
+    # Mistral-7B v0.1: same shapes, 4096-token sliding-window attention
+    "mistral-7b-v0.1": ModelConfig(
+        name="mistral-7b-v0.1", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32,
+        num_kv_heads=8, max_position_embeddings=32768,
+        sliding_window=4096,
+    ),
+    # Tiny sliding-window model for CPU tests (window << context)
+    "debug-sliding": ModelConfig(
+        name="debug-sliding", vocab_size=512, hidden_size=128,
+        intermediate_size=384, num_layers=2, num_heads=4, num_kv_heads=2,
+        max_position_embeddings=512, sliding_window=64,
     ),
     "qwen2-7b": ModelConfig(
         name="qwen2-7b", vocab_size=152064, hidden_size=3584,
@@ -241,12 +306,12 @@ PRESETS["qwen2.5-7b"] = dataclasses.replace(PRESETS["qwen2-7b"],
 HF_ALIASES: Dict[str, str] = {
     "meta-llama/Meta-Llama-3-8B": "llama-3-8b",
     "meta-llama/Meta-Llama-3-8B-Instruct": "llama-3-8b",
-    "meta-llama/Llama-3.1-8B": "llama-3-8b",
-    "meta-llama/Llama-3.1-8B-Instruct": "llama-3-8b",
+    "meta-llama/Llama-3.1-8B": "llama-3.1-8b",
+    "meta-llama/Llama-3.1-8B-Instruct": "llama-3.1-8b",
     "meta-llama/Meta-Llama-3-70B": "llama-3-70b",
     "meta-llama/Meta-Llama-3-70B-Instruct": "llama-3-70b",
-    "meta-llama/Llama-3.1-70B-Instruct": "llama-3-70b",
-    "mistralai/Mistral-7B-v0.1": "mistral-7b",
+    "meta-llama/Llama-3.1-70B-Instruct": "llama-3.1-70b",
+    "mistralai/Mistral-7B-v0.1": "mistral-7b-v0.1",
     "mistralai/Mistral-7B-Instruct-v0.2": "mistral-7b",
     "mistralai/Mistral-7B-Instruct-v0.3": "mistral-7b",
     "TinyLlama/TinyLlama-1.1B-Chat-v1.0": "tinyllama-1.1b",

@@ -146,7 +146,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
         if attention_fn is not None:
             attn = attention_fn(q, k, v)
         else:
-            attn = causal_attention(q, k, v, scale=hd ** -0.5)
+            attn = causal_attention(q, k, v, scale=hd ** -0.5,
+                                    sliding_window=cfg.sliding_window)
         new_kv = None
     else:
         quant_kv = len(kv) == 4   # (k, v, ks, vs): int8 pool + scales
@@ -176,6 +177,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             interp = pallas_attention.needs_interpret()
             sc = (dict(k_scales=k_scales, v_scales=v_scales)
                   if quant_kv else {})
+            if cfg.sliding_window:
+                sc["window"] = cfg.sliding_window
             if mesh is None:
                 # short windows (decode / speculative verify) take the
                 # wide kernel: all kv heads + several pool blocks per
@@ -200,7 +203,8 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 k_att = gather_view(k_cache, block_tables, nb)
                 v_att = gather_view(v_cache, block_tables, nb)
             attn = attention_with_cache(q, k_att, v_att, positions,
-                                        scale=hd ** -0.5)
+                                        scale=hd ** -0.5,
+                                        sliding_window=cfg.sliding_window)
         new_kv = ((k_cache, v_cache, k_scales, v_scales) if quant_kv
                   else (k_cache, v_cache))
     x = x + proj(attn.reshape(B, T, nh * hd), "o")
@@ -273,7 +277,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
-                          cfg.rope_theta)
+                          cfg.rope_theta, scaling=cfg.rope_scaling)
     if use_flash is None:
         use_flash = pallas_attention.flash_enabled()
     if block_tables is None:
@@ -328,7 +332,7 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     """
     if rope is None:
         rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
-                          cfg.rope_theta)
+                          cfg.rope_theta, scaling=cfg.rope_scaling)
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = _embed(params, cfg, tokens)

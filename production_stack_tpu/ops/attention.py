@@ -41,11 +41,14 @@ def causal_attention(
     v: jnp.ndarray,
     scale: Optional[float] = None,
     segment_ids: Optional[jnp.ndarray] = None,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Full-sequence causal GQA. q [B,T,H,D]; k,v [B,T,Hkv,D] -> [B,T,H,D].
 
     Used by the training step and by single-shot (non-incremental) forward.
     Optional segment_ids [B,T] confine attention within packed segments.
+    sliding_window W (Mistral/Gemma-2 local layers) further confines a
+    query at t to keys in (t - W, t].
     """
     B, T, H, D = q.shape
     Hkv = k.shape[2]
@@ -56,6 +59,8 @@ def causal_attention(
     scores = _grouped_scores(q5, k, scale)  # [B,Hkv,G,T,S] fp32
     t = jnp.arange(T)
     mask = t[:, None] >= t[None, :]  # [T,S] causal
+    if sliding_window is not None:
+        mask = mask & (t[None, :] > t[:, None] - sliding_window)
     if segment_ids is not None:
         same = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B,T,S]
         mask = mask[None] & same
@@ -75,6 +80,7 @@ def attention_with_cache(
     v_cache: jnp.ndarray,
     q_positions: jnp.ndarray,
     scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Incremental GQA over a preallocated per-slot cache.
 
@@ -83,8 +89,9 @@ def attention_with_cache(
     v_cache     [B,S,Hkv,D]
     q_positions [B,T]       — absolute position of each query token
 
-    Query token at position p attends to cache slots s <= p. Padding query
-    rows (q_positions < 0) produce garbage rows the caller discards.
+    Query token at position p attends to cache slots s <= p (and
+    s > p - sliding_window when windowed). Padding query rows
+    (q_positions < 0) produce garbage rows the caller discards.
     """
     B, T, H, D = q.shape
     S = k_cache.shape[1]
@@ -96,6 +103,9 @@ def attention_with_cache(
     scores = _grouped_scores(q5, k_cache, scale)  # [B,Hkv,G,T,S] fp32
     s_idx = jnp.arange(S)
     mask = s_idx[None, None, :] <= q_positions[:, :, None]  # [B,T,S]
+    if sliding_window is not None:
+        mask = mask & (s_idx[None, None, :]
+                       > q_positions[:, :, None] - sliding_window)
     scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)

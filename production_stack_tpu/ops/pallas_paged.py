@@ -73,7 +73,7 @@ def paged_viable(T: int, groups: int, head_dim: int,
 def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
                   block_q: int, groups: int,
                   block_size: int, nb: int, scale: float,
-                  quant: bool = False):
+                  quant: bool = False, window: int = 0):
     """One (batch row, kv head, q block, pool block) grid step.
 
     tabs_ref   (SMEM) [B, MB]      block tables
@@ -107,8 +107,13 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
     # step is skipped entirely
     max_pos = start + qi * block_q + (block_q - 1)
     jmax = jax.lax.div(max_pos, block_size)
+    # sliding window: blocks wholly before the EARLIEST query row's
+    # window are skipped the same way (window == 0 means full causal)
+    jmin = (jax.lax.div(
+        jnp.maximum(start + qi * block_q - (window - 1), 0), block_size)
+        if window else 0)
 
-    @pl.when(j <= jmax)
+    @pl.when((j <= jmax) & (j >= jmin))
     def _compute():
         # absolute position of each q row (rows ordered t*G + g)
         row_ids = jax.lax.broadcasted_iota(
@@ -126,7 +131,10 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
             preferred_element_type=jnp.float32)               # [rows, Bs]
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1)
-        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        live = k_pos <= q_pos
+        if window:
+            live = live & (k_pos > q_pos - window)
+        s = jnp.where(live, s, _NEG_INF)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1,
                                             keepdims=True))
@@ -148,10 +156,11 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nb", "block_q", "interpret"))
+                   static_argnames=("nb", "block_q", "interpret",
+                                    "window"))
 def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
                     block_q: int = 0, interpret: bool = False,
-                    k_scales=None, v_scales=None):
+                    k_scales=None, v_scales=None, window: int = 0):
     """Causal GQA over paged K/V, positions contiguous per row.
 
     q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
@@ -191,13 +200,18 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     q5 = q.reshape(B, Tp, Hkv, G, D)
 
     def kv_index(b, h, qi, j, tabs, sts):
-        # clamp past-causal blocks onto the last visible one: the index
+        # clamp out-of-range blocks (past-causal above, before the
+        # sliding window below) onto the nearest visible one: the index
         # stops changing, so Pallas skips the DMA re-fetch and pl.when
         # skips the compute
         jmax = jax.lax.div(sts[b] + qi * block_q + (block_q - 1),
                            Bs)
         jj = jnp.minimum(jnp.minimum(j, jmax),
                          jnp.int32(MB - 1))
+        if window:
+            jmin = jax.lax.div(
+                jnp.maximum(sts[b] + qi * block_q - (window - 1), 0), Bs)
+            jj = jnp.maximum(jj, jnp.minimum(jmin, jnp.int32(MB - 1)))
         jj = jnp.maximum(jj, 0)
         return (tabs[b, jj], h, 0, 0)
 
@@ -208,7 +222,7 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     grid = (B, Hkv, nq, nb)
     kernel = functools.partial(
         _paged_kernel, block_q=block_q, groups=G, block_size=Bs,
-        nb=nb, scale=scale, quant=quant)
+        nb=nb, scale=scale, quant=quant, window=window)
     rows = block_q * G
     in_specs = [
         pl.BlockSpec((1, block_q, 1, G, D),
@@ -274,7 +288,7 @@ _BLOCKS_PER_STEP = 4
 def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                          heads_kv: int, groups: int, block_size: int,
                          ngrp: int, R: int, scale: float,
-                         quant: bool = False):
+                         quant: bool = False, window: int = 0):
     """One (batch row, block group) grid step.
 
     tabs_ref   (SMEM) [B, MB]     block tables
@@ -306,8 +320,12 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
 
     start = starts_ref[b]
     jmax = jax.lax.div(start + (T - 1), block_size)
+    # sliding window: whole groups before the earliest query's window
+    # are skipped (window == 0 means full causal)
+    jmin = (jax.lax.div(jnp.maximum(start - (window - 1), 0), block_size)
+            if window else 0)
 
-    @pl.when(jg * R <= jmax)
+    @pl.when((jg * R <= jmax) & (jg * R + (R - 1) >= jmin))
     def _compute():
         # row r (within a head) queries position start + r // G
         row_pos = start + jax.lax.broadcasted_iota(
@@ -331,6 +349,8 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                 k_pos = j * block_size + jax.lax.broadcasted_iota(
                     jnp.int32, (1, block_size), 1)
                 live = (k_pos <= row_pos) & (j <= jmax)
+                if window:
+                    live = live & (k_pos > row_pos - window)
                 s = jnp.where(live, s, _NEG_INF)
                 m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1,
                                                     keepdims=True))
@@ -352,10 +372,12 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
         out_ref[0] = out.reshape(heads_kv, rows, D).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("nb", "interpret"))
+@functools.partial(jax.jit, static_argnames=("nb", "interpret",
+                                             "window"))
 def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
                            nb: int, interpret: bool = False,
-                           k_scales=None, v_scales=None):
+                           k_scales=None, v_scales=None,
+                           window: int = 0):
     """paged_attention specialized for short query windows (T <=
     DECODE_T_MAX): same contract, same result, far fewer grid steps.
 
@@ -384,12 +406,18 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
             jmax = jax.lax.div(sts[b] + (T - 1), jnp.int32(Bs))
             jj = jnp.minimum(jnp.minimum(jg * R + i, jmax),
                              jnp.int32(MB - 1))
+            if window:
+                jmin = jax.lax.div(
+                    jnp.maximum(sts[b] - (window - 1), 0), jnp.int32(Bs))
+                jj = jnp.maximum(jj, jnp.minimum(jmin,
+                                                 jnp.int32(MB - 1)))
             return (tabs[b, jnp.maximum(jj, 0)], 0, 0, 0)
         return index
 
     kernel = functools.partial(
         _paged_decode_kernel, T=T, heads_kv=Hkv, groups=G,
-        block_size=Bs, ngrp=ngrp, R=R, scale=scale, quant=quant)
+        block_size=Bs, ngrp=ngrp, R=R, scale=scale, quant=quant,
+        window=window)
     kv_specs = [pl.BlockSpec((1, Hkv, Bs, D), kv_index(i))
                 for i in range(R)]
     in_specs = [
@@ -441,7 +469,8 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
 
 def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
                             nb: int, interpret: bool = False,
-                            k_scales=None, v_scales=None):
+                            k_scales=None, v_scales=None,
+                            window: int = 0):
     """paged_attention under a tp-only mesh: shard_map over the head
     axis (q heads and pool kv heads both shard by tp, tables/starts
     replicated) — shard-local, no collectives. Caller guarantees the
@@ -461,11 +490,12 @@ def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
     if k_scales is not None:
         def fn(qq, kk, vv, tt, ss, ks, vs):
             return base(qq, kk, vv, tt, ss, nb=nb, interpret=interpret,
-                        k_scales=ks, v_scales=vs)
+                        k_scales=ks, v_scales=vs, window=window)
         in_specs = in_specs + (P(None, "tp", None), P(None, "tp", None))
         args = args + (k_scales, v_scales)
     else:
-        fn = functools.partial(base, nb=nb, interpret=interpret)
+        fn = functools.partial(base, nb=nb, interpret=interpret,
+                               window=window)
     return shard_map(
         fn, mesh=mesh,
         in_specs=in_specs,

@@ -13,13 +13,23 @@ import jax.numpy as jnp
 
 
 @lru_cache(maxsize=32)
-def rope_table(max_positions: int, head_dim: int, theta: float = 10000.0):
+def rope_table(max_positions: int, head_dim: int, theta: float = 10000.0,
+               scaling: tuple = None):
     """Precompute (cos, sin), each [max_positions, head_dim // 2], fp32.
 
-    Cached per (max_positions, head_dim, theta). Positions >= max_positions
-    would be clamp-gathered under jit (silently wrong logits) — callers with
-    a cache longer than the model's max_position_embeddings must pass a
-    table sized to the cache length (the engine does; see engine/runner.py).
+    Cached per (max_positions, head_dim, theta, scaling). Positions >=
+    max_positions would be clamp-gathered under jit (silently wrong
+    logits) — callers with a cache longer than the model's
+    max_position_embeddings must pass a table sized to the cache length
+    (the engine does; see engine/runner.py).
+
+    scaling is a hashable spec from ModelConfig.rope_scaling_:
+    ("linear", factor) divides every frequency by `factor`;
+    ("llama3", factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings) applies Llama-3.1's
+    wavelength-dependent warp (long wavelengths scaled by 1/factor,
+    short kept, smooth ramp between — same formula as HF
+    transformers' _compute_llama3_parameters).
 
     Computed and CACHED in numpy: the lru_cache makes traced values
     poisonous — a first call under a jit trace (any rope=None path)
@@ -32,6 +42,28 @@ def rope_table(max_positions: int, head_dim: int, theta: float = 10000.0):
     inv_freq = 1.0 / (
         theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim)
     )
+    if scaling is not None:
+        kind = scaling[0]
+        if kind == "linear":
+            inv_freq = inv_freq / float(scaling[1])
+        elif kind == "llama3":
+            factor, low_f, high_f, orig = (float(scaling[1]),
+                                           float(scaling[2]),
+                                           float(scaling[3]),
+                                           float(scaling[4]))
+            low_wavelen = orig / low_f
+            high_wavelen = orig / high_f
+            wavelen = 2.0 * np.pi / inv_freq
+            smooth = (orig / wavelen - low_f) / (high_f - low_f)
+            warped = ((1.0 - smooth) * inv_freq / factor
+                      + smooth * inv_freq)
+            inv_freq = np.where(
+                wavelen > low_wavelen, inv_freq / factor,
+                np.where(wavelen < high_wavelen, inv_freq, warped))
+        else:
+            raise ValueError(
+                f"unsupported rope scaling {kind!r} (supported: "
+                f"linear, llama3)")
     pos = np.arange(max_positions, dtype=np.float32)
     angles = np.outer(pos, inv_freq)  # [P, D/2]
     return np.cos(angles), np.sin(angles)

@@ -78,7 +78,7 @@ def pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int):
     """
     n_stages = mesh.shape["pp"]
     rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
-                      cfg.rope_theta)
+                      cfg.rope_theta, scaling=cfg.rope_scaling)
 
     has_head = not cfg.tie_word_embeddings
 

@@ -85,6 +85,14 @@ def jit_train_step(mesh: Mesh, cfg: ModelConfig, params: Dict[str, Any],
     tok_sharding = data_sharding(mesh, sequence_parallel=use_sp)
     attention_fn = None
     if use_sp:
+        if cfg.sliding_window:
+            # the ring-attention override bypasses the windowed
+            # causal_attention path — training full-causal while
+            # serving windowed would silently diverge
+            raise NotImplementedError(
+                "sequence-parallel training does not implement "
+                "sliding-window attention yet; train this config "
+                "with sp=1")
         attention_fn = lambda q, k, v: ring_causal_attention(  # noqa: E731
             q, k, v, mesh, axis_name="sp")
 

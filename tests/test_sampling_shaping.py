@@ -114,17 +114,22 @@ def test_engine_logit_bias_forces_token(engine):
 
 
 def test_engine_presence_penalty_changes_repeats(engine):
-    """A strong presence+frequency penalty must break the greedy
-    repetition loop an unpenalized run settles into."""
+    """Max-contract presence+frequency penalties (2.0 each, the OpenAI
+    bound) must reduce repetition vs the unpenalized greedy run, and
+    an extreme repetition_penalty (unbounded above) forbids repeats
+    outright."""
     base = _run(engine, range(30, 60), temperature=0.0, max_tokens=24,
                 ignore_eos=True)
     pen = _run(engine, range(30, 60), temperature=0.0, max_tokens=24,
-               ignore_eos=True, presence_penalty=25.0,
-               frequency_penalty=25.0)
-    # the penalized run can never emit the same token twice: a 25-logit
-    # drop dwarfs debug-tiny's logit range
-    assert len(set(pen.output_tokens)) == len(pen.output_tokens)
+               ignore_eos=True, presence_penalty=2.0,
+               frequency_penalty=2.0)
     assert base.output_tokens != pen.output_tokens
+    assert len(set(pen.output_tokens)) >= len(set(base.output_tokens))
+    rep = _run(engine, range(30, 60), temperature=0.0, max_tokens=24,
+               ignore_eos=True, repetition_penalty=50.0)
+    # /50 on any seen positive logit dwarfs debug-tiny's logit range:
+    # no token (prompt or output) repeats
+    assert len(set(rep.output_tokens)) == len(rep.output_tokens)
 
 
 def test_engine_repetition_penalty_applies_to_prompt(engine):
@@ -144,7 +149,7 @@ def test_shaped_and_unshaped_interleave(engine):
     before = _run(engine, range(40, 70), temperature=0.0, max_tokens=10,
                   ignore_eos=True)
     _run(engine, range(40, 70), temperature=0.0, max_tokens=10,
-         ignore_eos=True, presence_penalty=9.0, min_tokens=5)
+         ignore_eos=True, presence_penalty=2.0, min_tokens=5)
     after = _run(engine, range(40, 70), temperature=0.0, max_tokens=10,
                  ignore_eos=True)
     assert before.output_tokens == after.output_tokens
@@ -216,3 +221,16 @@ def test_bad_logit_bias_rejected_at_admission(engine):
     seq = _run(engine, range(5, 15), temperature=0.0, max_tokens=3,
                ignore_eos=True)
     assert len(seq.output_tokens) == 3
+
+
+def test_penalty_ranges_rejected(engine):
+    """Out-of-contract penalty values are 400-shaped ValueErrors at
+    admission (vLLM/OpenAI ranges), never garbage logits."""
+    for kw in ({"repetition_penalty": -1.0},
+               {"repetition_penalty": 0.0},
+               {"presence_penalty": 3.0},
+               {"frequency_penalty": -2.5},
+               {"min_p": 1.5},
+               {"min_tokens": -1}):
+        with pytest.raises(ValueError):
+            engine.add_request([1, 2, 3], SamplingOptions(**kw))
