@@ -55,6 +55,20 @@ class ModelConfig:
     # layers): each query attends only the last `sliding_window`
     # positions. None = full causal.
     sliding_window: Optional[int] = None
+    # Gemma-2: every second layer (even indices) uses the sliding
+    # window, odd layers are global. False = sliding_window (if any)
+    # applies to every layer (Mistral).
+    alternating_sliding: bool = False
+    # Gemma-2 softcaps: s -> cap * tanh(s / cap) on attention scores
+    # and final logits (None = off)
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    # Gemma-2 attention scale: 1/sqrt(query_pre_attn_scalar) instead
+    # of 1/sqrt(head_dim) (None = head_dim)
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma-2 sandwich norms: post-attention and post-feedforward
+    # RMSNorms in ADDITION to the usual pre-norms
+    sandwich_norms: bool = False
     # RoPE frequency scaling as a hashable spec (ops/rope.py):
     # ("linear", factor) or ("llama3", factor, low_freq_factor,
     # high_freq_factor, original_max_position_embeddings). None = none.
@@ -122,18 +136,20 @@ class ModelConfig:
         # Qwen2MoeForCausalLM as their simpler cousins and serve garbage
         is_qwen2 = model_type == "qwen2" or arch == "Qwen2ForCausalLM"
         is_gemma = model_type == "gemma" or arch == "GemmaForCausalLM"
+        is_gemma2 = (model_type == "gemma2"
+                     or arch == "Gemma2ForCausalLM")
         is_mixtral = (model_type == "mixtral"
                       or arch == "MixtralForCausalLM")
         is_qwen2_moe = (model_type == "qwen2_moe"
                         or arch == "Qwen2MoeForCausalLM")
         is_llama_like = (model_type in ("llama", "mistral") or arch in
                          ("LlamaForCausalLM", "MistralForCausalLM"))
-        if not (is_qwen2 or is_gemma or is_mixtral or is_qwen2_moe
-                or is_llama_like) and (model_type or arch):
+        if not (is_qwen2 or is_gemma or is_gemma2 or is_mixtral
+                or is_qwen2_moe or is_llama_like) and (model_type or arch):
             raise ValueError(
                 f"unsupported model family (model_type={model_type!r}, "
                 f"architecture={arch!r}); supported: llama, mistral, "
-                f"qwen2, gemma, mixtral, qwen2_moe")
+                f"qwen2, gemma, gemma2, mixtral, qwen2_moe")
         if is_qwen2_moe:
             if (cfg.get("decoder_sparse_step", 1) != 1
                     or cfg.get("mlp_only_layers")):
@@ -141,8 +157,9 @@ class ModelConfig:
                     "qwen2_moe with dense interleaving "
                     "(decoder_sparse_step != 1 or mlp_only_layers) is "
                     "not supported: every layer must be sparse")
+        gemmaish = is_gemma or is_gemma2
         hidden_act = cfg.get("hidden_act") or cfg.get(
-            "hidden_activation") or ("gelu_tanh" if is_gemma else "silu")
+            "hidden_activation") or ("gelu_tanh" if gemmaish else "silu")
         return ModelConfig(
             name=name or cfg.get("_name_or_path", "hf-model"),
             vocab_size=cfg["vocab_size"],
@@ -159,14 +176,22 @@ class ModelConfig:
             # (v0.3+) and absent both mean full causal. Mixtral configs
             # carry the field but HF/vLLM ignore it for that family.
             sliding_window=(cfg.get("sliding_window")
-                            if is_llama_like else None),
+                            if (is_llama_like or is_gemma2) else None),
+            alternating_sliding=is_gemma2,
+            attn_logit_softcap=(cfg.get("attn_logit_softcapping")
+                                if is_gemma2 else None),
+            final_logit_softcap=(cfg.get("final_logit_softcapping")
+                                 if is_gemma2 else None),
+            query_pre_attn_scalar=(cfg.get("query_pre_attn_scalar")
+                                   if is_gemma2 else None),
+            sandwich_norms=is_gemma2,
             rope_scaling=_rope_scaling_spec(cfg.get("rope_scaling")),
-            tie_word_embeddings=cfg.get("tie_word_embeddings", is_gemma),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", gemmaish),
             attention_bias=cfg.get("attention_bias",
                                    is_qwen2 or is_qwen2_moe),
             activation="gelu_tanh" if "gelu" in hidden_act else "silu",
-            rms_norm_offset=is_gemma,
-            embed_scale=is_gemma,
+            rms_norm_offset=gemmaish,
+            embed_scale=gemmaish,
             num_experts=(cfg.get("num_local_experts", 0) if is_mixtral
                          else cfg.get("num_experts", 0) if is_qwen2_moe
                          else 0),
@@ -286,6 +311,39 @@ PRESETS: Dict[str, ModelConfig] = {
         moe_intermediate_size=1408, shared_expert_size=5632,
         moe_naming="qwen2",
     ),
+    # Gemma-2-2B: alternating 4096-window/global layers, softcaps,
+    # sandwich norms, query_pre_attn_scalar = head_dim (256)
+    "gemma-2-2b": ModelConfig(
+        name="gemma-2-2b", vocab_size=256000, hidden_size=2304,
+        intermediate_size=9216, num_layers=26, num_heads=8,
+        num_kv_heads=4, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, activation="gelu_tanh",
+        rms_norm_offset=True, embed_scale=True,
+        sliding_window=4096, alternating_sliding=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=256.0, sandwich_norms=True,
+    ),
+    "gemma-2-9b": ModelConfig(
+        name="gemma-2-9b", vocab_size=256000, hidden_size=3584,
+        intermediate_size=14336, num_layers=42, num_heads=16,
+        num_kv_heads=8, head_dim=256, max_position_embeddings=8192,
+        tie_word_embeddings=True, activation="gelu_tanh",
+        rms_norm_offset=True, embed_scale=True,
+        sliding_window=4096, alternating_sliding=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=256.0, sandwich_norms=True,
+    ),
+    # Tiny Gemma-2-style model for CPU tests (all deviations on)
+    "debug-gemma2": ModelConfig(
+        name="debug-gemma2", vocab_size=512, hidden_size=128,
+        intermediate_size=384, num_layers=2, num_heads=4,
+        num_kv_heads=2, max_position_embeddings=512,
+        tie_word_embeddings=True, activation="gelu_tanh",
+        rms_norm_offset=True, embed_scale=True,
+        sliding_window=64, alternating_sliding=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=32.0, sandwich_norms=True,
+    ),
     "gemma-7b": ModelConfig(
         name="gemma-7b", vocab_size=256000, hidden_size=3072,
         intermediate_size=24576, num_layers=28, num_heads=16,
@@ -327,6 +385,10 @@ HF_ALIASES: Dict[str, str] = {
     "google/gemma-2b-it": "gemma-2b",
     "google/gemma-7b": "gemma-7b",
     "google/gemma-7b-it": "gemma-7b",
+    "google/gemma-2-2b": "gemma-2-2b",
+    "google/gemma-2-2b-it": "gemma-2-2b",
+    "google/gemma-2-9b": "gemma-2-9b",
+    "google/gemma-2-9b-it": "gemma-2-9b",
 }
 
 

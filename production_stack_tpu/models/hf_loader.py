@@ -55,6 +55,17 @@ def params_from_state_dict(cfg: ModelConfig, sd: Mapping[str, Any]) -> Dict:
         return jnp.asarray(x, dtype=cfg.dtype)
 
     layer_map = dict(_LAYER_MAP)
+    if cfg.sandwich_norms:
+        # Gemma-2 norm naming: post_attention_layernorm is the SANDWICH
+        # post-attn norm (not the MLP pre-norm as in Llama), the MLP
+        # pre-norm is pre_feedforward_layernorm, and there is a
+        # post_feedforward_layernorm too
+        layer_map["mlp_norm"] = ("pre_feedforward_layernorm.weight",
+                                 False)
+        layer_map["post_attn_norm"] = (
+            "post_attention_layernorm.weight", False)
+        layer_map["post_mlp_norm"] = (
+            "post_feedforward_layernorm.weight", False)
     if cfg.attention_bias:
         # Qwen2: q/k/v projection biases ([out] vectors; no transpose)
         layer_map.update({

@@ -58,6 +58,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         },
         "final_norm": norm_init((h,), cfg.dtype),
     }
+    if cfg.sandwich_norms:
+        # Gemma-2: post-attention and post-feedforward norms too
+        params["layers"]["post_attn_norm"] = norm_init((L, h), cfg.dtype)
+        params["layers"]["post_mlp_norm"] = norm_init((L, h), cfg.dtype)
     # key order matters: dense models must draw gate/up/down from the
     # same key positions as before MoE existed (seeded tests pin outputs)
     if E:
@@ -102,7 +106,7 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
                 lora_scaling: float = 1.0,
                 token_valid: Optional[jnp.ndarray] = None,
                 block_tables: Optional[jnp.ndarray] = None,
-                mesh=None):
+                mesh=None, layer_local=None):
     """One transformer block. x [B,T,H]; kv = this layer's paged pool
     (k, v) [N,Bs,Hkv,D] addressed through block_tables [B,MB]
     (models/kv.py).
@@ -142,12 +146,30 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
     q = apply_rope(q, positions, cos, sin)
     k = apply_rope(k, positions, cos, sin)
 
+    # Gemma-2 deviations from the Llama baseline: attention scale from
+    # query_pre_attn_scalar, tanh score softcap, and (alternating)
+    # sliding windows. layer_local (traced bool, from the scan's
+    # per-layer flags) picks between two STATICALLY-windowed branches
+    # via lax.cond — kernels stay static-shaped.
+    scale_val = ((float(cfg.query_pre_attn_scalar) ** -0.5)
+                 if cfg.query_pre_attn_scalar else hd ** -0.5)
+    cap = cfg.attn_logit_softcap
+    sw = cfg.sliding_window
+
+    def _windowed(attn_fn_w):
+        if cfg.alternating_sliding:
+            return jax.lax.cond(layer_local,
+                                lambda: attn_fn_w(sw),
+                                lambda: attn_fn_w(None))
+        return attn_fn_w(sw)
+
     if kv is None:
         if attention_fn is not None:
             attn = attention_fn(q, k, v)
         else:
-            attn = causal_attention(q, k, v, scale=hd ** -0.5,
-                                    sliding_window=cfg.sliding_window)
+            attn = _windowed(lambda w: causal_attention(
+                q, k, v, scale=scale_val, sliding_window=w,
+                logit_softcap=cap))
         new_kv = None
     else:
         quant_kv = len(kv) == 4   # (k, v, ks, vs): int8 pool + scales
@@ -166,34 +188,39 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
         Bs = k_cache.shape[2]
         MB = block_tables.shape[1]
         nb = MB if kv_len is None else min(-(-kv_len // Bs), MB)
-        if (use_flash
-                and pallas_paged.paged_viable(T, nh // nkv, hd, Bs)
-                and (mesh is None or pallas_paged.mesh_tp_only(mesh))):
-            # paged flash kernel: K/V blocks streamed straight from the
-            # pool through the tables — no gathered copy, no [T, S]
-            # score materialization, per-row causal block skipping.
-            # Covers prefill chunks AND decode/spec windows; under a
-            # tp-only mesh it runs shard-local per head via shard_map.
-            interp = pallas_attention.needs_interpret()
-            sc = (dict(k_scales=k_scales, v_scales=v_scales)
-                  if quant_kv else {})
-            if cfg.sliding_window:
-                sc["window"] = cfg.sliding_window
-            if mesh is None:
-                # short windows (decode / speculative verify) take the
-                # wide kernel: all kv heads + several pool blocks per
-                # grid step, ~16x fewer grid steps than the general one
-                paged_fn = (pallas_paged.paged_decode_attention
-                            if T <= pallas_paged.DECODE_T_MAX
-                            else pallas_paged.paged_attention)
-                attn = paged_fn(
-                    q, k_cache, v_cache, block_tables, starts, nb=nb,
-                    interpret=interp, **sc)
-            else:
-                attn = pallas_paged.paged_attention_sharded(
+
+        def cached_attn(w):
+            if (use_flash
+                    and pallas_paged.paged_viable(T, nh // nkv, hd, Bs)
+                    and (mesh is None
+                         or pallas_paged.mesh_tp_only(mesh))):
+                # paged flash kernel: K/V blocks streamed straight from
+                # the pool through the tables — no gathered copy, no
+                # [T, S] score materialization, per-row causal block
+                # skipping. Covers prefill chunks AND decode/spec
+                # windows; under a tp-only mesh it runs shard-local per
+                # head via shard_map.
+                interp = pallas_attention.needs_interpret()
+                sc = (dict(k_scales=k_scales, v_scales=v_scales)
+                      if quant_kv else {})
+                if w:
+                    sc["window"] = w
+                sc["scale"] = scale_val
+                sc["softcap"] = cap or 0.0
+                if mesh is None:
+                    # short windows (decode / speculative verify) take
+                    # the wide kernel: all kv heads + several pool
+                    # blocks per grid step, ~16x fewer grid steps than
+                    # the general one
+                    paged_fn = (pallas_paged.paged_decode_attention
+                                if T <= pallas_paged.DECODE_T_MAX
+                                else pallas_paged.paged_attention)
+                    return paged_fn(
+                        q, k_cache, v_cache, block_tables, starts,
+                        nb=nb, interpret=interp, **sc)
+                return pallas_paged.paged_attention_sharded(
                     q, k_cache, v_cache, block_tables, starts, mesh,
                     nb=nb, interpret=interp, **sc)
-        else:
             if quant_kv:
                 k_att = gather_view_q(k_cache, k_scales, block_tables,
                                       nb, dtype=q.dtype)
@@ -202,12 +229,20 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             else:
                 k_att = gather_view(k_cache, block_tables, nb)
                 v_att = gather_view(v_cache, block_tables, nb)
-            attn = attention_with_cache(q, k_att, v_att, positions,
-                                        scale=hd ** -0.5,
-                                        sliding_window=cfg.sliding_window)
+            return attention_with_cache(q, k_att, v_att, positions,
+                                        scale=scale_val,
+                                        sliding_window=w,
+                                        logit_softcap=cap)
+
+        attn = _windowed(cached_attn)
         new_kv = ((k_cache, v_cache, k_scales, v_scales) if quant_kv
                   else (k_cache, v_cache))
-    x = x + proj(attn.reshape(B, T, nh * hd), "o")
+    o_out = proj(attn.reshape(B, T, nh * hd), "o")
+    if cfg.sandwich_norms:
+        # Gemma-2: normalize the attention OUTPUT before the residual
+        o_out = rms_norm(o_out, lp["post_attn_norm"], cfg.rms_norm_eps,
+                         offset=offset)
+    x = x + o_out
 
     hidden = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, offset=offset)
     act = jax.nn.silu if cfg.activation == "silu" else _gelu_tanh
@@ -237,7 +272,11 @@ def _layer_body(cfg: ModelConfig, rope: Tuple[jnp.ndarray, jnp.ndarray],
             x = x + y.reshape(B, T, H)
     else:
         gated = act(proj(hidden, "gate")) * proj(hidden, "up")
-        x = x + proj(gated, "down")
+        mlp_out = proj(gated, "down")
+        if cfg.sandwich_norms:
+            mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
+                               cfg.rms_norm_eps, offset=offset)
+        x = x + mlp_out
     return x, new_kv
 
 
@@ -291,11 +330,19 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
 
     quant_kv = cache.quantized
     has_lora = lora_params is not None
+    alternating = cfg.alternating_sliding
+    nkv_leaves = 4 if quant_kv else 2
 
     def scan_body(carry, xs):
+        i = 1
         lp = xs[0]
-        kv_tuple = xs[1:5] if quant_kv else xs[1:3]
-        ll = xs[-1] if has_lora else None
+        kv_tuple = xs[i:i + nkv_leaves]
+        i += nkv_leaves
+        ll = None
+        if has_lora:
+            ll = xs[i]
+            i += 1
+        local = xs[i] if alternating else None
         out, new_kv = _layer_body(cfg, rope, positions, starts, carry,
                                   lp, kv_tuple, kv_len=kv_len,
                                   use_flash=use_flash, lora_layer=ll,
@@ -303,7 +350,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
                                   lora_scaling=lora_scaling,
                                   token_valid=token_valid,
                                   block_tables=block_tables,
-                                  mesh=mesh)
+                                  mesh=mesh, layer_local=local)
         return out, new_kv
 
     xs = (params["layers"], cache.k, cache.v)
@@ -311,6 +358,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
         xs = xs + (cache.ks, cache.vs)
     if has_lora:
         xs = xs + (lora_params,)
+    if alternating:
+        # Gemma-2 layer pattern: even layers sliding, odd global
+        xs = xs + (jnp.arange(cfg.num_layers) % 2 == 0,)
     x, new = jax.lax.scan(scan_body, x, xs)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                  offset=1.0 if cfg.rms_norm_offset else 0.0)
@@ -337,13 +387,18 @@ def encode(params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     x = _embed(params, cfg, tokens)
 
-    def scan_body(carry, lp):
+    def scan_body(carry, xs):
+        lp, local = xs
         out, _ = _layer_body(cfg, rope, positions, None, carry, lp, None,
                              attention_fn=attention_fn,
-                             token_valid=token_valid)
+                             token_valid=token_valid,
+                             layer_local=local)
         return out, None
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    local_flags = (jnp.arange(cfg.num_layers) % 2 == 0
+                   if cfg.alternating_sliding
+                   else jnp.zeros((cfg.num_layers,), bool))
+    x, _ = jax.lax.scan(scan_body, x, (params["layers"], local_flags))
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                     offset=1.0 if cfg.rms_norm_offset else 0.0)
 
@@ -371,6 +426,10 @@ def _embed(params: Params, cfg: ModelConfig,
 
 
 def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    from production_stack_tpu.ops.attention import _softcap
+
+    def cap(logits):
+        return _softcap(logits, cfg.final_logit_softcap)
     if cfg.tie_word_embeddings:
         emb = params["embed"]
         if quant.is_quantized(emb):
@@ -379,13 +438,13 @@ def _lm_head(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
             logits = jnp.einsum("bth,vh->btv", x,
                                 emb["w8"].astype(x.dtype),
                                 preferred_element_type=jnp.float32)
-            return logits * emb["scale"][None, None, :]
-        return jnp.einsum("bth,hv->btv", x, emb.T,
-                          preferred_element_type=jnp.float32)
+            return cap(logits * emb["scale"][None, None, :])
+        return cap(jnp.einsum("bth,hv->btv", x, emb.T,
+                              preferred_element_type=jnp.float32))
     head = params["lm_head"]
     if quant.is_quantized(head):
         logits = jnp.einsum("bth,hv->btv", x, head["w8"].astype(x.dtype),
                             preferred_element_type=jnp.float32)
-        return logits * head["scale"][None, None, :]
-    return jnp.einsum("bth,hv->btv", x, head,
-                      preferred_element_type=jnp.float32)
+        return cap(logits * head["scale"][None, None, :])
+    return cap(jnp.einsum("bth,hv->btv", x, head,
+                          preferred_element_type=jnp.float32))

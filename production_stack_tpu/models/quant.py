@@ -29,8 +29,8 @@ from typing import Any, Dict
 import jax.numpy as jnp
 
 # layer-dict entries that stay un-quantized (small or accuracy-critical)
-_SKIP_LAYER = ("attn_norm", "mlp_norm", "q_bias", "k_bias", "v_bias",
-               "router", "s_gate_w")
+_SKIP_LAYER = ("attn_norm", "mlp_norm", "post_attn_norm", "post_mlp_norm",
+               "q_bias", "k_bias", "v_bias", "router", "s_gate_w")
 
 
 def quantize_tensor(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:

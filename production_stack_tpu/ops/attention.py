@@ -35,6 +35,15 @@ def _grouped_out(probs: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
 
 
+def _softcap(scores: jnp.ndarray, cap: Optional[float]) -> jnp.ndarray:
+    """Gemma-2 logit softcapping: s -> cap * tanh(s / cap). Applied to
+    RAW scores, before any -inf masking (capping a masked score would
+    resurrect it at -cap)."""
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
+
 def causal_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -42,13 +51,15 @@ def causal_attention(
     scale: Optional[float] = None,
     segment_ids: Optional[jnp.ndarray] = None,
     sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     """Full-sequence causal GQA. q [B,T,H,D]; k,v [B,T,Hkv,D] -> [B,T,H,D].
 
     Used by the training step and by single-shot (non-incremental) forward.
     Optional segment_ids [B,T] confine attention within packed segments.
     sliding_window W (Mistral/Gemma-2 local layers) further confines a
-    query at t to keys in (t - W, t].
+    query at t to keys in (t - W, t]. logit_softcap applies Gemma-2's
+    tanh cap to the raw scores.
     """
     B, T, H, D = q.shape
     Hkv = k.shape[2]
@@ -56,7 +67,8 @@ def causal_attention(
     if scale is None:
         scale = D ** -0.5
     q5 = q.reshape(B, T, Hkv, G, D)
-    scores = _grouped_scores(q5, k, scale)  # [B,Hkv,G,T,S] fp32
+    scores = _softcap(_grouped_scores(q5, k, scale),
+                      logit_softcap)  # [B,Hkv,G,T,S] fp32
     t = jnp.arange(T)
     mask = t[:, None] >= t[None, :]  # [T,S] causal
     if sliding_window is not None:
@@ -81,6 +93,7 @@ def attention_with_cache(
     q_positions: jnp.ndarray,
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jnp.ndarray:
     """Incremental GQA over a preallocated per-slot cache.
 
@@ -100,7 +113,8 @@ def attention_with_cache(
     if scale is None:
         scale = D ** -0.5
     q5 = q.reshape(B, T, Hkv, G, D)
-    scores = _grouped_scores(q5, k_cache, scale)  # [B,Hkv,G,T,S] fp32
+    scores = _softcap(_grouped_scores(q5, k_cache, scale),
+                      logit_softcap)  # [B,Hkv,G,T,S] fp32
     s_idx = jnp.arange(S)
     mask = s_idx[None, None, :] <= q_positions[:, :, None]  # [B,T,S]
     if sliding_window is not None:

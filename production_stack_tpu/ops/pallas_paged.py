@@ -73,7 +73,8 @@ def paged_viable(T: int, groups: int, head_dim: int,
 def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
                   block_q: int, groups: int,
                   block_size: int, nb: int, scale: float,
-                  quant: bool = False, window: int = 0):
+                  quant: bool = False, window: int = 0,
+                  softcap: float = 0.0):
     """One (batch row, kv head, q block, pool block) grid step.
 
     tabs_ref   (SMEM) [B, MB]      block tables
@@ -129,6 +130,9 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)               # [rows, Bs]
+        if softcap:
+            # Gemma-2 tanh cap on RAW scores, before -inf masking
+            s = softcap * jnp.tanh(s / softcap)
         k_pos = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1)
         live = k_pos <= q_pos
@@ -157,10 +161,11 @@ def _paged_kernel(tabs_ref, starts_ref, q_ref, k_ref, v_ref, *refs,
 
 @functools.partial(jax.jit,
                    static_argnames=("nb", "block_q", "interpret",
-                                    "window"))
+                                    "window", "scale", "softcap"))
 def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
                     block_q: int = 0, interpret: bool = False,
-                    k_scales=None, v_scales=None, window: int = 0):
+                    k_scales=None, v_scales=None, window: int = 0,
+                    scale: float = None, softcap: float = 0.0):
     """Causal GQA over paged K/V, positions contiguous per row.
 
     q [B, T, H, D]; k/v pool [N, Hkv, Bs, D]; tables [B, MB] int32;
@@ -180,7 +185,8 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
     G = H // Hkv
     MB = tables.shape[1]
-    scale = D ** -0.5
+    if scale is None:
+        scale = D ** -0.5
     quant = k_scales is not None
     if not block_q:
         # whole chunk per q block while VMEM allows: K/V are streamed
@@ -222,7 +228,8 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
     grid = (B, Hkv, nq, nb)
     kernel = functools.partial(
         _paged_kernel, block_q=block_q, groups=G, block_size=Bs,
-        nb=nb, scale=scale, quant=quant, window=window)
+        nb=nb, scale=scale, quant=quant, window=window,
+        softcap=softcap)
     rows = block_q * G
     in_specs = [
         pl.BlockSpec((1, block_q, 1, G, D),
@@ -288,7 +295,8 @@ _BLOCKS_PER_STEP = 4
 def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                          heads_kv: int, groups: int, block_size: int,
                          ngrp: int, R: int, scale: float,
-                         quant: bool = False, window: int = 0):
+                         quant: bool = False, window: int = 0,
+                         softcap: float = 0.0):
     """One (batch row, block group) grid step.
 
     tabs_ref   (SMEM) [B, MB]     block tables
@@ -346,6 +354,8 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
                 s = jax.lax.dot_general(
                     q, k_blk, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32)      # [rows, Bs]
+                if softcap:
+                    s = softcap * jnp.tanh(s / softcap)
                 k_pos = j * block_size + jax.lax.broadcasted_iota(
                     jnp.int32, (1, block_size), 1)
                 live = (k_pos <= row_pos) & (j <= jmax)
@@ -373,11 +383,13 @@ def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
 
 
 @functools.partial(jax.jit, static_argnames=("nb", "interpret",
-                                             "window"))
+                                             "window", "scale",
+                                             "softcap"))
 def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
                            nb: int, interpret: bool = False,
                            k_scales=None, v_scales=None,
-                           window: int = 0):
+                           window: int = 0,
+                           scale: float = None, softcap: float = 0.0):
     """paged_attention specialized for short query windows (T <=
     DECODE_T_MAX): same contract, same result, far fewer grid steps.
 
@@ -390,7 +402,8 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
     Hkv, Bs = k_pool.shape[1], k_pool.shape[2]
     G = H // Hkv
     MB = tables.shape[1]
-    scale = D ** -0.5
+    if scale is None:
+        scale = D ** -0.5
     quant = k_scales is not None
     R = min(_BLOCKS_PER_STEP, nb)
     ngrp = -(-nb // R)
@@ -417,7 +430,7 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
     kernel = functools.partial(
         _paged_decode_kernel, T=T, heads_kv=Hkv, groups=G,
         block_size=Bs, ngrp=ngrp, R=R, scale=scale, quant=quant,
-        window=window)
+        window=window, softcap=softcap)
     kv_specs = [pl.BlockSpec((1, Hkv, Bs, D), kv_index(i))
                 for i in range(R)]
     in_specs = [
@@ -470,7 +483,8 @@ def paged_decode_attention(q, k_pool, v_pool, tables, starts, *,
 def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
                             nb: int, interpret: bool = False,
                             k_scales=None, v_scales=None,
-                            window: int = 0):
+                            window: int = 0,
+                            scale: float = None, softcap: float = 0.0):
     """paged_attention under a tp-only mesh: shard_map over the head
     axis (q heads and pool kv heads both shard by tp, tables/starts
     replicated) — shard-local, no collectives. Caller guarantees the
@@ -490,12 +504,14 @@ def paged_attention_sharded(q, k_pool, v_pool, tables, starts, mesh, *,
     if k_scales is not None:
         def fn(qq, kk, vv, tt, ss, ks, vs):
             return base(qq, kk, vv, tt, ss, nb=nb, interpret=interpret,
-                        k_scales=ks, v_scales=vs, window=window)
+                        k_scales=ks, v_scales=vs, window=window,
+                        scale=scale, softcap=softcap)
         in_specs = in_specs + (P(None, "tp", None), P(None, "tp", None))
         args = args + (k_scales, v_scales)
     else:
         fn = functools.partial(base, nb=nb, interpret=interpret,
-                               window=window)
+                               window=window, scale=scale,
+                               softcap=softcap)
     return shard_map(
         fn, mesh=mesh,
         in_specs=in_specs,

@@ -77,6 +77,13 @@ def pipeline_loss_fn(cfg: ModelConfig, mesh: Mesh, n_micro: int):
     stage_params()/stage_shardings(). Runs the GPipe schedule above.
     """
     n_stages = mesh.shape["pp"]
+    if cfg.alternating_sliding:
+        # per-layer window alternation needs layer identity, which the
+        # stage-local scan below does not thread — full-causal training
+        # of an alternating model would silently diverge from serving
+        raise NotImplementedError(
+            "pipeline-parallel training does not support alternating "
+            "sliding-window models (Gemma-2) yet; train with pp=1")
     rope = rope_table(cfg.max_position_embeddings, cfg.head_dim_,
                       cfg.rope_theta, scaling=cfg.rope_scaling)
 

@@ -30,9 +30,11 @@ _LAYER_SPECS: Dict[str, P] = {
     "q_bias": P(None, "tp"),
     "k_bias": P(None, "tp"),
     "v_bias": P(None, "tp"),
-    # norms replicated
+    # norms replicated (incl. Gemma-2's sandwich norms)
     "attn_norm": P(None, None),
     "mlp_norm": P(None, None),
+    "post_attn_norm": P(None, None),
+    "post_mlp_norm": P(None, None),
 }
 
 
