@@ -159,6 +159,18 @@ class LLMEngine:
             if tcfg.enabled:
                 self.connector = KVConnector(self.runner, self.model_cfg,
                                              engine_cfg, tcfg)
+        # rolling KV: models whose EVERY layer is windowed (Mistral
+        # v0.1-style) never attend positions behind the window again, so
+        # their blocks are freed as generation advances — live-context
+        # HBM bounded by W instead of total length. Off for alternating
+        # (Gemma-2: global layers need the full prefix) and under KV
+        # tiering (tier extraction reads from position 0).
+        self._roll_window = (
+            self.model_cfg.sliding_window
+            if (self.model_cfg.sliding_window
+                and not self.model_cfg.alternating_sliding
+                and self.connector is None)
+            else None)
         self.seqs: Dict[str, Sequence] = {}
         self._finished_order: List[str] = []
         self._id_counter = itertools.count()
@@ -320,8 +332,7 @@ class LLMEngine:
             if ok:
                 self._park_slot(slot)
                 if seq is not None:
-                    self.block_mgr.free(seq.block_ids)
-                    seq.block_ids = []
+                    self._free_seq_blocks(seq)
                     self._remember(seq)
             self._refresh_gauges()
             return ok
@@ -584,6 +595,10 @@ class LLMEngine:
         window is processed) — the caller then falls back to the
         ordinary process-first path."""
         W = self.cfg.decode_window
+        if self._roll_window:
+            # free behind-window blocks BEFORE growing coverage: the
+            # reclaimed blocks feed this very window's growth
+            self._roll_windows(decode_seqs)
         # block coverage first: every live slot's table must span the
         # whole window (worst case: speculation emits spec+1 per step).
         # Pool pressure preempts youngest-first; a sequence that cannot
@@ -764,13 +779,15 @@ class LLMEngine:
             # prefix caching: the full blocks stay in the pool under
             # their chain keys (zero-copy sharing); register BEFORE
             # free so refcount-0 registered blocks land in the
-            # evictable LRU instead of the free list
-            self.block_mgr.register(
-                (seq.prompt_tokens + seq.output_tokens)[:-1],
-                seq.block_ids,
-                salt=self._adapter_salt(seq.adapter_id))
-            self.block_mgr.free(seq.block_ids)
-            seq.block_ids = []
+            # evictable LRU instead of the free list. Rolled sequences
+            # skip registration: chain keys need the contiguous prefix,
+            # whose early blocks are gone.
+            if not seq.rolled_blocks:
+                self.block_mgr.register(
+                    (seq.prompt_tokens + seq.output_tokens)[:-1],
+                    seq.block_ids,
+                    salt=self._adapter_salt(seq.adapter_id))
+            self._free_seq_blocks(seq)
             slot = seq.slot
             self.scheduler.finish(seq, reason)
             self._park_slot(slot)
@@ -1073,8 +1090,42 @@ class LLMEngine:
     def _set_table_row(self, slot: int, block_ids) -> None:
         self._tables[slot, :] = 0
         if block_ids:
-            self._tables[slot, :len(block_ids)] = block_ids
+            # rolled entries are None placeholders -> trash block 0
+            # (never read: every attention path skips blocks behind the
+            # window, the only reason entries roll)
+            self._tables[slot, :len(block_ids)] = [
+                b or 0 for b in block_ids]
         self.runner.set_block_tables(self._tables)
+
+    def _free_seq_blocks(self, seq: Sequence) -> None:
+        """Release a sequence's live blocks (rolled entries are None
+        placeholders, already freed)."""
+        self.block_mgr.free([b for b in seq.block_ids if b])
+        seq.block_ids = []
+
+    def _roll_windows(self, decode_seqs) -> None:
+        """Free blocks every future query of a windowed sequence can no
+        longer attend (positions <= next_position - W). Safe against
+        in-flight windows: their starts are >= the host's view, so
+        their own window lower bound is at least as high, and they
+        never read (or write) behind it."""
+        W = self._roll_window
+        Bs = self.cfg.kv_block_size
+        for s in decode_seqs:
+            if s.status is not SeqStatus.RUNNING:
+                continue
+            keep_from = max(s.next_position - W + 1, 0) // Bs
+            if keep_from <= s.rolled_blocks:
+                continue
+            keep_from = min(keep_from, len(s.block_ids))
+            dead = [b for b in s.block_ids[s.rolled_blocks:keep_from]
+                    if b]
+            if dead:
+                self.block_mgr.free(dead)
+            for i in range(s.rolled_blocks, keep_from):
+                s.block_ids[i] = None
+            s.rolled_blocks = keep_from
+            self._set_table_row(s.slot, s.block_ids)
 
     def _ensure_blocks(self, seq: Sequence, upto_tokens: int,
                        allow_preempt: bool = True) -> bool:
@@ -1119,8 +1170,8 @@ class LLMEngine:
             "%d tokens will recompute", seq.seq_id, len(seq.block_ids),
             seq.num_tokens)
         slot = seq.slot
-        self.block_mgr.free(seq.block_ids)
-        seq.block_ids = []
+        self._free_seq_blocks(seq)
+        seq.rolled_blocks = 0   # recompute re-prefills from position 0
         self.scheduler.preempt(seq)
         self._park_slot(slot)
         self._set_table_row(slot, [])

@@ -72,8 +72,13 @@ class Sequence:
     slot: int = -1
     adapter_id: int = 0      # LoRA adapter (0 = base model, models/lora.py)
     # paged-KV blocks this sequence owns, table order (engine/
-    # block_manager.py); prefix-shared blocks lead, exclusive ones follow
+    # block_manager.py); prefix-shared blocks lead, exclusive ones
+    # follow. Rolled (sliding-window-freed) entries are None
+    # placeholders so virtual indexing stays stable.
     block_ids: List[int] = field(default_factory=list)
+    # blocks freed behind the sliding window (engine._roll_windows);
+    # prefix registration is skipped once any block rolled
+    rolled_blocks: int = 0
     output_tokens: List[int] = field(default_factory=list)
     # per output token: chosen-token logprob (raw model distribution)
     output_logprobs: List[Optional[float]] = field(default_factory=list)

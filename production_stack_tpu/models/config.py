@@ -249,6 +249,22 @@ PRESETS: Dict[str, ModelConfig] = {
         intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
         rope_theta=500000.0, max_position_embeddings=8192,
     ),
+    # Llama-3.2 small models: 3.1-style rope warp (factor 32), tied
+    # embeddings
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b", vocab_size=128256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32,
+        num_kv_heads=8, head_dim=64, rope_theta=500000.0,
+        max_position_embeddings=131072, tie_word_embeddings=True,
+        rope_scaling=("llama3", 32.0, 1.0, 4.0, 8192),
+    ),
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b", vocab_size=128256, hidden_size=3072,
+        intermediate_size=8192, num_layers=28, num_heads=24,
+        num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+        max_position_embeddings=131072, tie_word_embeddings=True,
+        rope_scaling=("llama3", 32.0, 1.0, 4.0, 8192),
+    ),
     "llama-3.1-70b": ModelConfig(
         name="llama-3.1-70b", vocab_size=128256, hidden_size=8192,
         intermediate_size=28672, num_layers=80, num_heads=64,
@@ -284,6 +300,7 @@ PRESETS: Dict[str, ModelConfig] = {
         name="gemma-2b", vocab_size=256000, hidden_size=2048,
         intermediate_size=16384, num_layers=18, num_heads=8,
         num_kv_heads=1, head_dim=256, max_position_embeddings=8192,
+        rms_norm_eps=1e-6,
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
     ),
@@ -317,6 +334,7 @@ PRESETS: Dict[str, ModelConfig] = {
         name="gemma-2-2b", vocab_size=256000, hidden_size=2304,
         intermediate_size=9216, num_layers=26, num_heads=8,
         num_kv_heads=4, head_dim=256, max_position_embeddings=8192,
+        rms_norm_eps=1e-6,
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
         sliding_window=4096, alternating_sliding=True,
@@ -327,6 +345,7 @@ PRESETS: Dict[str, ModelConfig] = {
         name="gemma-2-9b", vocab_size=256000, hidden_size=3584,
         intermediate_size=14336, num_layers=42, num_heads=16,
         num_kv_heads=8, head_dim=256, max_position_embeddings=8192,
+        rms_norm_eps=1e-6,
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
         sliding_window=4096, alternating_sliding=True,
@@ -338,6 +357,7 @@ PRESETS: Dict[str, ModelConfig] = {
         name="debug-gemma2", vocab_size=512, hidden_size=128,
         intermediate_size=384, num_layers=2, num_heads=4,
         num_kv_heads=2, max_position_embeddings=512,
+        rms_norm_eps=1e-6,
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
         sliding_window=64, alternating_sliding=True,
@@ -348,6 +368,7 @@ PRESETS: Dict[str, ModelConfig] = {
         name="gemma-7b", vocab_size=256000, hidden_size=3072,
         intermediate_size=24576, num_layers=28, num_heads=16,
         num_kv_heads=16, head_dim=256, max_position_embeddings=8192,
+        rms_norm_eps=1e-6,
         tie_word_embeddings=True, activation="gelu_tanh",
         rms_norm_offset=True, embed_scale=True,
     ),
@@ -385,6 +406,10 @@ HF_ALIASES: Dict[str, str] = {
     "google/gemma-2b-it": "gemma-2b",
     "google/gemma-7b": "gemma-7b",
     "google/gemma-7b-it": "gemma-7b",
+    "meta-llama/Llama-3.2-1B": "llama-3.2-1b",
+    "meta-llama/Llama-3.2-1B-Instruct": "llama-3.2-1b",
+    "meta-llama/Llama-3.2-3B": "llama-3.2-3b",
+    "meta-llama/Llama-3.2-3B-Instruct": "llama-3.2-3b",
     "google/gemma-2-2b": "gemma-2-2b",
     "google/gemma-2-2b-it": "gemma-2-2b",
     "google/gemma-2-9b": "gemma-2-9b",

@@ -120,7 +120,7 @@ def test_kernel_softcap_parity():
         v_pool = write_chunk(v_pool, newv, tables, positions)
         nb = -(-(max(lens) + T) // Bs)
         want = attention_with_cache(
-            gather := q, gather_view(k_pool, tables, nb),
+            q, gather_view(k_pool, tables, nb),
             gather_view(v_pool, tables, nb), positions,
             scale=0.31, logit_softcap=5.0)
         fn = paged_decode_attention if T <= 8 else paged_attention

@@ -206,3 +206,76 @@ def test_hf_config_parses_rope_scaling():
     with pytest.raises(ValueError):
         MC.from_hf_config({**base, "rope_scaling": {
             "rope_type": "yarn", "factor": 2.0}})
+
+
+def test_rolling_kv_frees_behind_window():
+    """Every-layer-windowed models (debug-sliding, W=64) free KV
+    blocks behind the window as generation advances: a pool FAR
+    smaller than the worst case serves a long generation without
+    preemption, and the stream is identical to a big-pool run."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    def run(pool_tokens):
+        cfg = EngineConfig(model="debug-sliding", max_model_len=512,
+                           max_num_seqs=2, prefill_chunk=32,
+                           prefill_buckets=(32,), decode_window=4,
+                           kv_block_size=16,
+                           kv_pool_tokens=pool_tokens)
+        eng = LLMEngine(cfg)
+        opts = SamplingOptions(temperature=0.0, max_tokens=300,
+                               ignore_eos=True)
+        sid = eng.add_request(list(range(3, 35)), opts)
+        guard = 0
+        done = False
+        while not done:
+            for out in eng.step():
+                if out.seq_id == sid and out.finished:
+                    done = True
+            guard += 1
+            assert guard < 2000
+        seq = eng.seqs[sid]
+        metrics = eng.metrics.render().decode()
+        preempt = 0.0
+        for line in metrics.splitlines():
+            if line.startswith("vllm:num_preemptions_total"):
+                preempt = float(line.rsplit(" ", 1)[1])
+        return seq.output_tokens, seq.rolled_blocks, preempt
+
+    # worst case needs 332 tokens of KV; give the pool only ~3 windows
+    small_toks, rolled, preemptions = run(3 * 64 + 32)
+    big_toks, _, _ = run(None)
+    assert rolled > 0, "no blocks rolled behind the window"
+    # the feature's point: the small pool serves the whole generation
+    # by ROLLING, not by preempt/recompute churn
+    assert preemptions == 0, preemptions
+    assert small_toks == big_toks
+    assert len(small_toks) == 300
+
+
+def test_rolling_kv_skips_prefix_registration():
+    """A rolled sequence must not register its (now-partial) chain
+    for prefix sharing."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    cfg = EngineConfig(model="debug-sliding", max_model_len=512,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4,
+                       kv_block_size=16, enable_prefix_caching=True)
+    eng = LLMEngine(cfg)
+    opts = SamplingOptions(temperature=0.0, max_tokens=200,
+                           ignore_eos=True)
+    sid = eng.add_request(list(range(3, 35)), opts)
+    done = False
+    guard = 0
+    while not done:
+        for out in eng.step():
+            if out.seq_id == sid and out.finished:
+                done = True
+        guard += 1
+        assert guard < 2000
+    assert eng.seqs[sid].rolled_blocks > 0
+    assert not eng.block_mgr._by_key, "rolled chain was registered"
