@@ -54,6 +54,9 @@ class SamplingOptions:
     min_p: float = 0.0
     min_tokens: int = 0
     logit_bias: Optional[Dict[int, float]] = None
+    # vLLM scheduling priority: LOWER values admit earlier; equal
+    # priorities keep FIFO arrival order (scheduler.add)
+    priority: int = 0
 
     @property
     def shaped(self) -> bool:
@@ -157,7 +160,25 @@ class Scheduler:
             raise ValueError(
                 f"prompt length {len(seq.prompt_tokens)} exceeds "
                 f"max_model_len {self.max_model_len}")
-        self.waiting.append(seq)
+        # priority insertion (vLLM semantics: lower value admits
+        # earlier; FIFO within a priority level). The common all-
+        # default case is a pure O(1) append. The scan iterates (no
+        # mid-deque indexing — deque[i] is O(n)) and never crosses a
+        # PREEMPTED sequence (one with emitted output): recompute-first
+        # holds even against higher-priority arrivals, or a steady
+        # stream of them would starve a partially-streamed request
+        # while its recompute debt grows.
+        pr = seq.options.priority
+        i = len(self.waiting)
+        for other in reversed(self.waiting):
+            if other.options.priority > pr and not other.output_tokens:
+                i -= 1
+            else:
+                break
+        if i == len(self.waiting):
+            self.waiting.append(seq)
+        else:
+            self.waiting.insert(i, seq)
 
     def abort(self, seq_id: str) -> bool:
         for seq in list(self.waiting):

@@ -75,6 +75,7 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         repetition_penalty=req.repetition_penalty,
         min_p=req.min_p,
         min_tokens=req.min_tokens,
+        priority=req.priority,
         logit_bias=_logit_bias(req),
     )
 

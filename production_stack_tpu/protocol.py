@@ -56,6 +56,7 @@ class CompletionRequest(OpenAIBase):
     repetition_penalty: float = 1.0    # vLLM extension (HF semantics)
     min_p: float = 0.0                 # vLLM extension
     min_tokens: int = 0                # vLLM extension
+    priority: int = 0                  # vLLM extension (lower = sooner)
     logit_bias: Optional[Dict[str, float]] = None
     user: Optional[str] = None
 
@@ -99,6 +100,7 @@ class ChatCompletionRequest(OpenAIBase):
     repetition_penalty: float = 1.0    # vLLM extension (HF semantics)
     min_p: float = 0.0                 # vLLM extension
     min_tokens: int = 0                # vLLM extension
+    priority: int = 0                  # vLLM extension (lower = sooner)
     logit_bias: Optional[Dict[str, float]] = None
     user: Optional[str] = None
 

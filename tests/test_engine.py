@@ -526,3 +526,62 @@ def test_plain_sampling_matches_full_path_when_untruncated():
     top1 = np.asarray(sample(logits, trunc, key))
     np.testing.assert_array_equal(top1, np.asarray(
         jnp.argmax(logits, axis=-1)))
+
+
+def test_priority_scheduling():
+    """Lower priority value admits earlier (vLLM semantics): with both
+    slots busy, a later-arriving priority=-1 request jumps a queued
+    default-priority one, while equal priorities keep FIFO order."""
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4)
+    eng = LLMEngine(cfg)
+    hold = SamplingOptions(temperature=0.0, max_tokens=60,
+                           ignore_eos=True)
+    quick = SamplingOptions(temperature=0.0, max_tokens=4,
+                            ignore_eos=True)
+    vip = SamplingOptions(temperature=0.0, max_tokens=4,
+                          ignore_eos=True, priority=-1)
+    a = eng.add_request(list(range(3, 13)), hold)
+    b = eng.add_request(list(range(23, 33)), hold)
+    for _ in range(3):
+        eng.step()      # both slots now busy
+    c = eng.add_request(list(range(40, 50)), quick)   # queued first
+    d = eng.add_request(list(range(50, 60)), quick)   # queued second
+    e = eng.add_request(list(range(60, 70)), vip)     # arrives LAST
+    finished = []
+    guard = 0
+    while len(finished) < 5:
+        finished += [o.seq_id for o in eng.step() if o.finished]
+        guard += 1
+        assert guard < 1000
+    queued = [s for s in finished if s in (c, d, e)]
+    assert queued[0] == e, f"priority request did not jump: {queued}"
+    assert queued[1:] == [c, d], f"FIFO broken within level: {queued}"
+
+
+def test_priority_never_jumps_preempted():
+    """A preempted (partially-generated) sequence at the queue head is
+    not overtaken by later higher-priority arrivals — recompute-first
+    beats priority, or steady priority traffic would starve it."""
+    from production_stack_tpu.engine.scheduler import Scheduler, Sequence
+
+    sched = Scheduler(max_num_seqs=1, max_model_len=128,
+                      prefill_chunk=32)
+    pre = Sequence(seq_id="pre", prompt_tokens=[1, 2, 3],
+                   options=SamplingOptions(priority=5))
+    pre.output_tokens = [9, 9]          # partially generated
+    sched.waiting.appendleft(pre)       # as scheduler.preempt does
+    vip = Sequence(seq_id="vip", prompt_tokens=[4, 5],
+                   options=SamplingOptions(priority=-10))
+    sched.add(vip)
+    assert [s.seq_id for s in sched.waiting] == ["pre", "vip"]
+    # but vip still jumps ordinary queued (no-output) sequences
+    plain = Sequence(seq_id="plain", prompt_tokens=[6],
+                     options=SamplingOptions())
+    sched.add(plain)
+    vip2 = Sequence(seq_id="vip2", prompt_tokens=[7],
+                    options=SamplingOptions(priority=-1))
+    sched.add(vip2)
+    assert [s.seq_id for s in sched.waiting] == \
+        ["pre", "vip", "vip2", "plain"]
