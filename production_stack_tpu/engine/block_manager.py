@@ -194,3 +194,27 @@ class BlockManager:
             self._key_of[blk] = key
             count += 1
         return count
+
+    def register_incremental(self, tokens: Sequence[int],
+                             blocks: Sequence[int], state,
+                             salt: str = ""):
+        """Progressive register() for live sequences: key and register
+        only blocks completed SINCE the previous call, threading the
+        hasher's (chunks_keyed, digest) chain state — O(new blocks)
+        per prefill chunk where re-keying from scratch would make a
+        long prompt's hashing quadratic (kvcache/chunks.chain_keys).
+        Returns the new state; pass it back on the next call."""
+        if self.hasher is None:
+            return state
+        n = min(len(tokens) // self.block_size, len(blocks))
+        start = state[0] if state else 0
+        if n <= start:
+            return state
+        new_keys, state = self.hasher.chain_keys(
+            list(tokens[:n * self.block_size]), salt=salt, state=state)
+        for key, blk in zip(new_keys, blocks[start:n]):
+            if key in self._by_key or blk in self._key_of:
+                continue
+            self._by_key[key] = blk
+            self._key_of[blk] = key
+        return state

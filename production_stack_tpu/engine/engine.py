@@ -477,6 +477,21 @@ class LLMEngine:
             for w in group:
                 self.scheduler.on_prefill_done(w)
                 self.metrics.prompt_tokens.inc(len(w.chunk))
+                if (self.cfg.enable_prefix_caching
+                        and not w.seq.rolled_blocks):
+                    # LIVE progressive registration: a full block's
+                    # K/V is final the moment its last position is
+                    # written (write-then-attend; full blocks are
+                    # never rewritten), so a concurrent same-prefix
+                    # request can attach it WITHOUT waiting for this
+                    # sequence to finish. The hasher chain state rides
+                    # the sequence so each chunk keys only its NEW
+                    # blocks (O(L^2) otherwise on long prompts).
+                    seq = w.seq
+                    seq.reg_state = self.block_mgr.register_incremental(
+                        seq.prefill_tokens[:seq.num_prefilled],
+                        seq.block_ids, seq.reg_state,
+                        salt=self._adapter_salt(seq.adapter_id))
                 if self.connector is not None:
                     # progressive publish: disagg decode engines can pull
                     # the prefix while later chunks still prefill
@@ -1172,6 +1187,7 @@ class LLMEngine:
         slot = seq.slot
         self._free_seq_blocks(seq)
         seq.rolled_blocks = 0   # recompute re-prefills from position 0
+        seq.reg_state = None    # re-register the recomputed blocks
         self.scheduler.preempt(seq)
         self._park_slot(slot)
         self._set_table_row(slot, [])

@@ -82,6 +82,9 @@ class Sequence:
     # blocks freed behind the sliding window (engine._roll_windows);
     # prefix registration is skipped once any block rolled
     rolled_blocks: int = 0
+    # live progressive-registration hasher chain state
+    # (block_manager.register_incremental); reset on preemption
+    reg_state: object = None
     output_tokens: List[int] = field(default_factory=list)
     # per output token: chosen-token logprob (raw model distribution)
     output_logprobs: List[Optional[float]] = field(default_factory=list)

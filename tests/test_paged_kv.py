@@ -222,3 +222,39 @@ def test_32k_class_config_serves_with_bounded_pool():
         guard += 1
         assert guard < 500
     assert all(len(eng.seqs[s].output_tokens) == 8 for s in sids)
+
+
+def test_live_prefix_sharing_between_concurrent_requests():
+    """A full prompt block registers the moment it is prefilled, so a
+    same-prefix request arriving while the FIRST is still generating
+    attaches its blocks (zero-copy hit) and produces identical greedy
+    output."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions, SeqStatus
+
+    cfg = EngineConfig(model="debug-tiny", max_model_len=256,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(32,), decode_window=4,
+                       kv_block_size=16, enable_prefix_caching=True)
+    eng = LLMEngine(cfg)
+    prompt = list(range(3, 83))                      # 80 tokens, 5 blocks
+    opts = SamplingOptions(temperature=0.0, max_tokens=40,
+                           ignore_eos=True)
+    a = eng.add_request(prompt, opts)
+    # drive until A is generating (prompt fully prefilled + registered)
+    while not eng.seqs[a].output_tokens:
+        eng.step()
+    assert eng.seqs[a].status is SeqStatus.RUNNING
+    b = eng.add_request(prompt, opts)
+    done = set()
+    guard = 0
+    while len(done) < 2:
+        done.update(o.seq_id for o in eng.step() if o.finished)
+        guard += 1
+        assert guard < 1000
+    # B attached A's LIVE prompt blocks: prefill was skipped past the
+    # shared prefix (num_prefilled jumped at admission) and the pool
+    # recorded a hit
+    assert eng.block_mgr.hit_rate > 0
+    assert eng.seqs[b].output_tokens == eng.seqs[a].output_tokens

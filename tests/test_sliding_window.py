@@ -255,9 +255,12 @@ def test_rolling_kv_frees_behind_window():
     assert all(len(t) == 300 for t in small_toks)
 
 
-def test_rolling_kv_skips_prefix_registration():
-    """A rolled sequence must not register its (now-partial) chain
-    for prefix sharing."""
+def test_rolling_kv_skips_finish_registration():
+    """PROMPT blocks register at prefill time (live sharing — they are
+    contiguous and final when written, even if later rolled away), but
+    a rolled sequence must NOT register its output chain at finish:
+    the chain's early blocks are gone, so those keys would be
+    unreachable at best."""
     from production_stack_tpu.engine.config import EngineConfig
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.scheduler import SamplingOptions
@@ -269,14 +272,19 @@ def test_rolling_kv_skips_prefix_registration():
     eng = LLMEngine(cfg)
     opts = SamplingOptions(temperature=0.0, max_tokens=200,
                            ignore_eos=True)
-    sid = eng.add_request(list(range(3, 35)), opts)
+    sid = eng.add_request(list(range(3, 35)), opts)    # 2 full blocks
+    keys_after_prefill = None
     done = False
     guard = 0
     while not done:
         for out in eng.step():
             if out.seq_id == sid and out.finished:
                 done = True
+        if keys_after_prefill is None and eng.seqs[sid].output_tokens:
+            keys_after_prefill = set(eng.block_mgr._by_key)
         guard += 1
         assert guard < 2000
     assert eng.seqs[sid].rolled_blocks > 0
-    assert not eng.block_mgr._by_key, "rolled chain was registered"
+    assert len(keys_after_prefill) == 2    # the prompt's full blocks
+    assert set(eng.block_mgr._by_key) == keys_after_prefill, \
+        "rolled sequence registered output-chain keys at finish"
