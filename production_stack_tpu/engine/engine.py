@@ -41,6 +41,8 @@ class StepOutput:
     finish_reason: Optional[str]
     # chosen token's log p under the raw model distribution (runner)
     logprob: Optional[float] = None
+    # top_logprobs alternatives [(token_id, logprob)] when requested
+    top_alts: Optional[list] = None
 
 
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
@@ -402,7 +404,7 @@ class LLMEngine:
                and not (self.cfg.speculative_ngram_tokens
                         and self._hist_dirty)
                and self._worth_dispatch_ahead()):
-            ahead = sum(w[3] for w in self._inflight)
+            ahead = sum(w[4] for w in self._inflight)
             if not self._dispatch_decode(
                     list(self.scheduler.running.values()), ahead=ahead):
                 break
@@ -412,7 +414,7 @@ class LLMEngine:
         reach its token budget within the windows already in flight —
         then the whole dispatch would likely be discarded work (and
         would delay the next admission wave by one window)."""
-        inflight_steps = sum(w[3] for w in self._inflight)
+        inflight_steps = sum(w[4] for w in self._inflight)
         live = [s for s in self.scheduler.running.values()
                 if s.status is SeqStatus.RUNNING]
         if not live:
@@ -457,6 +459,10 @@ class LLMEngine:
                         gstates[w.seq.slot] = w.seq.fsm_state
             penalized = any(w.seq.options.shaped for w in group
                             if w.is_last)
+            topk = max((w.seq.options.top_logprobs for w in group
+                        if w.is_last), default=0)
+            if topk:
+                topk = 1 << (topk - 1).bit_length()
             if penalized:
                 # the group's last-chunk rows sample their first token
                 # with shaped logits; mirrors are current (all in-flight
@@ -466,14 +472,11 @@ class LLMEngine:
                 # this very prefill samples, which prefill executables
                 # don't record device-side
                 self.runner.set_penalty_state(*self._penalty_arrays())
-            ids_dev, lps_dev = self.runner.prefill(tokens, starts, lengths,
-                                                   self._dev_sampling,
-                                                   kv_len,
-                                                   guide_table=gtable,
-                                                   guide_ids=gids,
-                                                   guide_states=gstates,
-                                                   penalized=penalized)
-            ids = lps = None
+            ids_dev, lps_dev, tops_dev = self.runner.prefill(
+                tokens, starts, lengths, self._dev_sampling, kv_len,
+                guide_table=gtable, guide_ids=gids,
+                guide_states=gstates, penalized=penalized, topk=topk)
+            ids = lps = tops = None
             for w in group:
                 self.scheduler.on_prefill_done(w)
                 self.metrics.prompt_tokens.inc(len(w.chunk))
@@ -510,13 +513,24 @@ class LLMEngine:
                 if ids is None:
                     ids = np.asarray(ids_dev)  # one sync per bucket group
                     lps = np.asarray(lps_dev)
+                    tops = (None if tops_dev is None else
+                            (np.asarray(tops_dev[0]),
+                             np.asarray(tops_dev[1])))
                 # prompt fully prefilled: the sampled id is the first
                 # output token
+                k = seq.options.top_logprobs
+                alts = None
+                if tops is not None and k:
+                    alts = [(int(t), float(l)) for t, l in
+                            zip(tops[0][seq.slot, :k],
+                                tops[1][seq.slot, :k])
+                            if l > -1e29]
                 seq.first_token_time = time.monotonic()
                 self.metrics.ttft.observe(
                     seq.first_token_time - seq.arrival_time)
                 outputs.extend(self._accept_token(
-                    seq, int(ids[seq.slot]), float(lps[seq.slot])))
+                    seq, int(ids[seq.slot]), float(lps[seq.slot]),
+                    alts))
         # prefill changed slot contents/positions: refresh decode carry
         self._decode_dirty = True
         self._hist_dirty = True
@@ -645,11 +659,19 @@ class LLMEngine:
         # penalized windows carry [B, V] token counts and shape logits
         # before sampling; unshaped batches keep the ordinary executables
         penalized = any(s.options.shaped for s in decode_seqs)
+        # OpenAI top_logprobs alternatives: one executable per
+        # power-of-two K bucket, only when some live row asks
+        topk = max((s.options.top_logprobs for s in decode_seqs),
+                   default=0)
+        if topk:
+            topk = 1 << (topk - 1).bit_length()
         # n-gram speculation: greedy-only (argmax verify is exact),
-        # never with guided rows (drafts would bypass the DFA mask) or
-        # shaped rows (draft verification ignores the adjusted logits)
+        # never with guided rows (drafts would bypass the DFA mask),
+        # shaped rows (draft verification ignores the adjusted
+        # logits), or alternatives (macro-steps emit several tokens)
         spec = (self.cfg.speculative_ngram_tokens
-                if greedy and gtable is None and not penalized else 0)
+                if greedy and gtable is None and not penalized
+                and not topk else 0)
         kv_len = self.cfg.kv_bucket_for(
             min(max_pos + (W + ahead) * (spec + 1) + 1,
                 self.cfg.max_model_len))
@@ -685,12 +707,12 @@ class LLMEngine:
         plain = all(s.options.top_p >= 1.0 and not s.options.top_k
                     and not s.options.min_p
                     for s in decode_seqs)
-        ids_dev, lps_dev, counts_dev = self.runner.decode(
+        ids_dev, lps_dev, counts_dev, tops_dev = self.runner.decode(
             self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
             seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec,
-            plain=plain, penalized=penalized)
-        self._inflight.append((ids_dev, lps_dev, counts_dev, W,
-                               list(decode_seqs), time.monotonic()))
+            plain=plain, penalized=penalized, topk=topk)
+        self._inflight.append((ids_dev, lps_dev, counts_dev, tops_dev,
+                               W, list(decode_seqs), time.monotonic()))
         return True
 
     def _drain_decode(self) -> List[StepOutput]:
@@ -704,23 +726,27 @@ class LLMEngine:
 
     def _sync_inflight(self):
         """Device->host sync of the OLDEST in-flight window's arrays (no
-        token processing): (ids, lps, counts, W, seqs, t0) or None. t0
+        token processing): (ids, lps, counts, tops, W, seqs, t0) or
+        None. t0
         is clamped to the previous sync's completion so pipelined
         windows report per-window wall, not time-since-dispatch."""
         if not self._inflight:
             return None
-        ids_dev, lps_dev, counts_dev, W, seqs, t0 = self._inflight.pop(0)
+        (ids_dev, lps_dev, counts_dev, tops_dev, W, seqs,
+         t0) = self._inflight.pop(0)
         t0 = max(t0, getattr(self, "_last_sync_t", 0.0))
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
         counts = None if counts_dev is None else np.asarray(counts_dev)
+        tops = (None if tops_dev is None else
+                (np.asarray(tops_dev[0]), np.asarray(tops_dev[1])))
         self._last_sync_t = time.monotonic()
-        return ids, lps, counts, W, seqs, t0
+        return ids, lps, counts, tops, W, seqs, t0
 
     def _process_window(self, synced) -> List[StepOutput]:
         if synced is None:
             return []
-        ids, lps, counts, W, seqs, t0 = synced
+        ids, lps, counts, tops, W, seqs, t0 = synced
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
@@ -743,10 +769,24 @@ class LLMEngine:
                     row = [(int(ids[seq.slot, j, t]),
                             float(lps[seq.slot, j, t]))
                            for t in range(c)]
+                # top_logprobs alternatives for rows that asked (trim
+                # the window's K bucket to the request's k); spec and
+                # alternatives are mutually exclusive (dispatch gate)
+                k = seq.options.top_logprobs
+                alts = None
+                if tops is not None and k:
+                    ti, tl = tops
+                    # guided rows mask forbidden tokens to -inf; those
+                    # slots are garbage ids and would serialize as
+                    # invalid JSON (-Infinity) — drop them (OpenAI
+                    # allows fewer than k alternatives)
+                    alts = [(int(t), float(l)) for t, l in
+                            zip(ti[seq.slot, j, :k], tl[seq.slot, j, :k])
+                            if l > -1e29]
                 finished = False
                 for token, lp in row:
                     self.metrics.per_token.observe(per_tok_dt)
-                    outs = self._accept_token(seq, token, lp)
+                    outs = self._accept_token(seq, token, lp, alts)
                     outputs.extend(outs)
                     if outs[-1].finished:
                         finished = True
@@ -759,9 +799,12 @@ class LLMEngine:
         return outputs
 
     def _accept_token(self, seq: Sequence, token: int,
-                      logprob: Optional[float] = None) -> List[StepOutput]:
+                      logprob: Optional[float] = None,
+                      top_alts=None) -> List[StepOutput]:
         seq.output_tokens.append(token)
         seq.output_logprobs.append(logprob)
+        if seq.options.top_logprobs:
+            seq.output_top.append(top_alts)
         if seq.grammar is not None:
             # host mirror of the device-carried DFA state (re-uploaded on
             # slot composition changes); DEAD can't be sampled, max() is
@@ -810,10 +853,10 @@ class LLMEngine:
             self.metrics.e2e_latency.observe(
                 time.monotonic() - seq.arrival_time)
             return [StepOutput(seq.seq_id, token, text_delta, True, reason,
-                               logprob)]
+                               logprob, top_alts)]
         self._sync_slot(seq)
         return [StepOutput(seq.seq_id, token, text_delta, False, None,
-                           logprob)]
+                           logprob, top_alts)]
 
     def _stop_reason(self, seq: Sequence, token: int,
                      delta: str) -> Optional[str]:

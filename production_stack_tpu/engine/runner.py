@@ -194,7 +194,8 @@ class ModelRunner:
                      *, steps: int, kv_len: int,
                      greedy: bool, seeded: bool = False,
                      guided: bool = False, plain: bool = False,
-                     penalized: bool = False, eos_id: int = 0):
+                     penalized: bool = False, eos_id: int = 0,
+                     topk: int = 0):
         """tokens/positions [B] -> (ids [B, steps], logprobs [B, steps],
         tokens', positions', cache').
 
@@ -258,16 +259,28 @@ class ModelRunner:
                                    jnp.maximum(adv, 0), gstate)
             if penalized:
                 counts = counts.at[jnp.arange(B), ids].add(1)
-            lp = jnp.take_along_axis(
-                jax.nn.log_softmax(last, axis=-1), ids[:, None],
-                axis=-1)[:, 0]
-            return (cache, ids, pos + 1, gstate, counts), (ids, lp)
+            lsm = jax.nn.log_softmax(last, axis=-1)
+            lp = jnp.take_along_axis(lsm, ids[:, None], axis=-1)[:, 0]
+            if topk:
+                # OpenAI top_logprobs alternatives: the K highest
+                # entries of the same raw distribution the chosen
+                # logprob reports — one top_k next to the argmax, noise
+                # next to the weight streaming
+                tl, ti = jax.lax.top_k(lsm, topk)
+            else:
+                tl = jnp.zeros((B, 1), jnp.float32)
+                ti = jnp.zeros((B, 1), jnp.int32)
+            return ((cache, ids, pos + 1, gstate, counts),
+                    (ids, lp, ti, tl))
 
-        (cache, toks, pos, gstate, counts), (ids, lps) = jax.lax.scan(
-            body, (cache, tokens, positions, guide_state, out_counts),
-            jnp.arange(steps))
-        return (ids.T, lps.T, toks, pos, gstate, counts,
-                cache)  # ids/lps [B, steps]
+        (cache, toks, pos, gstate, counts), (ids, lps, tis, tls) = \
+            jax.lax.scan(
+                body, (cache, tokens, positions, guide_state, out_counts),
+                jnp.arange(steps))
+        # ids/lps [B, steps]; tis/tls [B, steps, K]
+        return (ids.T, lps.T, tis.transpose(1, 0, 2),
+                tls.transpose(1, 0, 2), toks, pos, gstate, counts,
+                cache)
 
     def _decode_spec_impl(self, params, cache: KVCache,
                           tables: jnp.ndarray,
@@ -355,7 +368,8 @@ class ModelRunner:
                       guide_state: jnp.ndarray,
                       out_counts: jnp.ndarray, prompt_seen: jnp.ndarray,
                       *, kv_len: int, guided: bool = False,
-                      penalized: bool = False, eos_id: int = 0):
+                      penalized: bool = False, eos_id: int = 0,
+                      topk: int = 0):
         """Full-batch chunk prefill. tokens [B, Tb], starts/lengths [B].
 
         Every row writes its chunk at its own offset through its block
@@ -398,9 +412,15 @@ class ModelRunner:
             last = jnp.where(is_g & (nxt_row < 0), -jnp.inf, last)
         ids = sample(last, sampling, key,
                      positions=starts + jnp.maximum(lengths, 1))
-        lp = jnp.take_along_axis(
-            jax.nn.log_softmax(last, axis=-1), ids[:, None], axis=-1)[:, 0]
-        return ids, lp, cache
+        lsm = jax.nn.log_softmax(last, axis=-1)
+        lp = jnp.take_along_axis(lsm, ids[:, None], axis=-1)[:, 0]
+        if topk:
+            tl, ti = jax.lax.top_k(lsm, topk)
+        else:
+            B2 = last.shape[0]
+            tl = jnp.zeros((B2, 1), jnp.float32)
+            ti = jnp.zeros((B2, 1), jnp.int32)
+        return ids, lp, ti, tl, cache
 
     # ------------------------------------------------------------------
     # host API
@@ -457,13 +477,15 @@ class ModelRunner:
                kv_len: Optional[int] = None, greedy: bool = False,
                seeded: bool = False, guide_table=None, guide_ids=None,
                spec: int = 0, plain: bool = False,
-               penalized: bool = False):
+               penalized: bool = False, topk: int = 0):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
-        (ids, logprobs, counts): without speculation ids/logprobs are
-        [B, steps] and counts is None; with spec > 0 (greedy, unguided
-        windows only) they are [B, steps, spec+1] plus counts [B, steps]
-        of valid tokens per macro-step (_decode_spec_impl). The first
+        (ids, logprobs, counts, tops): without speculation ids/logprobs
+        are [B, steps] and counts is None; with spec > 0 (greedy,
+        unguided windows only) they are [B, steps, spec+1] plus counts
+        [B, steps] of valid tokens per macro-step (_decode_spec_impl).
+        tops is None unless topk > 0: then (ids [B, steps, K],
+        logprobs [B, steps, K]) top-K alternatives per step. The first
         np.asarray() is the window's single sync.
 
         guide_table [G, S, V] device int32 + guide_ids [B] activate
@@ -490,13 +512,13 @@ class ModelRunner:
                                              make_spec, args)
             (ids, lps, counts, self._dec_tokens, self._dec_pos,
              self._dec_hist, self.cache) = fn(*args)
-            return ids, lps, counts
+            return ids, lps, counts, None
         seeded = seeded and not greedy
         plain = plain and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
         cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain,
-                     penalized)
+                     penalized, topk)
         B = self.engine_cfg.max_num_seqs
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
@@ -524,16 +546,16 @@ class ModelRunner:
                 partial(self._decode_impl, steps=steps, kv_len=kv_len,
                         greedy=greedy, seeded=seeded, guided=guided,
                         plain=plain, penalized=penalized,
-                        eos_id=self._eos_id),
+                        eos_id=self._eos_id, topk=topk),
                 donate_argnums=(1,))
 
         fn = self._compile_with_fallback(self._decode_fns, cache_key,
                                          make_decode, args)
-        (ids, lps, self._dec_tokens, self._dec_pos, self._dec_gstate,
-         counts_out, self.cache) = fn(*args)
+        (ids, lps, tis, tls, self._dec_tokens, self._dec_pos,
+         self._dec_gstate, counts_out, self.cache) = fn(*args)
         if penalized:
             self._dec_counts = counts_out
-        return ids, lps, None
+        return ids, lps, None, (tis, tls) if topk else None
 
     def _compile_with_fallback(self, cache: dict, key, make_fn, args):
         """Fetch-or-compile an executable; if the pallas paged kernel
@@ -568,10 +590,12 @@ class ModelRunner:
 
     def prefill(self, tokens, starts, lengths, sampling: SamplingParams,
                 kv_len: int, guide_table=None, guide_ids=None,
-                guide_states=None, penalized: bool = False):
+                guide_states=None, penalized: bool = False,
+                topk: int = 0):
         """Full-batch chunk prefill (see _prefill_impl). tokens [B, Tb]
-        int32 np; starts/lengths [B]. Returns device (ids, logprobs),
-        each [B].
+        int32 np; starts/lengths [B]. Returns device (ids, logprobs,
+        tops) — ids/logprobs [B]; tops None unless topk > 0, then
+        ([B, K] ids, [B, K] logprobs) alternatives.
 
         Prefill executables compile lazily per (chunk, kv bucket); if the
         pallas flash kernel fails to BUILD for a combination (backend or
@@ -609,14 +633,15 @@ class ModelRunner:
                         " penalized" if penalized else "")
             return jax.jit(partial(self._prefill_impl, kv_len=kv_len,
                                    guided=guided, penalized=penalized,
-                                   eos_id=self._eos_id),
+                                   eos_id=self._eos_id, topk=topk),
                            donate_argnums=(1,))
 
         fn = self._compile_with_fallback(
-            self._prefill_fns, (Tb, kv_len, guided, gshape, penalized),
+            self._prefill_fns,
+            (Tb, kv_len, guided, gshape, penalized, topk),
             make_prefill, args)
-        ids, lps, self.cache = fn(*args)
-        return ids, lps
+        ids, lps, tis, tls, self.cache = fn(*args)
+        return ids, lps, (tis, tls) if topk else None
 
     def embed(self, tokens, lengths):
         """Mean-pooled final hidden states for padded prompts.

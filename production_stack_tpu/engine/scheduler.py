@@ -39,6 +39,9 @@ class SamplingOptions:
     stop_token_ids: List[int] = field(default_factory=list)
     ignore_eos: bool = False
     logprobs: bool = False
+    # OpenAI top_logprobs: return the K highest-probability
+    # alternatives per generated token (0 = chosen-token only)
+    top_logprobs: int = 0
     # > 0: reproducible sampling — gumbel noise derived from
     # (seed, token position) only (engine/sampler.py)
     seed: Optional[int] = None
@@ -88,6 +91,9 @@ class Sequence:
     output_tokens: List[int] = field(default_factory=list)
     # per output token: chosen-token logprob (raw model distribution)
     output_logprobs: List[Optional[float]] = field(default_factory=list)
+    # per output token, when options.top_logprobs: [(id, logprob)] top
+    # alternatives (None for tokens emitted by paths without them)
+    output_top: List[Optional[list]] = field(default_factory=list)
     num_prefilled: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None

@@ -57,6 +57,28 @@ def _logit_bias(req) -> Optional[dict]:
                          "numbers")
 
 
+def _top_logprobs(req) -> int:
+    """How many per-token alternatives the request wants: chat's
+    top_logprobs, or legacy completions' integer logprobs=N (OpenAI
+    caps both at 20, rejects negatives, and requires chat's
+    logprobs=true alongside top_logprobs)."""
+    tl = getattr(req, "top_logprobs", None)
+    if tl is not None and not 0 <= tl <= 20:
+        raise ValueError(
+            f"top_logprobs must be in [0, 20] (got {tl})")
+    if tl and not getattr(req, "logprobs", None):
+        raise ValueError(
+            "top_logprobs requires logprobs to be set to true")
+    tl = tl or 0
+    if not tl:
+        lp = getattr(req, "logprobs", None)
+        if isinstance(lp, int) and not isinstance(lp, bool) and lp > 0:
+            tl = lp
+    if tl > 20:
+        raise ValueError(f"top_logprobs supports at most 20 (got {tl})")
+    return int(tl)
+
+
 def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
     stop = req.stop if isinstance(req.stop, list) else (
         [req.stop] if req.stop else [])
@@ -77,6 +99,7 @@ def _sampling_options(req, max_tokens: Optional[int]) -> SamplingOptions:
         min_tokens=req.min_tokens,
         priority=req.priority,
         logit_bias=_logit_bias(req),
+        top_logprobs=_top_logprobs(req),
     )
 
 
@@ -225,28 +248,50 @@ def _lp_skip(out) -> bool:
     return out.finished and out.finish_reason == "stop"
 
 
-def _chat_lp_entry(tok, token_id: int, logprob, want_top: bool):
-    """One chat-logprobs content entry. The engine tracks the CHOSEN
-    token's logprob (raw model distribution, engine/runner.py); when
-    top_logprobs is requested, that chosen entry is the one alternative
-    reported. Token text/bytes come from the tokenizer's own token
-    representation so multi-byte-split pieces stay distinct."""
+def _chat_lp_entry(tok, token_id: int, logprob, want_top: bool,
+                   alts=None):
+    """One chat-logprobs content entry. `alts` [(token_id, logprob)]
+    are the device-computed top-K alternatives of the same raw model
+    distribution the chosen logprob reports (engine/runner.py); paths
+    that don't produce them (e.g. speculative windows never run with
+    alternatives requested) fall back to the chosen entry. Token
+    text/bytes come from the tokenizer's own token representation so
+    multi-byte-split pieces stay distinct."""
     text, raw = tok.id_to_token(token_id)
     lp = logprob if logprob is not None else 0.0
     entry = proto.ChatLogprobToken(token=text, logprob=lp, bytes=raw)
     if want_top:
-        entry.top_logprobs = [proto.ChatLogprobTop(token=text, logprob=lp,
-                                                   bytes=raw)]
+        if alts:
+            tops = []
+            for tid, tlp in alts:
+                ttext, traw = tok.id_to_token(int(tid))
+                tops.append(proto.ChatLogprobTop(
+                    token=ttext, logprob=float(tlp), bytes=traw))
+            entry.top_logprobs = tops
+        else:
+            entry.top_logprobs = [proto.ChatLogprobTop(
+                token=text, logprob=lp, bytes=raw)]
     return entry
 
 
-def _completion_logprobs(tok, token_ids, logprobs,
-                         want_top: bool) -> "proto.CompletionLogprobs":
-    """Legacy completions logprobs block from chosen-token data."""
+def _completion_logprobs(tok, token_ids, logprobs, want_top: bool,
+                         alts_list=None) -> "proto.CompletionLogprobs":
+    """Legacy completions logprobs block. alts_list (parallel to
+    token_ids) holds [(id, logprob)] device-computed top-N
+    alternatives; entries without them fall back to the chosen
+    token."""
     texts = [tok.id_to_token(t)[0] for t in token_ids]
     lps = [lp if lp is not None else 0.0 for lp in logprobs]
-    top = ([{text: lp} for text, lp in zip(texts, lps)]
-           if want_top else None)
+    top = None
+    if want_top:
+        top = []
+        for i, (text, lp) in enumerate(zip(texts, lps)):
+            alts = alts_list[i] if alts_list else None
+            if alts:
+                top.append({tok.id_to_token(int(t))[0]: float(l)
+                            for t, l in alts})
+            else:
+                top.append({text: lp})
     return proto.CompletionLogprobs(tokens=texts, token_logprobs=lps,
                                     top_logprobs=top)
 
@@ -362,7 +407,8 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                         lp_block = proto.ChatLogprobs(content=[
                             _chat_lp_entry(tok, out.new_token,
                                            out.logprob,
-                                           bool(req.top_logprobs))])
+                                           bool(req.top_logprobs),
+                                           out.top_alts)])
                     # a token can produce no text yet (partial UTF-8 in
                     # the detokenizer) — its logprob entry must still
                     # be delivered
@@ -403,7 +449,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                     if req.logprobs and not _lp_skip(out):
                         lp_entries.append(_chat_lp_entry(
                             tok, out.new_token, out.logprob,
-                            bool(req.top_logprobs)))
+                            bool(req.top_logprobs), out.top_alts))
                 if out.finished:
                     finish_reason = out.finish_reason
         choice = proto.ChatCompletionChoice(
@@ -502,7 +548,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                             and not _lp_skip(out)):
                         lp_block = _completion_logprobs(
                             tok, [out.new_token], [out.logprob],
-                            req.logprobs > 0)
+                            req.logprobs > 0, [out.top_alts])
                     if out.text_delta or out.finished or lp_block:
                         chunk = proto.CompletionChunk(
                             id=rid, model=req.model,
@@ -528,6 +574,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
         parts: List[str] = []
         out_ids: List[int] = []
         out_lps: List = []
+        out_alts: List = []
         tokens = 0
         finish_reason = None
         async with aclosing(engine.stream(
@@ -539,10 +586,11 @@ async def completions(request: web.Request) -> web.StreamResponse:
                     if not _lp_skip(out):
                         out_ids.append(out.new_token)
                         out_lps.append(out.logprob)
+                        out_alts.append(out.top_alts)
                 if out.finished:
                     finish_reason = out.finish_reason
         lp_block = (_completion_logprobs(tok, out_ids, out_lps,
-                                         req.logprobs > 0)
+                                         req.logprobs > 0, out_alts)
                     if req.logprobs is not None else None)
         echo_text = ""
         if req.echo:

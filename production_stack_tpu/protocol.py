@@ -114,10 +114,11 @@ class UsageInfo(OpenAIBase):
 
 
 class CompletionLogprobs(OpenAIBase):
-    """Legacy completions logprobs block. Only the chosen token's
-    logprob is tracked by the engine (raw model distribution); the
-    top-N alternatives of the legacy API are not retained, so
-    top_logprobs carries just the chosen token's entry per position."""
+    """Legacy completions logprobs block. logprobs=N returns the N
+    highest-probability alternatives per position, computed on-device
+    next to the chosen token's logprob (raw model distribution,
+    engine/runner.py); paths without alternatives fall back to the
+    chosen token's entry."""
     tokens: List[str] = Field(default_factory=list)
     token_logprobs: List[Optional[float]] = Field(default_factory=list)
     top_logprobs: Optional[List[Optional[Dict[str, float]]]] = None

@@ -234,3 +234,73 @@ def test_penalty_ranges_rejected(engine):
                {"min_tokens": -1}):
         with pytest.raises(ValueError):
             engine.add_request([1, 2, 3], SamplingOptions(**kw))
+
+
+def test_engine_top_logprobs_alternatives(engine):
+    """top_logprobs returns K real alternatives per generated token:
+    sorted descending, and for greedy decoding the chosen token is the
+    top-1 with a matching logprob."""
+    seq = _run(engine, range(5, 25), temperature=0.0, max_tokens=6,
+               ignore_eos=True, top_logprobs=3)
+    assert len(seq.output_top) == 6
+    for chosen, lp, alts in zip(seq.output_tokens, seq.output_logprobs,
+                                seq.output_top):
+        assert alts is not None and len(alts) == 3
+        lps = [l for _, l in alts]
+        assert lps == sorted(lps, reverse=True)
+        assert alts[0][0] == chosen           # greedy: argmax is top-1
+        assert abs(alts[0][1] - lp) < 1e-4
+
+
+def test_server_top_logprobs():
+    """Chat top_logprobs returns K distinct alternatives; legacy
+    completions logprobs=N returns N-entry top dicts; >20 is a 400."""
+    import asyncio
+    from aiohttp.test_utils import TestClient, TestServer
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.server import build_app
+
+    async def run():
+        eng = AsyncLLMEngine(EngineConfig(
+            model="debug-tiny", max_model_len=128, max_num_seqs=2,
+            prefill_chunk=32, prefill_buckets=(16, 32), decode_window=4))
+        app = build_app(eng)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "alts"}],
+                "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+                "logprobs": True, "top_logprobs": 3})
+            assert r.status == 200, await r.text()
+            content = (await r.json())["choices"][0]["logprobs"]["content"]
+            assert len(content) == 4
+            for entry in content:
+                tops = entry["top_logprobs"]
+                assert len(tops) == 3
+                assert tops[0]["logprob"] >= tops[1]["logprob"] >= \
+                    tops[2]["logprob"]
+                assert abs(tops[0]["logprob"] - entry["logprob"]) < 1e-4
+            r = await client.post("/v1/completions", json={
+                "model": "debug-tiny", "prompt": "legacy", "max_tokens": 3,
+                "temperature": 0.0, "ignore_eos": True, "logprobs": 2})
+            assert r.status == 200, await r.text()
+            lpb = (await r.json())["choices"][0]["logprobs"]
+            assert len(lpb["top_logprobs"]) == 3
+            assert all(len(d) == 2 for d in lpb["top_logprobs"])
+            r = await client.post("/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2, "logprobs": True, "top_logprobs": 21})
+            assert r.status == 400
+    asyncio.run(run())
+
+
+def test_guided_top_logprobs_finite(engine):
+    """Guided rows' alternatives exclude DFA-forbidden (-inf) entries,
+    so every reported logprob is finite and JSON-serializable."""
+    seq = _run(engine, range(5, 20), temperature=0.0, max_tokens=12,
+               guided_regex=r"(one|two)", top_logprobs=5, logprobs=True)
+    assert seq.finish_reason == "stop"
+    for alts in seq.output_top:
+        assert alts is not None and 1 <= len(alts) <= 5
+        assert all(np.isfinite(l) for _, l in alts)
