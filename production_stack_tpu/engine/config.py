@@ -27,6 +27,14 @@ class EngineConfig:
     # Sequences that stop mid-window discard the tail (vLLM's
     # num-scheduler-steps tradeoff). 1 = token-at-a-time.
     decode_window: int = 8
+    # decode windows queued on the device at once (engine.step
+    # pipelining). 2 keeps the device saturated in the common case:
+    # window N+1 is queued while N runs, and the host processes N's
+    # tokens during N+1. Behind a high-RTT tunnel, 3 can buy extra
+    # overlap (host round-trips hide behind two device windows);
+    # deeper queues add latency to composition changes (admission
+    # waits behind every queued window).
+    pipeline_depth: int = 2
     # attention is computed over the cache prefix [:kv_len] where kv_len is
     # the smallest bucket covering every live position — decode cost scales
     # with live context, not max_model_len. Auto-derived in __post_init__.
@@ -55,10 +63,18 @@ class EngineConfig:
     # HBM traffic; None serves in --dtype precision
     quantization: Optional[str] = None
     # n-gram (prompt-lookup) speculative decoding: draft length per
-    # macro-step (0 = off). Activates only on all-greedy, unguided
-    # decode windows, where argmax verification is exact; other windows
-    # silently run the normal path (engine/runner.py).
+    # macro-step (0 = off). Eligibility is PER ROW: greedy, unguided,
+    # unshaped, no-alternatives rows speculate; other rows single-step
+    # inside the same window (engine/runner._decode_spec_impl).
     speculative_ngram_tokens: int = 0
+    # Serving meshes that shard the KV pool's block axis (dp > 1) cannot
+    # run the pallas paged-attention kernel shard-local; they fall back
+    # to the gathered-view jnp path, which re-materializes ~3x the KV
+    # traffic the kernel exists to delete. That perf cliff must be
+    # CHOSEN: constructing a runner on such a mesh with flash enabled
+    # raises unless this flag acknowledges the fallback (then it's one
+    # loud warning). tp-only meshes are unaffected.
+    dp_gather_attention_ok: bool = False
     seed: int = 0
     checkpoint: Optional[str] = None         # HF checkpoint dir; random if None
     # real embedding model for /v1/embeddings + rerank/score

@@ -48,14 +48,6 @@ class StepOutput:
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
 _FINISHED_RETENTION = 1024
 
-# decode windows queued on the device at once (engine.step pipelining).
-# 2 keeps the device saturated: window N+1 is queued while N runs, and
-# the host processes N's tokens during N+1. Deeper queues add latency
-# to composition changes (admission waits behind every queued window)
-# for no extra overlap.
-_PIPELINE_DEPTH = 2
-
-
 class LLMEngine:
     def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
         self.cfg = engine_cfg
@@ -195,7 +187,8 @@ class LLMEngine:
         # OpenAI/vLLM logit-shaping mirrors (engine/sampler.py); all
         # default-inert so unshaped batches compile the ordinary
         # executables
-        from production_stack_tpu.engine.sampler import LOGIT_BIAS_K
+        from production_stack_tpu.engine.sampler import (LOGIT_BIAS_K,
+                                                         MIN_TOKENS_STOP_K)
         self._slot_presence = np.zeros((B,), np.float32)
         self._slot_frequency = np.zeros((B,), np.float32)
         self._slot_repetition = np.ones((B,), np.float32)
@@ -204,6 +197,8 @@ class LLMEngine:
         self._slot_prompt_len = np.zeros((B,), np.int32)
         self._slot_bias_ids = np.full((B, LOGIT_BIAS_K), -1, np.int32)
         self._slot_bias_vals = np.zeros((B, LOGIT_BIAS_K), np.float32)
+        # stop_token_ids masked below min_tokens (sampler.adjust_logits)
+        self._slot_stop_ids = np.full((B, MIN_TOKENS_STOP_K), -1, np.int32)
         self.runner._eos_id = int(self.tokenizer.eos_token_id or 0)
         # guided decoding: per-slot DFA-state host mirror (grammar row
         # indices are rebuilt per dispatch from the sequences)
@@ -226,7 +221,7 @@ class LLMEngine:
         self._hist_dirty = True
         # decode windows kept in flight between step() calls (FIFO of
         # (ids_device, lps, counts, window, [seqs at dispatch], t0)).
-        # Up to _PIPELINE_DEPTH windows ride the device queue at once:
+        # Up to cfg.pipeline_depth windows ride the device queue at once:
         # window N+1 is dispatched BEFORE window N's results are synced,
         # so the device starts N+1 the instant N retires instead of
         # idling one host round-trip (which dominates when the chip sits
@@ -306,6 +301,15 @@ class LLMEngine:
         if options.min_tokens < 0:
             raise ValueError(f"min_tokens must be >= 0 "
                              f"(got {options.min_tokens})")
+        if options.min_tokens and options.stop_token_ids:
+            # the floor must ban these ids on-device; the mask array is
+            # a fixed small width (sampler.MIN_TOKENS_STOP_K)
+            from production_stack_tpu.engine.sampler import (
+                MIN_TOKENS_STOP_K)
+            if len(options.stop_token_ids) > MIN_TOKENS_STOP_K:
+                raise ValueError(
+                    f"min_tokens supports at most {MIN_TOKENS_STOP_K} "
+                    f"stop_token_ids (got {len(options.stop_token_ids)})")
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
                        options=options,
                        adapter_id=self.resolve_model(model),
@@ -366,7 +370,7 @@ class LLMEngine:
                 if not self._inflight:
                     self._dispatch_decode(decode_seqs)
                 # optimistic pipelining: top the device queue up to
-                # _PIPELINE_DEPTH windows BEFORE blocking on the front
+                # cfg.pipeline_depth windows BEFORE blocking on the front
                 # window's sync — with window N+1 already queued behind
                 # N, the device starts N+1 the instant N retires instead
                 # of idling one host round-trip (the dominant per-window
@@ -395,11 +399,11 @@ class LLMEngine:
 
     def _top_up_pipeline(self) -> None:
         """Queue optimistic decode windows behind the in-flight one(s)
-        up to _PIPELINE_DEPTH, provided the device carry is
+        up to cfg.pipeline_depth, provided the device carry is
         self-contained (no pending mirror uploads) and the extra window
         is unlikely to be pure discarded work."""
         while (self._inflight
-               and len(self._inflight) < _PIPELINE_DEPTH
+               and len(self._inflight) < self.cfg.pipeline_depth
                and not self._decode_dirty and not self._sampling_dirty
                and not (self.cfg.speculative_ngram_tokens
                         and self._hist_dirty)
@@ -551,7 +555,8 @@ class LLMEngine:
                 min_tokens=jnp.asarray(self._slot_min_tokens),
                 prompt_len=jnp.asarray(self._slot_prompt_len),
                 bias_ids=jnp.asarray(self._slot_bias_ids),
-                bias_vals=jnp.asarray(self._slot_bias_vals))
+                bias_vals=jnp.asarray(self._slot_bias_vals),
+                stop_ids=jnp.asarray(self._slot_stop_ids))
             self._sampling_dirty = False
 
     def _penalty_arrays(self):
@@ -665,13 +670,23 @@ class LLMEngine:
                    default=0)
         if topk:
             topk = 1 << (topk - 1).bit_length()
-        # n-gram speculation: greedy-only (argmax verify is exact),
-        # never with guided rows (drafts would bypass the DFA mask),
-        # shaped rows (draft verification ignores the adjusted
-        # logits), or alternatives (macro-steps emit several tokens)
-        spec = (self.cfg.speculative_ngram_tokens
-                if greedy and gtable is None and not penalized
-                and not topk else 0)
+        # n-gram speculation is PER-ROW: a row speculates iff it is
+        # greedy (argmax verify is exact), unguided (drafts would
+        # bypass the DFA mask), unshaped (draft verification ignores
+        # the adjusted logits), and asked for no alternatives
+        # (macro-steps emit several tokens). Ineligible rows single-
+        # step inside the same window — one presence_penalty user
+        # costs only their own row its speculation, not the batch's.
+        spec_rows = [s for s in decode_seqs
+                     if s.options.temperature <= 0.0
+                     and s.grammar is None and not s.options.shaped
+                     and not s.options.top_logprobs]
+        spec = (self.cfg.speculative_ngram_tokens if spec_rows else 0)
+        spec_ok = None
+        if spec:
+            spec_ok = np.zeros((self.cfg.max_num_seqs,), bool)
+            for s in spec_rows:
+                spec_ok[s.slot] = True
         kv_len = self.cfg.kv_bucket_for(
             min(max_pos + (W + ahead) * (spec + 1) + 1,
                 self.cfg.max_model_len))
@@ -710,9 +725,10 @@ class LLMEngine:
         ids_dev, lps_dev, counts_dev, tops_dev = self.runner.decode(
             self._dev_sampling, steps=W, kv_len=kv_len, greedy=greedy,
             seeded=seeded, guide_table=gtable, guide_ids=gids, spec=spec,
-            plain=plain, penalized=penalized, topk=topk)
+            spec_ok=spec_ok, plain=plain, penalized=penalized, topk=topk)
         self._inflight.append((ids_dev, lps_dev, counts_dev, tops_dev,
-                               W, list(decode_seqs), time.monotonic()))
+                               W, list(decode_seqs), time.monotonic(),
+                               spec_ok))
         return True
 
     def _drain_decode(self) -> List[StepOutput]:
@@ -733,7 +749,7 @@ class LLMEngine:
         if not self._inflight:
             return None
         (ids_dev, lps_dev, counts_dev, tops_dev, W, seqs,
-         t0) = self._inflight.pop(0)
+         t0, spec_ok) = self._inflight.pop(0)
         t0 = max(t0, getattr(self, "_last_sync_t", 0.0))
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
@@ -741,12 +757,12 @@ class LLMEngine:
         tops = (None if tops_dev is None else
                 (np.asarray(tops_dev[0]), np.asarray(tops_dev[1])))
         self._last_sync_t = time.monotonic()
-        return ids, lps, counts, tops, W, seqs, t0
+        return ids, lps, counts, tops, W, seqs, t0, spec_ok
 
     def _process_window(self, synced) -> List[StepOutput]:
         if synced is None:
             return []
-        ids, lps, counts, tops, W, seqs, t0 = synced
+        ids, lps, counts, tops, W, seqs, t0, spec_ok = synced
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
@@ -769,9 +785,14 @@ class LLMEngine:
                     row = [(int(ids[seq.slot, j, t]),
                             float(lps[seq.slot, j, t]))
                            for t in range(c)]
+                    if spec_ok is not None and spec_ok[seq.slot]:
+                        self.metrics.spec_macro_steps.inc()
+                        self.metrics.spec_accepted_tokens.inc(c - 1)
                 # top_logprobs alternatives for rows that asked (trim
-                # the window's K bucket to the request's k); spec and
-                # alternatives are mutually exclusive (dispatch gate)
+                # the window's K bucket to the request's k); a row with
+                # alternatives never speculates (per-row spec_ok gate),
+                # so its macro-steps always emit exactly one token and
+                # the per-step alts attach unambiguously
                 k = seq.options.top_logprobs
                 alts = None
                 if tops is not None and k:
@@ -910,6 +931,11 @@ class LLMEngine:
             for i, (tid, val) in enumerate(sorted(opt.logit_bias.items())):
                 bias_ids[i] = tid
                 bias_vals[i] = val
+        stop_ids = np.full((self._slot_stop_ids.shape[1],), -1, np.int32)
+        if opt.min_tokens and opt.stop_token_ids:
+            # only meaningful below the min_tokens floor; width validated
+            # at add_request
+            stop_ids[:len(opt.stop_token_ids)] = opt.stop_token_ids
         if (self._slot_temp[slot] != opt.temperature
                 or self._slot_top_p[slot] != opt.top_p
                 or self._slot_top_k[slot] != opt.top_k
@@ -923,7 +949,9 @@ class LLMEngine:
                 or self._slot_prompt_len[slot] != plen
                 or not np.array_equal(self._slot_bias_ids[slot], bias_ids)
                 or not np.array_equal(self._slot_bias_vals[slot],
-                                      bias_vals)):
+                                      bias_vals)
+                or not np.array_equal(self._slot_stop_ids[slot],
+                                      stop_ids)):
             self._slot_temp[slot] = opt.temperature
             self._slot_top_p[slot] = opt.top_p
             self._slot_top_k[slot] = opt.top_k
@@ -937,6 +965,7 @@ class LLMEngine:
             self._slot_prompt_len[slot] = plen
             self._slot_bias_ids[slot] = bias_ids
             self._slot_bias_vals[slot] = bias_vals
+            self._slot_stop_ids[slot] = stop_ids
             self._sampling_dirty = True
 
     def _park_slot(self, slot: int) -> None:
@@ -951,7 +980,8 @@ class LLMEngine:
                     or self._slot_repetition[slot] != 1.0
                     or self._slot_min_tokens[slot]
                     or self._slot_min_p[slot]
-                    or self._slot_bias_ids[slot, 0] >= 0):
+                    or self._slot_bias_ids[slot, 0] >= 0
+                    or self._slot_stop_ids[slot, 0] >= 0):
                 self._slot_presence[slot] = 0.0
                 self._slot_frequency[slot] = 0.0
                 self._slot_repetition[slot] = 1.0
@@ -959,6 +989,7 @@ class LLMEngine:
                 self._slot_min_tokens[slot] = 0
                 self._slot_bias_ids[slot, :] = -1
                 self._slot_bias_vals[slot, :] = 0.0
+                self._slot_stop_ids[slot, :] = -1
                 self._sampling_dirty = True
             self._decode_dirty = True
             self._hist_dirty = True

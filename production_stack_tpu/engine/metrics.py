@@ -62,6 +62,16 @@ class EngineMetrics:
         self.per_token = histo(
             "vllm:time_per_output_token_seconds", "Inter-token latency",
             (0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5))
+        # n-gram speculation effectiveness: accepted draft tokens are
+        # the tokens emitted BEYOND one per macro-step; macro_steps
+        # counts only rows eligible to speculate (per-row spec_ok), so
+        # accepted/steps is the true per-row acceptance rate
+        self.spec_accepted_tokens = counter(
+            "tpu:spec_accepted_draft_tokens_total",
+            "Draft tokens accepted by speculative verification")
+        self.spec_macro_steps = counter(
+            "tpu:spec_macro_steps_total",
+            "Speculative macro-steps executed by eligible rows")
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

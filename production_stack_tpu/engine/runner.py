@@ -108,6 +108,29 @@ class ModelRunner:
             from jax.sharding import NamedSharding
             from production_stack_tpu.parallel.sharding import (
                 cache_pspec, param_shardings)
+            from production_stack_tpu.ops import (pallas_attention,
+                                                  pallas_paged)
+            if (not pallas_paged.mesh_tp_only(mesh)
+                    and pallas_attention.flash_enabled()):
+                # block-axis-sharded pools (dp > 1) forfeit the paged
+                # kernel (ops/pallas_paged.py mesh_tp_only): the
+                # gathered-view fallback re-materializes ~3x the KV
+                # traffic. Never let a helm value stumble into that.
+                cliff = (
+                    "serving mesh %s shards the KV pool's block axis: "
+                    "the pallas paged-attention kernel only runs "
+                    "shard-local on tp-only meshes, so this config "
+                    "serves on the gathered-view jnp path (~3x decode "
+                    "KV traffic). Prefer tp-only serving meshes with "
+                    "replicaCount for data parallelism." % dict(
+                        mesh.shape))
+                if engine_cfg.dp_gather_attention_ok:
+                    logger.warning(
+                        "dp_gather_attention_ok=True: " + cliff)
+                else:
+                    raise ValueError(
+                        cliff + " Set dp_gather_attention_ok=True to "
+                        "serve on the gather path anyway.")
             tp = mesh.shape.get("tp", 1)
             if model_cfg.num_kv_heads % tp:
                 raise ValueError(
@@ -185,6 +208,59 @@ class ModelRunner:
     # jitted impls (pure)
     # ------------------------------------------------------------------
 
+    def _sample_position(self, last, sampling: SamplingParams, counts,
+                         prompt_seen, pos, gstate, guide_next, guide_id,
+                         key, *, greedy: bool, seeded: bool, plain: bool,
+                         guided: bool, penalized: bool, eos_id: int,
+                         topk: int):
+        """The full single-position sampling treatment downstream of a
+        forward's [B, V] logits, SHARED verbatim by _decode_impl (every
+        step) and _decode_spec_impl (draft position 0 of every
+        macro-step) so a row emits identically whichever executable its
+        window ran on:
+
+        penalty shaping (sampler.adjust_logits — counts ride the scan
+        carry; the token being sampled is output index
+        pos + 1 - prompt_len), the guided-DFA mask + state advance
+        (one [B, V] gather per step, engine/guided.py), argmax or
+        sample() (the sampled token lands at pos + 1 — the
+        deterministic per-seed index; seeded/plain fork executables so
+        default batches skip per-row PRNG / the [B, V] sort), the
+        counts update, and the chosen-token logprob + top-K
+        alternatives under the same post-shaping f32 distribution.
+
+        Returns (ids [B], logprob [B], top_ids [B, K], top_lps [B, K],
+        gstate', counts')."""
+        B = last.shape[0]
+        if penalized:
+            last = adjust_logits(last, sampling, counts, prompt_seen,
+                                 pos + 1 - sampling.prompt_len, eos_id)
+        if guided:
+            nxt_row = guide_next[guide_id, gstate, :]
+            is_g = (guide_id > 0)[:, None]
+            last = jnp.where(is_g & (nxt_row < 0), -jnp.inf, last)
+        if greedy:
+            ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        else:
+            ids = sample(last, sampling, key,
+                         positions=pos + 1 if seeded else None,
+                         plain=plain)
+        if guided:
+            adv = jnp.take_along_axis(nxt_row, ids[:, None],
+                                      axis=-1)[:, 0]
+            gstate = jnp.where(guide_id > 0,
+                               jnp.maximum(adv, 0), gstate)
+        if penalized:
+            counts = counts.at[jnp.arange(B), ids].add(1)
+        lsm = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+        lp = jnp.take_along_axis(lsm, ids[:, None], axis=-1)[:, 0]
+        if topk:
+            tl, ti = jax.lax.top_k(lsm, topk)
+        else:
+            tl = jnp.zeros((B, 1), jnp.float32)
+            ti = jnp.zeros((B, 1), jnp.int32)
+        return ids, lp, ti, tl, gstate, counts
+
     def _decode_impl(self, params, cache: KVCache, tables: jnp.ndarray,
                      tokens: jnp.ndarray,
                      positions: jnp.ndarray, sampling: SamplingParams,
@@ -210,13 +286,17 @@ class ModelRunner:
         live position stays < kv_len AND its table row covers the whole
         window (engine._ensure_blocks).
 
-        logprobs are the chosen tokens' log p under the raw (pre-
-        temperature) model distribution — one [B, V] log_softmax per
-        step, noise next to the weight streaming, so they're always
-        computed rather than forking the executable cache.
+        logprobs are the chosen tokens' log p under the PRE-temperature
+        but POST-shaping distribution — after penalties/logit_bias
+        (adjust_logits) and the guided-DFA mask, before temperature/
+        top-p/top-k. For unshaped, unguided rows that is exactly the
+        raw model distribution; shaped rows report the distribution
+        they were actually decoded from (documented in docs/engine.md
+        and protocol.py). One [B, V] log_softmax per step, noise next
+        to the weight streaming, so they're always computed rather
+        than forking the executable cache.
         """
         S = self.engine_cfg.max_model_len
-        B = tokens.shape[0]
 
         def body(carry, i):
             cache, toks, pos, gstate, counts = carry
@@ -228,48 +308,12 @@ class ModelRunner:
                 lora_params=self._lora, adapter_ids=sampling.adapter,
                 lora_scaling=self._lora_scaling,
                 token_valid=(pos < S)[:, None])
-            last = logits[:, 0, :]
-            if penalized:
-                # OpenAI logit shaping (sampler.adjust_logits): counts
-                # of generated tokens ride the scan carry; the token
-                # being sampled is output index pos + 1 - prompt_len
-                last = adjust_logits(last, sampling, counts, prompt_seen,
-                                     pos + 1 - sampling.prompt_len,
-                                     eos_id)
-            if guided:
-                # one [B, V] gather per step: each guided row's next-state
-                # table masks forbidden tokens (engine/guided.py)
-                nxt_row = guide_next[guide_id, gstate, :]
-                is_g = (guide_id > 0)[:, None]
-                last = jnp.where(is_g & (nxt_row < 0), -jnp.inf, last)
-            if greedy:
-                ids = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            else:
-                # pos is the input token's position; the sampled token
-                # lands at pos + 1 — the deterministic per-seed index.
-                # seeded forks the executable so all-unseeded batches
-                # skip the per-row PRNG work entirely
-                ids = sample(last, sampling, jax.random.fold_in(key, i),
-                             positions=pos + 1 if seeded else None,
-                             plain=plain)
-            if guided:
-                adv = jnp.take_along_axis(nxt_row, ids[:, None],
-                                          axis=-1)[:, 0]
-                gstate = jnp.where(guide_id > 0,
-                                   jnp.maximum(adv, 0), gstate)
-            if penalized:
-                counts = counts.at[jnp.arange(B), ids].add(1)
-            lsm = jax.nn.log_softmax(last, axis=-1)
-            lp = jnp.take_along_axis(lsm, ids[:, None], axis=-1)[:, 0]
-            if topk:
-                # OpenAI top_logprobs alternatives: the K highest
-                # entries of the same raw distribution the chosen
-                # logprob reports — one top_k next to the argmax, noise
-                # next to the weight streaming
-                tl, ti = jax.lax.top_k(lsm, topk)
-            else:
-                tl = jnp.zeros((B, 1), jnp.float32)
-                ti = jnp.zeros((B, 1), jnp.int32)
+            ids, lp, ti, tl, gstate, counts = self._sample_position(
+                logits[:, 0, :], sampling, counts, prompt_seen, pos,
+                gstate, guide_next, guide_id,
+                jax.random.fold_in(key, i), greedy=greedy,
+                seeded=seeded, plain=plain, guided=guided,
+                penalized=penalized, eos_id=eos_id, topk=topk)
             return ((cache, ids, pos + 1, gstate, counts),
                     (ids, lp, ti, tl))
 
@@ -285,26 +329,45 @@ class ModelRunner:
     def _decode_spec_impl(self, params, cache: KVCache,
                           tables: jnp.ndarray,
                           tokens: jnp.ndarray, positions: jnp.ndarray,
-                          history: jnp.ndarray,
-                          sampling: SamplingParams, *, steps: int,
-                          kv_len: int, spec: int):
-        """GREEDY decode window with n-gram (prompt-lookup) speculation.
+                          history: jnp.ndarray, spec_ok: jnp.ndarray,
+                          sampling: SamplingParams, key: jax.Array,
+                          guide_next: jnp.ndarray, guide_id: jnp.ndarray,
+                          guide_state: jnp.ndarray,
+                          out_counts: jnp.ndarray,
+                          prompt_seen: jnp.ndarray, *, steps: int,
+                          kv_len: int, spec: int, mixed: bool = False,
+                          seeded: bool = False, guided: bool = False,
+                          plain: bool = False, penalized: bool = False,
+                          eos_id: int = 0, topk: int = 0):
+        """Decode window with PER-ROW n-gram (prompt-lookup) speculation.
 
         tokens/positions [B]; history [B, S] device-resident token ids
         (hist[b, t] = sequence b's token at position t, live through
-        `positions[b]`). Each of the `steps` macro-steps drafts `spec`
-        tokens by copying what followed the most recent PRIOR occurrence
-        of the current bigram in the history, verifies all spec+1
-        positions in one forward, and emits the agreeing prefix plus the
-        bonus token — between 1 and spec+1 tokens per macro-step, exact
-        greedy semantics by construction (every emitted token is an
-        argmax given the true prefix).
+        `positions[b]`); spec_ok [B] bool marks rows that speculate —
+        greedy, unshaped, unguided, no-alternatives rows (the engine
+        computes eligibility per row). Each of the `steps` macro-steps
+        drafts `spec` tokens per row by copying what followed the most
+        recent PRIOR occurrence of the current bigram in the history,
+        verifies all spec+1 positions in one forward, and emits the
+        agreeing prefix plus the bonus token — between 1 and spec+1
+        tokens per macro-step, exact greedy semantics by construction
+        (every emitted token is an argmax given the true prefix).
 
-        Returns (ids [B, steps, spec+1], logprobs same, counts
-        [B, steps] valid-token counts, tokens', positions', history',
-        cache'). Rejected draft positions hold garbage K/V past the
-        live length; the write-then-attend invariant (models/kv.py)
-        makes them unobservable, exactly like window tail waste.
+        Rows with spec_ok=False emit exactly one token per macro-step
+        (acceptance forced to 0) and get the full single-step treatment
+        at draft position 0: penalty shaping (adjust_logits), the
+        guided-DFA mask + state advance, temperature sampling for
+        non-greedy rows (`mixed`), and top-K alternatives. One shaped,
+        guided, sampled, or top_logprobs row therefore no longer
+        collapses speculation for the whole batch — it just declines it
+        for itself.
+
+        Returns (ids [B, steps, spec+1], logprobs same, top-K ids/lps
+        [B, steps, K], counts [B, steps] valid-token counts, tokens',
+        positions', history', gstate', out_counts', cache'). Rejected
+        draft positions hold garbage K/V past the live length; the
+        write-then-attend invariant (models/kv.py) makes them
+        unobservable, exactly like window tail waste.
         """
         B = tokens.shape[0]
         S = history.shape[1]
@@ -321,8 +384,8 @@ class ModelRunner:
             j = jnp.max(jnp.where(m, idx, 0))     # 0 = no match
             return jax.lax.dynamic_slice(hist, (j + 1,), (K,))
 
-        def body(carry, _):
-            cache, toks, pos, hist = carry
+        def body(carry, i):
+            cache, toks, pos, hist, gstate, counts = carry
             draft = jax.vmap(draft_row)(hist, pos)          # [B, K]
             step_toks = jnp.concatenate([toks[:, None], draft], axis=1)
             step_pos = pos[:, None] + jnp.arange(K + 1)[None, :]
@@ -335,12 +398,28 @@ class ModelRunner:
                 lora_scaling=self._lora_scaling,
                 token_valid=step_pos < S_max)
             expected = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            # draft position 0 = the ordinary next token: the SHARED
+            # single-position treatment (_sample_position) so every row
+            # emits exactly what _decode_impl would have emitted. For
+            # spec-eligible rows (greedy, unshaped, unguided) every
+            # transform in it is identity and tok0 == the raw argmax,
+            # so substituting it for expected[:, 0] changes nothing on
+            # the speculative fast path.
+            tok0, lp0, ti, tl, gstate, counts = self._sample_position(
+                logits[:, 0, :], sampling, counts, prompt_seen, pos,
+                gstate, guide_next, guide_id,
+                jax.random.fold_in(key, i), greedy=not mixed,
+                seeded=seeded, plain=plain, guided=guided,
+                penalized=penalized, eos_id=eos_id, topk=topk)
+            expected = expected.at[:, 0].set(tok0)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1),
                 expected[..., None], axis=-1)[..., 0]       # [B, K+1]
+            lp = lp.at[:, 0].set(lp0)
             agree = (draft == expected[:, :K])
             accepted = jnp.sum(jnp.cumprod(
                 agree.astype(jnp.int32), axis=1), axis=1)   # [B] in 0..K
+            accepted = jnp.where(spec_ok, accepted, 0)
             count = accepted + 1                            # emitted
             new_pos = pos + count
             new_toks = jnp.take_along_axis(
@@ -350,15 +429,18 @@ class ModelRunner:
                 return jax.lax.dynamic_update_slice(h, emitted,
                                                     (p + 1,))
             hist = jax.vmap(write_row)(hist, pos, expected)
-            return (cache, new_toks, new_pos, hist), (expected, lp,
-                                                      count)
+            return ((cache, new_toks, new_pos, hist, gstate, counts),
+                    (expected, lp, ti, tl, count))
 
-        (cache, toks, pos, hist), (ids, lps, counts) = jax.lax.scan(
-            body, (cache, tokens, positions, history),
+        ((cache, toks, pos, hist, gstate, counts),
+         (ids, lps, tis, tls, cnt)) = jax.lax.scan(
+            body, (cache, tokens, positions, history, guide_state,
+                   out_counts),
             jnp.arange(steps))
         # scan stacks on axis 0: -> [B, steps, K+1] / [B, steps]
         return (ids.transpose(1, 0, 2), lps.transpose(1, 0, 2),
-                counts.T, toks, pos, hist, cache)
+                tis.transpose(1, 0, 2), tls.transpose(1, 0, 2),
+                cnt.T, toks, pos, hist, gstate, counts, cache)
 
     def _prefill_impl(self, params, cache: KVCache, tables: jnp.ndarray,
                       tokens: jnp.ndarray,
@@ -476,49 +558,28 @@ class ModelRunner:
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
                seeded: bool = False, guide_table=None, guide_ids=None,
-               spec: int = 0, plain: bool = False,
+               spec: int = 0, spec_ok=None, plain: bool = False,
                penalized: bool = False, topk: int = 0):
         """Multi-step decode window over all slots, reading the
         device-carried inputs (seed them with set_decode_state). Returns
         (ids, logprobs, counts, tops): without speculation ids/logprobs
-        are [B, steps] and counts is None; with spec > 0 (greedy,
-        unguided windows only) they are [B, steps, spec+1] plus counts
-        [B, steps] of valid tokens per macro-step (_decode_spec_impl).
-        tops is None unless topk > 0: then (ids [B, steps, K],
-        logprobs [B, steps, K]) top-K alternatives per step. The first
-        np.asarray() is the window's single sync.
+        are [B, steps] and counts is None; with spec > 0 they are
+        [B, steps, spec+1] plus counts [B, steps] of valid tokens per
+        macro-step (_decode_spec_impl) — speculation is PER-ROW via
+        spec_ok [B] bool (rows with False single-step with the full
+        shaping/guided/sampling treatment). tops is None unless
+        topk > 0: then (ids [B, steps, K], logprobs [B, steps, K])
+        top-K alternatives per step. The first np.asarray() is the
+        window's single sync.
 
         guide_table [G, S, V] device int32 + guide_ids [B] activate
         constrained sampling (engine/guided.py); the per-row DFA state
         rides the device carry like tokens/positions."""
         kv_len = kv_len or self.engine_cfg.max_model_len
-        if spec:
-            assert greedy and guide_table is None
-            args = (self.params, self.cache, self._dev_tables(),
-                    self._dec_tokens, self._dec_pos, self._dec_hist,
-                    sampling)
-            key = ("spec", steps, kv_len, spec)
-
-            def make_spec():
-                logger.info("compiling speculative decode window "
-                            "(steps=%d kv=%d draft=%d)", steps, kv_len,
-                            spec)
-                return jax.jit(
-                    partial(self._decode_spec_impl, steps=steps,
-                            kv_len=kv_len, spec=spec),
-                    donate_argnums=(1,))
-
-            fn = self._compile_with_fallback(self._decode_fns, key,
-                                             make_spec, args)
-            (ids, lps, counts, self._dec_tokens, self._dec_pos,
-             self._dec_hist, self.cache) = fn(*args)
-            return ids, lps, counts, None
         seeded = seeded and not greedy
         plain = plain and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
-        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain,
-                     penalized, topk)
         B = self.engine_cfg.max_num_seqs
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
@@ -530,6 +591,43 @@ class ModelRunner:
             # writes them, so keep them tiny
             counts = jnp.zeros((B, 1), jnp.int32)
             seen = jnp.zeros((B, 1), bool)
+        if spec:
+            mixed = not greedy
+            args = (self.params, self.cache, self._dev_tables(),
+                    self._dec_tokens, self._dec_pos, self._dec_hist,
+                    jnp.asarray(spec_ok, bool), sampling,
+                    self._next_key(), guide_table,
+                    jnp.asarray(guide_ids, jnp.int32), self._dec_gstate,
+                    counts, seen)
+            key = ("spec", steps, kv_len, spec, mixed, seeded, guided,
+                   gshape, plain, penalized, topk)
+
+            def make_spec():
+                logger.info("compiling speculative decode window "
+                            "(steps=%d kv=%d draft=%d%s%s%s%s)", steps,
+                            kv_len, spec,
+                            " mixed" if mixed else "",
+                            " guided" if guided else "",
+                            " penalized" if penalized else "",
+                            f" topk={topk}" if topk else "")
+                return jax.jit(
+                    partial(self._decode_spec_impl, steps=steps,
+                            kv_len=kv_len, spec=spec, mixed=mixed,
+                            seeded=seeded, guided=guided, plain=plain,
+                            penalized=penalized, eos_id=self._eos_id,
+                            topk=topk),
+                    donate_argnums=(1,))
+
+            fn = self._compile_with_fallback(self._decode_fns, key,
+                                             make_spec, args)
+            (ids, lps, tis, tls, cnt, self._dec_tokens, self._dec_pos,
+             self._dec_hist, self._dec_gstate, counts_out,
+             self.cache) = fn(*args)
+            if penalized:
+                self._dec_counts = counts_out
+            return ids, lps, cnt, (tis, tls) if topk else None
+        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain,
+                     penalized, topk)
         args = (self.params, self.cache, self._dev_tables(),
                 self._dec_tokens, self._dec_pos,
                 sampling, self._next_key(), guide_table,
@@ -829,7 +927,8 @@ class ModelRunner:
                 history=np.zeros((B, S), np.int32))
             self.decode(sampling, steps=cfg.decode_window,
                         kv_len=cfg.kv_len_buckets[0], greedy=True,
-                        spec=cfg.speculative_ngram_tokens)
+                        spec=cfg.speculative_ngram_tokens,
+                        spec_ok=np.ones((B,), bool))
             self.set_decode_state(np.zeros((B,), np.int32),
                                   np.full((B,), S, np.int32))
         self.decode(sampling, steps=cfg.decode_window,

@@ -15,10 +15,16 @@ import jax.numpy as jnp
 _EPS = 1e-6
 _NEG_INF = -1e30
 
-# logit_bias entries accepted per request (OpenAI caps the map at 300;
-# a fixed small K keeps the device arrays tiny and the executable
-# static — the server rejects larger maps with a 400)
-LOGIT_BIAS_K = 32
+# logit_bias slot width: covers OpenAI's documented 300-entry cap (the
+# server rejects >300 with a 400 for API parity; the engine boundary
+# rejects >LOGIT_BIAS_K). The arrays stay [B, K] int32/fp32 — a few
+# hundred KB — and the scatter-add in adjust_logits is noise next to
+# the [B, V] shaping math it feeds.
+LOGIT_BIAS_K = 320
+
+# stop_token_ids masked while out_len < min_tokens (vLLM semantics:
+# min_tokens bans EOS and every stop token, not EOS alone)
+MIN_TOKENS_STOP_K = 16
 
 
 class SamplingParams(NamedTuple):
@@ -42,15 +48,17 @@ class SamplingParams(NamedTuple):
     frequency: jnp.ndarray    # fp32; 0 => off (OpenAI frequency_penalty)
     repetition: jnp.ndarray   # fp32; 1 => off (HF/vLLM repetition_penalty)
     min_p: jnp.ndarray        # fp32; 0 => off (vLLM min_p truncation)
-    min_tokens: jnp.ndarray   # int32; EOS forbidden below this many out
+    min_tokens: jnp.ndarray   # int32; EOS + stop ids forbidden below this
     prompt_len: jnp.ndarray   # int32; output count = position+1 - this
     bias_ids: jnp.ndarray     # int32 [B, K]; -1 => unused slot
     bias_vals: jnp.ndarray    # fp32 [B, K] (OpenAI logit_bias)
+    stop_ids: jnp.ndarray     # int32 [B, KS]; -1 => unused (min_tokens)
 
     @staticmethod
     def filled(batch: int, temperature=1.0, top_p=1.0, top_k=0, adapter=0,
                seed=0, presence=0.0, frequency=0.0, repetition=1.0,
-               min_p=0.0, min_tokens=0, prompt_len=0, bias_k=LOGIT_BIAS_K):
+               min_p=0.0, min_tokens=0, prompt_len=0, bias_k=LOGIT_BIAS_K,
+               stop_k=MIN_TOKENS_STOP_K):
         return SamplingParams(
             temperature=jnp.full((batch,), temperature, jnp.float32),
             top_p=jnp.full((batch,), top_p, jnp.float32),
@@ -65,6 +73,7 @@ class SamplingParams(NamedTuple):
             prompt_len=jnp.full((batch,), prompt_len, jnp.int32),
             bias_ids=jnp.full((batch, bias_k), -1, jnp.int32),
             bias_vals=jnp.zeros((batch, bias_k), jnp.float32),
+            stop_ids=jnp.full((batch, stop_k), -1, jnp.int32),
         )
 
 
@@ -84,7 +93,9 @@ def adjust_logits(logits: jnp.ndarray, params: SamplingParams,
       every token seen in prompt OR output (HF convention);
     - presence_penalty: subtract once for any generated token;
     - frequency_penalty: subtract per occurrence generated;
-    - min_tokens: EOS forbidden while out_len < min_tokens.
+    - min_tokens: EOS AND the request's stop_token_ids (params.stop_ids)
+      forbidden while out_len < min_tokens (vLLM semantics — a stop id
+      terminating before the floor would end the sequence early).
     """
     B, V = logits.shape
     valid = params.bias_ids >= 0
@@ -98,9 +109,12 @@ def adjust_logits(logits: jnp.ndarray, params: SamplingParams,
     logits = jnp.where(seen_any, penal, logits)
     logits = logits - params.presence[:, None] * seen_out
     logits = logits - params.frequency[:, None] * out_counts
-    block_eos = (out_len < params.min_tokens)[:, None]
-    eos_col = (jnp.arange(V) == eos_id)[None, :]
-    return jnp.where(block_eos & eos_col, _NEG_INF, logits)
+    below_floor = (out_len < params.min_tokens)[:, None]
+    banned = (jnp.arange(V) == eos_id)[None, :] | jnp.zeros(
+        (B, V), bool).at[jnp.arange(B)[:, None],
+                         jnp.maximum(params.stop_ids, 0)].max(
+        params.stop_ids >= 0)
+    return jnp.where(below_floor & banned, _NEG_INF, logits)
 
 
 def sample(logits: jnp.ndarray, params: SamplingParams,

@@ -89,7 +89,8 @@ class Sequence:
     # (block_manager.register_incremental); reset on preemption
     reg_state: object = None
     output_tokens: List[int] = field(default_factory=list)
-    # per output token: chosen-token logprob (raw model distribution)
+    # per output token: chosen-token logprob (pre-temperature, post-
+    # shaping distribution — raw model distribution for unshaped rows)
     output_logprobs: List[Optional[float]] = field(default_factory=list)
     # per output token, when options.top_logprobs: [(id, logprob)] top
     # alternatives (None for tokens emitted by paths without them)

@@ -45,11 +45,12 @@ def _logit_bias(req) -> Optional[dict]:
     raw = getattr(req, "logit_bias", None)
     if not raw:
         return None
-    from production_stack_tpu.engine.sampler import LOGIT_BIAS_K
-    if len(raw) > LOGIT_BIAS_K:
+    # OpenAI documents a 300-entry cap; the device slot width
+    # (sampler.LOGIT_BIAS_K) covers it, so the API-parity bound is the
+    # binding one here
+    if len(raw) > 300:
         raise ValueError(
-            f"logit_bias supports at most {LOGIT_BIAS_K} entries "
-            f"(got {len(raw)})")
+            f"logit_bias supports at most 300 entries (got {len(raw)})")
     try:
         return {int(k): float(v) for k, v in raw.items()}
     except (TypeError, ValueError):

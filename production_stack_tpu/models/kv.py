@@ -213,8 +213,12 @@ def gather_view_q(cache_layer: jnp.ndarray, scale_layer: jnp.ndarray,
     feeding attention, never resident."""
     Hkv, Bs = cache_layer.shape[1], cache_layer.shape[2]
     t = tables[:, :nb]
-    g = cache_layer[t].astype(dtype)                  # [B,nb,Hkv,Bs,D]
-    s = scale_layer[t].astype(dtype)                  # [B,nb,Hkv,Bs]
-    g = g * s[..., None]
+    # dequantize in f32 and cast the PRODUCT — the pallas kernels
+    # dequantize at f32 too, so the fallback and kernel paths stay
+    # numerically identical (greedy streams must not depend on which
+    # backend served a window)
+    g = cache_layer[t].astype(jnp.float32)            # [B,nb,Hkv,Bs,D]
+    s = scale_layer[t].astype(jnp.float32)            # [B,nb,Hkv,Bs]
+    g = (g * s[..., None]).astype(dtype)
     g = g.transpose(0, 1, 3, 2, 4)
     return g.reshape(t.shape[0], nb * Bs, Hkv, cache_layer.shape[-1])

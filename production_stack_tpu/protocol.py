@@ -116,9 +116,12 @@ class UsageInfo(OpenAIBase):
 class CompletionLogprobs(OpenAIBase):
     """Legacy completions logprobs block. logprobs=N returns the N
     highest-probability alternatives per position, computed on-device
-    next to the chosen token's logprob (raw model distribution,
-    engine/runner.py); paths without alternatives fall back to the
-    chosen token's entry."""
+    next to the chosen token's logprob. Both report the PRE-temperature,
+    POST-shaping distribution: for requests without penalties/
+    logit_bias/guided constraints that is the raw model distribution;
+    shaped requests report the distribution they were actually decoded
+    from (engine/runner.py). Paths without alternatives fall back to
+    the chosen token's entry."""
     tokens: List[str] = Field(default_factory=list)
     token_logprobs: List[Optional[float]] = Field(default_factory=list)
     top_logprobs: Optional[List[Optional[Dict[str, float]]]] = None

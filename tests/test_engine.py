@@ -324,18 +324,18 @@ def test_abort_running_seq_with_inflight_window():
     assert eng.seqs[b].output_tokens == solo.seqs[s].output_tokens
 
 
-def test_pipelined_windows_match_unpipelined(monkeypatch):
-    """Depth-2 window pipelining (engine._PIPELINE_DEPTH) must not
-    change any stream: staggered budgets force mid-run slot recycling
-    while optimistic windows are in flight, and every sequence's
-    greedy output must match a depth-1 (no dispatch-ahead) run."""
-    from production_stack_tpu.engine import engine as engine_mod
+def test_pipelined_windows_match_unpipelined():
+    """Window pipelining (EngineConfig.pipeline_depth) must not change
+    any stream: staggered budgets force mid-run slot recycling while
+    optimistic windows are in flight, and every sequence's greedy
+    output must match a depth-1 (no dispatch-ahead) run at every
+    supported depth."""
 
     def run(depth):
-        monkeypatch.setattr(engine_mod, "_PIPELINE_DEPTH", depth)
         cfg = EngineConfig(model="debug-tiny", max_model_len=256,
                            max_num_seqs=4, prefill_chunk=32,
-                           prefill_buckets=(32,), decode_window=4)
+                           prefill_buckets=(32,), decode_window=4,
+                           pipeline_depth=depth)
         eng = LLMEngine(cfg)
         ids = [eng.add_request(
             list(range(5 + i, 15 + i)),
@@ -351,6 +351,7 @@ def test_pipelined_windows_match_unpipelined(monkeypatch):
         return [eng.seqs[i].output_tokens for i in ids]
 
     assert run(2) == run(1)
+    assert run(3) == run(1)
 
 
 def test_fp32_model_with_bf16_kv_cache():
@@ -462,6 +463,58 @@ def test_speculative_ngram_exact_greedy_parity():
     plain2 = run(0, prompt2, 16)
     spec2 = run(3, prompt2, 16)
     assert spec2 == plain2
+
+
+def test_speculative_per_row_gating_mixed_batch():
+    """One shaped (presence_penalty) row must NOT collapse speculation
+    for a plain greedy row sharing the batch (per-row spec_ok): the
+    plain row still accrues accepted draft tokens, and both rows emit
+    exactly what they emit when run alone."""
+    import numpy as np
+
+    def mk(spec):
+        cfg = EngineConfig(model="debug-tiny", max_model_len=512,
+                           max_num_seqs=2, prefill_chunk=64,
+                           prefill_buckets=(64,), decode_window=4,
+                           speculative_ngram_tokens=spec,
+                           dtype="float32", kv_dtype="float32")
+        return LLMEngine(cfg)
+
+    def drain(eng, pending):
+        pending = set(pending)
+        while pending:
+            for out in eng.step():
+                if out.finished:
+                    pending.discard(out.seq_id)
+
+    rng = np.random.default_rng(3)
+    rep = rng.integers(1, 40, size=(12,)).tolist() * 6  # repetitive
+    plain_opts = dict(temperature=0.0, max_tokens=24, ignore_eos=True)
+    shaped_opts = dict(temperature=0.0, max_tokens=24, ignore_eos=True,
+                       presence_penalty=0.7)
+
+    # isolated spec-free references
+    ref = {}
+    for name, opts in (("plain", plain_opts), ("shaped", shaped_opts)):
+        eng0 = mk(0)
+        sid = eng0.add_request(list(rep), SamplingOptions(**opts))
+        drain(eng0, [sid])
+        ref[name] = eng0.seqs[sid].output_tokens
+
+    # mixed batch with speculation enabled
+    eng = mk(3)
+    g = eng.add_request(list(rep), SamplingOptions(**plain_opts))
+    p = eng.add_request(list(rep), SamplingOptions(**shaped_opts))
+    drain(eng, [g, p])
+    assert eng.seqs[g].output_tokens == ref["plain"]
+    assert eng.seqs[p].output_tokens == ref["shaped"]
+    # the plain row really speculated despite the shaped neighbor
+    accepted = eng.metrics.spec_accepted_tokens._value.get()
+    steps = eng.metrics.spec_macro_steps._value.get()
+    assert accepted > 0, "no draft tokens accepted for the plain row"
+    assert steps > 0
+    # fewer macro-steps than emitted tokens = speculation did real work
+    assert steps < len(ref["plain"])
 
 
 def test_speculative_mixed_batch_and_sampled_fallback():

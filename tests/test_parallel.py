@@ -117,3 +117,34 @@ def test_tp_serving_engine_matches_unsharded():
                                max_num_seqs=2, prefill_chunk=32,
                                prefill_buckets=(16, 32),
                                tensor_parallel_size=8))
+
+
+def test_dp_mesh_gather_cliff_is_explicit():
+    """A dp>1 serving mesh forfeits the paged pallas kernel (block axis
+    sharded — ops/pallas_paged.mesh_tp_only). When flash would actually
+    be used, constructing the runner must REFUSE unless the config
+    acknowledges the ~3x-KV-traffic gather fallback; tp-only meshes are
+    untouched. (flash_enabled() is false on the CPU test backend, so
+    the cliff is forced visible here via the explicit override.)"""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.ops import pallas_attention
+
+    import jax
+    mesh = build_mesh(MeshConfig(dp=2, sp=1, tp=2), jax.devices()[:4])
+    cfg = dict(model="debug-tiny", max_model_len=128, max_num_seqs=4,
+               prefill_chunk=32, prefill_buckets=(32,))
+    pallas_attention.set_flash_enabled(True)
+    try:
+        with pytest.raises(ValueError, match="gathered-view"):
+            LLMEngine(EngineConfig(**cfg), mesh=mesh)
+        # acknowledged: constructs (with a logged warning)
+        eng = LLMEngine(EngineConfig(dp_gather_attention_ok=True, **cfg),
+                        mesh=mesh)
+        assert eng is not None
+        # tp-only meshes never trip the guard
+        tp_mesh = build_mesh(MeshConfig(dp=1, sp=1, tp=2),
+                             jax.devices()[:2])
+        LLMEngine(EngineConfig(**cfg), mesh=tp_mesh)
+    finally:
+        pallas_attention.set_flash_enabled(None)

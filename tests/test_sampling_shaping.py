@@ -107,6 +107,32 @@ def test_engine_min_tokens_blocks_eos(engine):
     assert eos not in seq.output_tokens[:-1]
 
 
+def test_engine_min_tokens_blocks_stop_token_ids(engine):
+    """min_tokens must ban the request's stop_token_ids on-device, not
+    just EOS (vLLM semantics): with logit_bias forcing a stop token,
+    the floor holds it off exactly min_tokens tokens, then it fires."""
+    seq = _run(engine, range(5, 25), temperature=0.0, max_tokens=20,
+               min_tokens=6, stop_token_ids=[42], ignore_eos=True,
+               logit_bias={42: 60.0})
+    assert seq.finish_reason == "stop"
+    assert len(seq.output_tokens) == 7
+    assert seq.output_tokens[-1] == 42
+    assert 42 not in seq.output_tokens[:-1]
+
+
+def test_adjust_logits_min_tokens_stop_ids():
+    """Below the floor, stop_ids rows are -inf; at/above, untouched."""
+    B, V = 2, 8
+    logits = jnp.zeros((B, V), jnp.float32)
+    params = SamplingParams.filled(B, min_tokens=3)
+    params = params._replace(stop_ids=params.stop_ids.at[:, 0].set(4))
+    out = np.asarray(adjust_logits(
+        logits, params, jnp.zeros((B, V), jnp.int32),
+        jnp.zeros((B, V), bool), jnp.asarray([0, 3]), eos_id=7))
+    assert out[0, 4] < -1e29 and out[0, 7] < -1e29   # below floor
+    assert out[1, 4] == 0.0 and out[1, 7] == 0.0     # floor reached
+
+
 def test_engine_logit_bias_forces_token(engine):
     seq = _run(engine, range(5, 25), temperature=0.0, max_tokens=6,
                ignore_eos=True, logit_bias={77: 80.0})
