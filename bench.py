@@ -2,8 +2,10 @@
 
 Measures end-to-end continuous-batching generation throughput (output
 tokens/sec) of the TPU-native engine on a TinyLlama-1.1B-geometry model
-(random weights — throughput is weight-value-independent), batch 8,
-128-token prompts, 128 generated tokens per request, greedy.
+(random weights — throughput is weight-value-independent), batch 32
+(the paged engine's best verified config; --batch 8 for the legacy
+compatibility point), 128-token prompts, 128 generated tokens per
+request, greedy.
 
 Failure model (this harness must produce a verifiable number in EVERY
 world — two of the first three rounds lost their perf record to a wedged
@@ -55,8 +57,10 @@ def parse_cli(argv=None):
     ap.add_argument("--child", action="store_true",
                     help="internal: run the bench in-process (no "
                          "supervision); used by the parent orchestrator")
-    ap.add_argument("--batch", type=int, default=8,
-                    help="concurrent batch slots (default 8)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="concurrent batch slots (default: 32 full mode "
+                         "— the paged engine's best verified config — "
+                         "8 small mode)")
     ap.add_argument("--gen-len", type=int, default=0,
                     help="tokens generated per request (0 = mode default)")
     ap.add_argument("--requests", type=int, default=0,
@@ -71,6 +75,12 @@ def parse_cli(argv=None):
                          "decode KV HBM traffic)")
     ap.add_argument("--spec", type=int, default=0,
                     help="n-gram speculative draft length (0 = off)")
+    ap.add_argument("--prompt-repeat", type=int, default=0,
+                    help="build each prompt by tiling a short per-"
+                         "request phrase this many times (repetitive "
+                         "multi-round-QA-like histories — the workload "
+                         "n-gram speculation is FOR; 0 = the synthetic "
+                         "near-random default, adversarial for spec)")
     ap.add_argument("--kv-pool-frac", type=float, default=1.0,
                     help="KV pool size as a fraction of the worst-case "
                          "batch*max_model_len reservation (paged KV)")
@@ -82,6 +92,10 @@ def parse_cli(argv=None):
                          "per window the host pays one dispatch + one "
                          "sync, so longer windows amortize tunnel/"
                          "dispatch latency)")
+    ap.add_argument("--pipeline-depth", type=int, default=0,
+                    help="decode windows queued on the device at once "
+                         "(0 = config default 2; 3 can hide more tunnel "
+                         "RTT behind device work)")
     ap.add_argument("--cold", action="store_true",
                     help="skip the untimed warm pass (measure a cold "
                          "engine, lazy compiles land in the timed region)")
@@ -93,7 +107,7 @@ def run_bench(args) -> dict:
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.scheduler import SamplingOptions
 
-    batch = args.batch
+    batch = args.batch or (8 if args.small else 32)
     if args.small:
         cfg_kw = dict(model="debug-tiny", max_model_len=512,
                       max_num_seqs=batch, prefill_chunk=128,
@@ -134,6 +148,8 @@ def run_bench(args) -> dict:
     if args.kv_pool_frac < 1.0:
         worst = cfg_kw["max_num_seqs"] * cfg_kw["max_model_len"]
         cfg_kw["kv_pool_tokens"] = int(worst * args.kv_pool_frac)
+    if args.pipeline_depth:
+        cfg_kw["pipeline_depth"] = args.pipeline_depth
     cfg = EngineConfig(**cfg_kw)
 
     eng = LLMEngine(cfg)
@@ -141,8 +157,20 @@ def run_bench(args) -> dict:
 
     opts = SamplingOptions(temperature=0.0, max_tokens=gen_len,
                            ignore_eos=True)
-    rng_tokens = [[(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
-                  for i in range(n_requests)]
+    if args.prompt_repeat:
+        # repetitive histories (multi-round QA re-sends the growing
+        # conversation every round): a short per-request phrase tiled
+        # across the prompt, so n-gram lookup finds real continuations
+        rng_tokens = []
+        for i in range(n_requests):
+            phrase = [(13 * i + j) % 1000 + 1
+                      for j in range(max(4, prompt_len
+                                         // max(1, args.prompt_repeat)))]
+            tiled = (phrase * (prompt_len // len(phrase) + 1))[:prompt_len]
+            rng_tokens.append(tiled)
+    else:
+        rng_tokens = [[(7 * i + j) % 1000 + 1 for j in range(prompt_len)]
+                      for i in range(n_requests)]
 
     def run_pass():
         ids = [eng.add_request(toks, opts) for toks in rng_tokens]
@@ -169,7 +197,20 @@ def run_bench(args) -> dict:
 
     out_tokens = sum(len(eng.seqs[i].output_tokens) for i in ids)
     in_tokens = sum(len(t) for t in rng_tokens)
+    spec_stats = {}
+    if cfg.speculative_ngram_tokens:
+        steps = eng.metrics.spec_macro_steps._value.get()
+        accepted = eng.metrics.spec_accepted_tokens._value.get()
+        spec_stats = {
+            # accepted draft tokens per macro-step (0..spec): the
+            # workload-dependent quantity that decides whether
+            # speculation pays for its (spec+1)-wide verify forwards
+            "spec_acceptance": round(accepted / steps, 4) if steps
+            else 0.0,
+            "spec_macro_steps": int(steps),
+        }
     return {
+        **spec_stats,
         "output_tokens_per_s": out_tokens / wall,
         "total_tokens_per_s": (out_tokens + in_tokens) / wall,
         "wall_s": wall,
@@ -193,8 +234,14 @@ def run_bench(args) -> dict:
 
 def record_line(args, stats: dict, platform: str) -> dict:
     value = round(stats["output_tokens_per_s"], 2)
-    # baselines keyed by (mode, platform) so runs never clobber each other
-    key = f"{'small' if args.small else 'full'}-{platform}"
+    batch = stats["batch_slots"]
+    # baselines keyed by (mode, platform, batch) so vs_baseline always
+    # compares a config against ITS OWN prior record — batch 32 against
+    # the verified round-4 batch-32 number, never against the round-1
+    # batch-8 cold point. Legacy (pre-r5) entries were unkeyed by batch
+    # and recorded at batch 8; fall back to them for batch-8 runs.
+    mode = "small" if args.small else "full"
+    key = f"{mode}-{platform}-b{batch}"
     refs = {}
     if os.path.exists(REF_PATH):
         try:
@@ -203,12 +250,16 @@ def record_line(args, stats: dict, platform: str) -> dict:
         except (OSError, json.JSONDecodeError, ValueError):
             refs = {}
     ref = refs.get(key)
-    standard = (args.batch == 8 and not args.quantization
+    if ref is None and batch == 8:
+        ref = refs.get(f"{mode}-{platform}")
+    standard = (not args.quantization
                 and not args.kv_cache_dtype
                 and not args.spec and not args.gen_len
                 and not args.prompt_len and not args.requests
                 and not args.prefill_chunk and not args.cold
-                and not args.window and args.kv_pool_frac == 1.0)
+                and not args.window and not args.prompt_repeat
+                and not args.pipeline_depth
+                and args.kv_pool_frac == 1.0)
     if ref is None and standard:
         # only standard configs may set the baseline for a pair
         refs[key] = ref = value
@@ -219,7 +270,7 @@ def record_line(args, stats: dict, platform: str) -> dict:
             pass
     return {
         "metric": "engine decode throughput (TinyLlama-1.1B geometry, "
-                  f"batch {args.batch}, {stats['prompt_len']}+"
+                  f"batch {batch}, {stats['prompt_len']}+"
                   f"{stats['gen_len']} tok, single chip)"
         if not args.small else "engine decode throughput (debug-tiny)",
         "value": value,
@@ -305,7 +356,7 @@ def forward_args(args) -> list:
     out = []
     if args.small:
         out.append("--small")
-    if args.batch != 8:
+    if args.batch is not None:
         out += ["--batch", str(args.batch)]
     if args.gen_len:
         out += ["--gen-len", str(args.gen_len)]
@@ -319,6 +370,10 @@ def forward_args(args) -> list:
         out += ["--kv-cache-dtype", args.kv_cache_dtype]
     if args.spec:
         out += ["--spec", str(args.spec)]
+    if args.prompt_repeat:
+        out += ["--prompt-repeat", str(args.prompt_repeat)]
+    if args.pipeline_depth:
+        out += ["--pipeline-depth", str(args.pipeline_depth)]
     if args.kv_pool_frac != 1.0:
         out += ["--kv-pool-frac", str(args.kv_pool_frac)]
     if args.prefill_chunk:
