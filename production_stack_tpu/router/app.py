@@ -257,9 +257,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--semantic-cache-threshold", type=float, default=0.95)
     p.add_argument("--semantic-cache-max-entries", type=int, default=4096)
     p.add_argument("--semantic-cache-embedder", default="hashing",
-                   help="'hashing' (dependency-free) or "
+                   help="'hashing' (dependency-free), "
+                        "'engine:http://host:port[#model]' (REAL "
+                        "embeddings via an engine's /v1/embeddings — "
+                        "models/encoder.py), or "
                         "'sentence-transformers/<model>'")
-    p.add_argument("--pii-analyzer", default="regex")
+    p.add_argument("--pii-analyzer", default="regex",
+                   help="'regex' (dependency-free patterns) or "
+                        "'ner:<checkpoint-dir>' (BERT token-"
+                        "classification model via the JAX encoder — "
+                        "finds names/places/orgs regex cannot)")
     p.add_argument("--pii-action", choices=["block", "redact"],
                    default="block")
     p.add_argument("--pii-types", default=None,

@@ -106,9 +106,78 @@ class SentenceTransformerEmbedder(Embedder):
         return vec / norm if norm > 0 else vec
 
 
+class EngineEmbedder(Embedder):
+    """Embed via a serving engine's /v1/embeddings endpoint — the REAL
+    model path (models/encoder.py behind engine/server.py), so the
+    router process stays model-free and the encoder runs where the
+    accelerator is. Mirrors the reference's real-model embedder
+    (semantic_cache.py sentence-transformers) without pulling torch
+    into the router.
+
+    Spec form: ``engine:http://host:port`` or
+    ``engine:http://host:port#model-name``. Synchronous HTTP with a
+    bounded timeout — check()/store() already run on executor threads,
+    never on the event loop. The embedding dim is discovered by a probe
+    at construction, retried over ~15s to ride out the router-before-
+    engine startup race; if the endpoint never answers, construction
+    RAISES and the router fails fast (k8s restarts it until the engine
+    is up) — silently downgrading an explicitly configured real-model
+    embedder to hashing geometry would flip hit/miss behavior with one
+    log line as the only trace. Runtime failures are bounded by the
+    cache's breaker (SemanticCache._embed_guarded)."""
+
+    def __init__(self, url: str, model: Optional[str] = None,
+                 timeout_s: float = 3.0, probe_retries: int = 5,
+                 probe_delay_s: float = 3.0):
+        self.url = url.rstrip("/") + "/v1/embeddings"
+        self.model = model
+        self.timeout_s = timeout_s
+        last_err = None
+        for attempt in range(probe_retries):
+            try:
+                self.dim = len(self._fetch("dimension probe"))
+                return
+            except Exception as e:      # noqa: BLE001 — urllib raises
+                last_err = e            # URLError/OSError/HTTPError/...
+                if attempt + 1 < probe_retries:
+                    logger.info(
+                        "engine embedder probe %d/%d failed (%s); "
+                        "retrying in %.0fs", attempt + 1, probe_retries,
+                        e, probe_delay_s)
+                    time.sleep(probe_delay_s)
+        raise RuntimeError(
+            f"engine embedder endpoint {self.url} unreachable after "
+            f"{probe_retries} probes: {last_err}")
+
+    def _fetch(self, text: str) -> np.ndarray:
+        import urllib.request
+        payload = {"input": [text]}
+        if self.model:
+            payload["model"] = self.model
+        req = urllib.request.Request(
+            self.url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            data = json.loads(resp.read())
+        return np.asarray(data["data"][0]["embedding"], np.float32)
+
+    def embed(self, text: str) -> np.ndarray:
+        vec = self._fetch(text)
+        norm = float(np.linalg.norm(vec))
+        return vec / norm if norm > 0 else vec
+
+
 def make_embedder(spec: str = "hashing", dim: int = DEFAULT_DIM) -> Embedder:
     if spec == "hashing":
         return HashingEmbedder(dim)
+    if spec.startswith("engine:"):
+        # no hashing fallback here, deliberately: the operator asked for
+        # real-model embeddings; a dead endpoint fails router startup
+        # (EngineEmbedder docstring) rather than silently serving a
+        # different similarity geometry
+        rest = spec[len("engine:"):]
+        url, _, model = rest.partition("#")
+        return EngineEmbedder(url, model or None)
     if spec.startswith("sentence-transformers/") or spec == "minilm":
         name = spec.split("/", 1)[1] if "/" in spec else "all-MiniLM-L6-v2"
         try:
@@ -320,6 +389,14 @@ class SemanticCache:
     INDEX_FILE = "semantic_index.bin"
     META_FILE = "semantic_meta.json"
 
+    # embedder circuit breaker: after this many CONSECUTIVE embed
+    # failures the cache disables itself for the cooldown (requests
+    # route straight to engines — a sick embedding endpoint must never
+    # queue the whole router behind its timeout), then lets one request
+    # probe again (half-open)
+    EMBED_BREAKER_THRESHOLD = 3
+    EMBED_BREAKER_COOLDOWN_S = 30.0
+
     def __init__(self, embedder: Optional[Embedder] = None,
                  threshold: float = DEFAULT_SIMILARITY_THRESHOLD,
                  max_entries: int = 4096,
@@ -331,6 +408,8 @@ class SemanticCache:
         self.hits = 0
         self.misses = 0
         self.last_lookup_s = 0.0
+        self._embed_failures = 0
+        self._embed_retry_at = 0.0
         self._lock = threading.Lock()
         self._meta: Dict[int, dict] = {}
         self._order: List[int] = []          # insertion order for eviction
@@ -369,6 +448,34 @@ class SemanticCache:
 
     # -- core ------------------------------------------------------------
 
+    def _embed_guarded(self, text: str) -> Optional[np.ndarray]:
+        """embed() behind the consecutive-failure breaker: None = the
+        cache is sitting out this request (open circuit or a fresh
+        failure); the caller treats it as 'no cache', never an error —
+        an embedding outage must cost one log line, not requests."""
+        now = time.monotonic()
+        with self._lock:
+            if (self._embed_failures >= self.EMBED_BREAKER_THRESHOLD
+                    and now < self._embed_retry_at):
+                return None
+        try:
+            vec = self.embedder.embed(text)
+        except Exception as e:   # noqa: BLE001 — any transport failure
+            with self._lock:
+                self._embed_failures += 1
+                self._embed_retry_at = (time.monotonic()
+                                        + self.EMBED_BREAKER_COOLDOWN_S)
+                tripped = (self._embed_failures
+                           == self.EMBED_BREAKER_THRESHOLD)
+            (logger.warning if tripped else logger.info)(
+                "semantic-cache embed failed (%s)%s", e,
+                f"; breaker OPEN for {self.EMBED_BREAKER_COOLDOWN_S:.0f}s"
+                if tripped else "")
+            return None
+        with self._lock:
+            self._embed_failures = 0
+        return vec
+
     def check(self, body: dict) -> Optional[dict]:
         """Cached response for a semantically-equivalent request, or None."""
         if not self.cacheable(body):
@@ -379,7 +486,9 @@ class SemanticCache:
         threshold = float(body.get("cache_similarity_threshold",
                                    self.threshold))
         t0 = time.monotonic()
-        vec = self.embedder.embed(text)
+        vec = self._embed_guarded(text)
+        if vec is None:
+            return None
         # k > 1: in multi-model deployments the global nearest neighbor may
         # belong to another model; take the best same-model hit instead
         scores, ids = self.index.search(vec, 8)
@@ -408,7 +517,9 @@ class SemanticCache:
         text = self.request_text(body)
         if text is None:
             return False
-        vec = self.embedder.embed(text)
+        vec = self._embed_guarded(text)
+        if vec is None:
+            return False
         with self._lock:
             vid = next(self._ids)
         # the vector must be in the index BEFORE vid is registered in

@@ -222,3 +222,117 @@ def test_router_semantic_cache_short_circuit():
             assert "vllm:semantic_cache_size 1.0" in m
         await server.close()
     asyncio.run(body())
+
+
+# ---------------------------------------------------------------- engine
+# embedder: the REAL-model path (router -> engine /v1/embeddings ->
+# models/encoder.py). The fake endpoint embeds with a stopword-dropping
+# bag-of-words so paraphrases land at cosine ~1.0 and distinct topics
+# near 0 — a stand-in for real encoder geometry that exercises the
+# full EngineEmbedder -> index -> threshold path end to end.
+
+def _fake_embedding_server():
+    import hashlib
+    import json as _json
+    import threading
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    stop = {"user", "assistant", "system", "how", "do", "can", "i",
+            "my", "the", "a", "is", "what", "please"}
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = _json.loads(self.rfile.read(n))
+            vec = np.zeros(64, np.float64)
+            for w in body["input"][0].lower().split():
+                w = w.strip("?.,!:")
+                if not w or w in stop:
+                    continue
+                h = int.from_bytes(hashlib.blake2b(
+                    w.encode(), digest_size=4).digest(), "little")
+                vec[h % 64] += 1.0
+            payload = _json.dumps(
+                {"data": [{"embedding": vec.tolist()}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):   # keep pytest output clean
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_engine_embedder_hit_quality():
+    from production_stack_tpu.router.semantic_cache import (EngineEmbedder,
+                                                            make_embedder)
+    srv = _fake_embedding_server()
+    try:
+        url = f"http://127.0.0.1:{srv.server_port}"
+        emb = make_embedder(f"engine:{url}#minilm-l6")
+        assert isinstance(emb, EngineEmbedder)   # probe succeeded
+        assert emb.dim == 64                     # discovered, not assumed
+        v = emb.embed("reset my password")
+        assert np.isclose(np.linalg.norm(v), 1.0, atol=1e-5)
+
+        cache = SemanticCache(embedder=emb)      # default 0.95 threshold
+        body = _chat_body("How do I reset my password?")
+        assert cache.check(body) is None
+        assert cache.store(body, RESPONSE)
+        # paraphrase (stopword/casing changes) -> hit
+        hit = cache.check(_chat_body("how can I reset my password"))
+        assert hit is not None and hit["cached"] is True
+        # distinct topic -> miss
+        assert cache.check(
+            _chat_body("best pizza restaurant in Naples")) is None
+    finally:
+        srv.shutdown()
+
+
+def test_engine_embedder_dead_endpoint_fails_fast():
+    from production_stack_tpu.router.semantic_cache import EngineEmbedder
+    # nothing listens on port 1: construction must RAISE (router fails
+    # fast; k8s restarts until the engine is up) — never silently
+    # downgrade an explicitly configured real-model embedder to
+    # hashing geometry
+    with pytest.raises(RuntimeError, match="unreachable"):
+        EngineEmbedder("http://127.0.0.1:1", probe_retries=2,
+                       probe_delay_s=0.0)
+
+
+def test_embed_breaker_disables_cache_not_requests():
+    """Consecutive embed failures open the breaker: check()/store()
+    return miss/no-store (requests keep flowing) instead of raising,
+    and a later success closes it."""
+
+    class FlakyEmbedder(HashingEmbedder):
+        def __init__(self):
+            super().__init__(64)
+            self.fail = True
+            self.calls = 0
+
+        def embed(self, text):
+            self.calls += 1
+            if self.fail:
+                raise OSError("embedding endpoint down")
+            return super().embed(text)
+
+    emb = FlakyEmbedder()
+    cache = SemanticCache(embedder=emb, threshold=0.9)
+    body = _chat_body("does the breaker work?")
+    for _ in range(cache.EMBED_BREAKER_THRESHOLD):
+        assert cache.check(body) is None          # failures, no raise
+    calls_at_trip = emb.calls
+    assert cache.check(body) is None              # breaker OPEN...
+    assert not cache.store(body, RESPONSE)
+    assert emb.calls == calls_at_trip             # ...no embed attempts
+    # cooldown elapses -> half-open probe succeeds -> cache works again
+    cache._embed_retry_at = 0.0
+    emb.fail = False
+    assert cache.store(body, RESPONSE)
+    assert cache.check(body)["cached"] is True
