@@ -116,11 +116,12 @@ def _layer_norm(x, w, b, eps):
     return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
 
 
-def encode(params: Params, cfg: EncoderConfig, tokens: jnp.ndarray,
-           lengths: jnp.ndarray) -> jnp.ndarray:
-    """tokens [N, T] int32 (right-padded), lengths [N] ->
-    mean-pooled embeddings fp32 [N, H] (sentence-transformers mean
-    pooling: sum of valid hidden states / count)."""
+def encode_hidden(params: Params, cfg: EncoderConfig, tokens: jnp.ndarray,
+                  lengths: jnp.ndarray) -> jnp.ndarray:
+    """tokens [N, T] int32 (right-padded), lengths [N] -> final-layer
+    hidden states [N, T, H] in cfg.dtype (padding rows are garbage the
+    caller must mask). Shared body of encode() (mean-pooled embeddings)
+    and token-level heads (e.g. the NER PII analyzer, router/pii.py)."""
     N, T = tokens.shape
     mask = jnp.arange(T)[None, :] < lengths[:, None]          # [N, T]
     x = (params["word_emb"][tokens]
@@ -153,6 +154,17 @@ def encode(params: Params, cfg: EncoderConfig, tokens: jnp.ndarray,
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x
+
+
+def encode(params: Params, cfg: EncoderConfig, tokens: jnp.ndarray,
+           lengths: jnp.ndarray) -> jnp.ndarray:
+    """tokens [N, T] int32 (right-padded), lengths [N] ->
+    mean-pooled embeddings fp32 [N, H] (sentence-transformers mean
+    pooling: sum of valid hidden states / count)."""
+    T = tokens.shape[1]
+    mask = jnp.arange(T)[None, :] < lengths[:, None]          # [N, T]
+    x = encode_hidden(params, cfg, tokens, lengths)
     pooled = jnp.sum(x.astype(jnp.float32) * mask[:, :, None], axis=1)
     return pooled / jnp.maximum(lengths, 1)[:, None]
 

@@ -20,6 +20,7 @@ dependency-free default, as in the reference.
 import asyncio
 import enum
 import json
+import os
 import re
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
@@ -54,6 +55,11 @@ class PIIType(enum.Enum):
     DOB = "date_of_birth"
     PASSWORD = "password"
     SECRET_URL_CRED = "url_credential"
+    # entity types only a model can find (NERPIIAnalyzer; the regex
+    # analyzer never produces them — names/places have no pattern)
+    PERSON = "person"
+    LOCATION = "location"
+    ORGANIZATION = "organization"
 
 
 @dataclass
@@ -184,10 +190,142 @@ class RegexPIIAnalyzer(PIIAnalyzer):
         return result
 
 
+class NERPIIAnalyzer(PIIAnalyzer):
+    """Model-based analyzer: a BERT token-classification checkpoint
+    (HF ``BertForTokenClassification`` layout) run through this repo's
+    JAX encoder (models/encoder.py encode_hidden) with the classifier
+    head applied on top — the TPU-native counterpart of the reference's
+    Presidio/spaCy analyzer
+    (reference src/vllm_router/experimental/pii/analyzers/presidio.py:1-172),
+    finding entities regex cannot (names, places, organizations).
+
+    Spec form: ``ner:<checkpoint-dir>``. The dir must hold config.json
+    with ``id2label`` (BIO or bare labels: PER/PERSON -> PERSON,
+    LOC/GPE -> LOCATION, ORG -> ORGANIZATION; O and unmapped labels are
+    ignored), weights (safetensors or .bin, ``bert.*`` + ``classifier.*``),
+    and a fast tokenizer (char offsets come from its offset mapping).
+    Construction failures RAISE — the operator explicitly configured a
+    model; silently scanning with regex instead would be a silent
+    security downgrade. analyze() runs on middleware threads; jit keeps
+    repeat calls at one host dispatch per (batched) length bucket."""
+
+    _LABEL_MAP = {
+        "PER": PIIType.PERSON, "PERSON": PIIType.PERSON,
+        "LOC": PIIType.LOCATION, "LOCATION": PIIType.LOCATION,
+        "GPE": PIIType.LOCATION,
+        "ORG": PIIType.ORGANIZATION,
+        "ORGANIZATION": PIIType.ORGANIZATION,
+    }
+
+    def __init__(self, path: str):
+        import json
+
+        import jax
+        import jax.numpy as jnp
+
+        from production_stack_tpu.models import encoder as enc
+        from production_stack_tpu.models import hf_loader
+
+        with open(os.path.join(path, "config.json")) as f:
+            hf_cfg = json.load(f)
+        if "id2label" not in hf_cfg:
+            raise ValueError(
+                f"{path}/config.json has no id2label — not a token-"
+                f"classification checkpoint")
+        self._id2label = {int(k): v for k, v in hf_cfg["id2label"].items()}
+        self._cfg = enc.config_from_hf_json(hf_cfg, name=f"ner:{path}")
+        import numpy as np
+
+        sd = hf_loader.read_state_dict(path)
+        self._params = enc.params_from_state_dict(self._cfg, sd)
+
+        def np_(t):
+            return t.detach().cpu().numpy() if hasattr(t, "detach") \
+                else np.asarray(t)
+        self._head_w = jnp.asarray(np_(sd["classifier.weight"]).T,
+                                   jnp.float32)        # [H, num_labels]
+        self._head_b = jnp.asarray(np_(sd["classifier.bias"]),
+                                   jnp.float32)
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(path)
+        if not getattr(self._tok, "is_fast", False):
+            raise ValueError(
+                f"tokenizer at {path} is not a fast tokenizer; the NER "
+                f"analyzer needs offset mappings for span extraction")
+
+        def _logits(tokens, lengths):
+            h = enc.encode_hidden(self._params, self._cfg, tokens,
+                                  lengths)
+            return h.astype(jnp.float32) @ self._head_w + self._head_b
+
+        self._fn = jax.jit(_logits)
+
+    def analyze(self, text: str,
+                types: Optional[Set[PIIType]] = None) -> PIIAnalysisResult:
+        import numpy as _np
+        result = PIIAnalysisResult()
+        enc_out = self._tok(
+            text, return_offsets_mapping=True, truncation=True,
+            max_length=self._cfg.max_position_embeddings,
+            return_attention_mask=False)
+        ids = enc_out["input_ids"]
+        offsets = enc_out["offset_mapping"]
+        if not ids:
+            return result
+        # pad to power-of-two length buckets: request lengths vary
+        # almost per request, and an exact-shape jit would recompile
+        # the encoder (seconds, on a middleware thread) for every new
+        # length and grow the executable cache without bound. Padding
+        # is masked out of attention by `lengths` (encode_hidden) and
+        # never enters the offsets loop below.
+        n = len(ids)
+        bucket = min(max(16, 1 << (n - 1).bit_length()),
+                     self._cfg.max_position_embeddings)
+        toks = _np.zeros((1, bucket), _np.int32)
+        toks[0, :n] = ids
+        logits = _np.asarray(self._fn(
+            toks, _np.asarray([n], _np.int32)))[0]          # [T, L]
+        labels = logits.argmax(-1)
+        # BIO decode into char spans: I- (or bare-label) tokens extend
+        # the running entity; a B- token always STARTS a new one, so
+        # adjacent same-type entities ("alice smith bob jones" as
+        # B-PER I-PER B-PER I-PER) stay two matches. Special tokens
+        # ([CLS]/[SEP]) carry (0, 0) offsets and break merges.
+        cur_type, cur_start, cur_end = None, 0, 0
+
+        def flush():
+            if cur_type is not None and cur_end > cur_start:
+                if types is None or cur_type in types:
+                    result.detected = True
+                    result.types.add(cur_type)
+                    result.matches.append(PIIMatch(
+                        cur_type, cur_start, cur_end,
+                        text[cur_start:cur_end]))
+
+        for i, (a, b) in enumerate(offsets):
+            label = self._id2label.get(int(labels[i]), "O")
+            kind = self._LABEL_MAP.get(label.split("-", 1)[-1])
+            if a == b or kind is None:      # special/pad token or O
+                flush()
+                cur_type = None
+                continue
+            begins = label.startswith("B-")
+            if not begins and kind is cur_type and a <= cur_end + 1:
+                cur_end = b                  # extend (wordpiece / space)
+            else:
+                flush()
+                cur_type, cur_start, cur_end = kind, a, b
+        flush()
+        return result
+
+
 def make_analyzer(spec: str = "regex") -> PIIAnalyzer:
     if spec == "regex":
         return RegexPIIAnalyzer()
-    raise ValueError(f"unknown PII analyzer {spec!r} (available: regex)")
+    if spec.startswith("ner:"):
+        return NERPIIAnalyzer(spec[len("ner:"):])
+    raise ValueError(f"unknown PII analyzer {spec!r} (available: regex, "
+                     f"ner:<token-classification checkpoint dir>)")
 
 
 # ---------------------------------------------------------------- config

@@ -177,3 +177,84 @@ def test_router_redacts_pii():
             assert "[REDACTED:email]" in fake.last_chat_body
         await server.close()
     asyncio.run(body())
+
+
+# ---------------------------------------------------------------- NER
+# model-based analyzer: a tiny BertForTokenClassification checkpoint
+# with a RIGGED classifier head (zero weights, bias forcing one label)
+# so the real load -> JAX encoder forward -> head -> BIO span decode
+# path runs deterministically without downloaded weights.
+
+@pytest.fixture(scope="module")
+def ner_checkpoint(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, BertForTokenClassification
+    from transformers import BertTokenizerFast
+
+    d = tmp_path_factory.mktemp("ner-ckpt")
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "alice", "works", "at", "acme", "in", "paris", "hello",
+             "world", "a", "b", "c"]
+    (d / "vocab.txt").write_text("\n".join(vocab) + "\n")
+    tok = BertTokenizerFast(vocab_file=str(d / "vocab.txt"),
+                            do_lower_case=True)
+    tok.save_pretrained(str(d))
+    cfg = BertConfig(
+        vocab_size=len(vocab), hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64,
+        num_labels=3, id2label={0: "O", 1: "B-PER", 2: "I-PER"},
+        label2id={"O": 0, "B-PER": 1, "I-PER": 2})
+    model = BertForTokenClassification(cfg)
+    with torch.no_grad():
+        model.classifier.weight.zero_()
+        model.classifier.bias.copy_(torch.tensor([0.0, 5.0, 0.0]))
+    model.save_pretrained(str(d))
+    return str(d)
+
+
+def test_ner_analyzer_spans_and_factory(ner_checkpoint):
+    from production_stack_tpu.router.pii import make_analyzer
+    analyzer = make_analyzer(f"ner:{ner_checkpoint}")
+    text = "alice works at acme"
+    # the rigged head labels every real token B-PER: each B- tag STARTS
+    # a new entity (BIO semantics), so four words = four PERSON matches
+    res = analyzer.analyze(text)
+    assert res.detected
+    assert res.types == {PIIType.PERSON}
+    assert [m.text for m in res.matches] == text.split()
+    # the types filter drops entity kinds the caller didn't ask for
+    assert not analyzer.analyze(text, types={PIIType.EMAIL}).detected
+    # redaction works off the model's spans like any analyzer's
+    from production_stack_tpu.router.pii import redact
+    assert redact(text, res.matches) == \
+        " ".join(["[REDACTED:person]"] * 4)
+    # I- tags CONTINUE the running entity: relabel the rigged output as
+    # I-PER and the same four tokens merge into one span
+    analyzer._id2label = {0: "O", 1: "I-PER", 2: "I-PER"}
+    merged = analyzer.analyze(text)
+    assert len(merged.matches) == 1
+    assert merged.matches[0].text == text
+
+
+def test_ner_analyzer_length_bucketing(ner_checkpoint):
+    """Inputs pad to power-of-two buckets so varying request lengths
+    reuse one compiled encoder instead of retracing per length."""
+    from production_stack_tpu.router.pii import make_analyzer
+    analyzer = make_analyzer(f"ner:{ner_checkpoint}")
+    calls = []
+    real = analyzer._fn
+    analyzer._fn = lambda t, l: calls.append(t.shape) or real(t, l)
+    analyzer.analyze("alice")                   # 3 tokens w/ specials
+    analyzer.analyze("alice works")             # 4
+    analyzer.analyze("alice works at acme in paris")   # 8
+    assert all(s[1] in (16, 32) for s in calls), calls
+    assert len({s for s in calls}) <= 2         # shared buckets
+
+
+def test_ner_analyzer_bad_checkpoint_raises(tmp_path):
+    from production_stack_tpu.router.pii import make_analyzer
+    (tmp_path / "config.json").write_text('{"vocab_size": 8}')
+    with pytest.raises((ValueError, OSError, KeyError)):
+        make_analyzer(f"ner:{tmp_path}")
