@@ -47,3 +47,25 @@ def test_tpu_pool_is_tpu_native():
     assert "tpu_topology" in text
     assert "nvidia" not in text
     assert "guest_accelerator" not in text
+
+
+def test_api_reference_generator_renders():
+    """docs/generate_api.py must render every listed module (a module
+    that stops importing or a signature crash fails here, not at the
+    next docs regeneration) and the committed pages must exist."""
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "generate_api", os.path.join("docs", "generate_api.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    for group, modnames in gen.MODULES.items():
+        for modname in modnames:
+            page = gen.render_module(modname)
+            assert page.startswith(f"# `{modname}`"), modname
+            committed = os.path.join(
+                "docs", "api",
+                modname.replace("production_stack_tpu.", "").replace(
+                    ".", "_") + ".md")
+            assert os.path.exists(committed), f"{committed} not committed"
+    assert os.path.exists(os.path.join("docs", "api", "README.md"))
