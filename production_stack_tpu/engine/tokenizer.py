@@ -236,21 +236,47 @@ class DetokenizeStream:
     def __init__(self, tokenizer):
         self._tok = tokenizer
         self._ids: List[int] = []
-        self._emitted = 0
+        # incremental window (the vLLM detokenizer scheme): decode only
+        # ids[prefix:] each push — prefix trails read by a few tokens of
+        # context so SentencePiece prefix-space merges and multi-byte
+        # codepoints resolve identically to a full decode, while per-
+        # token cost stays O(window), not O(sequence) (a full re-decode
+        # per token is quadratic and dominates host time at long
+        # generations).
+        self._prefix = 0     # window start
+        self._stable = ""    # decode(ids[prefix:]) at last emit
 
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
-        text = self._tok.decode(self._ids)
+        text = self._tok.decode(self._ids[self._prefix:])
         if text.endswith("�"):  # mid-codepoint; wait for more bytes
             return ""
-        delta = text[self._emitted:]
-        self._emitted = len(text)
+        delta = text[len(self._stable):]
+        # slide the window: keep the trailing tokens as context so the
+        # next decode resolves prefix-space merges exactly like a full
+        # decode would. _stable is re-decoded FROM THE NEW START so the
+        # next delta is measured against the same origin (a suffix
+        # decode can render its first chars differently than the full
+        # string; consistency of origin is what matters). String-
+        # position-dependent rendering (SentencePiece strips a leading
+        # space at position 0) can only leak into a delta when _stable
+        # is EMPTY — then the next token sits at the window's string
+        # start — so widen the window until it renders text (bounded:
+        # >128 consecutive invisible tokens keeps the near window).
+        start = max(0, len(self._ids) - 8)
+        stable = self._tok.decode(self._ids[start:])
+        floor = max(0, len(self._ids) - 128)
+        while start > floor and stable == "":
+            start = max(floor, start - 8)
+            stable = self._tok.decode(self._ids[start:])
+        self._prefix = start
+        self._stable = stable
         return delta
 
     def flush(self) -> str:
         """Emit whatever is still buffered (e.g. a trailing partial
         codepoint rendered as the replacement char) at end of stream."""
-        text = self._tok.decode(self._ids)
-        delta = text[self._emitted:]
-        self._emitted = len(text)
+        text = self._tok.decode(self._ids[self._prefix:])
+        delta = text[len(self._stable):]
+        self._stable = text
         return delta
