@@ -57,7 +57,7 @@ def main() -> None:
     from production_stack_tpu.engine.engine import LLMEngine
     from production_stack_tpu.engine.scheduler import SamplingOptions
 
-    # +4 windows of slack: priming leaves up to engine._PIPELINE_DEPTH
+    # +4 windows of slack: priming leaves up to cfg.pipeline_depth
     # optimistic windows in flight past the processed tokens, plus the
     # warm window and the host-side rounding of the priming loop —
     # under-covering would clamp the tail windows' KV writes onto the
@@ -102,7 +102,10 @@ def main() -> None:
     kv_len = cfg.kv_bucket_for(span)
     dec = dict(steps=args.window, kv_len=kv_len, greedy=True)
     if args.spec:
+        # speculation is per-row (engine._dispatch_decode builds this
+        # from eligibility); here every row is plain greedy
         dec["spec"] = args.spec
+        dec["spec_ok"] = np.ones((args.batch,), bool)
     # warm this exact executable (larger kv bucket than engine used)
     out = runner.decode(sampling, **dec)
     jax.block_until_ready(out[0])

@@ -245,12 +245,30 @@ class DetokenizeStream:
         # generations).
         self._prefix = 0     # window start
         self._stable = ""    # decode(ids[prefix:]) at last emit
+        self._hold = 0       # consecutive mid-codepoint holds
+        self._empty = {}     # id -> renders-nothing-alone (cached)
+
+    def _invisible(self, token_id: int) -> bool:
+        v = self._empty.get(token_id)
+        if v is None:
+            v = self._empty[token_id] = \
+                self._tok.decode([token_id]) == ""
+        return v
 
     def push(self, token_id: int) -> str:
         self._ids.append(token_id)
         text = self._tok.decode(self._ids[self._prefix:])
-        if text.endswith("�"):  # mid-codepoint; wait for more bytes
-            return ""
+        if text.endswith("�"):  # mid-codepoint; wait for more bytes —
+            # but BOUNDED: a UTF-8 sequence resolves within 4 bytes, so
+            # 8 consecutive pending decodes mean the tail is invalid
+            # bytes, not an in-flight codepoint. Emit it as-is (the
+            # replacement-char rendering of the bytes seen so far)
+            # instead of freezing the window and re-paying an
+            # ever-growing decode per push on degenerate byte storms.
+            self._hold += 1
+            if self._hold <= 8:
+                return ""
+        self._hold = 0
         delta = text[len(self._stable):]
         # slide the window: keep the trailing tokens as context so the
         # next decode resolves prefix-space merges exactly like a full
@@ -260,17 +278,27 @@ class DetokenizeStream:
         # string; consistency of origin is what matters). String-
         # position-dependent rendering (SentencePiece strips a leading
         # space at position 0) can only leak into a delta when _stable
-        # is EMPTY — then the next token sits at the window's string
-        # start — so widen the window until it renders text (bounded:
-        # >128 consecutive invisible tokens keeps the near window).
+        # is EMPTY — the next token would sit at the window's string
+        # start and lose its boundary space — so when the trailing
+        # window renders nothing, KEEP the current origin and instead
+        # bound the buffer by dropping middle ids that render nothing
+        # on their own (skipped specials: decode output is unchanged
+        # without them, and the kept window stays O(16) through
+        # arbitrarily long invisible runs, e.g. an eos loop under
+        # ignore_eos).
         start = max(0, len(self._ids) - 8)
         stable = self._tok.decode(self._ids[start:])
-        floor = max(0, len(self._ids) - 128)
-        while start > floor and stable == "":
-            start = max(floor, start - 8)
-            stable = self._tok.decode(self._ids[start:])
-        self._prefix = start
-        self._stable = stable
+        if stable == "" and start > self._prefix:
+            self._stable = text
+            keep_head = self._prefix + 8
+            tail_start = len(self._ids) - 8
+            if tail_start > keep_head:
+                mid = [i for i in self._ids[keep_head:tail_start]
+                       if not self._invisible(i)]
+                self._ids[keep_head:tail_start] = mid
+        else:
+            self._prefix = start
+            self._stable = stable
         return delta
 
     def flush(self) -> str:

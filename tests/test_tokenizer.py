@@ -108,3 +108,43 @@ def test_detok_stream_space_survives_invisible_run():
     st = DetokenizeStream(tok)
     out = "".join(st.push(i) for i in ids) + st.flush()
     assert out == tok.decode(ids) == "hello world"
+
+
+def test_detok_stream_invisible_run_stays_bounded():
+    """An arbitrarily long run of invisible tokens (e.g. an eos loop
+    under ignore_eos) must neither drop the next word-boundary space
+    (>128-run regression) nor regrow the decode window (the buffer
+    compacts invisible middles)."""
+
+    class SPM:
+        def decode(self, ids):
+            words = [{1: " hello", 2: " world"}.get(i, "") for i in ids]
+            text = "".join(words)
+            return text[1:] if text.startswith(" ") else text
+
+    tok = SPM()
+    ids = [1] + [0] * 500 + [2]
+    st = DetokenizeStream(tok)
+    out = "".join(st.push(i) for i in ids) + st.flush()
+    assert out == tok.decode(ids) == "hello world"
+    assert len(st._ids) < 40, len(st._ids)   # middles compacted away
+
+
+def test_detok_stream_invalid_byte_storm_bounded():
+    """A degenerate greedy loop on a lone UTF-8 lead byte (every decode
+    ends mid-codepoint) must not freeze the window: holds are bounded,
+    the replacement-char text is emitted, and per-push decode cost
+    stays O(window)."""
+    inner = ByteTokenizer()
+    seen = []
+
+    class Spy:
+        def decode(self, ids):
+            seen.append(len(ids))
+            return inner.decode(ids)
+
+    st = DetokenizeStream(Spy())
+    out = "".join(st.push(0xC3) for _ in range(2000))
+    out += st.flush()
+    assert "�" in out and len(out) > 1900      # emitted, not held forever
+    assert max(seen) <= 32, max(seen)          # window never regrows
