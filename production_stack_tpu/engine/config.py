@@ -125,6 +125,9 @@ class EngineConfig:
             raise ValueError("expert_parallel_size must be >= 1")
         if not 0 <= self.speculative_ngram_tokens <= 16:
             raise ValueError("speculative_ngram_tokens must be in 0..16")
+        if not 1 <= self.pipeline_depth <= 8:
+            raise ValueError("pipeline_depth must be in 1..8 (each queued "
+                             "window delays admission by one window)")
         if self.quantization not in (None, "int8"):
             raise ValueError(
                 f"quantization={self.quantization!r} unsupported: only "

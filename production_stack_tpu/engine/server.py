@@ -937,8 +937,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "--tensor-parallel-size)")
     p.add_argument("--speculative-ngram-tokens", type=int, default=0,
                    help="n-gram (prompt-lookup) speculative decoding "
-                        "draft length; greedy requests emit up to N+1 "
-                        "verified tokens per decode step (0 = off)")
+                        "draft length; eligible rows (greedy, unguided, "
+                        "unshaped) emit up to N+1 verified tokens per "
+                        "decode step (0 = off)")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="decode windows queued on the device at once; "
+                        "3 hides more host/tunnel RTT behind device "
+                        "work at the cost of admission latency")
+    p.add_argument("--dp-gather-attention-ok", action="store_true",
+                   help="acknowledge serving on a dp>1 mesh WITHOUT "
+                        "the paged attention kernel (gathered-view "
+                        "fallback, ~3x decode KV traffic); without "
+                        "this flag such a mesh refuses to construct")
     p.add_argument("--quantization", choices=["int8"], default=None,
                    help="weight-only int8: halves decode weight-"
                         "streaming HBM traffic (norms/biases/router "
@@ -1021,6 +1031,8 @@ def main(argv=None) -> None:
         moe_capacity_factor=args.moe_capacity_factor,
         quantization=args.quantization,
         speculative_ngram_tokens=args.speculative_ngram_tokens,
+        pipeline_depth=args.pipeline_depth,
+        dp_gather_attention_ok=args.dp_gather_attention_ok,
         seed=args.seed,
         embedding_model=args.embedding_model,
         kv_transfer_config=kv_transfer,

@@ -244,9 +244,14 @@ class DetokenizeStream:
         # per token is quadratic and dominates host time at long
         # generations).
         self._prefix = 0     # window start
-        self._stable = ""    # decode(ids[prefix:]) at last emit
+        self._stable = ""    # emitted portion of decode(ids[prefix:])
         self._hold = 0       # consecutive mid-codepoint holds
         self._empty = {}     # id -> renders-nothing-alone (cached)
+
+    # context window (tokens): window start, keep_head offset, buffer
+    # tail, and the hold bound all derive from this ONE constant — the
+    # slide/compaction invariants require them mutually consistent
+    _WINDOW = 8
 
     def _invisible(self, token_id: int) -> bool:
         v = self._empty.get(token_id)
@@ -256,20 +261,31 @@ class DetokenizeStream:
         return v
 
     def push(self, token_id: int) -> str:
+        W = self._WINDOW
         self._ids.append(token_id)
         text = self._tok.decode(self._ids[self._prefix:])
-        if text.endswith("�"):  # mid-codepoint; wait for more bytes —
-            # but BOUNDED: a UTF-8 sequence resolves within 4 bytes, so
-            # 8 consecutive pending decodes mean the tail is invalid
-            # bytes, not an in-flight codepoint. Emit it as-is (the
-            # replacement-char rendering of the bytes seen so far)
-            # instead of freezing the window and re-paying an
-            # ever-growing decode per push on degenerate byte storms.
+        pending = text.endswith("�")
+        if pending:
+            # trailing codepoint may still be in flight: hold — but
+            # BOUNDED. A UTF-8 sequence resolves within 4 bytes, so W
+            # consecutive pending decodes mean the tail is invalid
+            # bytes, not an in-flight codepoint: emit everything EXCEPT
+            # the final (only still-completable) char instead of
+            # freezing the window and re-paying an ever-growing decode
+            # per push on degenerate byte storms. Because the pending
+            # char is never counted emitted (_stable excludes it, and
+            # slid windows exclude it below), a later completion emits
+            # the resolved char through the ordinary delta — no
+            # retroactive divergence, no lost codepoint.
             self._hold += 1
-            if self._hold <= 8:
+            if self._hold <= W:
                 return ""
+            emit_to = len(text) - 1
+        else:
+            emit_to = len(text)
         self._hold = 0
-        delta = text[len(self._stable):]
+        delta = text[len(self._stable):emit_to] \
+            if emit_to > len(self._stable) else ""
         # slide the window: keep the trailing tokens as context so the
         # next decode resolves prefix-space merges exactly like a full
         # decode would. _stable is re-decoded FROM THE NEW START so the
@@ -283,15 +299,17 @@ class DetokenizeStream:
         # window renders nothing, KEEP the current origin and instead
         # bound the buffer by dropping middle ids that render nothing
         # on their own (skipped specials: decode output is unchanged
-        # without them, and the kept window stays O(16) through
+        # without them, and the kept window stays O(2W) through
         # arbitrarily long invisible runs, e.g. an eos loop under
         # ignore_eos).
-        start = max(0, len(self._ids) - 8)
+        start = max(0, len(self._ids) - W)
         stable = self._tok.decode(self._ids[start:])
+        if pending and stable.endswith("�"):
+            stable = stable[:-1]     # pending char stays un-emitted
         if stable == "" and start > self._prefix:
-            self._stable = text
-            keep_head = self._prefix + 8
-            tail_start = len(self._ids) - 8
+            self._stable = text[:emit_to]
+            keep_head = self._prefix + W
+            tail_start = len(self._ids) - W
             if tail_start > keep_head:
                 mid = [i for i in self._ids[keep_head:tail_start]
                        if not self._invisible(i)]

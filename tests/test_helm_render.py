@@ -64,6 +64,8 @@ def test_full_values_render_engine_deployment_contract():
         "sql-expert=/data/adapters/sql.npz,summarizer=/data/adapters/sum.npz"
     assert "--tensor-parallel-size" in args
     assert "--decode-window" in args
+    assert "--pipeline-depth" in args
+    assert args[args.index("--pipeline-depth") + 1] == "3"
     assert "--kv-transfer-config" in args
     res = container["resources"]["requests"]
     assert res["google.com/tpu"] == "4"

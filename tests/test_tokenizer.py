@@ -146,5 +146,19 @@ def test_detok_stream_invalid_byte_storm_bounded():
     st = DetokenizeStream(Spy())
     out = "".join(st.push(0xC3) for _ in range(2000))
     out += st.flush()
-    assert "�" in out and len(out) > 1900      # emitted, not held forever
+    assert out == inner.decode([0xC3] * 2000)  # exact parity, no hold-
+    assert len(out) == 2000                    # forever, nothing lost
     assert max(seen) <= 32, max(seen)          # window never regrows
+
+
+def test_detok_stream_hold_overflow_then_resolution():
+    """A codepoint that completes AFTER the bounded hold force-emitted
+    the junk before it must still be emitted (reviewer repro: the
+    trailing still-completable char is never counted emitted, so its
+    late resolution flows through the ordinary delta)."""
+    tok = ByteTokenizer()
+    for junk in (9, 50):
+        ids = [0xC3] * junk + [0xA9]
+        st = DetokenizeStream(tok)
+        out = "".join(st.push(i) for i in ids) + st.flush()
+        assert out == tok.decode(ids) == "�" * (junk - 1) + "é"
