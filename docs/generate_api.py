@@ -35,6 +35,7 @@ MODULES = {
         "production_stack_tpu.engine.block_manager",
         "production_stack_tpu.engine.guided",
         "production_stack_tpu.engine.metrics",
+        "production_stack_tpu.engine.tokenizer",
         "production_stack_tpu.engine.server",
     ],
     "Request router": [
