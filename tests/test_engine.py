@@ -354,6 +354,40 @@ def test_pipelined_windows_match_unpipelined():
     assert run(3) == run(1)
 
 
+def test_pipelined_speculative_windows_match_unpipelined():
+    """Pipelining composes with per-row speculation: optimistic spec
+    windows (device-carried history, variable tokens per macro-step)
+    must leave every stream identical to a depth-1 run — including a
+    shaped row denying itself speculation mid-batch."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    rep = rng.integers(1, 40, size=(10,)).tolist() * 5  # repetitive
+
+    def run(depth):
+        cfg = EngineConfig(model="debug-tiny", max_model_len=512,
+                           max_num_seqs=3, prefill_chunk=64,
+                           prefill_buckets=(64,), decode_window=4,
+                           speculative_ngram_tokens=3,
+                           pipeline_depth=depth,
+                           dtype="float32", kv_dtype="float32")
+        eng = LLMEngine(cfg)
+        ids = [eng.add_request(list(rep), SamplingOptions(
+                   temperature=0.0, max_tokens=12 + 5 * i,
+                   ignore_eos=True,
+                   presence_penalty=0.5 if i == 1 else 0.0))
+               for i in range(4)]   # 4 requests on 3 slots
+        done = set()
+        steps = 0
+        while len(done) < len(ids):
+            done.update(o.seq_id for o in eng.step() if o.finished)
+            steps += 1
+            assert steps < 2000
+        return [eng.seqs[i].output_tokens for i in ids]
+
+    assert run(3) == run(1)
+
+
 def test_fp32_model_with_bf16_kv_cache():
     """--dtype float32 with the default bfloat16 KV cache must serve
     (the K/V write casts to the cache dtype; attention promotes)."""
