@@ -43,6 +43,7 @@ interpret mode on CPU.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -289,7 +290,12 @@ def paged_attention(q, k_pool, v_pool, tables, starts, *, nb: int,
 # decode/spec windows have T <= spec+1 << this; prefill chunks go to
 # the general kernel
 DECODE_T_MAX = 8
-_BLOCKS_PER_STEP = 4
+# KV pool blocks fetched+processed per decode-kernel grid step. More
+# blocks per step = fewer grid steps (less per-step overhead) but a
+# bigger VMEM working set (R panels of [Hkv, Bs, D] K and V each).
+# Env-tunable for hardware sweeps: PSTPU_DECODE_BLOCKS_PER_STEP.
+_BLOCKS_PER_STEP = int(os.environ.get(
+    "PSTPU_DECODE_BLOCKS_PER_STEP", "4"))
 
 
 def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,
