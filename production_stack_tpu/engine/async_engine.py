@@ -8,6 +8,8 @@ variable so an idle engine burns no CPU.
 
 import asyncio
 import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
 from typing import AsyncIterator, Dict, List, Optional, Tuple
 
 from production_stack_tpu.engine.config import EngineConfig
@@ -28,12 +30,23 @@ class AsyncLLMEngine:
         self._wake = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # dedicated pool for calls that wait on the ENGINE LOCK
+        # (add_request/abort): during a multi-second lazy compile the
+        # lock is held and each waiting call pins a thread — on the
+        # loop's SHARED default executor a burst would exhaust the pool
+        # and stall unrelated offloaded work (DNS, embeddings). The
+        # waits serialize on the lock anyway, so a few threads suffice.
+        self._lock_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="engine-lock")
 
     # ------------------------------------------------------------------
 
     def start(self, loop: Optional[asyncio.AbstractEventLoop] = None,
               warmup: bool = True) -> None:
         self._loop = loop or asyncio.get_event_loop()
+        if self._lock_pool._shutdown:    # restarted after stop()
+            self._lock_pool = ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="engine-lock")
         if warmup:
             self.engine.runner.warmup()
         self._running = True
@@ -47,6 +60,7 @@ class AsyncLLMEngine:
             self._wake.notify_all()
         if self._thread:
             self._thread.join(timeout=10)
+        self._lock_pool.shutdown(wait=False)
 
     def _run(self) -> None:
         while self._running:
@@ -77,10 +91,47 @@ class AsyncLLMEngine:
                      options: SamplingOptions,
                      seq_id: Optional[str] = None,
                      model: Optional[str] = None) -> Tuple[str, asyncio.Queue]:
+        # add_request takes the ENGINE LOCK (engine.py), which the
+        # engine thread holds across whole steps — including lazy XLA
+        # compiles of new executable variants (seconds each). Taking
+        # that lock here would block the EVENT LOOP: under a burst of
+        # first-time feature combinations the server stops accepting
+        # connections entirely (observed as connect-refused storms in
+        # the r5 mixed-traffic soak). The executor thread absorbs the
+        # wait; it also keeps the connector's tier prefetch IO off the
+        # loop, as engine.add_request's contract expects.
+        #
+        # The seq_id is generated HERE so the result queue exists
+        # before the engine can emit: once add_request returns on the
+        # executor thread, the engine thread may prefill and dispatch
+        # within its next iterations — registering the queue after the
+        # await would race those first outputs.
+        seq_id = seq_id or f"seq-{uuid.uuid4().hex[:12]}"
         q: asyncio.Queue = asyncio.Queue()
-        seq_id = self.engine.add_request(prompt_tokens, options,
-                                        seq_id=seq_id, model=model)
         self._queues[seq_id] = q
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(
+            self._lock_pool, lambda: self.engine.add_request(
+                prompt_tokens, options, seq_id=seq_id, model=model))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            # the executor call cannot be interrupted: add_request may
+            # still COMPLETE after this cancellation (client vanished
+            # while we waited on the engine lock). Abort the sequence
+            # once the call settles, else the orphan decodes to its
+            # token budget on a slot nobody is reading.
+            self._queues.pop(seq_id, None)
+
+            def _cleanup(f):
+                if f.cancelled() or f.exception() is not None:
+                    return          # request never entered the engine
+                self._lock_pool.submit(self.engine.abort, seq_id)
+            fut.add_done_callback(_cleanup)
+            raise
+        except Exception:
+            self._queues.pop(seq_id, None)
+            raise
         with self._wake:
             self._wake.notify_all()
         return seq_id, q
@@ -97,10 +148,19 @@ class AsyncLLMEngine:
                 if out.finished:
                     return
         finally:
-            # client disconnected mid-stream: free the slot
+            # client disconnected mid-stream: free the slot. Cleanup
+            # may run under GeneratorExit where awaiting is illegal, so
+            # the abort is DISPATCHED to an executor thread (same
+            # engine-lock rationale as submit) and not awaited; abort
+            # is idempotent and slot-guarded, so ordering vs later
+            # admissions is safe.
             if seq_id in self._queues:
                 self._queues.pop(seq_id, None)
-                self.engine.abort(seq_id)
+                f = self._lock_pool.submit(self.engine.abort, seq_id)
+                f.add_done_callback(
+                    lambda f: f.exception() and logger.warning(
+                        "async abort of %s failed: %s", seq_id,
+                        f.exception()))
 
     @property
     def tokenizer(self):

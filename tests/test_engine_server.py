@@ -512,3 +512,43 @@ def test_disconnect_while_queued_aborts(engine):
             await asyncio.sleep(0.05)
         assert sched.num_running == 0
     _with_client(engine, body)
+
+
+def test_loop_responsive_while_engine_lock_held(engine):
+    """Admission waits on the engine lock (held across whole steps,
+    including multi-second lazy compiles) must NOT block the event
+    loop: while a chat request is stuck behind the lock, /health still
+    answers (r5 soak regression: connect-refused storms during
+    compile bursts because submit() took the lock on the loop)."""
+    import threading
+    import time as _time
+
+    async def body(client):
+        release = threading.Event()
+        held = threading.Event()
+
+        def hold_lock():
+            with engine.engine._lock:
+                held.set()
+                release.wait(timeout=10)
+
+        t = threading.Thread(target=hold_lock, daemon=True)
+        t.start()
+        assert held.wait(timeout=5)
+        try:
+            chat = asyncio.create_task(client.post(
+                "/v1/chat/completions", json={
+                    "model": "debug-tiny",
+                    "messages": [{"role": "user", "content": "hi"}],
+                    "max_tokens": 3, "temperature": 0.0}))
+            await asyncio.sleep(0.2)     # chat is now parked on the lock
+            t0 = _time.monotonic()
+            r = await client.get("/health")
+            dt = _time.monotonic() - t0
+            assert r.status == 200
+            assert dt < 1.0, f"/health took {dt:.2f}s with lock held"
+        finally:
+            release.set()
+        r = await chat
+        assert r.status == 200           # and the parked request finishes
+    _with_client(engine, body)
