@@ -1,0 +1,21 @@
+#!/bin/bash
+# DP scale-out curve: aggregate tokens/s vs replica count through the
+# session-affinity router (BASELINE config 2). Thin wrapper — the
+# orchestrator in production_stack_tpu/loadgen launches the engine
+# processes and the router itself; nothing needs to be running first.
+#
+#   benchmarks/run_scaleout.sh [replicas] [engine] [duration]
+#
+# Defaults measure N=1,2,4 debug-tiny engines on CPU, 60 s per point.
+# Use engine "fake" for a hardware-free orchestration check in under a
+# minute.
+set -euo pipefail
+
+REPLICAS="${1:-1,2,4}"
+ENGINE="${2:-debug-tiny}"
+DURATION="${3:-60s}"
+
+python -m production_stack_tpu.loadgen scaleout \
+  --replicas "$REPLICAS" --engine "$ENGINE" --routing session \
+  --duration "$DURATION" \
+  --output "SCALEOUT_$(date +%Y%m%d_%H%M%S).json"

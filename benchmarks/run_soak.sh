@@ -1,0 +1,24 @@
+#!/bin/bash
+# Mixed-traffic invariant-checked soak (the committed form of the
+# round-5 endurance methodology: BASELINE.md "Mixed-traffic stability
+# soaks"). Thin wrapper — all logic lives in
+# production_stack_tpu/loadgen; this pins the knobs the prose results
+# used so the soak is a one-command reproduction.
+#
+#   benchmarks/run_soak.sh <base-url> [duration] [out.json]
+#
+# duration accepts 120s / 30m / 4.4h (default 30m). Exit 1 on any
+# invariant violation (5xx, transport error, lost record, wedged abort).
+set -euo pipefail
+
+BASE_URL="${1:?usage: run_soak.sh <base-url> [duration] [out.json]}"
+DURATION="${2:-30m}"
+OUT="${3:-BENCH_soak_$(date +%Y%m%d_%H%M%S).json}"
+KEY="${OPENAI_API_KEY:-}"
+
+python -m production_stack_tpu.loadgen soak \
+  --base-url "$BASE_URL" ${KEY:+--api-key "$KEY"} \
+  --workload mixed --duration "$DURATION" \
+  --abort-fraction 0.08 \
+  --checkpoint-file "${OUT%.json}.checkpoints.jsonl" \
+  --output "$OUT"

@@ -107,14 +107,31 @@ class AsyncLLMEngine:
         # within its next iterations — registering the queue after the
         # await would race those first outputs.
         seq_id = seq_id or f"seq-{uuid.uuid4().hex[:12]}"
+        if seq_id in self._queues:
+            # silently replacing the live stream's queue would orphan
+            # it (and the error-path pop below would then tear down the
+            # WRONG stream's registration)
+            raise ValueError(f"seq_id {seq_id!r} already has a live stream")
         q: asyncio.Queue = asyncio.Queue()
         self._queues[seq_id] = q
         loop = asyncio.get_running_loop()
-        fut = loop.run_in_executor(
-            self._lock_pool, lambda: self.engine.add_request(
-                prompt_tokens, options, seq_id=seq_id, model=model))
+        # submit directly (not run_in_executor) so the CONCURRENT
+        # future stays reachable: on task cancellation asyncio cancels
+        # the wrapper even though the executor call keeps running, so
+        # only the concurrent future's state says whether add_request
+        # actually completed.
         try:
-            await fut
+            cfut = self._lock_pool.submit(
+                lambda: self.engine.add_request(
+                    prompt_tokens, options, seq_id=seq_id, model=model))
+        except RuntimeError:
+            # pool already shut down (request raced stop()): the
+            # request never entered the engine, but the registration
+            # above must not outlive this admission attempt
+            self._queues.pop(seq_id, None)
+            raise
+        try:
+            await asyncio.wrap_future(cfut, loop=loop)
         except asyncio.CancelledError:
             # the executor call cannot be interrupted: add_request may
             # still COMPLETE after this cancellation (client vanished
@@ -126,8 +143,21 @@ class AsyncLLMEngine:
             def _cleanup(f):
                 if f.cancelled() or f.exception() is not None:
                     return          # request never entered the engine
-                self._lock_pool.submit(self.engine.abort, seq_id)
-            fut.add_done_callback(_cleanup)
+                # runs on the pool worker that finished add_request (or
+                # the loop thread if it settled before registration)
+                try:
+                    self._lock_pool.submit(self.engine.abort, seq_id)
+                except RuntimeError:
+                    # stop() shut the pool down while add_request was
+                    # settling: abort inline rather than lose it (the
+                    # callback machinery would swallow the RuntimeError
+                    # and the admitted orphan would keep its slot)
+                    try:
+                        self.engine.abort(seq_id)
+                    except Exception as e:
+                        logger.warning("inline abort of %s failed: %s",
+                                       seq_id, e)
+            cfut.add_done_callback(_cleanup)
             raise
         except Exception:
             self._queues.pop(seq_id, None)
@@ -156,11 +186,23 @@ class AsyncLLMEngine:
             # admissions is safe.
             if seq_id in self._queues:
                 self._queues.pop(seq_id, None)
-                f = self._lock_pool.submit(self.engine.abort, seq_id)
-                f.add_done_callback(
-                    lambda f: f.exception() and logger.warning(
-                        "async abort of %s failed: %s", seq_id,
-                        f.exception()))
+                try:
+                    f = self._lock_pool.submit(self.engine.abort, seq_id)
+                except RuntimeError:
+                    # stop() already shut the pool down (server shutdown
+                    # with live streams): abort inline rather than lose
+                    # it — the engine thread is stopping, so the brief
+                    # lock wait here cannot stall a running loop.
+                    try:
+                        self.engine.abort(seq_id)
+                    except Exception as e:
+                        logger.warning("inline abort of %s failed: %s",
+                                       seq_id, e)
+                else:
+                    f.add_done_callback(
+                        lambda f: f.exception() and logger.warning(
+                            "async abort of %s failed: %s", seq_id,
+                            f.exception()))
 
     @property
     def tokenizer(self):

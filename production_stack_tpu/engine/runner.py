@@ -110,12 +110,14 @@ class ModelRunner:
                 cache_pspec, param_shardings)
             from production_stack_tpu.ops import (pallas_attention,
                                                   pallas_paged)
-            if (not pallas_paged.mesh_tp_only(mesh)
-                    and pallas_attention.flash_enabled()):
+            if not pallas_paged.mesh_tp_only(mesh):
                 # block-axis-sharded pools (dp > 1) forfeit the paged
                 # kernel (ops/pallas_paged.py mesh_tp_only): the
                 # gathered-view fallback re-materializes ~3x the KV
-                # traffic. Never let a helm value stumble into that.
+                # traffic. Never let a helm value stumble into that —
+                # and never stumble into it SILENTLY: the fallback is
+                # announced at engine start in every world, not just
+                # when the kernel would otherwise have run.
                 cliff = (
                     "serving mesh %s shards the KV pool's block axis: "
                     "the pallas paged-attention kernel only runs "
@@ -124,7 +126,13 @@ class ModelRunner:
                     "KV traffic). Prefer tp-only serving meshes with "
                     "replicaCount for data parallelism." % dict(
                         mesh.shape))
-                if engine_cfg.dp_gather_attention_ok:
+                if not pallas_attention.flash_enabled():
+                    # kernel unavailable on this backend anyway (CPU /
+                    # interpret): informational, nothing to refuse
+                    logger.warning(
+                        "paged-attention kernel disabled for this "
+                        "mesh: " + cliff)
+                elif engine_cfg.dp_gather_attention_ok:
                     logger.warning(
                         "dp_gather_attention_ok=True: " + cliff)
                 else:

@@ -1,0 +1,35 @@
+"""loadgen: committed load-generation, soak, and DP scale-out measurement.
+
+The measurement layer the serving stack's perf claims rest on — in-repo
+so every BASELINE number is a one-command reproduction from a fresh
+clone (the reference commits its request generator and multi-server
+sweep the same way: src/tests/perftest/request_generator.py,
+run-multi-server.sh).
+
+Pieces (each importable on its own):
+
+- ``spec``     — dataclass workload specs (traffic mix, session shape,
+                 arrival process) + JSON round-trip and named presets
+- ``workload`` — deterministic, seeded session/turn planning and
+                 OpenAI-protocol payload construction
+- ``arrival``  — closed-loop and open-loop (Poisson, QPS ramp) arrival
+                 processes
+- ``client``   — asyncio streaming client with per-request TTFT / ITL /
+                 e2e capture and abort injection
+- ``runner``   — drives a workload against a base URL; soak invariants
+                 and periodic checkpoint lines
+- ``report``   — aggregation into BENCH-schema JSON and SCALEOUT_*.json
+- ``orchestrator`` — launches N engine processes + the router and
+                 measures the aggregate-tokens/s-vs-replicas curve
+
+CLI: ``python -m production_stack_tpu.loadgen {run,soak,scaleout} ...``
+(docs/benchmarks.md has the cookbook).
+
+Talks to the stack only through its public HTTP surfaces; no imports
+from engine/ or router/ internals.
+"""
+
+from production_stack_tpu.loadgen.spec import (ArrivalSpec, SessionSpec,
+                                               TrafficMix, WorkloadSpec)
+
+__all__ = ["ArrivalSpec", "SessionSpec", "TrafficMix", "WorkloadSpec"]

@@ -1,0 +1,212 @@
+"""CLI: python -m production_stack_tpu.loadgen {run,soak,scaleout}
+
+run      — drive a workload (preset or --spec JSON file) against a
+           running stack; print + write a BENCH-schema JSON report
+soak     — duration-bounded mixed-traffic run with invariant checks,
+           abort injection, and periodic checkpoint lines; exit 1 on
+           any invariant violation
+scaleout — launch real router+engine processes at N=1,2,4,... and
+           write the aggregate-tokens/s-vs-replicas SCALEOUT_*.json
+
+Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
+"""
+
+import argparse
+import asyncio
+import json
+import re
+import sys
+import time
+
+from production_stack_tpu.loadgen import report as report_mod
+from production_stack_tpu.loadgen.orchestrator import run_scaleout
+from production_stack_tpu.loadgen.runner import run_workload
+from production_stack_tpu.loadgen.spec import WorkloadSpec, preset
+
+
+def parse_duration(text: str) -> float:
+    """'120', '120s', '5m', '4.4h' -> seconds."""
+    m = re.fullmatch(r"\s*([0-9.]+)\s*([smh]?)\s*", text)
+    if not m:
+        raise argparse.ArgumentTypeError(f"bad duration {text!r}")
+    mult = {"": 1.0, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2)]
+    return float(m.group(1)) * mult
+
+
+def _load_spec(args) -> WorkloadSpec:
+    if getattr(args, "spec", None):
+        spec = WorkloadSpec.from_file(args.spec)
+    else:
+        spec = preset(args.workload)
+    if getattr(args, "model", None):
+        spec.model = args.model
+    if getattr(args, "seed", None) is not None:
+        spec.seed = args.seed
+    if getattr(args, "users", None) is not None:
+        spec.arrival.users = args.users
+    return spec.validate()
+
+
+def _print_report(result, out: dict) -> None:
+    print(json.dumps(out, indent=2))
+    if result.violations:
+        print(f"INVARIANT VIOLATIONS ({len(result.violations)}):",
+              file=sys.stderr)
+        for v in result.violations[:20]:
+            print(f"  - {v}", file=sys.stderr)
+
+
+def cmd_run(args) -> int:
+    spec = _load_spec(args)
+    result = asyncio.run(run_workload(
+        spec, args.base_url, api_key=args.api_key,
+        duration_s=args.duration, max_sessions=args.max_sessions,
+        checkpoint_interval_s=args.checkpoint_interval))
+    out = report_mod.bench_schema(
+        f"loadgen {spec.name} ({spec.arrival.mode}-loop) via "
+        f"{args.base_url}", result.summary,
+        detail={"workload": spec.name, "seed": spec.seed,
+                "model": spec.model, "arrival_mode": spec.arrival.mode})
+    if args.output:
+        report_mod.write_json(args.output, out)
+    _print_report(result, out)
+    return 0 if result.ok else 1
+
+
+def cmd_soak(args) -> int:
+    spec = _load_spec(args)
+    # precedence: explicit --duration, else the spec file's own
+    # duration_s, else 120 s — a spec configured for a 4.4 h soak must
+    # not be silently truncated by the CLI default
+    duration = args.duration if args.duration is not None else \
+        (spec.duration_s if spec.duration_s is not None else 120.0)
+    result = asyncio.run(run_workload(
+        spec, args.base_url, api_key=args.api_key,
+        duration_s=duration,
+        abort_fraction=args.abort_fraction,
+        p99_ttft_bound_s=args.p99_ttft_bound,
+        checkpoint_interval_s=args.checkpoint_interval,
+        checkpoint_path=args.checkpoint_file))
+    out = report_mod.bench_schema(
+        f"loadgen soak {spec.name} ({duration:.0f}s)",
+        result.summary,
+        detail={"workload": spec.name, "seed": spec.seed,
+                "model": spec.model,
+                "abort_fraction": args.abort_fraction,
+                "invariant_violations": result.violations,
+                "checkpoints": len(result.checkpoints)})
+    if args.output:
+        report_mod.write_json(args.output, out)
+    _print_report(result, out)
+    if result.ok:
+        print(f"soak PASSED: {result.summary['finished']} requests, "
+              f"zero invariant violations")
+    return 0 if result.ok else 1
+
+
+def cmd_scaleout(args) -> int:
+    spec = _load_spec(args)
+    replicas = [int(x) for x in args.replicas.split(",") if x.strip()]
+    output = args.output or \
+        f"SCALEOUT_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    record = asyncio.run(run_scaleout(
+        spec, replicas=replicas, engine=args.engine,
+        routing=args.routing, duration_s=args.duration,
+        users_per_replica=args.users_per_replica,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout,
+        checkpoint_interval_s=args.checkpoint_interval, output=output))
+    print(json.dumps(record, indent=2))
+    # a curve measured through an error storm is not a curve: fail the
+    # run (same contract as run/soak, whose exit status BASELINE.md
+    # advertises as enforcing the invariants)
+    bad = [p for p in record["points"]
+           if p["errors"] or p.get("invariant_violations")]
+    for p in bad:
+        print(f"N={p['replicas']}: {p['errors']} errors, "
+              f"{len(p.get('invariant_violations') or [])} invariant "
+              f"violations — curve is suspect", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "python -m production_stack_tpu.loadgen",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp, base_url=True):
+        if base_url:
+            sp.add_argument("--base-url", required=True,
+                            help="router (or engine) URL")
+            sp.add_argument("--api-key", default=None)
+        sp.add_argument("--workload", default="chat",
+                        help="preset: chat | mixed | scaleout | ref-ramp")
+        sp.add_argument("--spec", default=None,
+                        help="WorkloadSpec JSON file (overrides "
+                             "--workload)")
+        sp.add_argument("--model", default=None,
+                        help="override the spec's model id")
+        sp.add_argument("--seed", type=int, default=None)
+        sp.add_argument("--users", type=int, default=None,
+                        help="override closed-loop user count")
+        sp.add_argument("--output", default=None,
+                        help="write the JSON report here")
+        sp.add_argument("--checkpoint-interval", type=float, default=30.0)
+
+    sp = sub.add_parser("run", help="one workload against a running stack")
+    common(sp)
+    sp.add_argument("--duration", type=parse_duration, default=None)
+    sp.add_argument("--max-sessions", type=int, default=None)
+    sp.set_defaults(fn=cmd_run)
+
+    sp = sub.add_parser("soak", help="duration-bounded invariant-checked "
+                                     "mixed-traffic run")
+    common(sp)
+    sp.add_argument("--duration", type=parse_duration, default=None,
+                    help="e.g. 120s, 30m, 4.4h (default: the spec's "
+                         "duration_s, else 120s)")
+    sp.add_argument("--abort-fraction", type=float, default=0.02,
+                    help="fraction of streams disconnected mid-flight "
+                         "(invariant I5)")
+    sp.add_argument("--p99-ttft-bound", type=float, default=None,
+                    help="seconds; invariant I4 when set")
+    sp.add_argument("--checkpoint-file", default=None,
+                    help="append checkpoint JSON lines here")
+    # the soak's whole point is mixed traffic
+    sp.set_defaults(fn=cmd_soak, workload="mixed")
+
+    sp = sub.add_parser("scaleout",
+                        help="launch router+N engines, measure the "
+                             "tokens/s-vs-replicas curve")
+    common(sp, base_url=False)
+    sp.add_argument("--replicas", default="1,2,4",
+                    help="comma-separated replica counts")
+    sp.add_argument("--engine", default="debug-tiny",
+                    help="engine model name, or 'fake' for the mock")
+    sp.add_argument("--routing", default="session",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--duration", type=parse_duration, default=60.0,
+                    help="measured window per replica point")
+    sp.add_argument("--users-per-replica", type=int, default=None)
+    sp.add_argument("--platform", default="cpu",
+                    help="JAX_PLATFORMS for engine processes ('' to "
+                         "inherit, e.g. for TPU)")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    # the scaleout preset is sized to the engine geometry the
+    # orchestrator launches (max-model-len 1024)
+    sp.set_defaults(fn=cmd_scaleout, workload="scaleout")
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,64 @@
+"""Arrival processes: when requests launch.
+
+Pure time-generation (no IO) so the statistics are unit-testable: the
+drivers in runner.py consume these offsets and do the sleeping.
+
+Open-loop arrivals are a Poisson process — exponential interarrivals at
+rate qps — because that is the arrival model under which serving
+latency distributions mean anything (requests keep coming while the
+server is slow; a closed loop self-throttles and hides the queue). The
+QPS ramp concatenates stages, each its own Poisson segment.
+"""
+
+import random
+from typing import Iterator, List, Sequence, Tuple
+
+
+def poisson_times(rng: random.Random, qps: float,
+                  duration_s: float) -> List[float]:
+    """Arrival offsets in [0, duration_s) of a Poisson process at rate
+    ``qps`` (exponential interarrivals, mean 1/qps)."""
+    if qps <= 0:
+        raise ValueError(f"qps must be positive, got {qps}")
+    out: List[float] = []
+    t = rng.expovariate(qps)
+    while t < duration_s:
+        out.append(t)
+        t += rng.expovariate(qps)
+    return out
+
+
+def ramp_times(rng: random.Random,
+               stages: Sequence[Tuple[float, float]]
+               ) -> List[Tuple[float, float]]:
+    """Concatenated Poisson stages -> [(absolute_offset, stage_qps)].
+
+    Each stage (qps, duration_s) contributes its own Poisson arrivals,
+    shifted by the cumulative duration of prior stages — the reference
+    run.sh QPS 0.1→4.1 sweep as one continuous open-loop schedule.
+    """
+    out: List[Tuple[float, float]] = []
+    base = 0.0
+    for qps, duration in stages:
+        out.extend((base + t, qps) for t in poisson_times(rng, qps,
+                                                          duration))
+        base += duration
+    return out
+
+
+def arrival_stream(rng: random.Random,
+                   stages: Sequence[Tuple[float, float]],
+                   repeat_last: bool = False
+                   ) -> Iterator[Tuple[float, float]]:
+    """Lazily yield (absolute_offset, qps); with ``repeat_last`` the
+    final stage extends forever (duration-bounded soaks outlive the
+    declared ramp)."""
+    base = 0.0
+    stages = list(stages)
+    while stages:
+        qps, duration = stages.pop(0)
+        for t in poisson_times(rng, qps, duration):
+            yield (base + t, qps)
+        base += duration
+        if repeat_last and not stages:
+            stages = [(qps, duration)]

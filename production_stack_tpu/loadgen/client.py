@@ -1,0 +1,166 @@
+"""Asyncio load client: fires RequestPlans, measures TTFT / ITL / e2e.
+
+One aiohttp session, unbounded connector (the arrival process is the
+concurrency control, not the client pool). Streaming requests parse SSE
+chunk arrival times into TTFT and inter-token latencies; non-streaming
+(embeddings) record e2e only.
+
+Abort injection: ``execute(plan, abort_after_s=...)`` drops the
+connection mid-stream — the soak uses this to prove the stack survives
+client disconnects (the engine must abort the orphan generation; later
+requests must be unaffected).
+"""
+
+import asyncio
+import json
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import aiohttp
+
+from production_stack_tpu.loadgen.workload import RequestPlan
+
+
+@dataclass
+class RequestRecord:
+    """Per-request measurement. ``request_id`` is assigned by the
+    runner, strictly increasing in launch order — the monotonicity /
+    exactly-one-terminal-record invariants hang off it."""
+    request_id: int
+    session_id: int
+    turn_index: int
+    kind: str
+    launch_time: float = 0.0          # wall clock (epoch)
+    finish_time: float = 0.0
+    ttft_s: float = 0.0
+    e2e_s: float = 0.0
+    itl_s: List[float] = field(default_factory=list)   # inter-chunk gaps
+    prompt_tokens: int = 0
+    output_tokens: int = 0
+    status: int = 0                   # HTTP status (0 = transport error)
+    error: Optional[str] = None
+    aborted: bool = False             # injected disconnect, not a failure
+    cancelled: bool = False           # harness-side drain cancel, ditto
+    body: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and not self.aborted \
+            and not self.cancelled
+
+
+def _estimate_tokens(body: dict) -> int:
+    msgs = body.get("messages") or []
+    n = sum(len(str(m.get("content", "")).split()) for m in msgs)
+    if "input" in body:
+        n += len(str(body["input"]).split())
+    return n
+
+
+class LoadClient:
+    def __init__(self, base_url: str, api_key: Optional[str] = None,
+                 request_timeout_s: float = 600.0):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self.request_timeout_s = request_timeout_s
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0))
+
+    async def close(self) -> None:
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    async def execute(self, plan: RequestPlan, request_id: int,
+                      abort_after_s: Optional[float] = None
+                      ) -> RequestRecord:
+        rec = RequestRecord(request_id=request_id,
+                            session_id=plan.session_id,
+                            turn_index=plan.turn_index, kind=plan.kind,
+                            launch_time=time.time())
+        headers = {"Content-Type": "application/json", **plan.headers}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        t0 = time.monotonic()
+        try:
+            coro = self._run(plan, rec, headers, t0)
+            if abort_after_s is not None:
+                try:
+                    await asyncio.wait_for(coro, timeout=abort_after_s)
+                except asyncio.TimeoutError:
+                    # the injected disconnect: connection torn down by
+                    # wait_for's cancellation, exactly like a vanished
+                    # client
+                    rec.aborted = True
+            else:
+                await coro
+        except asyncio.CancelledError:
+            raise
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError, json.JSONDecodeError) as e:
+            # JSONDecodeError: a 200 with a malformed body (truncated
+            # proxy response) must be recorded, not crash the run
+            rec.error = f"{type(e).__name__}: {e}"
+        end = time.monotonic()
+        rec.finish_time = time.time()
+        rec.e2e_s = end - t0
+        if rec.ttft_s == 0.0 and rec.ok:
+            rec.ttft_s = rec.e2e_s       # non-streaming: first byte = last
+        return rec
+
+    async def _run(self, plan: RequestPlan, rec: RequestRecord,
+                   headers: dict, t0: float) -> None:
+        timeout = aiohttp.ClientTimeout(total=self.request_timeout_s)
+        async with self._session.post(
+                f"{self.base_url}{plan.path}", json=plan.body,
+                headers=headers, timeout=timeout) as resp:
+            rec.status = resp.status
+            if resp.status != 200:
+                rec.error = (f"HTTP {resp.status}: "
+                             f"{(await resp.text())[:200]}")
+                return
+            if not plan.stream:
+                data = await resp.json()
+                usage = data.get("usage") or {}
+                rec.prompt_tokens = usage.get("prompt_tokens",
+                                              _estimate_tokens(plan.body))
+                rec.output_tokens = usage.get("completion_tokens", 0)
+                return
+            chunks: List[str] = []
+            usage = None
+            last_at: Optional[float] = None
+            async for raw_line in resp.content:
+                line = raw_line.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                data = line[5:].strip()
+                if data == "[DONE]":
+                    break
+                try:
+                    chunk = json.loads(data)
+                except json.JSONDecodeError:
+                    continue
+                if chunk.get("usage"):
+                    usage = chunk["usage"]
+                for choice in chunk.get("choices", []):
+                    delta = choice.get("delta") or {}
+                    if delta.get("content"):
+                        now = time.monotonic()
+                        if last_at is None:
+                            rec.ttft_s = now - t0    # first real token
+                        else:
+                            rec.itl_s.append(now - last_at)
+                        last_at = now
+                        chunks.append(delta["content"])
+            rec.body = "".join(chunks)
+            if usage:
+                rec.prompt_tokens = usage.get("prompt_tokens", 0)
+                rec.output_tokens = usage.get("completion_tokens",
+                                              len(chunks))
+            else:
+                rec.prompt_tokens = _estimate_tokens(plan.body)
+                rec.output_tokens = len(chunks)

@@ -1,0 +1,143 @@
+"""Aggregation and reporting.
+
+Two committed shapes:
+
+- BENCH-schema JSON (the repo's existing perf record format, bench.py):
+  ``{"metric", "value", "unit", "platform", "detail": {...}}`` — one
+  headline number plus full methodology in ``detail``.
+- ``SCALEOUT_*.json`` — the replicas → aggregate tokens/s curve with
+  per-point summaries and scaling efficiency vs N=1 (BASELINE config 2).
+"""
+
+import json
+import platform as _platform
+import time
+from typing import Dict, List, Optional, Sequence
+
+from production_stack_tpu.loadgen.client import RequestRecord
+
+
+def percentile(values: Sequence[float], p: float) -> float:
+    """Nearest-rank percentile on an unsorted sequence; 0.0 if empty."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def aggregate(records: List[RequestRecord],
+              window_start: Optional[float] = None,
+              window_end: Optional[float] = None) -> Dict:
+    """Summary metrics over records launched inside the window
+    (semantics match benchmarks/multi_round_qa/summary.py: offered QPS
+    counts launches; throughput counts finished tokens over the wall
+    window)."""
+    if window_start is None:
+        window_start = min((r.launch_time for r in records), default=0.0)
+    if window_end is None:
+        window_end = max((r.finish_time for r in records),
+                         default=window_start)
+    in_window = [r for r in records
+                 if window_start <= r.launch_time <= window_end]
+    ok = [r for r in in_window if r.ok and r.finish_time <= window_end]
+    errors = [r for r in in_window if r.error is not None]
+    aborted = [r for r in in_window if r.aborted]
+    cancelled = [r for r in in_window if r.cancelled]
+    duration = max(window_end - window_start, 1e-9)
+    ttfts = [r.ttft_s for r in ok]
+    e2es = [r.e2e_s for r in ok]
+    itls = [g for r in ok for g in r.itl_s]
+    kinds: Dict[str, int] = {}
+    for r in in_window:
+        kinds[r.kind] = kinds.get(r.kind, 0) + 1
+    # first few distinct error strings: a run that produced only zeros
+    # must explain itself in its own report
+    error_samples: List[str] = []
+    seen = set()
+    for r in errors:
+        key = (r.error or "")[:120]
+        if key not in seen:
+            seen.add(key)
+            error_samples.append(key)
+        if len(error_samples) >= 5:
+            break
+    return {
+        "duration_s": round(duration, 3),
+        "launched": len(in_window),
+        "finished": len(ok),
+        "errors": len(errors),
+        "http_5xx": len([r for r in errors if r.status >= 500]),
+        "aborted_injected": len(aborted),
+        "cancelled_by_harness": len(cancelled),
+        "offered_qps": round(len(in_window) / duration, 4),
+        "processed_qps": round(len(ok) / duration, 4),
+        "input_tokens_per_s": round(
+            sum(r.prompt_tokens for r in ok) / duration, 2),
+        "output_tokens_per_s": round(
+            sum(r.output_tokens for r in ok) / duration, 2),
+        "total_output_tokens": sum(r.output_tokens for r in ok),
+        "ttft_s": {"mean": round(sum(ttfts) / len(ttfts), 4) if ttfts
+                   else 0.0,
+                   "p50": round(percentile(ttfts, 50), 4),
+                   "p90": round(percentile(ttfts, 90), 4),
+                   "p99": round(percentile(ttfts, 99), 4)},
+        "itl_s": {"mean": round(sum(itls) / len(itls), 4) if itls
+                  else 0.0,
+                  "p99": round(percentile(itls, 99), 4)},
+        "e2e_s": {"p50": round(percentile(e2es, 50), 4),
+                  "p99": round(percentile(e2es, 99), 4)},
+        "requests_by_kind": kinds,
+        "error_samples": error_samples,
+    }
+
+
+def bench_schema(metric: str, agg: Dict, *, platform: str = "cpu",
+                 detail: Optional[Dict] = None) -> Dict:
+    """Wrap an aggregate into the BENCH_*.json record shape so driver
+    tooling that scrapes bench.py output can scrape loadgen output
+    unchanged."""
+    d = dict(agg)
+    d.update(detail or {})
+    return {
+        "metric": metric,
+        "value": agg["output_tokens_per_s"],
+        "unit": "out_tok/s",
+        "platform": platform,
+        "detail": d,
+    }
+
+
+def scaleout_record(*, engine: str, routing: str, workload: str,
+                    points: List[Dict], platform: str = "cpu",
+                    notes: str = "") -> Dict:
+    """The SCALEOUT_*.json shape: one point per replica count, each
+    carrying its full aggregate; efficiency is tokens/s relative to
+    perfect linear scaling from the N=1 point."""
+    base = next((p for p in points if p["replicas"] == 1), None)
+    for p in points:
+        if base and base["output_tokens_per_s"] > 0:
+            ideal = base["output_tokens_per_s"] * p["replicas"]
+            p["scaling_efficiency"] = round(
+                p["output_tokens_per_s"] / ideal, 4)
+        else:
+            p["scaling_efficiency"] = None
+    return {
+        "metric": "aggregate output tokens/s vs replicas "
+                  "(DP scale-out through the router)",
+        "engine": engine,
+        "routing": routing,
+        "workload": workload,
+        "platform": platform,
+        "host": _platform.node(),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "points": points,
+        "notes": notes,
+    }
+
+
+def write_json(path: str, obj: Dict) -> str:
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2)
+        f.write("\n")
+    return path

@@ -1,0 +1,394 @@
+"""Workload driver: plays a WorkloadSpec against a base URL.
+
+Closed loop — ``arrival.users`` worker tasks each hold one live session
+at a time, issuing its next turn when the previous answer lands.
+
+Open loop — requests launch at Poisson arrival offsets regardless of
+completions; each arrival fires the next turn of a ready session (or
+admits a new one), so sustained overload shows up as latency and queue
+growth, not a self-throttled client.
+
+Soak invariants (checked continuously, reported at the end):
+  I1 zero HTTP 5xx
+  I2 zero transport/protocol errors (injected aborts excluded)
+  I3 request ids assigned strictly monotonically, exactly one terminal
+     record per launched id (no lost or duplicated measurements)
+  I4 p99 TTFT within the configured bound
+  I5 after an injected client disconnect, later requests still succeed
+     (the abort was clean; no slot/stream leaked into a wedge)
+
+Checkpoint lines — one JSON object per interval on stdout (and
+optionally appended to a file): a long soak that dies at hour 4 still
+leaves hour-by-hour evidence.
+"""
+
+import asyncio
+import dataclasses
+import itertools
+import json
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from production_stack_tpu.loadgen.arrival import arrival_stream
+from production_stack_tpu.loadgen.client import LoadClient, RequestRecord
+from production_stack_tpu.loadgen.report import aggregate, percentile
+from production_stack_tpu.loadgen.spec import (KINDS, SessionSpec,
+                                               TrafficMix, WorkloadSpec)
+from production_stack_tpu.loadgen.workload import (SessionPlan, SessionState,
+                                                   plan_sessions)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+DRAIN_GRACE_S = 30.0
+
+
+class InvariantTracker:
+    def __init__(self, p99_ttft_bound_s: Optional[float] = None):
+        self.p99_ttft_bound_s = p99_ttft_bound_s
+        self.violations: List[str] = []
+        self._last_id = -1
+        self._launched: set = set()
+        self._terminal: set = set()
+        self._first_abort_finish: Optional[float] = None
+        self._ok_after_abort = 0
+        self._launched_after_abort = 0
+
+    def on_launch(self, request_id: int) -> None:
+        if request_id <= self._last_id:
+            self.violations.append(
+                f"I3 non-monotonic request id {request_id} after "
+                f"{self._last_id}")
+        if request_id in self._launched:
+            self.violations.append(f"I3 duplicate launch id {request_id}")
+        self._launched.add(request_id)
+        self._last_id = max(self._last_id, request_id)
+        if self._first_abort_finish is not None:
+            self._launched_after_abort += 1
+
+    def on_complete(self, rec: RequestRecord) -> None:
+        if rec.request_id in self._terminal:
+            self.violations.append(
+                f"I3 duplicate terminal record for id {rec.request_id}")
+        self._terminal.add(rec.request_id)
+        if rec.status >= 500:
+            self.violations.append(
+                f"I1 HTTP {rec.status} on request {rec.request_id} "
+                f"({rec.kind}): {rec.error}")
+        elif rec.error is not None:
+            self.violations.append(
+                f"I2 error on request {rec.request_id} ({rec.kind}): "
+                f"{rec.error}")
+        if rec.aborted and self._first_abort_finish is None:
+            self._first_abort_finish = rec.finish_time
+        if rec.ok and self._first_abort_finish is not None and \
+                rec.launch_time > self._first_abort_finish:
+            self._ok_after_abort += 1
+
+    def finalize(self, records: List[RequestRecord]) -> List[str]:
+        missing = self._launched - self._terminal
+        if missing:
+            self.violations.append(
+                f"I3 {len(missing)} launched requests have no terminal "
+                f"record (ids {sorted(missing)[:5]}...)")
+        if self.p99_ttft_bound_s is not None:
+            ttfts = [r.ttft_s for r in records if r.ok]
+            p99 = percentile(ttfts, 99)
+            if p99 > self.p99_ttft_bound_s:
+                self.violations.append(
+                    f"I4 p99 TTFT {p99:.3f}s exceeds bound "
+                    f"{self.p99_ttft_bound_s:.3f}s")
+        if self._first_abort_finish is not None and \
+                self._launched_after_abort > 0 and self._ok_after_abort == 0:
+            self.violations.append(
+                "I5 no successful request after the first injected "
+                "disconnect — abort may have wedged the stack")
+        return self.violations
+
+
+def warmup_spec(spec: WorkloadSpec,
+                kind: Optional[str] = None) -> WorkloadSpec:
+    """Single-turn warmup traffic derived from ``spec``: same model,
+    adapter, and traffic mix (so the right executables compile — a
+    chat-only warmup would leave the first guided/shaped/embeddings
+    request to pay its compile inside the measured window) but sized
+    far below any engine geometry the orchestrator launches
+    (max-model-len 1024, ~8 model tokens per filler word under
+    debug-tiny's character tokenizer) — a warmup the engine 400s would
+    silently push the compiles back into the measured window.
+    ``kind`` pins the mix to a single request kind."""
+    if kind:
+        # zero every kind explicitly: TrafficMix defaults chat to 1.0
+        mix = TrafficMix(**{**{k: 0.0 for k in KINDS}, kind: 1.0})
+    else:
+        mix = TrafficMix(**dataclasses.asdict(spec.mix))
+    return WorkloadSpec(
+        name="warmup", model=spec.model, seed=spec.seed + 7919,
+        lora_model=spec.lora_model, mix=mix,
+        guided_choices=spec.guided_choices,
+        session=SessionSpec(
+            rounds_min=1, rounds_max=1, system_prompt_tokens=8,
+            question_tokens_mean=8.0, question_tokens_sigma=0.0,
+            question_tokens_max=16, answer_tokens_mean=8.0,
+            answer_tokens_sigma=0.0, answer_tokens_max=8))
+
+
+@dataclass
+class RunResult:
+    records: List[RequestRecord]
+    summary: Dict
+    violations: List[str]
+    checkpoints: List[Dict] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class _Run:
+    """Shared machinery between the two loop modes."""
+
+    def __init__(self, spec: WorkloadSpec, client: LoadClient,
+                 tracker: InvariantTracker, abort_fraction: float):
+        self.spec = spec
+        self.client = client
+        self.tracker = tracker
+        self.records: List[RequestRecord] = []
+        self._ids = itertools.count()
+        # independent RNG stream for abort injection so injecting aborts
+        # does not perturb the planned workload
+        self._abort_rng = random.Random((spec.seed << 8) ^ 0x5eed)
+        self.abort_fraction = abort_fraction
+        self._next_session = 0
+
+    def new_session(self) -> SessionState:
+        plan = plan_sessions(self.spec, 1, first_id=self._next_session)[0]
+        self._next_session += 1
+        return SessionState(plan, self.spec)
+
+    @property
+    def sessions_started(self) -> int:
+        return self._next_session
+
+    async def fire(self, state: SessionState) -> RequestRecord:
+        plan = state.next_request()
+        rid = next(self._ids)
+        self.tracker.on_launch(rid)
+        abort_after = None
+        if self.abort_fraction > 0 and plan.stream and \
+                self._abort_rng.random() < self.abort_fraction:
+            abort_after = 0.2 + self._abort_rng.random() * 0.8
+        try:
+            rec = await self.client.execute(plan, rid,
+                                            abort_after_s=abort_after)
+        except asyncio.CancelledError:
+            # harness-side cancellation (open-loop drain, shutdown):
+            # the launched id still needs its terminal record, or
+            # finalize() would report the harness's own cancels as a
+            # false I3 violation against the stack
+            rec = RequestRecord(
+                request_id=rid, session_id=plan.session_id,
+                turn_index=plan.turn_index, kind=plan.kind,
+                launch_time=time.time(), finish_time=time.time(),
+                cancelled=True)
+            self.records.append(rec)
+            self.tracker.on_complete(rec)
+            raise
+        state.record_answer(rec.body)
+        rec.body = ""        # only the history append above needs it; a
+        # 4.4 h soak must not retain every response string until exit
+        self.records.append(rec)
+        self.tracker.on_complete(rec)
+        return rec
+
+
+async def _closed_loop(run: _Run, deadline: Optional[float],
+                       max_sessions: Optional[int]) -> None:
+    spec = run.spec
+
+    async def worker() -> None:
+        while True:
+            if deadline is not None and time.monotonic() >= deadline:
+                return
+            if max_sessions is not None and \
+                    run.sessions_started >= max_sessions:
+                return
+            state = run.new_session()
+            while not state.done:
+                if deadline is not None and time.monotonic() >= deadline:
+                    return
+                rec = await run.fire(state)
+                if rec.error is not None:
+                    # instantly-failing requests (a 4xx storm, a dead
+                    # backend) must not spin the closed loop into a
+                    # tight error-generating hot loop
+                    await asyncio.sleep(0.2)
+                if spec.arrival.think_time_s:
+                    await asyncio.sleep(spec.arrival.think_time_s)
+
+    workers = [asyncio.create_task(worker())
+               for _ in range(spec.arrival.users)]
+    try:
+        await asyncio.gather(*workers)
+    finally:
+        for w in workers:
+            w.cancel()
+
+
+async def _open_loop(run: _Run, deadline: Optional[float],
+                     max_sessions: Optional[int]) -> None:
+    spec = run.spec
+    rng = random.Random((spec.seed << 8) ^ 0xa441)
+    ready: List[SessionState] = []
+    in_flight: set = set()
+    t0 = time.monotonic()
+    endless = deadline is not None     # duration-bounded: ramp's last
+    # stage repeats so the soak outlives the declared sweep
+
+    def fire_one() -> None:
+        if ready:
+            state = ready.pop(0)
+        elif max_sessions is not None and \
+                run.sessions_started >= max_sessions:
+            return
+        else:
+            state = run.new_session()
+
+        async def task() -> None:
+            await run.fire(state)
+            if not state.done:
+                ready.append(state)
+
+        t = asyncio.create_task(task())
+        in_flight.add(t)
+        t.add_done_callback(in_flight.discard)
+
+    for offset, _qps in arrival_stream(rng, spec.arrival.stages(),
+                                       repeat_last=endless):
+        now = time.monotonic()
+        if deadline is not None and t0 + offset >= deadline:
+            break
+        if t0 + offset > now:
+            await asyncio.sleep(t0 + offset - now)
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+        fire_one()
+        if max_sessions is not None and not ready and \
+                run.sessions_started >= max_sessions and not in_flight:
+            break
+    # drain: stop launching, let in-flight requests land
+    drain_until = time.monotonic() + DRAIN_GRACE_S
+    while in_flight and time.monotonic() < drain_until:
+        await asyncio.sleep(0.1)
+    for t in list(in_flight):
+        t.cancel()
+    if in_flight:
+        await asyncio.gather(*in_flight, return_exceptions=True)
+
+
+async def _checkpoint_loop(run: _Run, interval_s: float, started: float,
+                           out: List[Dict],
+                           path: Optional[str]) -> None:
+    seq = 0
+    while True:
+        await asyncio.sleep(interval_s)
+        seq += 1
+        recs = run.records
+        ok = [r for r in recs if r.ok]
+        elapsed = time.monotonic() - started
+        line = {
+            "checkpoint": seq,
+            "t_s": round(elapsed, 1),
+            "launched": run.tracker._last_id + 1,
+            "finished": len(ok),
+            "errors": len([r for r in recs if r.error is not None]),
+            "aborted": len([r for r in recs if r.aborted]),
+            "output_tokens_per_s": round(
+                sum(r.output_tokens for r in ok) / max(elapsed, 1e-9), 2),
+            "p99_ttft_s": round(
+                percentile([r.ttft_s for r in ok], 99), 4),
+            "violations": len(run.tracker.violations),
+        }
+        out.append(line)
+        text = json.dumps(line)
+        print(f"CHECKPOINT {text}", flush=True)
+        if path:
+            with open(path, "a") as f:
+                f.write(text + "\n")
+
+
+async def run_workload(spec: WorkloadSpec, base_url: str, *,
+                       api_key: Optional[str] = None,
+                       duration_s: Optional[float] = None,
+                       max_sessions: Optional[int] = None,
+                       abort_fraction: float = 0.0,
+                       p99_ttft_bound_s: Optional[float] = None,
+                       checkpoint_interval_s: float = 30.0,
+                       checkpoint_path: Optional[str] = None,
+                       warmup_requests: int = 0) -> RunResult:
+    """Drive ``spec`` against ``base_url``; returns records + summary +
+    invariant verdicts. ``duration_s``/``max_sessions`` override the
+    spec's own bounds when given."""
+    spec.validate()
+    duration_s = duration_s if duration_s is not None else spec.duration_s
+    max_sessions = max_sessions if max_sessions is not None \
+        else spec.max_sessions
+    if duration_s is None and max_sessions is None:
+        max_sessions = spec.arrival.users * 2    # finite default
+    client = LoadClient(base_url, api_key=api_key,
+                        request_timeout_s=spec.request_timeout_s)
+    await client.start()
+    tracker = InvariantTracker(p99_ttft_bound_s=p99_ttft_bound_s)
+    run = _Run(spec, client, tracker, abort_fraction)
+    checkpoints: List[Dict] = []
+    try:
+        if warmup_requests > 0:
+            # untracked single-turn pokes (distinct users so session
+            # routing spreads them over every replica) to absorb
+            # first-request compiles before the measured window
+            # one warm _Run per active request kind, round-robined so
+            # EVERY kind fires at least once regardless of count —
+            # proportional sampling could leave a kind (and its
+            # executable's compile) for the measured window
+            kinds = [k for k, _ in spec.mix.weights()]
+            warm_runs = [_Run(warmup_spec(spec, kind=k), client,
+                              InvariantTracker(), 0.0) for k in kinds]
+            await asyncio.gather(*[
+                warm_runs[i % len(warm_runs)].fire(
+                    warm_runs[i % len(warm_runs)].new_session())
+                for i in range(max(warmup_requests, len(warm_runs)))])
+            warm_records = [r for w in warm_runs for r in w.records]
+            warm_errors = [r for r in warm_records if r.error is not None]
+            if warm_errors:
+                # a failed warmup silently pushes the compiles back
+                # into the measured window — say so
+                logger.warning(
+                    "%d/%d warmup requests failed (first: %s) — "
+                    "compiles may land in the measured window",
+                    len(warm_errors), len(warm_records),
+                    warm_errors[0].error)
+        started = time.monotonic()
+        deadline = started + duration_s if duration_s is not None else None
+        ck_task = asyncio.create_task(_checkpoint_loop(
+            run, checkpoint_interval_s, started, checkpoints,
+            checkpoint_path))
+        try:
+            if spec.arrival.mode == "closed":
+                await _closed_loop(run, deadline, max_sessions)
+            else:
+                await _open_loop(run, deadline, max_sessions)
+        finally:
+            ck_task.cancel()
+            try:
+                await ck_task
+            except asyncio.CancelledError:
+                pass
+    finally:
+        await client.close()
+    violations = tracker.finalize(run.records)
+    return RunResult(records=run.records,
+                     summary=aggregate(run.records),
+                     violations=violations,
+                     checkpoints=checkpoints)

@@ -1,0 +1,212 @@
+"""Workload specification: what traffic to generate, how it arrives.
+
+One ``WorkloadSpec`` fully determines a run given a seed: the traffic
+mix (chat / guided / shaped / embeddings / LoRA), the session shape
+(ShareGPT-style turn-length distributions), and the arrival process
+(closed-loop user population or open-loop Poisson QPS ramp). Specs
+round-trip through JSON so a BASELINE claim can pin the exact workload
+next to the number it produced.
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# request kinds the planner can emit; weights live in TrafficMix
+KINDS = ("chat", "guided", "shaped", "embeddings", "lora")
+
+
+@dataclass
+class TrafficMix:
+    """Relative weights per request kind (normalized at planning time).
+
+    ``lora`` requires ``WorkloadSpec.lora_model`` (the adapter's served
+    model id); a nonzero lora weight with no adapter configured is a
+    spec error caught in validate().
+    """
+    chat: float = 1.0
+    guided: float = 0.0
+    shaped: float = 0.0
+    embeddings: float = 0.0
+    lora: float = 0.0
+
+    def weights(self) -> List[Tuple[str, float]]:
+        total = sum(getattr(self, k) for k in KINDS)
+        if total <= 0:
+            raise ValueError("traffic mix has no positive weight")
+        return [(k, getattr(self, k) / total) for k in KINDS
+                if getattr(self, k) > 0]
+
+
+@dataclass
+class SessionSpec:
+    """Multi-round chat session shape.
+
+    Turn lengths follow a lognormal (the shape of ShareGPT human-turn
+    lengths: many short questions, a long tail), parameterized by the
+    target mean so specs stay readable; sigma is the log-space spread.
+    """
+    rounds_min: int = 2
+    rounds_max: int = 8
+    system_prompt_tokens: int = 200   # shared prefix (KV-reuse stressor)
+    question_tokens_mean: float = 48.0
+    question_tokens_sigma: float = 0.6
+    question_tokens_max: int = 512
+    answer_tokens_mean: float = 96.0
+    answer_tokens_sigma: float = 0.4
+    answer_tokens_max: int = 256
+
+
+@dataclass
+class ArrivalSpec:
+    """How requests hit the server.
+
+    closed — ``users`` concurrent sessions, each issuing its next turn
+    when the previous answer lands (plus ``think_time_s``): concurrency
+    is the controlled variable, throughput the measurement.
+
+    open — requests launch at Poisson arrival times regardless of
+    completions (the serving-benchmark arrival model LMCache and the
+    KV-offload study both stress): QPS is the controlled variable,
+    latency under load the measurement. The ramp walks qps_start →
+    qps_end by qps_step, ``stage_duration_s`` per stage (the reference
+    run.sh sweeps 0.1 → 4.1 the same way).
+    """
+    mode: str = "closed"              # "closed" | "open"
+    users: int = 8
+    think_time_s: float = 0.0
+    qps_start: float = 0.1
+    qps_end: float = 4.1
+    qps_step: float = 1.0
+    stage_duration_s: float = 30.0
+
+    def stages(self) -> List[Tuple[float, float]]:
+        """Open-loop (qps, duration_s) stages."""
+        if self.qps_step <= 0:
+            # a non-advancing step would loop this builder forever;
+            # constant-rate (start == end) is the one sensible reading
+            if self.qps_start == self.qps_end:
+                return [(round(self.qps_start, 6), self.stage_duration_s)]
+            raise ValueError(
+                f"qps_step {self.qps_step} must be positive to ramp "
+                f"{self.qps_start} -> {self.qps_end}")
+        out: List[Tuple[float, float]] = []
+        q = self.qps_start
+        # tolerance so 0.1 + 4 * 1.0 == 4.1 lands despite float drift
+        while q <= self.qps_end + 1e-9:
+            out.append((round(q, 6), self.stage_duration_s))
+            q += self.qps_step
+        if not out:
+            raise ValueError("open-loop ramp has no stages")
+        return out
+
+
+@dataclass
+class WorkloadSpec:
+    name: str = "chat"
+    model: str = "debug-tiny"
+    seed: int = 0
+    mix: TrafficMix = field(default_factory=TrafficMix)
+    session: SessionSpec = field(default_factory=SessionSpec)
+    arrival: ArrivalSpec = field(default_factory=ArrivalSpec)
+    duration_s: Optional[float] = None   # wall bound; None = finite run
+    max_sessions: Optional[int] = None   # finite closed-loop run length
+    request_timeout_s: float = 600.0
+    lora_model: Optional[str] = None     # served adapter id for kind=lora
+    guided_choices: Tuple[str, ...] = ("yes", "no", "maybe")
+
+    def validate(self) -> "WorkloadSpec":
+        if self.arrival.mode not in ("closed", "open"):
+            raise ValueError(f"arrival.mode {self.arrival.mode!r} must be "
+                             f"'closed' or 'open'")
+        if self.mix.lora > 0 and not self.lora_model:
+            raise ValueError("mix.lora > 0 requires lora_model (the "
+                             "adapter's served model id)")
+        if self.session.rounds_min < 1 or \
+                self.session.rounds_max < self.session.rounds_min:
+            raise ValueError("rounds_min/rounds_max malformed")
+        self.mix.weights()               # raises on all-zero mix
+        if self.arrival.mode == "open":
+            self.arrival.stages()        # raises on a malformed ramp
+        return self
+
+    # ---------------------------------------------------- JSON round-trip
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "WorkloadSpec":
+        d = dict(d)
+        if "mix" in d:
+            d["mix"] = TrafficMix(**d["mix"])
+        if "session" in d:
+            d["session"] = SessionSpec(**d["session"])
+        if "arrival" in d:
+            d["arrival"] = ArrivalSpec(**d["arrival"])
+        if "guided_choices" in d:
+            d["guided_choices"] = tuple(d["guided_choices"])
+        return cls(**d).validate()
+
+    @classmethod
+    def from_json(cls, text: str) -> "WorkloadSpec":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_file(cls, path: str) -> "WorkloadSpec":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+
+def preset(name: str) -> WorkloadSpec:
+    """Named workloads the CLI and docs refer to by name."""
+    if name == "chat":
+        return WorkloadSpec(name="chat").validate()
+    if name == "mixed":
+        # the soak workload: mostly chat, with guided decoding, shaped
+        # sampling, and embeddings exercising the non-default
+        # executables. Sized to fit the CPU debug-tiny stack the
+        # committed soak runs against (its character-level tokenizer
+        # expands a filler word to ~8 model tokens, and the orchestrator
+        # launches engines at max-model-len 1024): round-3 prompts stay
+        # near ~800 model tokens.
+        return WorkloadSpec(
+            name="mixed",
+            mix=TrafficMix(chat=0.6, guided=0.15, shaped=0.15,
+                           embeddings=0.10),
+            session=SessionSpec(rounds_min=1, rounds_max=3,
+                                system_prompt_tokens=32,
+                                question_tokens_mean=16.0,
+                                question_tokens_sigma=0.5,
+                                question_tokens_max=48,
+                                answer_tokens_mean=48.0,
+                                answer_tokens_sigma=0.4,
+                                answer_tokens_max=64),
+        ).validate()
+    if name == "scaleout":
+        # the replica-curve workload: pure multi-round chat, sized so
+        # session histories fit the engines run_scaleout launches
+        # itself (same ~8-tokens-per-word arithmetic as "mixed") —
+        # a 400 "prompt exceeds max_model_len" storm would measure
+        # nothing but the error path
+        return WorkloadSpec(
+            name="scaleout",
+            session=SessionSpec(rounds_min=1, rounds_max=3,
+                                system_prompt_tokens=16,
+                                question_tokens_mean=12.0,
+                                question_tokens_sigma=0.4,
+                                question_tokens_max=24,
+                                answer_tokens_mean=32.0,
+                                answer_tokens_sigma=0.3,
+                                answer_tokens_max=48),
+        ).validate()
+    if name == "ref-ramp":
+        # the reference run.sh shape: open-loop Poisson sweep 0.1 -> 4.1
+        return WorkloadSpec(
+            name="ref-ramp",
+            arrival=ArrivalSpec(mode="open", qps_start=0.1, qps_end=4.1,
+                                qps_step=1.0, stage_duration_s=30.0),
+        ).validate()
+    raise ValueError(f"unknown workload preset {name!r} "
+                     f"(known: chat, mixed, scaleout, ref-ramp)")

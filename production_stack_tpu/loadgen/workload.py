@@ -1,0 +1,171 @@
+"""Deterministic session/turn planning and payload construction.
+
+``plan_sessions(spec, n)`` is a pure function of (spec, n): the same
+spec and seed always produce byte-identical plans — a soak or scale-out
+run is reproducible evidence, and N=1 vs N=2 replicas face the *same*
+traffic. Randomness comes only from ``random.Random(spec.seed)``.
+
+Payloads speak the stack's public OpenAI surface: /v1/chat/completions
+(chat / guided / shaped / lora kinds) and /v1/embeddings.
+"""
+
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from production_stack_tpu.loadgen.spec import WorkloadSpec
+
+# deterministic filler vocabulary: cycled by token index, so a payload
+# is a function of its length alone (and compresses poorly enough to be
+# honest on the wire)
+_WORDS = ("alpha", "bravo", "charlie", "delta", "echo", "foxtrot",
+          "golf", "hotel", "india", "juliet", "kilo", "lima", "mike",
+          "november", "oscar", "papa", "quebec", "romeo", "sierra",
+          "tango", "uniform", "victor", "whiskey", "xray", "yankee",
+          "zulu")
+
+
+def filler(n_tokens: int, salt: int = 0) -> str:
+    """~n whitespace tokens of deterministic text; ``salt`` rotates the
+    word cycle so distinct sessions don't share a prefix by accident."""
+    return " ".join(_WORDS[(salt + i) % len(_WORDS)]
+                    for i in range(max(n_tokens, 1)))
+
+
+def _sample_len(rng: random.Random, mean: float, sigma: float,
+                cap: int) -> int:
+    """Lognormal with the given arithmetic mean (mu backed out of the
+    lognormal mean identity), clamped to [1, cap]."""
+    import math
+    mu = math.log(max(mean, 1.0)) - sigma * sigma / 2.0
+    return max(1, min(cap, int(round(rng.lognormvariate(mu, sigma)))))
+
+
+@dataclass
+class TurnPlan:
+    kind: str                     # chat | guided | shaped | embeddings | lora
+    question_tokens: int
+    answer_tokens: int
+
+
+@dataclass
+class SessionPlan:
+    session_id: int
+    user_id: str                  # x-user-id header (session routing key)
+    kind: str
+    turns: List[TurnPlan]
+
+
+def plan_sessions(spec: WorkloadSpec, count: int,
+                  first_id: int = 0) -> List[SessionPlan]:
+    """The first ``count`` sessions of the spec's infinite schedule,
+    starting at session ``first_id`` (planning is resumable: sessions
+    [0, k) then [k, n) equals sessions [0, n))."""
+    out: List[SessionPlan] = []
+    weights = spec.mix.weights()
+    kinds = [k for k, _ in weights]
+    probs = [w for _, w in weights]
+    s = spec.session
+    for sid in range(first_id, first_id + count):
+        # one RNG per session, keyed by (seed, sid): session sid's plan
+        # is independent of how many sessions were planned before it
+        rng = random.Random((spec.seed << 20) ^ sid)
+        kind = rng.choices(kinds, probs)[0]
+        rounds = 1 if kind == "embeddings" else \
+            rng.randint(s.rounds_min, s.rounds_max)
+        turns = [TurnPlan(
+            kind=kind,
+            question_tokens=_sample_len(rng, s.question_tokens_mean,
+                                        s.question_tokens_sigma,
+                                        s.question_tokens_max),
+            answer_tokens=_sample_len(rng, s.answer_tokens_mean,
+                                      s.answer_tokens_sigma,
+                                      s.answer_tokens_max),
+        ) for _ in range(rounds)]
+        out.append(SessionPlan(session_id=sid, user_id=f"lg-user-{sid}",
+                               kind=kind, turns=turns))
+    return out
+
+
+@dataclass
+class RequestPlan:
+    """One wire-ready request: everything the client needs to fire it."""
+    path: str                     # /v1/chat/completions | /v1/embeddings
+    body: Dict
+    headers: Dict[str, str]
+    stream: bool
+    kind: str
+    session_id: int
+    turn_index: int
+    max_tokens: int
+
+
+class SessionState:
+    """Plays a SessionPlan turn by turn, accumulating chat history (the
+    KV-reuse stressor: every round re-sends the grown prefix)."""
+
+    def __init__(self, plan: SessionPlan, spec: WorkloadSpec):
+        self.plan = plan
+        self.spec = spec
+        self.turn_index = 0
+        self.messages: List[Dict] = []
+
+    @property
+    def done(self) -> bool:
+        return self.turn_index >= len(self.plan.turns)
+
+    def next_request(self) -> RequestPlan:
+        assert not self.done
+        turn = self.plan.turns[self.turn_index]
+        spec = self.spec
+        headers = {"x-user-id": self.plan.user_id}
+        if turn.kind == "embeddings":
+            body = {"model": spec.model,
+                    "input": filler(turn.question_tokens,
+                                    salt=self.plan.session_id)}
+            req = RequestPlan(path="/v1/embeddings", body=body,
+                              headers=headers, stream=False,
+                              kind=turn.kind,
+                              session_id=self.plan.session_id,
+                              turn_index=self.turn_index, max_tokens=0)
+            self.turn_index += 1
+            return req
+        if not self.messages:
+            self.messages.append({
+                "role": "system",
+                "content": "Shared context: " + filler(
+                    spec.session.system_prompt_tokens,
+                    salt=self.plan.session_id)})
+        question = (f"Question {self.turn_index + 1}: " +
+                    filler(turn.question_tokens,
+                           salt=self.plan.session_id + self.turn_index))
+        self.messages.append({"role": "user", "content": question})
+        body: Dict = {
+            "model": spec.lora_model if turn.kind == "lora" else spec.model,
+            "messages": list(self.messages),
+            "max_tokens": turn.answer_tokens,
+            "stream": True,
+            "stream_options": {"include_usage": True},
+            "temperature": 0.0,
+        }
+        if turn.kind == "guided":
+            body["guided_choice"] = list(spec.guided_choices)
+            # a guided answer is one choice, not a story
+            body["max_tokens"] = max(
+                8, max(len(c.split()) for c in spec.guided_choices) + 2)
+        elif turn.kind == "shaped":
+            body.update(temperature=0.7, presence_penalty=0.5,
+                        frequency_penalty=0.2)
+        req = RequestPlan(path="/v1/chat/completions", body=body,
+                          headers=headers, stream=True, kind=turn.kind,
+                          session_id=self.plan.session_id,
+                          turn_index=self.turn_index,
+                          max_tokens=body["max_tokens"])
+        self.turn_index += 1
+        return req
+
+    def record_answer(self, text: str) -> None:
+        """Feed the assistant turn back into the history (multi-round)."""
+        if self.plan.kind != "embeddings":
+            self.messages.append({"role": "assistant",
+                                  "content": text or "(no answer)"})

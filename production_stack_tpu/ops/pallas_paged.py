@@ -44,6 +44,7 @@ interpret mode on CPU.
 
 import functools
 import os
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -294,8 +295,31 @@ DECODE_T_MAX = 8
 # blocks per step = fewer grid steps (less per-step overhead) but a
 # bigger VMEM working set (R panels of [Hkv, Bs, D] K and V each).
 # Env-tunable for hardware sweeps: PSTPU_DECODE_BLOCKS_PER_STEP.
-_BLOCKS_PER_STEP = int(os.environ.get(
-    "PSTPU_DECODE_BLOCKS_PER_STEP", "4"))
+
+
+def _env_blocks_per_step(default: int = 4) -> int:
+    """Validated at import: a malformed or non-positive value must not
+    crash module import or reach the decode-kernel grid math — warn and
+    serve on the default instead."""
+    raw = os.environ.get("PSTPU_DECODE_BLOCKS_PER_STEP")
+    if raw is None:
+        return default
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        warnings.warn(
+            f"PSTPU_DECODE_BLOCKS_PER_STEP={raw!r} is not an integer; "
+            f"falling back to {default}", RuntimeWarning)
+        return default
+    if value < 1:
+        warnings.warn(
+            f"PSTPU_DECODE_BLOCKS_PER_STEP={value} must be >= 1; "
+            f"falling back to {default}", RuntimeWarning)
+        return default
+    return value
+
+
+_BLOCKS_PER_STEP = _env_blocks_per_step()
 
 
 def _paged_decode_kernel(tabs_ref, starts_ref, q_ref, *refs, T: int,

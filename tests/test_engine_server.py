@@ -552,3 +552,101 @@ def test_loop_responsive_while_engine_lock_held(engine):
         r = await chat
         assert r.status == 200           # and the parked request finishes
     _with_client(engine, body)
+
+
+def test_submit_rejects_duplicate_seq_id(engine):
+    """A caller-supplied seq_id that collides with a live stream must be
+    rejected, not silently replace the live stream's result queue (the
+    error-path pop would then tear down the wrong registration)."""
+    async def body():
+        original = asyncio.Queue()
+        engine._queues["dup-seq"] = original
+        try:
+            from production_stack_tpu.engine.scheduler import SamplingOptions
+            with pytest.raises(ValueError, match="live stream"):
+                await engine.submit(
+                    [1, 2, 3], SamplingOptions(max_tokens=2),
+                    seq_id="dup-seq")
+            assert engine._queues["dup-seq"] is original
+        finally:
+            engine._queues.pop("dup-seq", None)
+    asyncio.run(body())
+
+
+def test_stream_disconnect_abort_survives_shutdown_pool():
+    """Disconnect cleanup races server shutdown: once stop() has shut
+    the lock pool down, the finally-block abort must fall back to an
+    inline call instead of losing the abort to a RuntimeError."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+
+    eng = AsyncLLMEngine.__new__(AsyncLLMEngine)   # only what stream() touches
+    aborted = []
+
+    class _MiniEngine:
+        def abort(self, seq_id):
+            aborted.append(seq_id)
+
+    eng.engine = _MiniEngine()
+    eng._queues = {}
+    eng._lock_pool = ThreadPoolExecutor(max_workers=1)
+    eng._lock_pool.shutdown()
+
+    async def fake_submit(prompt_tokens, options, model=None):
+        q = asyncio.Queue()
+        eng._queues["s1"] = q
+        return "s1", q
+
+    eng.submit = fake_submit
+
+    async def body():
+        gen = eng.stream([1], None)
+        first = asyncio.ensure_future(gen.__anext__())
+        await asyncio.sleep(0.05)      # parked on q.get(): a live stream
+        first.cancel()                 # the client vanishes
+        with pytest.raises(asyncio.CancelledError):
+            await first
+        await gen.aclose()
+    asyncio.run(body())
+    assert aborted == ["s1"]           # abort landed inline, not lost
+
+
+def test_submit_cancel_abort_survives_shutdown_pool():
+    """The same race inside submit(): the client cancels while
+    add_request is parked on the engine lock, then stop() shuts the
+    pool down before the call settles — the cleanup callback must abort
+    inline instead of losing the abort to the pool's RuntimeError."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+
+    aborted = []
+    release = threading.Event()
+
+    class _MiniEngine:
+        def add_request(self, *a, **k):
+            release.wait(5)            # the slow engine-lock hold
+
+        def abort(self, seq_id):
+            aborted.append(seq_id)
+
+    eng = AsyncLLMEngine.__new__(AsyncLLMEngine)
+    eng.engine = _MiniEngine()
+    eng._queues = {}
+    eng._lock_pool = ThreadPoolExecutor(max_workers=1)
+
+    async def body():
+        task = asyncio.ensure_future(eng.submit(
+            [1], SamplingOptions(max_tokens=2), seq_id="s2"))
+        await asyncio.sleep(0.05)      # parked inside the executor call
+        task.cancel()                  # the client vanishes
+        with pytest.raises(asyncio.CancelledError):
+            await task
+        eng._lock_pool.shutdown(wait=False)  # server shutdown begins...
+        release.set()                  # ...then add_request settles on
+        await asyncio.sleep(0.2)       # the gone pool; callback runs
+    asyncio.run(body())
+    assert aborted == ["s2"]           # abort landed inline, not lost

@@ -1,0 +1,109 @@
+"""Scale-out orchestrator tier: real router process + engine processes.
+
+Tier-1 smoke: N=1 vs N=2 FAKE engines through the real router with
+session routing — proves the orchestrator launches, health-gates,
+routes, measures, and writes a well-formed SCALEOUT record, in well
+under a minute.
+
+Slow tier (-m slow): the real thing — debug-tiny engine processes on
+CPU (BASELINE config 2) and the mixed-traffic soak.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from production_stack_tpu.loadgen.orchestrator import (LocalStack,
+                                                       run_scaleout)
+from production_stack_tpu.loadgen.runner import run_workload
+from production_stack_tpu.loadgen.spec import preset
+
+
+def test_fake_engine_scaleout_smoke(tmp_path):
+    """N=1 vs N=2 fake engines: the full orchestration path (launch,
+    health gate, static discovery, session routing, measure, report)
+    with mock backends."""
+    spec = preset("chat")
+    spec.arrival.users = 4
+    out = str(tmp_path / "SCALEOUT_smoke.json")
+    record = asyncio.run(run_scaleout(
+        spec, replicas=[1, 2], engine="fake", routing="session",
+        duration_s=4.0, log_dir=str(tmp_path / "logs"), output=out))
+
+    assert os.path.exists(out)
+    with open(out) as f:
+        assert json.load(f) == record
+    assert record["engine"] == "fake"
+    assert record["routing"] == "session"
+    points = {p["replicas"]: p for p in record["points"]}
+    assert set(points) == {1, 2}
+    for n, p in points.items():
+        assert p["errors"] == 0, p
+        assert p["summary"]["finished"] > 0
+        assert p["output_tokens_per_s"] > 0
+        assert p["users"] == 4 * n           # load scales with N
+    assert points[1]["scaling_efficiency"] == 1.0
+    assert points[2]["scaling_efficiency"] is not None
+
+
+def test_local_stack_launch_failure_cleans_up(tmp_path, monkeypatch):
+    """A stack that cannot become healthy must not leak processes: the
+    __aenter__ failure path has to reap every process it spawned before
+    re-raising."""
+    from production_stack_tpu.loadgen import orchestrator
+
+    async def never_healthy(url, timeout_s, require_endpoints=0):
+        raise TimeoutError(f"{url}/health not ready (injected)")
+
+    monkeypatch.setattr(orchestrator, "wait_healthy", never_healthy)
+
+    async def body():
+        stack = LocalStack(1, "fake", log_dir=str(tmp_path / "logs"),
+                           startup_timeout_s=8.0)
+        with pytest.raises(TimeoutError, match="injected"):
+            async with stack:
+                pytest.fail("stack must not enter on a health timeout")
+        assert stack.procs                   # the engine WAS spawned...
+        assert all(p.popen.poll() is not None for p in stack.procs)
+    asyncio.run(body())                      # ...and was reaped
+
+
+@pytest.mark.slow
+def test_debug_tiny_scaleout_real_engines(tmp_path):
+    """BASELINE config 2 shape on CPU: real engine processes behind the
+    real router, session routing, N=1 vs N=2."""
+    spec = preset("scaleout")
+    spec.arrival.users = 4
+    record = asyncio.run(run_scaleout(
+        spec, replicas=[1, 2], engine="debug-tiny", routing="session",
+        duration_s=20.0, log_dir=str(tmp_path / "logs"),
+        output=str(tmp_path / "SCALEOUT_real.json")))
+    points = {p["replicas"]: p for p in record["points"]}
+    for p in points.values():
+        assert p["summary"]["finished"] > 0
+        assert p["errors"] == 0
+    # the DP scale-out claim: two engines outproduce one
+    assert points[2]["output_tokens_per_s"] > \
+        points[1]["output_tokens_per_s"]
+
+
+@pytest.mark.slow
+def test_mixed_soak_against_real_stack(tmp_path):
+    """Short mixed-traffic soak (chat/guided/shaped/embeddings + abort
+    injection) against a real single-replica stack: zero invariant
+    violations."""
+    async def body():
+        async with LocalStack(1, "debug-tiny", routing="session",
+                              log_dir=str(tmp_path / "logs")) as stack:
+            spec = preset("mixed")
+            spec.arrival.users = 4
+            result = await run_workload(
+                spec, stack.url, duration_s=60.0, abort_fraction=0.05,
+                warmup_requests=2, checkpoint_interval_s=20.0)
+            assert result.ok, result.violations
+            assert result.summary["finished"] > 0
+            kinds = set(result.summary["requests_by_kind"])
+            assert "chat" in kinds and len(kinds) >= 3
+    asyncio.run(body())

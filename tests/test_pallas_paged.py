@@ -277,3 +277,24 @@ def test_engine_end_to_end_with_paged_kernel(monkeypatch):
             pallas_attention.set_flash_enabled(None)
 
     assert run(True) == run(False)
+
+
+def test_env_blocks_per_step_validation(monkeypatch):
+    """PSTPU_DECODE_BLOCKS_PER_STEP must never crash import or reach
+    the decode grid math as 0/negative: malformed values warn and fall
+    back to the default."""
+    import pytest
+
+    from production_stack_tpu.ops.pallas_paged import _env_blocks_per_step
+
+    monkeypatch.delenv("PSTPU_DECODE_BLOCKS_PER_STEP", raising=False)
+    assert _env_blocks_per_step() == 4
+    monkeypatch.setenv("PSTPU_DECODE_BLOCKS_PER_STEP", "8")
+    assert _env_blocks_per_step() == 8
+    monkeypatch.setenv("PSTPU_DECODE_BLOCKS_PER_STEP", "banana")
+    with pytest.warns(RuntimeWarning, match="not an integer"):
+        assert _env_blocks_per_step() == 4
+    for bad in ("0", "-3"):
+        monkeypatch.setenv("PSTPU_DECODE_BLOCKS_PER_STEP", bad)
+        with pytest.warns(RuntimeWarning, match="must be >= 1"):
+            assert _env_blocks_per_step() == 4
