@@ -1,0 +1,23 @@
+#!/bin/bash
+# Router data-plane overhead A/B (BASELINE.md Round 7): launches one
+# zero-think fake engine + the real router, drives the identical
+# closed-loop non-streaming chat storm at both URLs, and reports
+# router-vs-direct req/s + the overhead ratio. Thin wrapper — all
+# logic lives in production_stack_tpu/loadgen/overhead.py; this pins
+# the knobs the committed ROUTER_OVERHEAD_*.json numbers used.
+#
+#   benchmarks/run_router_overhead.sh [users] [duration] [out.json]
+#
+# Defaults reproduce the committed measurement: 64 users, 15 s per
+# side, 8-token responses. Add a second run with --stream (see
+# docs/benchmarks.md "Router performance") to exercise the chunk
+# relay loop instead of the buffered path.
+set -euo pipefail
+
+USERS="${1:-64}"
+DURATION="${2:-15s}"
+OUT="${3:-ROUTER_OVERHEAD_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen overhead \
+  --engine fake --users "$USERS" --duration "$DURATION" \
+  --num-tokens 8 --output "$OUT"

@@ -1,4 +1,4 @@
-"""CLI: python -m production_stack_tpu.loadgen {run,soak,scaleout}
+"""CLI: python -m production_stack_tpu.loadgen {run,soak,scaleout,overhead}
 
 run      — drive a workload (preset or --spec JSON file) against a
            running stack; print + write a BENCH-schema JSON report
@@ -7,6 +7,9 @@ soak     — duration-bounded mixed-traffic run with invariant checks,
            any invariant violation
 scaleout — launch real router+engine processes at N=1,2,4,... and
            write the aggregate-tokens/s-vs-replicas SCALEOUT_*.json
+overhead — launch one engine + the router, drive the identical
+           closed-loop storm at both URLs, report router-vs-direct
+           req/s and the overhead ratio (ROUTER_OVERHEAD_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -20,6 +23,7 @@ import time
 
 from production_stack_tpu.loadgen import report as report_mod
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
+from production_stack_tpu.loadgen.overhead import run_overhead
 from production_stack_tpu.loadgen.runner import run_workload
 from production_stack_tpu.loadgen.spec import WorkloadSpec, preset
 
@@ -129,6 +133,24 @@ def cmd_scaleout(args) -> int:
     return 1 if bad else 0
 
 
+def cmd_overhead(args) -> int:
+    record = asyncio.run(run_overhead(
+        engine=args.engine, users=args.users, duration_s=args.duration,
+        num_tokens=args.num_tokens, stream=args.stream,
+        routing=args.routing, platform=args.platform,
+        log_dir=args.log_dir, startup_timeout_s=args.startup_timeout,
+        snapshot_ttl=args.snapshot_ttl))
+    print(json.dumps(record, indent=2))
+    if args.output:
+        report_mod.write_json(args.output, record)
+    d = record["detail"]
+    bad = d["direct"]["errors"] + d["router"]["errors"]
+    if bad:
+        print(f"{bad} requests errored — the A/B is suspect",
+              file=sys.stderr)
+    return 1 if bad else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -199,6 +221,37 @@ def build_parser() -> argparse.ArgumentParser:
     # the scaleout preset is sized to the engine geometry the
     # orchestrator launches (max-model-len 1024)
     sp.set_defaults(fn=cmd_scaleout, workload="scaleout")
+
+    sp = sub.add_parser("overhead",
+                        help="router-vs-direct A/B: launch one engine "
+                             "+ the router, storm both URLs, report "
+                             "the overhead ratio")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (zero-think mock — measures the "
+                         "router, not the model) or a real engine "
+                         "model name")
+    sp.add_argument("--users", type=int, default=64,
+                    help="closed-loop concurrency per side")
+    sp.add_argument("--duration", type=parse_duration, default=15.0,
+                    help="measured window per side (e.g. 15s)")
+    sp.add_argument("--num-tokens", type=int, default=8,
+                    help="response length the engine generates")
+    sp.add_argument("--stream", action="store_true",
+                    help="streaming responses (exercises the chunk "
+                         "relay loop; TTFT percentiles reported)")
+    sp.add_argument("--routing", default="roundrobin",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--snapshot-ttl", type=float, default=None,
+                    help="router --request-stats-snapshot-ttl override "
+                         "(seconds; 0 disables snapshot caching)")
+    sp.add_argument("--output", default=None,
+                    help="write the JSON report here "
+                         "(e.g. ROUTER_OVERHEAD_r07.json)")
+    sp.set_defaults(fn=cmd_overhead)
 
     return p
 

@@ -75,10 +75,13 @@ def launch_engine(kind: str, port: int, *, log_dir: str,
     model name served by the real engine server."""
     url = f"http://127.0.0.1:{port}"
     if kind == "fake":
+        # defaults pace the mock like a tiny real engine; extra_args
+        # can override (the overhead A/B pins a zero-think engine so
+        # the measurement is the router, not the pacing)
         cmd = [sys.executable, "-m", "tests.fake_engine",
                "--port", str(port), "--host", "127.0.0.1",
                "--model", "fake-model", "--num-tokens", "16",
-               "--tokens-per-s", "200"]
+               "--tokens-per-s", "200", *(extra_args or [])]
         return _spawn(f"engine-fake-{port}", cmd, url, log_dir)
     cmd = [sys.executable, "-m", "production_stack_tpu.engine.server",
            "--model", kind, "--host", "127.0.0.1", "--port", str(port),
@@ -88,7 +91,8 @@ def launch_engine(kind: str, port: int, *, log_dir: str,
 
 
 def launch_router(backend_urls: List[str], model: str, port: int, *,
-                  routing: str = "session", log_dir: str) -> Proc:
+                  routing: str = "session", log_dir: str,
+                  snapshot_ttl: Optional[float] = None) -> Proc:
     cmd = [sys.executable, "-m", "production_stack_tpu.router.app",
            "--host", "127.0.0.1", "--port", str(port),
            "--service-discovery", "static",
@@ -96,6 +100,8 @@ def launch_router(backend_urls: List[str], model: str, port: int, *,
            "--static-models", ",".join([model] * len(backend_urls)),
            "--routing-logic", routing,
            "--engine-stats-interval", "5"]
+    if snapshot_ttl is not None:
+        cmd += ["--request-stats-snapshot-ttl", str(snapshot_ttl)]
     return _spawn(f"router-{port}", cmd, f"http://127.0.0.1:{port}",
                   log_dir)
 

@@ -27,7 +27,7 @@ from production_stack_tpu.router.proxy import route_general_request
 from production_stack_tpu.router.rewriter import make_rewriter
 from production_stack_tpu.router.routing import make_router
 from production_stack_tpu.router.service_discovery import (
-    K8sServiceDiscovery, StaticServiceDiscovery)
+    K8sServiceDiscovery, StaticServiceDiscovery, engine_auth_headers)
 from production_stack_tpu.router.stats import (EngineStatsScraper,
                                                RequestStatsMonitor)
 from production_stack_tpu.utils import (init_logger, parse_comma_separated,
@@ -105,9 +105,16 @@ def build_app(args: argparse.Namespace) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
     state: dict = {
         "request_timeout": args.request_timeout,
+        # hot-path statics, built once: the client timeout object and
+        # the engine-auth header overlay (proxy._forward_headers) are
+        # per-request allocations otherwise
+        "client_timeout": aiohttp.ClientTimeout(
+            total=args.request_timeout),
+        "auth_overlay": engine_auth_headers(),
         "metrics": RouterMetrics(),
         "request_stats": RequestStatsMonitor(
-            horizon_s=args.request_stats_window),
+            horizon_s=args.request_stats_window,
+            snapshot_ttl_s=args.request_stats_snapshot_ttl),
         "feature_gates": FeatureGates(args.feature_gates),
         "rewriter": make_rewriter("noop"),
     }
@@ -247,6 +254,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "lines (0 disables; the reference's "
                         "--log-stats equivalent)")
     p.add_argument("--request-stats-window", type=float, default=30.0)
+    p.add_argument("--request-stats-snapshot-ttl", type=float,
+                   default=0.05,
+                   help="seconds a routing-decision stats snapshot may "
+                        "be reused before the sliding-window aggregates "
+                        "are recomputed (in-flight counters are always "
+                        "live; 0 recomputes every request)")
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument("--dynamic-config-json", default=None)
     p.add_argument("--dynamic-config-interval", type=float, default=10.0)
@@ -310,8 +323,12 @@ def main(argv=None) -> None:
         # task, which closes the backend connection — propagating the
         # disconnect to the engine so IT can abort the generation
         # (aiohttp >= 3.9 defaults this off; without it an abandoned
-        # request is only noticed when the next token write fails)
-        runner = web.AppRunner(app, handler_cancellation=True)
+        # request is only noticed when the next token write fails).
+        # access_log=None: the default access logger formats a line per
+        # request even when no handler consumes it — per-request stats
+        # live in the stats plane, not in access logs
+        runner = web.AppRunner(app, handler_cancellation=True,
+                               access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, args.host, args.port)
         await site.start()

@@ -194,7 +194,7 @@ class BatchProcessor:
                     "response": {"status_code": 400, "body": {
                         "error": f"no backend serves {model!r}"}}}
         url = self.state["router"].route(
-            endpoints, self.state["request_stats"].get(), {}, body)
+            endpoints, self.state["request_stats"].snapshot(), {}, body)
         path = req.get("url", batch["endpoint"])
         session: aiohttp.ClientSession = self.state["client"]
         from production_stack_tpu.router.service_discovery import (

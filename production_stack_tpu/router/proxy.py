@@ -3,8 +3,27 @@
 Capability parity with reference src/vllm_router/services/request_service/
 request.py:44-196 (body parse -> model filter -> route -> stream relay ->
 stats hooks -> response), re-designed on one shared aiohttp
-ClientSession: the relay forwards raw bytes chunk-by-chunk (no SSE
-re-parse on the hot loop) and fires first-byte/complete stats hooks.
+ClientSession around a zero-rework fast path:
+
+- the body is parsed ONCE and the client's raw bytes are forwarded
+  untouched unless a rewriter / cache knob / disagg hook actually
+  mutated them (byte-identical passthrough is pinned by
+  tests/test_router_fastpath.py);
+- the static forward-header overlay (the router's engine Bearer) and
+  the client timeout object are built at app startup, not per request;
+- the streaming loop does one bare attribute increment per chunk on an
+  ActiveRequest record (stats.py) — all window math runs at
+  on_request_complete;
+- routing reads RequestStatsMonitor.snapshot() (window aggregates
+  cached ~50 ms, in-flight counters live) instead of recomputing every
+  engine's sliding windows per request;
+- small non-streaming backend responses (Content-Length present, no
+  event-stream) are relayed as ONE buffered write instead of a
+  prepare/chunk/eof sequence.
+
+The committed A/B for all of this is
+``python -m production_stack_tpu.loadgen overhead``
+(BASELINE.md Round 7; docs/benchmarks.md "Router performance").
 """
 
 import asyncio
@@ -16,6 +35,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.rewriter import NoopRequestRewriter
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -33,20 +53,50 @@ HOP_HEADERS = {"host", "content-length", "transfer-encoding", "connection",
 # in numpy/kvcache when the cache gate is off.
 CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
 
+# buffered-relay cap: a non-streaming backend response up to this size
+# is read whole and written in one shot; anything bigger (or chunked,
+# or an event stream) goes through the chunk relay loop
+BUFFERED_RESPONSE_MAX = 4 * 1024 * 1024
 
-def _forward_headers(request: web.Request) -> dict:
+
+def _copy_backend_headers(resp: web.StreamResponse,
+                          backend: aiohttp.ClientResponse) -> None:
+    for k, v in backend.headers.items():
+        if k.lower() not in HOP_HEADERS:
+            resp.headers[k] = v
+
+
+def _log_store_failure(fut) -> None:
+    e = fut.exception()
+    if e is not None:
+        logger.warning("semantic cache store failed: %s", e)
+
+
+def _store_cached_response(semantic_cache, body: dict,
+                           payload: bytes) -> None:
+    """Fire-and-forget semantic-cache store: the sync CPU embed +
+    index insert must never sit between the handler and the client."""
+    try:
+        response_body = json.loads(payload)
+    except Exception as e:
+        logger.warning("semantic cache store failed: %s", e)
+        return
+    fut = asyncio.get_running_loop().run_in_executor(
+        None, semantic_cache.store, body, response_body)
+    fut.add_done_callback(_log_store_failure)
+
+
+def _forward_headers(request: web.Request, auth_overlay: dict) -> dict:
     headers = {k: v for k, v in request.headers.items()
                if k.lower() not in HOP_HEADERS}
     # membership test on the CIMultiDict (case-insensitive): a lowercase
     # 'authorization' must suppress injection too, or the upstream
-    # request would carry both the client's and the router's Bearer
-    if "Authorization" not in request.headers:
-        # engines enforcing ENGINE_API_KEY (engine/server.py) accept the
-        # router's own key for clients trusted at the router boundary; a
-        # client-provided Bearer always passes through untouched
-        from production_stack_tpu.router.service_discovery import (
-            engine_auth_headers)
-        headers.update(engine_auth_headers())
+    # request would carry both the client's and the router's Bearer.
+    # engines enforcing ENGINE_API_KEY (engine/server.py) accept the
+    # router's own key for clients trusted at the router boundary; a
+    # client-provided Bearer always passes through untouched
+    if auth_overlay and "Authorization" not in request.headers:
+        headers.update(auth_overlay)
     return headers
 
 
@@ -72,9 +122,12 @@ async def route_general_request(request: web.Request,
             {"error": {"message": "missing 'model' field",
                        "type": "invalid_request_error"}}, status=400)
 
-    # optional pluggable rewrite hook
+    # optional pluggable rewrite hook (the exact noop default is
+    # skipped so the fast path stays allocation-free; a SUBCLASS of the
+    # noop must still be invoked)
     rewriter = state.get("rewriter")
-    if rewriter is not None:
+    if rewriter is not None and \
+            type(rewriter) is not NoopRequestRewriter:
         body, raw = rewriter.rewrite(endpoint_path, body, raw)
 
     # semantic cache short-circuit (gated; chat completions only) —
@@ -109,10 +162,11 @@ async def route_general_request(request: web.Request,
             {"error": {"message": f"no backend serves model {model!r}",
                        "type": "invalid_request_error"}}, status=400)
 
-    request_stats = state["request_stats"].get()
+    # routing reads the TTL-cached snapshot: window aggregates at most
+    # snapshot_ttl_s stale, in-flight counters live
+    request_stats = state["request_stats"].snapshot()
     url = state["router"].route(endpoints, request_stats,
-                                dict(request.headers), body)
-    request_id = request.headers.get("x-request-id", uuid.uuid4().hex)
+                                request.headers, body)
 
     # disaggregated prefill: the prefill pool computes the prompt KV into
     # the shared tier (publishing chunk-by-chunk as it goes) while decode
@@ -120,14 +174,14 @@ async def route_general_request(request: web.Request,
     # breaker) degrade to a normal full prefill on the decode engine
     disagg = state.get("disagg")
     if disagg is not None:
+        request_id = request.headers.get("x-request-id") or \
+            uuid.uuid4().hex
         prefill_headers = {"x-request-id": request_id}
         if "Authorization" in request.headers:
             prefill_headers["Authorization"] = \
                 request.headers["Authorization"]
         else:
-            from production_stack_tpu.router.service_discovery import (
-                engine_auth_headers)
-            prefill_headers.update(engine_auth_headers())
+            prefill_headers.update(state["auth_overlay"])
         await disagg.run_with_headstart(state["client"], endpoint_path,
                                         model, body,
                                         headers=prefill_headers)
@@ -136,42 +190,64 @@ async def route_general_request(request: web.Request,
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
-    monitor.on_new_request(url, request_id)
+    rec = monitor.on_new_request(url)
     resp: Optional[web.StreamResponse] = None
     try:
         async with session.post(
                 f"{url}{endpoint_path}", data=raw,
-                headers=_forward_headers(request),
-                timeout=aiohttp.ClientTimeout(total=state["request_timeout"]),
+                headers=_forward_headers(request, state["auth_overlay"]),
+                timeout=state["client_timeout"],
         ) as backend:
-            resp = web.StreamResponse(status=backend.status)
-            for k, v in backend.headers.items():
-                if k.lower() not in HOP_HEADERS:
-                    resp.headers[k] = v
-            await resp.prepare(request)
             # capture the body for the semantic cache only when this
             # response is storable (non-streaming 200 on the chat path)
             capture = (check_cache and backend.status == 200
                        and semantic_cache.cacheable(body))
+
+            length = backend.headers.get("Content-Length", "")
+            if length.isdigit() and int(length) <= BUFFERED_RESPONSE_MAX \
+                    and "text/event-stream" not in \
+                    backend.headers.get("Content-Type", ""):
+                # buffered fast path: whole body in one write (no
+                # chunked framing on the client leg); first byte and
+                # completion coincide
+                payload = await backend.read()
+                monitor.on_first_token(rec)
+                rec.tokens += 1
+                resp = web.Response(status=backend.status, body=payload)
+                _copy_backend_headers(resp, backend)
+                if capture:
+                    _store_cached_response(semantic_cache, body, payload)
+                return resp
+
+            resp = web.StreamResponse(status=backend.status)
+            _copy_backend_headers(resp, backend)
+            await resp.prepare(request)
             captured = bytearray() if capture else None
-            first = True
             async for chunk in backend.content.iter_any():
-                if first:
-                    monitor.on_first_token(url, request_id)
-                    first = False
-                monitor.on_token(url, request_id)
+                if rec.first_byte is None:
+                    monitor.on_first_token(rec)
+                rec.tokens += 1
                 if captured is not None:
                     captured.extend(chunk)
                 await resp.write(chunk)
             await resp.write_eof()
             if captured is not None:
-                try:
-                    response_body = json.loads(bytes(captured))
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, semantic_cache.store, body, response_body)
-                except Exception as e:
-                    logger.warning("semantic cache store failed: %s", e)
+                _store_cached_response(semantic_cache, body,
+                                       bytes(captured))
             return resp
+    except asyncio.TimeoutError:
+        # the configured --request-timeout fired: a structured 504, not
+        # an escaped-exception 500 (aiohttp's total timeout raises bare
+        # asyncio.TimeoutError, which is not a ClientError)
+        logger.warning("backend %s timed out after %gs", url,
+                       state["request_timeout"])
+        if resp is not None and resp.prepared:
+            resp.force_close()
+            return resp
+        return web.json_response(
+            {"error": {"message": f"backend timed out after "
+                                  f"{state['request_timeout']:g}s",
+                       "type": "timeout_error"}}, status=504)
     except (aiohttp.ClientError, ConnectionError) as e:
         logger.warning("backend %s failed: %s", url, e)
         if resp is not None and resp.prepared:
@@ -184,4 +260,4 @@ async def route_general_request(request: web.Request,
             {"error": {"message": f"backend error: {e}",
                        "type": "server_error"}}, status=502)
     finally:
-        monitor.on_request_complete(url, request_id)
+        monitor.on_request_complete(rec)

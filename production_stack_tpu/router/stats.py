@@ -22,32 +22,49 @@ logger = init_logger(__name__)
 
 
 class _Window:
-    """Sliding window of (timestamp, value) pairs."""
+    """Sliding window of (timestamp, value) pairs.
+
+    A running sum makes ``mean`` O(popped), not O(len): under load a
+    30 s arrival window holds tens of thousands of entries, and the
+    stats plane reads every window on each snapshot refresh.
+
+    ``now`` is compared with ``is None`` throughout — an explicit 0.0
+    (epoch zero, which deterministic tests use as a time origin) is a
+    timestamp, not "not provided".
+    """
 
     def __init__(self, horizon_s: float):
         self.horizon = horizon_s
         self._items: Deque[Tuple[float, float]] = collections.deque()
+        self._sum = 0.0
 
     def add(self, value: float, now: Optional[float] = None) -> None:
-        self._items.append((now or time.time(), value))
+        if now is None:
+            now = time.time()
+        self._items.append((now, value))
+        self._sum += value
 
     def _trim(self, now: float) -> None:
         cutoff = now - self.horizon
         while self._items and self._items[0][0] < cutoff:
-            self._items.popleft()
+            _, value = self._items.popleft()
+            self._sum -= value
+        if not self._items:
+            self._sum = 0.0        # shed accumulated float drift
 
     def count(self, now: Optional[float] = None) -> int:
-        self._trim(now or time.time())
+        self._trim(time.time() if now is None else now)
         return len(self._items)
 
     def mean(self, now: Optional[float] = None) -> float:
-        self._trim(now or time.time())
+        self._trim(time.time() if now is None else now)
         if not self._items:
             return 0.0
-        return sum(v for _, v in self._items) / len(self._items)
+        return self._sum / len(self._items)
 
     def rate(self, now: Optional[float] = None) -> float:
-        now = now or time.time()
+        if now is None:
+            now = time.time()
         self._trim(now)
         return len(self._items) / self.horizon
 
@@ -66,11 +83,37 @@ class RequestStats:
     finished: int = 0              # total completed
 
 
-class RequestStatsMonitor:
-    """Lifecycle hooks called by the proxy; windows per engine URL."""
+class ActiveRequest:
+    """Mutable per-request record handed out by ``on_new_request``.
 
-    def __init__(self, horizon_s: float = 30.0):
+    The proxy's streaming hot loop does a bare ``rec.tokens += 1`` per
+    chunk — no dict lookup by (url, request_id) tuple key — and every
+    piece of window math is deferred to ``on_request_complete``.
+    """
+
+    __slots__ = ("url", "start", "first_byte", "tokens")
+
+    def __init__(self, url: str, start: float):
+        self.url = url
+        self.start = start
+        self.first_byte: Optional[float] = None
+        self.tokens = 0
+
+
+class RequestStatsMonitor:
+    """Lifecycle hooks called by the proxy; windows per engine URL.
+
+    ``snapshot()`` is the routing-decision read: the full sliding-window
+    aggregate is recomputed at most every ``snapshot_ttl_s`` seconds
+    (50 ms default — far inside any horizon's resolution) while the
+    in-flight counters are always read live. ``get()`` always computes
+    fresh (metrics scrapes, stat logging, tests).
+    """
+
+    def __init__(self, horizon_s: float = 30.0,
+                 snapshot_ttl_s: float = 0.05):
         self.horizon = horizon_s
+        self.snapshot_ttl_s = snapshot_ttl_s
         self._arrivals: Dict[str, _Window] = {}
         self._ttft: Dict[str, _Window] = {}
         self._latency: Dict[str, _Window] = {}
@@ -78,9 +121,8 @@ class RequestStatsMonitor:
         self._in_prefill: Dict[str, int] = collections.defaultdict(int)
         self._in_decoding: Dict[str, int] = collections.defaultdict(int)
         self._finished: Dict[str, int] = collections.defaultdict(int)
-        self._start: Dict[Tuple[str, str], float] = {}
-        self._first_byte: Dict[Tuple[str, str], float] = {}
-        self._tokens: Dict[Tuple[str, str], int] = {}
+        self._snapshot: Dict[str, RequestStats] = {}
+        self._snapshot_at: float = float("-inf")
 
     def _window(self, store: Dict[str, _Window], url: str) -> _Window:
         if url not in store:
@@ -89,41 +131,42 @@ class RequestStatsMonitor:
 
     # lifecycle ---------------------------------------------------------
 
-    def on_new_request(self, url: str, request_id: str) -> None:
-        now = time.time()
+    def on_new_request(self, url: str,
+                       now: Optional[float] = None) -> ActiveRequest:
+        if now is None:
+            now = time.time()
         self._window(self._arrivals, url).add(1.0, now)
-        self._start[(url, request_id)] = now
         self._in_prefill[url] += 1
+        return ActiveRequest(url, now)
 
-    def on_first_token(self, url: str, request_id: str) -> None:
-        key = (url, request_id)
-        now = time.time()
-        start = self._start.get(key)
-        if start is not None and key not in self._first_byte:
-            self._first_byte[key] = now
-            self._window(self._ttft, url).add(now - start, now)
-            self._in_prefill[url] = max(0, self._in_prefill[url] - 1)
-            self._in_decoding[url] += 1
+    def on_first_token(self, rec: ActiveRequest,
+                       now: Optional[float] = None) -> None:
+        if rec.first_byte is not None:
+            return
+        rec.first_byte = time.time() if now is None else now
+        url = rec.url
+        self._in_prefill[url] = max(0, self._in_prefill[url] - 1)
+        self._in_decoding[url] += 1
 
-    def on_token(self, url: str, request_id: str) -> None:
-        self._tokens[(url, request_id)] = self._tokens.get(
-            (url, request_id), 0) + 1
-
-    def on_request_complete(self, url: str, request_id: str) -> None:
-        key = (url, request_id)
-        now = time.time()
-        start = self._start.pop(key, None)
-        first = self._first_byte.pop(key, None)
-        ntok = self._tokens.pop(key, 0)
+    def on_request_complete(self, rec: ActiveRequest,
+                            now: Optional[float] = None) -> None:
+        if now is None:
+            now = time.time()
+        url = rec.url
+        first = rec.first_byte
         if first is None:
             self._in_prefill[url] = max(0, self._in_prefill[url] - 1)
         else:
             self._in_decoding[url] = max(0, self._in_decoding[url] - 1)
-            if ntok > 1:
+            # window math deferred from the hot loop; stamped with the
+            # completion time (like latency/ITL) — timestamps must stay
+            # monotonic within the deque or the front-trim stops early
+            # and expired samples linger in the mean
+            self._window(self._ttft, url).add(first - rec.start, now)
+            if rec.tokens > 1:
                 self._window(self._itl, url).add(
-                    (now - first) / max(1, ntok - 1), now)
-        if start is not None:
-            self._window(self._latency, url).add(now - start, now)
+                    (now - first) / (rec.tokens - 1), now)
+        self._window(self._latency, url).add(now - rec.start, now)
         self._finished[url] += 1
 
     def evict_except(self, live_urls) -> None:
@@ -133,11 +176,13 @@ class RequestStatsMonitor:
                       self._in_prefill, self._in_decoding, self._finished):
             for url in [u for u in store if u not in live]:
                 del store[url]
+        self._snapshot_at = float("-inf")   # force a fresh snapshot
 
     # reads -------------------------------------------------------------
 
-    def get(self) -> Dict[str, RequestStats]:
-        now = time.time()
+    def get(self, now: Optional[float] = None) -> Dict[str, RequestStats]:
+        if now is None:
+            now = time.time()
         urls = set(self._arrivals) | set(self._in_prefill) | set(
             self._in_decoding)
         out = {}
@@ -153,6 +198,33 @@ class RequestStatsMonitor:
                 finished=self._finished[url],
             )
         return out
+
+    def snapshot(self) -> Dict[str, RequestStats]:
+        """Cached window aggregates + live in-flight counters: what a
+        routing decision reads. With ``snapshot_ttl_s <= 0`` this is
+        exactly ``get()``."""
+        now = time.time()
+        if self.snapshot_ttl_s <= 0 or \
+                now - self._snapshot_at >= self.snapshot_ttl_s:
+            self._snapshot = self.get(now)
+            self._snapshot_at = now
+            return self._snapshot
+        for url, st in self._snapshot.items():
+            st.in_prefill = self._in_prefill.get(url, 0)
+            st.in_decoding = self._in_decoding.get(url, 0)
+            st.in_flight = st.in_prefill + st.in_decoding
+        # an engine whose FIRST request arrived inside the TTL is not in
+        # the cached dict yet — surface it with live counters (and zero
+        # window aggregates) or least-loaded routing would read it as
+        # idle and dogpile it until the next refresh
+        for url in [u for u in set(self._in_prefill)
+                    | set(self._in_decoding) if u not in self._snapshot]:
+            pre = self._in_prefill.get(url, 0)
+            dec = self._in_decoding.get(url, 0)
+            if pre or dec:
+                self._snapshot[url] = RequestStats(
+                    in_prefill=pre, in_decoding=dec, in_flight=pre + dec)
+        return self._snapshot
 
 
 @dataclass

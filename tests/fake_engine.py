@@ -31,7 +31,8 @@ class FakeEngine:
             "vllm:gpu_prefix_cache_hit_rate": 0.0,
         }
         self.requests_seen = []          # (path, user header, model)
-        self.last_chat_body = ""         # raw JSON of the last chat request
+        self.last_chat_body = ""         # JSON text of the last chat request
+        self.last_raw = b""              # exact bytes of the last POST body
         self._in_flight = 0
 
     def build_app(self) -> web.Application:
@@ -48,7 +49,10 @@ class FakeEngine:
             await asyncio.sleep(1.0 / self.tokens_per_s)
 
     async def chat(self, request: web.Request) -> web.StreamResponse:
-        body = await request.json()
+        # keep the exact wire bytes: the router's passthrough fast path
+        # promises byte identity (tests/test_router_fastpath.py)
+        self.last_raw = await request.read()
+        body = json.loads(self.last_raw)
         self.last_chat_body = json.dumps(body)
         self.requests_seen.append(
             ("/v1/chat/completions", request.headers.get("x-user-id"),
@@ -91,7 +95,8 @@ class FakeEngine:
             self.gauges["vllm:num_requests_running"] = float(self._in_flight)
 
     async def completions(self, request: web.Request) -> web.Response:
-        body = await request.json()
+        self.last_raw = await request.read()
+        body = json.loads(self.last_raw)
         self.requests_seen.append(
             ("/v1/completions", request.headers.get("x-user-id"),
              body.get("model")))

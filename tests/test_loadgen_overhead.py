@@ -1,0 +1,82 @@
+"""Overhead A/B rig tier: the router-vs-direct measurement from
+BASELINE.md (r5 prose, r7 committed) must be reproducible from a fresh
+clone.
+
+Tier-1 smoke: fake engine + real router process, a short storm at both
+URLs, zero errors, well-formed BENCH-schema record. Slow tier: the same
+rig against a real debug-tiny engine on CPU.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from production_stack_tpu.loadgen.overhead import (overhead_payload,
+                                                   run_overhead)
+
+
+def _check_schema(record):
+    assert set(record) >= {"metric", "value", "unit", "platform",
+                           "detail"}
+    assert record["unit"] == "req/s"
+    d = record["detail"]
+    for side in ("direct", "router"):
+        s = d[side]
+        assert s["finished"] > 0, s
+        assert s["errors"] == 0, s
+        assert s["req_per_s"] > 0
+        assert s["latency_ms"]["p50"] >= 0
+    assert d["overhead_ratio"] is not None and d["overhead_ratio"] > 0
+    assert record["value"] == d["router"]["req_per_s"]
+
+
+def test_overhead_payload_is_stable_bytes():
+    a = overhead_payload("m", num_tokens=4)
+    assert a == overhead_payload("m", num_tokens=4)
+    body = json.loads(a)
+    assert body["model"] == "m" and body["max_tokens"] == 4
+    assert body["stream"] is False
+
+
+def test_cli_parser_overhead_defaults():
+    from production_stack_tpu.loadgen.__main__ import build_parser
+    args = build_parser().parse_args(["overhead", "--duration", "5s"])
+    assert args.fn.__name__ == "cmd_overhead"
+    assert args.engine == "fake"
+    assert args.users == 64
+    assert args.duration == 5.0
+    assert args.snapshot_ttl is None     # router default unless set
+
+
+def test_fake_engine_overhead_smoke(tmp_path):
+    """Launch fake engine + real router, measure both sides briefly:
+    both complete with zero errors and the report validates."""
+    record = asyncio.run(run_overhead(
+        engine="fake", users=8, duration_s=1.5, num_tokens=4,
+        warmup_requests=4, log_dir=str(tmp_path / "logs")))
+    _check_schema(record)
+    # the router cannot be FASTER than the engine it proxies
+    d = record["detail"]
+    assert d["router"]["req_per_s"] <= d["direct"]["req_per_s"] * 1.1
+
+
+def test_fake_engine_overhead_streaming_smoke(tmp_path):
+    """Streaming mode exercises the chunk relay loop and reports TTFT
+    percentiles."""
+    record = asyncio.run(run_overhead(
+        engine="fake", users=4, duration_s=1.5, num_tokens=4,
+        stream=True, warmup_requests=4, log_dir=str(tmp_path / "logs")))
+    _check_schema(record)
+    for side in ("direct", "router"):
+        assert record["detail"][side]["ttft_ms"] is not None
+
+
+@pytest.mark.slow
+def test_real_engine_overhead(tmp_path):
+    """The same rig against a real debug-tiny engine on CPU: the
+    numbers then include model compute, so only sanity is asserted."""
+    record = asyncio.run(run_overhead(
+        engine="debug-tiny", users=4, duration_s=10.0, num_tokens=4,
+        log_dir=str(tmp_path / "logs")))
+    _check_schema(record)

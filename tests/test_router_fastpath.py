@@ -1,0 +1,193 @@
+"""Router data-plane fast path: byte-identical passthrough on the
+untouched path, correct re-serialization on the shaped paths, buffered
+(non-chunked) relay of non-streaming responses, and the structured 504
+on a backend request timeout.
+
+These pin the PR-2 hot-loop rebuild (proxy.py): the bytes an engine
+receives on the no-rewriter/no-cache-knob/no-disagg path are EXACTLY
+the bytes the client sent — no json.dumps round-trip that could reorder
+keys, change whitespace, or re-escape unicode.
+"""
+
+import asyncio
+import json
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app, parse_args
+from production_stack_tpu.router.rewriter import ModelAliasRewriter
+from tests.fake_engine import FakeEngine
+
+
+def _router_args(backends, models, extra=None):
+    argv = ["--service-discovery", "static",
+            "--static-backends", ",".join(backends),
+            "--static-models", ",".join(models),
+            "--engine-stats-interval", "0.2"]
+    return parse_args(argv + (extra or []))
+
+
+async def _start_fake(fake):
+    server = TestServer(fake.build_app())
+    await server.start_server()
+    return server, f"http://127.0.0.1:{server.port}"
+
+
+def test_passthrough_bytes_identical():
+    """Untouched path: whitespace, key order, unicode escapes, unknown
+    fields — the engine sees the client's exact bytes."""
+    # deliberately NOT what json.dumps would emit: odd spacing, model
+    # key last, a unicode escape AND a literal multibyte char, an
+    # unknown field a round-trip might drop or reorder
+    raw = ('{"messages": [ {"role":"user","content":"caf\\u00e9 ☕"} ] ,'
+           '  "max_tokens": 3,"zz_unknown":null,  "model": "m-a"}'
+           ).encode()
+
+    async def body():
+        fake = FakeEngine(model="m-a")
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post(
+                "/v1/chat/completions", data=raw,
+                headers={"Content-Type": "application/json"})
+            assert r.status == 200, await r.text()
+        assert fake.last_raw == raw, (fake.last_raw, raw)
+        await server.close()
+    asyncio.run(body())
+
+
+def test_cache_knob_path_strips_and_serializes():
+    """skip_cache / cache_similarity_threshold are router-level knobs:
+    the forwarded bytes must NOT contain them (strict backends reject
+    unknown params) but must keep everything else."""
+    async def body():
+        fake = FakeEngine(model="m-a")
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-a", "skip_cache": True,
+                "cache_similarity_threshold": 0.9,
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2})
+            assert r.status == 200
+        forwarded = json.loads(fake.last_raw)
+        assert "skip_cache" not in forwarded
+        assert "cache_similarity_threshold" not in forwarded
+        assert forwarded["model"] == "m-a"
+        assert forwarded["max_tokens"] == 2
+        await server.close()
+    asyncio.run(body())
+
+
+def test_rewriter_path_serializes():
+    """A non-noop rewriter mutates the forwarded bytes; they must be
+    the rewriter's serialization, not the client's."""
+    async def body():
+        fake = FakeEngine(model="m-a")
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["alias-model"]))
+        app["state"]["rewriter"] = ModelAliasRewriter(
+            {"alias-model": "m-a"})
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "alias-model",
+                "messages": [{"role": "user", "content": "x"}],
+                "max_tokens": 2})
+            assert r.status == 200
+        assert json.loads(fake.last_raw)["model"] == "m-a"
+        await server.close()
+    asyncio.run(body())
+
+
+def test_non_streaming_relay_is_buffered():
+    """A non-streaming backend response is relayed as ONE buffered
+    write: the client leg carries Content-Length, not chunked framing,
+    and the JSON arrives intact."""
+    async def body():
+        fake = FakeEngine(model="m-a", num_tokens=4)
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-a",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 200
+            assert r.headers.get("Transfer-Encoding") != "chunked"
+            assert "Content-Length" in r.headers
+            data = await r.json()
+            assert data["usage"]["completion_tokens"] == 4
+        await server.close()
+    asyncio.run(body())
+
+
+def test_streaming_relay_still_chunks():
+    """The SSE path must keep streaming chunk by chunk (no buffering
+    a live stream)."""
+    async def body():
+        fake = FakeEngine(model="m-a", num_tokens=5)
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-a", "stream": True,
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 200
+            raw = (await r.read()).decode()
+            events = [ln for ln in raw.splitlines()
+                      if ln.startswith("data: ")]
+            assert events[-1] == "data: [DONE]"
+            assert len(events) == 6
+        await server.close()
+    asyncio.run(body())
+
+
+def test_backend_timeout_returns_504():
+    """A request timeout is a structured 504 JSON error, not an
+    escaped asyncio.TimeoutError surfacing as a bare 500."""
+    async def body():
+        fake = FakeEngine(model="m-a", ttft_s=5.0)     # slower than the
+        server, url = await _start_fake(fake)          # router timeout
+        app = build_app(_router_args(
+            [url], ["m-a"], ["--request-timeout", "0.3"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m-a",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 504, await r.text()
+            err = (await r.json())["error"]
+            assert err["type"] == "timeout_error"
+            assert "timed out" in err["message"]
+        await server.close()
+    asyncio.run(body())
+
+
+def test_stats_parity_through_proxy():
+    """The per-request-record stats path reports the same gauges the
+    tuple-keyed path did: per-URL QPS, TTFT, in-flight accounting, and
+    finished counts after a mix of streaming and non-streaming."""
+    async def body():
+        fake = FakeEngine(model="m-a", num_tokens=3)
+        server, url = await _start_fake(fake)
+        app = build_app(_router_args([url], ["m-a"]))
+        async with TestClient(TestServer(app)) as client:
+            for stream in (False, True, False):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m-a", "stream": stream,
+                    "messages": [{"role": "user", "content": "x"}]})
+                assert r.status == 200
+                await r.read()
+            stats = app["state"]["request_stats"].get()
+            key = next(iter(stats))
+            st = stats[key]
+            assert st.finished == 3
+            assert st.in_flight == 0
+            assert st.qps == 3 / 30.0          # 3 arrivals, 30 s window
+            assert st.ttft >= 0.0
+            # /metrics renders the same numbers through the gauges
+            r = await client.get("/metrics")
+            text = (await r.read()).decode()
+            assert "vllm:current_qps" in text
+        await server.close()
+    asyncio.run(body())

@@ -1,0 +1,224 @@
+"""Router stats plane: sliding-window semantics, the per-request
+ActiveRequest lifecycle (the hot-loop record that replaced tuple-keyed
+dict lookups), and the TTL'd routing snapshot.
+
+Deterministic throughout: every lifecycle hook and window read takes an
+explicit ``now`` — including ``now=0.0`` (epoch zero), which an
+``x or time.time()`` default would silently replace with wall time.
+"""
+
+import time
+
+from production_stack_tpu.router.stats import (RequestStatsMonitor,
+                                               _Window)
+
+URL = "http://e1:8000"
+
+
+# ---------------------------------------------------------------- _Window
+
+def test_window_explicit_epoch_zero_now():
+    """now=0.0 is a timestamp, not 'not provided': entries stamped at
+    epoch zero must age out relative to later explicit nows."""
+    w = _Window(10.0)
+    w.add(1.0, now=0.0)
+    w.add(2.0, now=6.0)
+    assert w.count(now=6.0) == 2
+    assert w.mean(now=6.0) == 1.5
+    # at t=11 the epoch-zero entry is outside the 10 s horizon
+    assert w.count(now=11.0) == 1
+    assert w.mean(now=11.0) == 2.0
+    assert w.rate(now=11.0) == 1 / 10.0
+
+
+def test_window_reads_at_epoch_zero():
+    w = _Window(5.0)
+    w.add(3.0, now=0.0)
+    assert w.count(now=0.0) == 1
+    assert w.mean(now=0.0) == 3.0
+    assert w.rate(now=0.0) == 1 / 5.0
+
+
+def test_window_running_sum_survives_trim():
+    w = _Window(10.0)
+    for i in range(100):
+        w.add(float(i), now=float(i))
+    # at t=99 only entries with ts >= 89 remain: values 89..99
+    assert w.count(now=99.0) == 11
+    assert w.mean(now=99.0) == sum(range(89, 100)) / 11
+    # fully trimmed -> clean zero, no float-drift residue
+    assert w.mean(now=1000.0) == 0.0
+    w.add(7.0, now=1000.0)
+    assert w.mean(now=1000.0) == 7.0
+
+
+# ------------------------------------------------- ActiveRequest lifecycle
+
+def test_record_lifecycle_window_math():
+    """All window math lands at on_request_complete, with values equal
+    to what the old per-hook path recorded."""
+    mon = RequestStatsMonitor(horizon_s=30.0)
+    rec = mon.on_new_request(URL, now=100.0)
+    mon.on_first_token(rec, now=100.5)
+    rec.tokens = 5                       # 1 first byte + 4 more chunks
+    mon.on_request_complete(rec, now=102.5)
+
+    stats = mon.get(now=102.5)[URL]
+    assert stats.qps == 1 / 30.0
+    assert stats.ttft == 0.5
+    assert stats.latency == 2.5
+    # ITL: (complete - first_byte) / (tokens - 1)
+    assert abs(stats.itl - 2.0 / 4) < 1e-12
+    assert stats.finished == 1
+    assert stats.in_flight == 0
+
+
+def test_record_in_flight_transitions():
+    mon = RequestStatsMonitor()
+    rec = mon.on_new_request(URL, now=10.0)
+    st = mon.get(now=10.0)[URL]
+    assert (st.in_prefill, st.in_decoding, st.in_flight) == (1, 0, 1)
+    mon.on_first_token(rec, now=10.2)
+    st = mon.get(now=10.2)[URL]
+    assert (st.in_prefill, st.in_decoding, st.in_flight) == (0, 1, 1)
+    mon.on_request_complete(rec, now=10.4)
+    st = mon.get(now=10.4)[URL]
+    assert (st.in_prefill, st.in_decoding, st.in_flight) == (0, 0, 0)
+
+
+def test_record_first_token_idempotent():
+    mon = RequestStatsMonitor()
+    rec = mon.on_new_request(URL, now=1.0)
+    mon.on_first_token(rec, now=1.5)
+    mon.on_first_token(rec, now=2.5)      # second call must not move it
+    assert rec.first_byte == 1.5
+    assert mon.get(now=2.5)[URL].in_decoding == 1
+
+
+def test_record_failed_before_first_byte():
+    """A request that errors before any byte arrives leaves prefill,
+    records latency, and never touches the TTFT window."""
+    mon = RequestStatsMonitor()
+    rec = mon.on_new_request(URL, now=5.0)
+    mon.on_request_complete(rec, now=6.0)
+    st = mon.get(now=6.0)[URL]
+    assert st.in_flight == 0
+    assert st.ttft == 0.0
+    assert st.latency == 1.0
+    assert st.finished == 1
+
+
+def test_ttft_window_timestamps_stay_monotonic():
+    """A long stream completing AFTER a short one must not append an
+    older timestamp behind a newer one (the front-trim only pops while
+    items[0] is expired, so out-of-order stamps would let expired
+    samples linger in the mean)."""
+    mon = RequestStatsMonitor(horizon_s=30.0)
+    slow = mon.on_new_request(URL, now=0.0)
+    mon.on_first_token(slow, now=1.0)         # first byte early...
+    fast = mon.on_new_request(URL, now=90.0)
+    mon.on_first_token(fast, now=90.5)
+    mon.on_request_complete(fast, now=100.0)
+    mon.on_request_complete(slow, now=120.0)  # ...completes last
+    # at t=121 both completions are inside the horizon -> both count
+    assert mon.get(now=121.0)[URL].ttft == (0.5 + 1.0) / 2
+    # at t=151 both are past the horizon -> the window fully drains
+    # (with a first-byte-stamped add, slow's t=1 sample would hide
+    # behind fast's t=100 entry and keep counting)
+    assert mon.get(now=151.0)[URL].ttft == 0.0
+
+
+def test_single_token_response_no_itl():
+    mon = RequestStatsMonitor()
+    rec = mon.on_new_request(URL, now=0.0)
+    mon.on_first_token(rec, now=0.5)
+    rec.tokens = 1
+    mon.on_request_complete(rec, now=0.6)
+    assert mon.get(now=0.6)[URL].itl == 0.0
+
+
+# ------------------------------------------------------------- snapshot
+
+def test_snapshot_caches_window_aggregates():
+    """Inside the TTL the snapshot's window numbers are frozen but the
+    in-flight counters are live."""
+    mon = RequestStatsMonitor(snapshot_ttl_s=3600.0)
+    done = mon.on_new_request(URL, now=time.time())
+    mon.on_request_complete(done, now=time.time())
+    snap1 = mon.snapshot()
+    assert snap1[URL].qps > 0
+    assert snap1[URL].in_flight == 0
+
+    # new arrival inside the TTL: cached qps, live in_flight
+    rec = mon.on_new_request(URL, now=time.time())
+    snap2 = mon.snapshot()
+    assert snap2 is snap1                # same cached dict
+    assert snap2[URL].qps == snap1[URL].qps
+    assert snap2[URL].in_flight == 1
+    assert snap2[URL].in_prefill == 1
+    mon.on_first_token(rec, now=time.time())
+    assert mon.snapshot()[URL].in_decoding == 1
+
+
+def test_snapshot_surfaces_brand_new_engine_in_flight():
+    """An engine whose FIRST request arrives inside the TTL must appear
+    in the snapshot with live in-flight counters — otherwise
+    least-loaded routing reads it as idle and dogpiles it until the
+    next refresh."""
+    mon = RequestStatsMonitor(snapshot_ttl_s=3600.0)
+    old = mon.on_new_request(URL, now=time.time())
+    mon.on_request_complete(old, now=time.time())
+    mon.snapshot()                       # cache holds only URL
+    new_url = "http://e2:8000"
+    mon.on_new_request(new_url, now=time.time())
+    snap = mon.snapshot()                # still inside the TTL
+    assert new_url in snap
+    assert snap[new_url].in_flight == 1
+    assert snap[new_url].in_prefill == 1
+    assert snap[new_url].qps == 0.0      # window math waits for refresh
+
+
+def test_snapshot_ttl_zero_is_always_fresh():
+    mon = RequestStatsMonitor(snapshot_ttl_s=0.0)
+    a = mon.snapshot()
+    mon.on_new_request(URL, now=time.time())
+    b = mon.snapshot()
+    assert a is not b
+    assert b[URL].qps > 0
+
+
+def test_snapshot_expires_after_ttl():
+    mon = RequestStatsMonitor(snapshot_ttl_s=0.01)
+    mon.snapshot()
+    mon.on_new_request(URL, now=time.time())
+    time.sleep(0.02)
+    assert mon.snapshot()[URL].qps > 0   # recomputed, sees the arrival
+
+
+def test_evict_except_invalidates_snapshot():
+    mon = RequestStatsMonitor(snapshot_ttl_s=3600.0)
+    rec = mon.on_new_request(URL, now=time.time())
+    mon.on_request_complete(rec, now=time.time())
+    assert URL in mon.snapshot()
+    mon.evict_except([])
+    assert mon.snapshot() == {}
+
+
+def test_get_matches_snapshot_after_refresh():
+    """Stats parity: the snapshot is exactly get() at refresh time."""
+    mon = RequestStatsMonitor(snapshot_ttl_s=3600.0)
+    t = time.time()
+    for i in range(5):
+        rec = mon.on_new_request(URL, now=t + i * 0.01)
+        mon.on_first_token(rec, now=t + i * 0.01 + 0.002)
+        rec.tokens = 3
+        mon.on_request_complete(rec, now=t + i * 0.01 + 0.005)
+    live = mon.get()
+    snap = mon.snapshot()
+    assert set(live) == set(snap)
+    for url in live:
+        assert abs(live[url].qps - snap[url].qps) < 1e-6
+        assert abs(live[url].ttft - snap[url].ttft) < 1e-6
+        assert abs(live[url].itl - snap[url].itl) < 1e-6
+        assert live[url].finished == snap[url].finished
+        assert live[url].in_flight == snap[url].in_flight

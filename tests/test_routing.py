@@ -118,9 +118,9 @@ def test_stat_logger_logs_per_engine(caplog):
                                                    StatLogger)
 
     monitor = RequestStatsMonitor()
-    monitor.on_new_request("http://e1:8000", "r1")
-    monitor.on_first_token("http://e1:8000", "r1")
-    monitor.on_request_complete("http://e1:8000", "r1")
+    rec = monitor.on_new_request("http://e1:8000")
+    monitor.on_first_token(rec)
+    monitor.on_request_complete(rec)
     scraper = EngineStatsScraper(lambda: [])
     scraper._stats["http://e1:8000"] = EngineStats(num_running=2,
                                                    num_waiting=1,

@@ -211,6 +211,16 @@ def test_router_semantic_cache_short_circuit():
             first = await r1.json()
             assert len(fake.requests_seen) == 1
 
+            # the store is fire-and-forget off the hot path (proxy
+            # _store_cached_response) — poll until the entry lands so
+            # the hit below is deterministic on any machine
+            cache = app["state"]["semantic_cache"]
+            for _ in range(100):
+                if len(cache):
+                    break
+                await asyncio.sleep(0.05)
+            assert len(cache) == 1
+
             r2 = await client.post("/v1/chat/completions", json=req)
             second = await r2.json()
             assert len(fake.requests_seen) == 1       # served from cache
