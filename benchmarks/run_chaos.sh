@@ -1,0 +1,23 @@
+#!/bin/bash
+# Chaos availability under engine churn (BASELINE.md Round 8): real
+# router + N fake engines, closed-loop storm while the orchestrator
+# SIGKILLs/restarts engines on a schedule and injects backend-500
+# bursts. Exit 1 on any client-visible 5xx (pre-stream failures must
+# fail over) or router transport error. Thin wrapper — all logic lives
+# in production_stack_tpu/loadgen/chaos.py; this pins the knobs the
+# committed CHAOS_*.json numbers used.
+#
+#   benchmarks/run_chaos.sh [engines] [duration] [out.json]
+#
+# Defaults reproduce the committed measurement: 3 engines, 16 users,
+# 60 s, kill every 10 s (3 s downtime), 500-burst every 7 s.
+set -euo pipefail
+
+ENGINES="${1:-3}"
+DURATION="${2:-60s}"
+OUT="${3:-CHAOS_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen chaos \
+  --engines "$ENGINES" --users 16 --duration "$DURATION" \
+  --kill-interval 10s --downtime 3s --error-burst-interval 7s \
+  --routing session --output "$OUT"

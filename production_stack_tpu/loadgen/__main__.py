@@ -1,4 +1,5 @@
-"""CLI: python -m production_stack_tpu.loadgen {run,soak,scaleout,overhead}
+"""CLI: python -m production_stack_tpu.loadgen
+{run,soak,scaleout,overhead,chaos}
 
 run      — drive a workload (preset or --spec JSON file) against a
            running stack; print + write a BENCH-schema JSON report
@@ -10,6 +11,10 @@ scaleout — launch real router+engine processes at N=1,2,4,... and
 overhead — launch one engine + the router, drive the identical
            closed-loop storm at both URLs, report router-vs-direct
            req/s and the overhead ratio (ROUTER_OVERHEAD_*.json)
+chaos    — launch the router + N engines and kill/restart engines on
+           a schedule while storming the router; exit 1 on any
+           client-visible 5xx / router transport error
+           (CHAOS_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -22,6 +27,7 @@ import sys
 import time
 
 from production_stack_tpu.loadgen import report as report_mod
+from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
 from production_stack_tpu.loadgen.runner import run_workload
@@ -151,6 +157,35 @@ def cmd_overhead(args) -> int:
     return 1 if bad else 0
 
 
+def cmd_chaos(args) -> int:
+    record = asyncio.run(run_chaos(
+        engines=args.engines, engine=args.engine, users=args.users,
+        duration_s=args.duration, kill_interval_s=args.kill_interval,
+        downtime_s=args.downtime,
+        error_burst_interval_s=args.error_burst_interval or None,
+        error_burst=args.error_burst,
+        stream_fraction=args.stream_fraction,
+        num_tokens=args.num_tokens, routing=args.routing,
+        seed=args.seed, p99_bound_s=args.p99_bound,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or f"CHAOS_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = chaos_violations(record)
+    for v in violations:
+        print(f"CHAOS VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        print(f"chaos PASSED: {d['requests']['ok']} ok, "
+              f"{d['kills']} kills/{d['restarts']} restarts, "
+              f"zero client-visible 5xx "
+              f"(availability {d['availability_pct']:.2f}%, "
+              f"{d['requests']['truncated_streams']} mid-stream "
+              f"truncations)")
+    return 1 if violations else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -252,6 +287,46 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write the JSON report here "
                          "(e.g. ROUTER_OVERHEAD_r07.json)")
     sp.set_defaults(fn=cmd_overhead)
+
+    sp = sub.add_parser("chaos",
+                        help="router + N engines with scheduled engine "
+                             "kills/restarts; assert zero client-"
+                             "visible 5xx for pre-stream failures")
+    sp.add_argument("--engines", type=int, default=3,
+                    help="engine replica count behind the router")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (chaos measures the router, not the "
+                         "model) or a real engine model name")
+    sp.add_argument("--users", type=int, default=16,
+                    help="closed-loop storm concurrency")
+    sp.add_argument("--duration", type=parse_duration, default=60.0)
+    sp.add_argument("--kill-interval", type=parse_duration, default=10.0,
+                    help="seconds between engine SIGKILLs")
+    sp.add_argument("--downtime", type=parse_duration, default=3.0,
+                    help="seconds a killed engine stays down")
+    sp.add_argument("--error-burst-interval", type=parse_duration,
+                    default=7.0,
+                    help="seconds between injected backend-500 bursts "
+                         "(fake engines only; 0 disables)")
+    sp.add_argument("--error-burst", type=int, default=5,
+                    help="500s per injected burst")
+    sp.add_argument("--stream-fraction", type=float, default=0.3,
+                    help="fraction of requests using SSE streaming")
+    sp.add_argument("--num-tokens", type=int, default=16)
+    sp.add_argument("--routing", default="session",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--p99-bound", type=parse_duration, default=None,
+                    help="seconds; fail the run if p99 latency under "
+                         "churn exceeds this")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write CHAOS_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_chaos)
 
     return p
 

@@ -1,0 +1,403 @@
+"""Chaos mode: real router + N engines under scheduled engine churn.
+
+The resilience layer's closed loop. The orchestrator launches the real
+router in front of N engine processes (the zero-dependency fake by
+default — chaos measures the *router's* failure handling, not model
+compute), then drives a closed-loop chat storm while a churn task
+kills engine processes with SIGKILL and restarts them on a schedule
+(optionally also injecting backend-500 bursts through the fake
+engine's ``/fault`` control endpoint).
+
+Every client request is classified:
+
+- ``ok``                    — HTTP 200, body/stream complete
+- ``http_5xx``              — a 5xx reached the client. The router's
+  pre-stream failover contract says this must be ZERO while at least
+  one replica is healthy; the CLI exits 1 otherwise.
+- ``truncated_streams``     — status 200 but the stream died before
+  ``[DONE]``: the engine died mid-stream. Allowed (bytes cannot be
+  replayed), counted, and reported.
+- ``transport_errors``      — connect/read failure talking to the
+  *router* itself; must also be zero (the router never restarts).
+  One caveat: truncating a stream force-closes that client connection,
+  so a pooled keep-alive connection can die under a later request's
+  pen before any response byte exists. That is an HTTP/1.1 reuse
+  race, retry-safe by construction — like every production OpenAI
+  client, the storm retries such pre-response connection errors once
+  on a fresh connection (counted as ``stale_conn_retries``).
+
+The committed record (``CHAOS_*.json``, BENCH schema) carries
+availability as the headline, latency percentiles under churn, the
+kill/restart event log, and the router's own resilience counters
+scraped from ``/metrics`` at the end.
+"""
+
+import asyncio
+import json
+import random
+import re
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.loadgen.orchestrator import (Proc, _stop,
+                                                       free_port,
+                                                       launch_engine,
+                                                       launch_router,
+                                                       wait_healthy)
+from production_stack_tpu.loadgen.report import percentile
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+CHAT_PATH = "/v1/chat/completions"
+
+# router knobs for a chaos run: fail fast, fail over, re-probe quickly
+ROUTER_CHAOS_ARGS = ["--request-timeout", "30",
+                     "--breaker-threshold", "2",
+                     "--breaker-cooldown", "2",
+                     "--breaker-probe-interval", "0.5",
+                     "--failover-attempts", "3"]
+
+
+class _Counters:
+    def __init__(self):
+        self.launched = 0
+        self.ok = 0
+        self.http_5xx = 0
+        self.http_4xx = 0
+        self.truncated_streams = 0
+        self.transport_errors = 0
+        self.stale_conn_retries = 0
+        self.latencies: List[float] = []
+        self.ttfts: List[float] = []
+        self.samples: List[str] = []
+
+    def sample(self, text: str) -> None:
+        if len(self.samples) < 8:
+            self.samples.append(text[:160])
+
+
+async def chaos_storm(url: str, model: str, *, users: int,
+                      deadline: float, stream_fraction: float,
+                      num_tokens: int, seed: int,
+                      request_timeout_s: float = 30.0) -> _Counters:
+    """Closed-loop storm with per-request outcome classification.
+    Workers carry stable ``x-user-id`` headers so session routing has
+    real sessions to keep sticky across the churn."""
+    c = _Counters()
+    timeout = aiohttp.ClientTimeout(total=request_timeout_s)
+
+    async def one(session: aiohttp.ClientSession, user: str,
+                  stream: bool) -> None:
+        body = json.dumps({
+            "model": model,
+            "messages": [{"role": "user", "content": f"chaos {user}"}],
+            "max_tokens": num_tokens, "stream": stream}).encode()
+        c.launched += 1
+        t0 = time.monotonic()
+        response_started = False
+        for attempt_no in (0, 1):
+            try:
+                async with session.post(
+                        f"{url}{CHAT_PATH}", data=body,
+                        headers={"Content-Type": "application/json",
+                                 "x-user-id": user},
+                        timeout=timeout) as resp:
+                    response_started = True
+                    if resp.status >= 500:
+                        c.http_5xx += 1
+                        c.sample(f"HTTP {resp.status}: "
+                                 f"{(await resp.text())}")
+                        return
+                    if resp.status >= 400:
+                        c.http_4xx += 1
+                        c.sample(f"HTTP {resp.status}")
+                        return
+                    if stream:
+                        first_at = None
+                        done = False
+                        try:
+                            async for chunk in resp.content.iter_any():
+                                if first_at is None:
+                                    first_at = time.monotonic()
+                                if b"[DONE]" in chunk:
+                                    done = True
+                        except (aiohttp.ClientError, ConnectionError,
+                                asyncio.TimeoutError):
+                            done = False
+                        if not done:
+                            # 200 + dead stream: engine died mid-relay
+                            c.truncated_streams += 1
+                            return
+                        if first_at is not None:
+                            c.ttfts.append(first_at - t0)
+                    else:
+                        await resp.read()
+                    c.ok += 1
+                    c.latencies.append(time.monotonic() - t0)
+                    return
+            except (aiohttp.ClientOSError,
+                    aiohttp.ServerDisconnectedError) as e:
+                if not response_started and attempt_no == 0:
+                    # stale pooled keep-alive connection (the router
+                    # force-closed it truncating an earlier stream):
+                    # pre-response, so retry once on a fresh socket
+                    c.stale_conn_retries += 1
+                    continue
+                c.transport_errors += 1
+                c.sample(f"{type(e).__name__}: {e}")
+                return
+            except (aiohttp.ClientError, ConnectionError, OSError,
+                    asyncio.TimeoutError) as e:
+                c.transport_errors += 1
+                c.sample(f"{type(e).__name__}: {e}")
+                return
+
+    async def worker(i: int) -> None:
+        rng = random.Random(seed * 997 + i)
+        user = f"chaos-user-{i}"
+        async with aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)) as session:
+            while time.monotonic() < deadline:
+                stream = rng.random() < stream_fraction
+                await one(session, user, stream)
+                await asyncio.sleep(0.01)
+
+    await asyncio.gather(*[worker(i) for i in range(users)])
+    return c
+
+
+async def _churn_loop(engines: List[Proc], *, engine_kind: str,
+                      kill_interval_s: float, downtime_s: float,
+                      deadline: float, log_dir: str, t0: float,
+                      events: List[Dict],
+                      platform: str = "cpu") -> None:
+    """Kill one engine (SIGKILL — no goodbye), wait ``downtime_s``,
+    restart it on the same port, round-robin over the fleet."""
+    i = 0
+    while True:
+        await asyncio.sleep(kill_interval_s)
+        # leave room for the restart inside the measured window
+        if time.monotonic() + downtime_s + 2.0 >= deadline:
+            return
+        victim_idx = i % len(engines)
+        i += 1
+        victim = engines[victim_idx]
+        port = int(victim.url.rsplit(":", 1)[1])
+        victim.popen.kill()
+        victim.popen.wait()
+        events.append({"t_s": round(time.monotonic() - t0, 2),
+                       "event": "kill", "url": victim.url})
+        logger.info("chaos: killed %s", victim.url)
+        await asyncio.sleep(downtime_s)
+        engines[victim_idx] = launch_engine(engine_kind, port,
+                                            log_dir=log_dir,
+                                            platform=platform)
+        events.append({"t_s": round(time.monotonic() - t0, 2),
+                       "event": "restart", "url": victim.url})
+        logger.info("chaos: restarted %s", victim.url)
+        try:
+            await wait_healthy(engines[victim_idx].url, 60.0)
+        except TimeoutError:
+            logger.warning("chaos: %s not healthy after restart",
+                           engines[victim_idx].url)
+
+
+async def _error_burst_loop(engine_urls: List[str], *,
+                            interval_s: float, burst: int,
+                            deadline: float, seed: int, t0: float,
+                            events: List[Dict]) -> None:
+    """Every ``interval_s``, tell one (fake) engine to 500 the next
+    ``burst`` inference requests — exercises the backend-5xx failover
+    path, not just dead sockets."""
+    rng = random.Random(seed ^ 0xc4a05)
+    async with aiohttp.ClientSession() as session:
+        while time.monotonic() + 1.0 < deadline:
+            await asyncio.sleep(interval_s)
+            url = rng.choice(engine_urls)
+            try:
+                async with session.post(
+                        f"{url}/fault",
+                        json={"mode": "error", "count": burst},
+                        timeout=aiohttp.ClientTimeout(total=2)) as r:
+                    if r.status == 200:
+                        events.append(
+                            {"t_s": round(time.monotonic() - t0, 2),
+                             "event": f"error_burst x{burst}",
+                             "url": url})
+            except (aiohttp.ClientError, ConnectionError, OSError,
+                    asyncio.TimeoutError):
+                pass    # victim currently dead; fine
+
+
+async def _scrape_router_resilience(router_url: str) -> Dict[str, float]:
+    """Pull the router's resilience counters off /metrics (totals only
+    — per-endpoint label detail stays in the exposition)."""
+    wanted = ("vllm:upstream_failures_total",
+              "vllm:upstream_retries_total",
+              "vllm:relayed_5xx_total",
+              "vllm:breaker_opens_total",
+              "vllm:healthy_pods_total")
+    out: Dict[str, float] = {}
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"{router_url}/metrics",
+                    timeout=aiohttp.ClientTimeout(total=5)) as r:
+                text = await r.text()
+    except (aiohttp.ClientError, ConnectionError, OSError,
+            asyncio.TimeoutError):
+        return out
+    for name in wanted:
+        total = 0.0
+        for m in re.finditer(
+                rf"^{re.escape(name)}(?:{{[^}}]*}})?\s+([0-9.eE+-]+)",
+                text, re.M):
+            total += float(m.group(1))
+        out[name] = total
+    return out
+
+
+async def run_chaos(*, engines: int = 3,
+                    engine: str = "fake",
+                    users: int = 16,
+                    duration_s: float = 60.0,
+                    kill_interval_s: float = 10.0,
+                    downtime_s: float = 3.0,
+                    error_burst_interval_s: Optional[float] = 7.0,
+                    error_burst: int = 5,
+                    stream_fraction: float = 0.3,
+                    num_tokens: int = 16,
+                    routing: str = "session",
+                    seed: int = 0,
+                    p99_bound_s: Optional[float] = None,
+                    platform: str = "cpu",
+                    log_dir: str = "loadgen-logs",
+                    startup_timeout_s: float = 420.0,
+                    router_extra_args: Optional[List[str]] = None
+                    ) -> Dict:
+    """Launch router + N engines, storm the router while killing and
+    restarting engines on a schedule; return the CHAOS record."""
+    procs: List[Proc] = []
+    engine_procs: List[Proc] = []
+    events: List[Dict] = []
+    try:
+        for _ in range(engines):
+            engine_procs.append(launch_engine(engine, free_port(),
+                                              log_dir=log_dir,
+                                              platform=platform))
+        procs.extend(engine_procs)
+        await asyncio.gather(*[wait_healthy(e.url, startup_timeout_s)
+                               for e in engine_procs])
+        model = "fake-model" if engine == "fake" else engine
+        router = launch_router(
+            [e.url for e in engine_procs], model, free_port(),
+            routing=routing, log_dir=log_dir,
+            extra_args=ROUTER_CHAOS_ARGS + (router_extra_args or []))
+        procs.append(router)
+        await wait_healthy(router.url, 60.0, require_endpoints=engines)
+
+        logger.info("chaos: %d users vs router + %d %s engines for "
+                    "%.0fs (kill every %.0fs, %.0fs downtime)",
+                    users, engines, engine, duration_s,
+                    kill_interval_s, downtime_s)
+        t0 = time.monotonic()
+        deadline = t0 + duration_s
+        tasks = [asyncio.create_task(_churn_loop(
+            engine_procs, engine_kind=engine,
+            kill_interval_s=kill_interval_s, downtime_s=downtime_s,
+            deadline=deadline, log_dir=log_dir, t0=t0, events=events,
+            platform=platform))]
+        if engine == "fake" and error_burst_interval_s:
+            tasks.append(asyncio.create_task(_error_burst_loop(
+                [e.url for e in engine_procs],
+                interval_s=error_burst_interval_s, burst=error_burst,
+                deadline=deadline, seed=seed, t0=t0, events=events)))
+        try:
+            c = await chaos_storm(router.url, model, users=users,
+                                  deadline=deadline,
+                                  stream_fraction=stream_fraction,
+                                  num_tokens=num_tokens, seed=seed)
+        finally:
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+        elapsed = time.monotonic() - t0
+        router_counters = await _scrape_router_resilience(router.url)
+    finally:
+        # the churn loop swaps engine Procs in place; stop the CURRENT
+        # processes plus anything from the launch-time snapshot (the
+        # router, and already-dead originals — _stop skips exited pids)
+        current = list(engine_procs)
+        current.extend(p for p in procs if p not in current)
+        _stop(current)
+
+    kills = len([e for e in events if e["event"] == "kill"])
+    restarts = len([e for e in events if e["event"] == "restart"])
+    done = c.ok + c.http_5xx + c.http_4xx + c.truncated_streams + \
+        c.transport_errors
+    availability = 100.0 * c.ok / done if done else 0.0
+
+    def pcts(vals: List[float]) -> Dict:
+        return {"p50": round(percentile(vals, 50) * 1e3, 1),
+                "p90": round(percentile(vals, 90) * 1e3, 1),
+                "p99": round(percentile(vals, 99) * 1e3, 1)}
+
+    return {
+        "metric": "client-visible availability under engine churn "
+                  "(router pre-stream failover; fake engines killed/"
+                  "restarted on schedule)",
+        "value": round(availability, 3),
+        "unit": "%",
+        "platform": platform,
+        "detail": {
+            "engine": engine, "engines": engines, "users": users,
+            "routing": routing,
+            "duration_s": round(elapsed, 1),
+            "kill_interval_s": kill_interval_s,
+            "downtime_s": downtime_s,
+            "error_burst_interval_s": error_burst_interval_s
+            if engine == "fake" else None,
+            "kills": kills, "restarts": restarts,
+            "requests": {
+                "launched": c.launched, "ok": c.ok,
+                "http_5xx": c.http_5xx, "http_4xx": c.http_4xx,
+                "truncated_streams": c.truncated_streams,
+                "transport_errors": c.transport_errors,
+                "stale_conn_retries": c.stale_conn_retries,
+            },
+            "availability_pct": round(availability, 3),
+            "req_per_s": round(c.ok / max(elapsed, 1e-9), 1),
+            "latency_ms": pcts(c.latencies),
+            "ttft_ms": pcts(c.ttfts) if c.ttfts else None,
+            "p99_bound_s": p99_bound_s,
+            "router_resilience_counters": router_counters,
+            "error_samples": c.samples,
+            "events": events,
+        },
+    }
+
+
+def chaos_violations(record: Dict) -> List[str]:
+    """The chaos run's pass/fail contract (CLI exits 1 on any)."""
+    d = record["detail"]
+    r = d["requests"]
+    out = []
+    if r["http_5xx"]:
+        out.append(f"{r['http_5xx']} client-visible 5xx (pre-stream "
+                   f"failures must fail over, not surface)")
+    if r["transport_errors"]:
+        out.append(f"{r['transport_errors']} transport errors talking "
+                   f"to the router (the router must not die)")
+    if r["ok"] == 0:
+        out.append("zero successful requests")
+    if not d["kills"]:
+        out.append("churn never killed an engine (window too short "
+                   "for kill_interval?)")
+    bound = d.get("p99_bound_s")
+    if bound and d["latency_ms"]["p99"] > bound * 1e3:
+        out.append(f"p99 {d['latency_ms']['p99']:.0f}ms exceeds the "
+                   f"{bound:g}s bound under churn")
+    return out

@@ -92,7 +92,8 @@ def launch_engine(kind: str, port: int, *, log_dir: str,
 
 def launch_router(backend_urls: List[str], model: str, port: int, *,
                   routing: str = "session", log_dir: str,
-                  snapshot_ttl: Optional[float] = None) -> Proc:
+                  snapshot_ttl: Optional[float] = None,
+                  extra_args: Optional[List[str]] = None) -> Proc:
     cmd = [sys.executable, "-m", "production_stack_tpu.router.app",
            "--host", "127.0.0.1", "--port", str(port),
            "--service-discovery", "static",
@@ -102,6 +103,7 @@ def launch_router(backend_urls: List[str], model: str, port: int, *,
            "--engine-stats-interval", "5"]
     if snapshot_ttl is not None:
         cmd += ["--request-stats-snapshot-ttl", str(snapshot_ttl)]
+    cmd += extra_args or []
     return _spawn(f"router-{port}", cmd, f"http://127.0.0.1:{port}",
                   log_dir)
 

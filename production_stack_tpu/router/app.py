@@ -14,6 +14,7 @@ and cancelled on cleanup.
 
 import argparse
 import asyncio
+import signal
 from typing import Optional
 
 import aiohttp
@@ -24,6 +25,9 @@ from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
 from production_stack_tpu.router.feature_gates import FeatureGates
 from production_stack_tpu.router.metrics import RouterMetrics
 from production_stack_tpu.router.proxy import route_general_request
+from production_stack_tpu.router.resilience import (HealthTracker,
+                                                    RetryBudget,
+                                                    wait_for_drain)
 from production_stack_tpu.router.rewriter import make_rewriter
 from production_stack_tpu.router.routing import make_router
 from production_stack_tpu.router.service_discovery import (
@@ -72,14 +76,55 @@ async def health(request: web.Request) -> web.Response:
     watcher = state.get("config_watcher")
     if watcher and not watcher.healthy():
         problems.append("dynamic config watcher dead")
+    tracker = state.get("health")
+    if tracker and not tracker.healthy():
+        problems.append("health re-probe task dead")
+    endpoints = state["discovery"].get_endpoints()
     body = {
         "status": "ok" if not problems else "unhealthy",
         "problems": problems,
-        "endpoints": len(state["discovery"].get_endpoints()),
+        "endpoints": len(endpoints),
+        "healthy_endpoints": len([ep for ep in endpoints
+                                  if tracker is None
+                                  or tracker.is_routable(ep.url)]),
+        "breakers": tracker.snapshot() if tracker else {},
+        "draining": state.get("draining_listener", False),
         "dynamic_config": watcher.current.to_json()
         if watcher and watcher.current else None,
     }
     return web.json_response(body, status=200 if not problems else 503)
+
+
+async def admin_drain(request: web.Request) -> web.Response:
+    """Start/stop draining one engine endpoint: no new admissions while
+    in-flight requests finish on their existing proxied connections.
+    Body: {"url": "http://engine:8100", "drain": true|false}."""
+    state = request.app["state"]
+    tracker = state["health"]
+    try:
+        body = await request.json()
+        url = body["url"].rstrip("/")
+        drain = bool(body.get("drain", True))
+    except (ValueError, KeyError, AttributeError, TypeError):
+        return web.json_response(
+            {"error": {"message": "body must be JSON with a 'url' "
+                                  "field (and optional bool 'drain')",
+                       "type": "invalid_request_error"}}, status=400)
+    if drain:
+        # a typo'd URL would be accepted, matched against nothing, and
+        # silently drain nobody — reject unknown endpoints instead
+        # (end_drain stays permissive so stale flags can be cleared)
+        known = {ep.url for ep in state["discovery"].all_endpoints()}
+        if url not in known:
+            return web.json_response(
+                {"error": {"message": f"unknown endpoint {url!r}; "
+                                      f"known: {sorted(known)}",
+                           "type": "invalid_request_error"}},
+                status=404)
+        tracker.start_drain(url)
+    else:
+        tracker.end_drain(url)
+    return web.json_response({"draining": tracker.draining()})
 
 
 async def version(request: web.Request) -> web.Response:
@@ -89,8 +134,21 @@ async def version(request: web.Request) -> web.Response:
 async def metrics(request: web.Request) -> web.Response:
     state = request.app["state"]
     endpoints = state["discovery"].get_endpoints()
-    state["request_stats"].evict_except(ep.url for ep in endpoints)
-    state["metrics"].refresh(state["request_stats"].get(), len(endpoints))
+    # evictions key off the CONFIGURED fleet: an endpoint temporarily
+    # withheld from routing (probe-marked unroutable) must not lose its
+    # windows/breaker state over a scrape
+    configured = state["discovery"].all_endpoints()
+    state["request_stats"].evict_except(ep.url for ep in configured)
+    tracker = state.get("health")
+    if tracker is not None:
+        tracker.evict_except(ep.url for ep in configured)
+        healthy = len([ep for ep in endpoints
+                       if tracker.is_routable(ep.url)])
+    else:
+        healthy = len(endpoints)
+    state["metrics"].refresh(state["request_stats"].get(), healthy)
+    if tracker is not None:
+        state["metrics"].refresh_resilience(tracker)
     if state.get("semantic_cache") is not None:
         state["metrics"].refresh_semantic_cache(state["semantic_cache"])
     if state.get("pii_middleware") is not None:
@@ -117,8 +175,30 @@ def build_app(args: argparse.Namespace) -> web.Application:
             snapshot_ttl_s=args.request_stats_snapshot_ttl),
         "feature_gates": FeatureGates(args.feature_gates),
         "rewriter": make_rewriter("noop"),
+        # resilience plane: per-endpoint breaker + global retry budget
+        # + failover bound, consumed by proxy.route_general_request
+        "health": HealthTracker(
+            failure_threshold=args.breaker_threshold,
+            cooldown_s=args.breaker_cooldown,
+            failure_rate=args.breaker_failure_rate,
+            probe_interval_s=args.breaker_probe_interval),
+        "retry_budget": RetryBudget(ratio=args.retry_budget),
+        "failover_attempts": max(1, args.failover_attempts),
+        "inflight": 0,
+        "draining_listener": False,
     }
     app["state"] = state
+
+    @web.middleware
+    async def track_inflight(request, handler):
+        # graceful listener drain counts every live handler, not just
+        # proxied inference requests
+        state["inflight"] += 1
+        try:
+            return await handler(request)
+        finally:
+            state["inflight"] -= 1
+    app.middlewares.append(track_inflight)
 
     if args.service_discovery == "static":
         state["discovery"] = StaticServiceDiscovery(
@@ -126,6 +206,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
             parse_comma_separated(args.static_models),
             aliases=parse_static_aliases(args.static_model_aliases),
             probe=args.probe_backends,
+            probe_failure_threshold=args.probe_failure_threshold,
+            health_tracker=state["health"],
         )
     elif args.service_discovery == "k8s":
         state["discovery"] = K8sServiceDiscovery(
@@ -180,6 +262,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
     app.router.add_get("/health", health)
     app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/admin/drain", admin_drain)
 
     if args.enable_files_api or args.enable_batch_api:
         from production_stack_tpu.router.files_api import mount_files_api
@@ -194,13 +277,15 @@ def build_app(args: argparse.Namespace) -> web.Application:
             lambda: state["discovery"].get_endpoints(),
             state["request_stats"], state["scraper"],
             metrics=state["metrics"],
-            interval_s=args.log_stats_interval)
+            interval_s=args.log_stats_interval,
+            health_tracker=state["health"])
 
     async def on_startup(app):
         state["client"] = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=0))
         await state["discovery"].start()
         await state["scraper"].start()
+        await state["health"].start(state["client"])
         if "stat_logger" in state:
             await state["stat_logger"].start()
         if "config_watcher" in state:
@@ -211,6 +296,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
             await state["stat_logger"].close()
         if "config_watcher" in state:
             await state["config_watcher"].close()
+        await state["health"].close()
         await state["scraper"].close()
         await state["discovery"].close()
         await state["client"].close()
@@ -261,6 +347,32 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "are recomputed (in-flight counters are always "
                         "live; 0 recomputes every request)")
     p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--probe-failure-threshold", type=int, default=3,
+                   help="consecutive /v1/models probe failures before "
+                        "static discovery marks an endpoint unroutable "
+                        "(with --probe-backends)")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive upstream failures before an "
+                        "endpoint's circuit opens")
+    p.add_argument("--breaker-cooldown", type=float, default=5.0,
+                   help="seconds an open circuit waits before the "
+                        "half-open /v1/models re-probe")
+    p.add_argument("--breaker-failure-rate", type=float, default=0.5,
+                   help="windowed failure-rate trip (fraction, over "
+                        ">=20 samples in the last 30s)")
+    p.add_argument("--breaker-probe-interval", type=float, default=1.0,
+                   help="seconds between half-open re-probe passes")
+    p.add_argument("--failover-attempts", type=int, default=3,
+                   help="max backend attempts per request for failures "
+                        "occurring before any byte reaches the client "
+                        "(1 disables failover)")
+    p.add_argument("--retry-budget", type=float, default=0.2,
+                   help="failover retries allowed as a fraction of "
+                        "request volume (token bucket; bounds retry "
+                        "storms)")
+    p.add_argument("--drain-timeout", type=float, default=30.0,
+                   help="seconds SIGTERM waits for in-flight requests "
+                        "after the listener stops accepting")
     p.add_argument("--dynamic-config-json", default=None)
     p.add_argument("--dynamic-config-interval", type=float, default=10.0)
     p.add_argument("--feature-gates", default=None,
@@ -335,8 +447,28 @@ def main(argv=None) -> None:
         logger.info("router listening on %s:%d (%s discovery, %s routing)",
                     args.host, args.port, args.service_discovery,
                     args.routing_logic)
-        while True:
-            await asyncio.sleep(3600)
+        # graceful drain: SIGTERM/SIGINT stops the listener (no new
+        # connections) and waits for in-flight requests to finish
+        # within --drain-timeout before tearing the app down
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        state = app["state"]
+        state["draining_listener"] = True
+        logger.info("shutdown: draining listener (%d in-flight, "
+                    "bound %.0fs)", state["inflight"], args.drain_timeout)
+        await site.stop()
+        drained = await wait_for_drain(lambda: state["inflight"],
+                                       args.drain_timeout)
+        logger.info("shutdown: %s", "drained clean" if drained else
+                    f"{state['inflight']} requests still in flight at "
+                    f"the drain bound; closing anyway")
+        await runner.cleanup()
 
     asyncio.run(_serve())
 

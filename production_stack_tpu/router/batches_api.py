@@ -193,6 +193,9 @@ class BatchProcessor:
                     "custom_id": req.get("custom_id"),
                     "response": {"status_code": 400, "body": {
                         "error": f"no backend serves {model!r}"}}}
+        health = self.state.get("health")
+        if health is not None:
+            endpoints = health.healthy_endpoints(endpoints)
         url = self.state["router"].route(
             endpoints, self.state["request_stats"].snapshot(), {}, body)
         path = req.get("url", batch["endpoint"])

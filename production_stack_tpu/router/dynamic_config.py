@@ -100,8 +100,9 @@ class DynamicConfigWatcher:
         logger.info("applying dynamic config: %s", cfg.to_json())
         if cfg.service_discovery == "static" and cfg.static_backends:
             old = self.state.get("discovery")
-            new = StaticServiceDiscovery(cfg.static_backends,
-                                         cfg.static_models)
+            new = StaticServiceDiscovery(
+                cfg.static_backends, cfg.static_models,
+                health_tracker=self.state.get("health"))
             await new.start()
             self.state["discovery"] = new
             if old is not None:

@@ -30,8 +30,33 @@ class RouterMetrics:
         self.num_running = gauge("vllm:num_requests_running",
                                  "In-flight requests via router")
         self.healthy_pods = Gauge("vllm:healthy_pods_total",
-                                  "Routable engine endpoints",
+                                  "Healthy (breaker-closed, non-"
+                                  "draining) engine endpoints",
                                   registry=self.registry)
+        # resilience surface: per-endpoint upstream failure/retry
+        # outcomes (previously invisible — a relayed backend 5xx looked
+        # identical to a healthy response in every exported series) and
+        # breaker state
+        self.upstream_failures = Gauge(
+            "vllm:upstream_failures_total",
+            "Upstream failures observed per endpoint by kind "
+            "(connect, timeout, http_5xx, mid_stream, probe)",
+            ["server", "kind"], registry=self.registry)
+        self.upstream_retries = Gauge(
+            "vllm:upstream_retries_total",
+            "Pre-stream failovers routed away from this endpoint",
+            ["server"], registry=self.registry)
+        self.relayed_5xx = Gauge(
+            "vllm:relayed_5xx_total",
+            "Backend 5xx responses relayed to clients (retries "
+            "exhausted)", ["server"], registry=self.registry)
+        self.breaker_state = Gauge(
+            "vllm:breaker_state",
+            "Circuit state per endpoint (0 closed, 1 half-open, 2 open)",
+            ["server"], registry=self.registry)
+        self.breaker_opens = Gauge(
+            "vllm:breaker_opens_total",
+            "Circuit-breaker open transitions", registry=self.registry)
         # semantic-cache surface (reference:
         # semantic_cache_integration.py:25-44 gauge names)
         def plain(name, doc):
@@ -54,8 +79,12 @@ class RouterMetrics:
         self.pii_redacted = plain("vllm:pii_requests_redacted",
                                   "Requests redacted for PII")
         self._seen_servers = set()
+        self._seen_failures = set()       # (url, kind) label pairs
+        self._seen_retry_servers = set()
+        self._seen_relayed_servers = set()
+        self._seen_breaker_servers = set()
 
-    def refresh(self, request_stats: dict, num_endpoints: int) -> None:
+    def refresh(self, request_stats: dict, num_healthy: int) -> None:
         # drop label series for engines that left the fleet so /metrics
         # never exports frozen stats for dead pods
         for url in self._seen_servers - set(request_stats):
@@ -75,7 +104,47 @@ class RouterMetrics:
             self.num_prefill.labels(server=url).set(st.in_prefill)
             self.num_decoding.labels(server=url).set(st.in_decoding)
             self.num_running.labels(server=url).set(st.in_flight)
-        self.healthy_pods.set(num_endpoints)
+        # healthy = breaker-closed and not draining (callers compute it
+        # from the HealthTracker), NOT raw discovery membership
+        self.healthy_pods.set(num_healthy)
+
+    def refresh_resilience(self, tracker) -> None:
+        """Export the HealthTracker's counters + breaker states,
+        dropping label series for endpoints the tracker evicted so
+        departed pods never export frozen resilience series."""
+        def sync(gauge, seen, current, setter):
+            for labels in seen - set(current):
+                try:
+                    gauge.remove(*labels)
+                except KeyError:
+                    pass
+            for labels in current:
+                setter(labels)
+            return set(current)
+
+        state_code = {"closed": 0, "half_open": 1, "open": 2}
+        self._seen_failures = sync(
+            self.upstream_failures, self._seen_failures,
+            tracker.failures,
+            lambda k: self.upstream_failures.labels(
+                server=k[0], kind=k[1]).set(tracker.failures[k]))
+        self._seen_retry_servers = sync(
+            self.upstream_retries, self._seen_retry_servers,
+            {(u,) for u in tracker.retries},
+            lambda k: self.upstream_retries.labels(server=k[0]).set(
+                tracker.retries[k[0]]))
+        self._seen_relayed_servers = sync(
+            self.relayed_5xx, self._seen_relayed_servers,
+            {(u,) for u in tracker.relayed_5xx},
+            lambda k: self.relayed_5xx.labels(server=k[0]).set(
+                tracker.relayed_5xx[k[0]]))
+        snap = tracker.snapshot()
+        self._seen_breaker_servers = sync(
+            self.breaker_state, self._seen_breaker_servers,
+            {(u,) for u in snap},
+            lambda k: self.breaker_state.labels(server=k[0]).set(
+                state_code.get(snap[k[0]]["state"], 0)))
+        self.breaker_opens.set(tracker.breaker_opens)
 
     def refresh_semantic_cache(self, cache) -> None:
         self.semantic_hits.set(cache.hits)

@@ -24,6 +24,15 @@ ClientSession around a zero-rework fast path:
 The committed A/B for all of this is
 ``python -m production_stack_tpu.loadgen overhead``
 (BASELINE.md Round 7; docs/benchmarks.md "Router performance").
+
+Resilience (resilience.py, BASELINE.md Round 8): candidates are
+filtered to breaker-closed/non-draining endpoints before routing, and
+failures occurring *before any byte reaches the client* (connect
+error, refusal, timeout, backend 5xx) mark the endpoint in the health
+tracker and fail over to the remaining candidates — bounded by
+``--failover-attempts``, a global retry budget, and jittered backoff.
+Mid-stream failures still truncate: relayed bytes cannot be replayed.
+The closed loop is ``python -m production_stack_tpu.loadgen chaos``.
 """
 
 import asyncio
@@ -35,6 +44,7 @@ from typing import Optional
 import aiohttp
 from aiohttp import web
 
+from production_stack_tpu.router.resilience import backoff_s
 from production_stack_tpu.router.rewriter import NoopRequestRewriter
 from production_stack_tpu.utils import init_logger
 
@@ -84,6 +94,25 @@ def _store_cached_response(semantic_cache, body: dict,
     fut = asyncio.get_running_loop().run_in_executor(
         None, semantic_cache.store, body, response_body)
     fut.add_done_callback(_log_store_failure)
+
+
+class _ClientDisconnect(Exception):
+    """The CLIENT side of the relay died (reset/broken pipe writing to
+    it). Distinct from backend failures: it must produce no health
+    signal against the engine and no retry — nobody is listening."""
+
+
+# client-leg transport failures (raised by resp.prepare/write/write_eof)
+_CLIENT_LEG_ERRORS = (OSError, RuntimeError, aiohttp.ClientError)
+
+
+def _can_retry(attempt: int, max_attempts: int, tried: set,
+               candidates, budget) -> bool:
+    """Pre-stream failover gate: attempts left, an untried candidate
+    left, and a retry-budget token available."""
+    return (attempt < max_attempts
+            and len(tried) + 1 < len(candidates)
+            and (budget is None or budget.try_spend()))
 
 
 def _forward_headers(request: web.Request, auth_overlay: dict) -> dict:
@@ -155,18 +184,19 @@ async def route_general_request(request: web.Request,
         raw = json.dumps({k: v for k, v in body.items()
                           if k not in CACHE_CONTROL_FIELDS}).encode()
 
-    endpoints = [ep for ep in state["discovery"].get_endpoints()
-                 if ep.serves(model)]
-    if not endpoints:
+    candidates = [ep for ep in state["discovery"].get_endpoints()
+                  if ep.serves(model)]
+    if not candidates:
         return web.json_response(
             {"error": {"message": f"no backend serves model {model!r}",
                        "type": "invalid_request_error"}}, status=400)
 
-    # routing reads the TTL-cached snapshot: window aggregates at most
-    # snapshot_ttl_s stale, in-flight counters live
-    request_stats = state["request_stats"].snapshot()
-    url = state["router"].route(endpoints, request_stats,
-                                request.headers, body)
+    # health-aware admission: every policy sees only breaker-closed,
+    # non-draining endpoints (fail-open to the full set when nothing is
+    # routable — see HealthTracker.healthy_endpoints)
+    health = state.get("health")
+    if health is not None:
+        candidates = health.healthy_endpoints(candidates)
 
     # disaggregated prefill: the prefill pool computes the prompt KV into
     # the shared tier (publishing chunk-by-chunk as it goes) while decode
@@ -185,79 +215,183 @@ async def route_general_request(request: web.Request,
         await disagg.run_with_headstart(state["client"], endpoint_path,
                                         model, body,
                                         headers=prefill_headers)
-    logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path, model, url,
-                 1e3 * (time.monotonic() - t_route0))
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
-    rec = monitor.on_new_request(url)
-    resp: Optional[web.StreamResponse] = None
-    try:
-        async with session.post(
-                f"{url}{endpoint_path}", data=raw,
-                headers=_forward_headers(request, state["auth_overlay"]),
-                timeout=state["client_timeout"],
-        ) as backend:
-            # capture the body for the semantic cache only when this
-            # response is storable (non-streaming 200 on the chat path)
-            capture = (check_cache and backend.status == 200
-                       and semantic_cache.cacheable(body))
+    fwd_headers = _forward_headers(request, state["auth_overlay"])
+    budget = state.get("retry_budget")
+    if budget is not None:
+        budget.on_request()
+    max_attempts = state.get("failover_attempts", 1)
+    tried: set = set()
+    attempt = 0
+    last_failure = ""      # human-readable cause of the final attempt
+    timed_out = False      # 504 vs 502 on exhaustion
 
-            length = backend.headers.get("Content-Length", "")
-            if length.isdigit() and int(length) <= BUFFERED_RESPONSE_MAX \
-                    and "text/event-stream" not in \
-                    backend.headers.get("Content-Type", ""):
-                # buffered fast path: whole body in one write (no
-                # chunked framing on the client leg); first byte and
-                # completion coincide
-                payload = await backend.read()
-                monitor.on_first_token(rec)
-                rec.tokens += 1
-                resp = web.Response(status=backend.status, body=payload)
-                _copy_backend_headers(resp, backend)
-                if capture:
-                    _store_cached_response(semantic_cache, body, payload)
-                return resp
+    # bounded pre-stream failover loop: a connect error, refusal,
+    # timeout, or backend 5xx *before any byte reached the client* marks
+    # the endpoint in the health tracker and re-routes among the
+    # remaining candidates (jittered backoff, global retry budget).
+    # Once bytes have been relayed the stream can only truncate — bytes
+    # cannot be replayed.
+    while True:
+        pool = [ep for ep in candidates if ep.url not in tried]
+        if not pool:
+            break
+        if attempt > 0:
+            # de-synchronize concurrent failovers off a dying endpoint
+            await asyncio.sleep(backoff_s(attempt))
+        # routing reads the TTL-cached snapshot: window aggregates at
+        # most snapshot_ttl_s stale, in-flight counters live
+        request_stats = state["request_stats"].snapshot()
+        url = state["router"].route(pool, request_stats,
+                                    request.headers, body)
+        attempt += 1
+        if attempt == 1:
+            logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path,
+                         model, url,
+                         1e3 * (time.monotonic() - t_route0))
+        rec = monitor.on_new_request(url)
+        resp: Optional[web.StreamResponse] = None
+        retry_cause: Optional[str] = None
+        try:
+            async with session.post(
+                    f"{url}{endpoint_path}", data=raw,
+                    headers=fwd_headers,
+                    timeout=state["client_timeout"],
+            ) as backend:
+                if backend.status >= 500:
+                    # upstream failure that never reached the client:
+                    # breaker signal, then either fail over or (when
+                    # retries are exhausted) relay the backend's answer
+                    if health is not None:
+                        health.record_failure(url, "http_5xx")
+                    last_failure = f"backend HTTP {backend.status}"
+                    if _can_retry(attempt, max_attempts, tried,
+                                  candidates, budget):
+                        retry_cause = last_failure
+                        continue
+                    if health is not None:
+                        health.note_relayed_5xx(url)
+                elif health is not None:
+                    health.record_success(url)
 
-            resp = web.StreamResponse(status=backend.status)
-            _copy_backend_headers(resp, backend)
-            await resp.prepare(request)
-            captured = bytearray() if capture else None
-            async for chunk in backend.content.iter_any():
-                if rec.first_byte is None:
+                # capture the body for the semantic cache only when this
+                # response is storable (non-streaming 200 on the chat
+                # path)
+                capture = (check_cache and backend.status == 200
+                           and semantic_cache.cacheable(body))
+
+                length = backend.headers.get("Content-Length", "")
+                if length.isdigit() and \
+                        int(length) <= BUFFERED_RESPONSE_MAX \
+                        and "text/event-stream" not in \
+                        backend.headers.get("Content-Type", ""):
+                    # buffered fast path: whole body in one write (no
+                    # chunked framing on the client leg); first byte and
+                    # completion coincide
+                    payload = await backend.read()
                     monitor.on_first_token(rec)
-                rec.tokens += 1
+                    rec.tokens += 1
+                    resp = web.Response(status=backend.status,
+                                        body=payload)
+                    _copy_backend_headers(resp, backend)
+                    if capture:
+                        _store_cached_response(semantic_cache, body,
+                                               payload)
+                    return resp
+
+                resp = web.StreamResponse(status=backend.status)
+                _copy_backend_headers(resp, backend)
+                try:
+                    await resp.prepare(request)
+                except _CLIENT_LEG_ERRORS as e:
+                    raise _ClientDisconnect() from e
+                captured = bytearray() if capture else None
+                async for chunk in backend.content.iter_any():
+                    if rec.first_byte is None:
+                        monitor.on_first_token(rec)
+                    rec.tokens += 1
+                    if captured is not None:
+                        captured.extend(chunk)
+                    # inline (not a helper coroutine): this is the
+                    # per-chunk hot loop
+                    try:
+                        await resp.write(chunk)
+                    except _CLIENT_LEG_ERRORS as e:
+                        raise _ClientDisconnect() from e
+                try:
+                    await resp.write_eof()
+                except _CLIENT_LEG_ERRORS as e:
+                    raise _ClientDisconnect() from e
                 if captured is not None:
-                    captured.extend(chunk)
-                await resp.write(chunk)
-            await resp.write_eof()
-            if captured is not None:
-                _store_cached_response(semantic_cache, body,
-                                       bytes(captured))
+                    _store_cached_response(semantic_cache, body,
+                                           bytes(captured))
+                return resp
+        except _ClientDisconnect:
+            # the client vanished mid-relay; the backend did nothing
+            # wrong (a few users hitting stop must not trip a healthy
+            # engine's breaker)
+            logger.debug("client disconnected during relay from %s",
+                         url)
+            if resp is not None and resp.prepared:
+                resp.force_close()
             return resp
-    except asyncio.TimeoutError:
-        # the configured --request-timeout fired: a structured 504, not
-        # an escaped-exception 500 (aiohttp's total timeout raises bare
-        # asyncio.TimeoutError, which is not a ClientError)
-        logger.warning("backend %s timed out after %gs", url,
-                       state["request_timeout"])
-        if resp is not None and resp.prepared:
-            resp.force_close()
-            return resp
+        except asyncio.TimeoutError:
+            # the configured --request-timeout fired: a structured 504,
+            # not an escaped-exception 500 (aiohttp's total timeout
+            # raises bare asyncio.TimeoutError, not a ClientError)
+            logger.warning("backend %s timed out after %gs", url,
+                           state["request_timeout"])
+            if resp is not None and resp.prepared:
+                if health is not None:
+                    health.record_failure(url, "mid_stream")
+                resp.force_close()
+                return resp
+            if health is not None:
+                health.record_failure(url, "timeout")
+            last_failure = (f"backend timed out after "
+                            f"{state['request_timeout']:g}s")
+            timed_out = True
+            if _can_retry(attempt, max_attempts, tried, candidates,
+                          budget):
+                retry_cause = "timeout"
+                continue
+        except (aiohttp.ClientError, ConnectionError) as e:
+            logger.warning("backend %s failed: %s", url, e)
+            if resp is not None and resp.prepared:
+                # headers already sent — a 502 body can't be delivered;
+                # drop the connection so the client sees a truncated
+                # stream, not a corrupted second response on the same
+                # exchange
+                if health is not None:
+                    health.record_failure(url, "mid_stream")
+                resp.force_close()
+                return resp
+            if health is not None:
+                health.record_failure(url, "connect")
+            last_failure = f"backend error: {e}"
+            timed_out = False
+            if _can_retry(attempt, max_attempts, tried, candidates,
+                          budget):
+                retry_cause = str(e)
+                continue
+        finally:
+            monitor.on_request_complete(rec)
+            if retry_cause is not None:
+                tried.add(url)
+                if health is not None:
+                    health.note_retry(url)
+                logger.info("failing over from %s after %s "
+                            "(attempt %d/%d)", url, retry_cause,
+                            attempt, max_attempts)
+        break
+
+    # all attempts exhausted before a byte reached the client
+    if timed_out:
         return web.json_response(
-            {"error": {"message": f"backend timed out after "
-                                  f"{state['request_timeout']:g}s",
+            {"error": {"message": last_failure or "backend timed out",
                        "type": "timeout_error"}}, status=504)
-    except (aiohttp.ClientError, ConnectionError) as e:
-        logger.warning("backend %s failed: %s", url, e)
-        if resp is not None and resp.prepared:
-            # headers already sent — a 502 body can't be delivered; drop
-            # the connection so the client sees a truncated stream, not a
-            # corrupted second response on the same exchange
-            resp.force_close()
-            return resp
-        return web.json_response(
-            {"error": {"message": f"backend error: {e}",
-                       "type": "server_error"}}, status=502)
-    finally:
-        monitor.on_request_complete(rec)
+    return web.json_response(
+        {"error": {"message": last_failure or "no routable backend",
+                   "type": "server_error"}}, status=502)

@@ -11,11 +11,21 @@ Capability parity with reference src/vllm_router/routers/routing_logic.py
   prefix — KV-cache-affinity routing so multi-round conversations with
   shared history land where their KV blocks live (the TPU stack's
   answer to LMCache-aware routing).
+
+Health awareness: the proxy filters the endpoint list through
+``resilience.HealthTracker.healthy_endpoints`` before calling ANY
+policy, so breaker-open / draining endpoints are invisible here. The
+session/prefix rings rebuild from whatever list arrives — consistent
+hashing means a health transition remaps only the failed endpoint's
+keys (to deterministic successors) and returns them when it recovers;
+everyone else's mapping is untouched (pinned by
+tests/test_router_resilience.py).
 """
 
 import bisect
 import hashlib
 import json
+import time
 from abc import ABC, abstractmethod
 from typing import Dict, List, Optional, Sequence
 
@@ -48,19 +58,72 @@ class RoundRobinRouter(Router):
 
 
 class LeastLoadedRouter(Router):
-    """Lowest observed in-flight requests (falls back to QPS, then RR)."""
+    """Lowest observed in-flight requests (falls back to QPS, then RR).
+
+    Slow start: an endpoint this router has never routed to (freshly
+    added to the fleet) or one returning after an absence (filtered
+    out while its breaker was open / probe-marked unroutable) used to
+    score as idle and absorb the entire arrival burst at once. Such
+    endpoints instead carry a virtual load — just above the busiest
+    known endpoint's in-flight count, decaying linearly to zero over
+    ``slow_start_s`` — so traffic ramps onto them. A cold start (the
+    router's very first call, when everything is equally new) ramps
+    nothing. Absence is detected against routing activity: an
+    endpoint missing from ``absent_reset_s`` worth of *calls* restarts
+    its ramp; an idle router restarts nobody's.
+    """
 
     name = "least_loaded"
 
-    def __init__(self):
+    def __init__(self, slow_start_s: float = 10.0,
+                 absent_reset_s: float = 2.0,
+                 now_fn=time.monotonic):
         self._rr = RoundRobinRouter()
+        self.slow_start_s = slow_start_s
+        self.absent_reset_s = absent_reset_s
+        self._now = now_fn
+        self._last_seen: Dict[str, float] = {}   # url -> last call with it
+        self._ramp_from: Dict[str, float] = {}   # url -> ramp start
+        self._last_call_at: Optional[float] = None
 
     def route(self, endpoints, request_stats, headers, body) -> str:
+        now = self._now()
+        cold = not self._last_seen
+        for ep in endpoints:
+            last = self._last_seen.get(ep.url)
+            if last is None:
+                if not cold:
+                    self._ramp_from[ep.url] = now
+            elif self._last_call_at is not None and \
+                    self._last_call_at - last >= self.absent_reset_s:
+                # the router kept routing without this endpoint (it was
+                # health-filtered away): back from the dead, ramp it
+                self._ramp_from[ep.url] = now
+            self._last_seen[ep.url] = now
+        self._last_call_at = now
+        if len(self._last_seen) > 4 * len(endpoints) + 64:
+            # bound growth across dynamic-config fleet swaps
+            live = {ep.url for ep in endpoints}
+            self._last_seen = {u: t for u, t in self._last_seen.items()
+                               if u in live}
+        peak = max((st.in_flight for st in request_stats.values()),
+                   default=0)
+
         def load(ep: EndpointInfo):
             st = request_stats.get(ep.url)
-            if st is None:
-                return (0, 0.0)
-            return (st.in_flight, st.qps)
+            real = (float(st.in_flight), st.qps) if st is not None \
+                else (0.0, 0.0)
+            start = self._ramp_from.get(ep.url)
+            if start is None or self.slow_start_s <= 0:
+                return real
+            ramp = min(1.0, (now - start) / self.slow_start_s)
+            if ramp >= 1.0:
+                del self._ramp_from[ep.url]
+                return real
+            # peak+1 (not peak): the ramping endpoint must start
+            # strictly busier-looking than the busiest known one, or
+            # the qps tiebreak still hands it the whole burst
+            return (max(real[0], (1.0 - ramp) * (peak + 1.0)), real[1])
         if not request_stats:
             return self._rr.route(endpoints, request_stats, headers, body)
         return min(endpoints, key=load).url

@@ -47,6 +47,13 @@ class ServiceDiscovery(ABC):
     def get_endpoints(self) -> List[EndpointInfo]:
         ...
 
+    def all_endpoints(self) -> List[EndpointInfo]:
+        """The full configured membership, INCLUDING endpoints
+        temporarily withheld from routing (e.g. probe-marked
+        unroutable). State eviction keys off this so a transient
+        outage doesn't wipe an endpoint's stats/breaker/drain state."""
+        return self.get_endpoints()
+
     async def start(self) -> None:
         pass
 
@@ -85,9 +92,23 @@ async def probe_model_name(session: aiohttp.ClientSession,
 
 
 class StaticServiceDiscovery(ServiceDiscovery):
+    """Fixed endpoint list, optionally liveness-checked.
+
+    With ``probe=True``, each backend's ``/v1/models`` is re-probed on
+    an interval: extra served models become routable aliases, and —
+    since a static list has no other liveness signal — an endpoint
+    failing ``probe_failure_threshold`` consecutive probes is marked
+    unroutable (dropped from ``get_endpoints``) until a probe succeeds
+    again. Probe outcomes are also fed to the router's
+    ``HealthTracker`` (when wired) so the breaker, ``/metrics``, and
+    discovery agree on who is healthy.
+    """
+
     def __init__(self, urls: List[str], models: List[str],
                  aliases: Optional[Dict[str, str]] = None,
-                 probe: bool = False, probe_interval: float = 30.0):
+                 probe: bool = False, probe_interval: float = 30.0,
+                 probe_failure_threshold: int = 3,
+                 health_tracker=None):
         if len(urls) != len(models):
             raise ValueError(
                 f"{len(urls)} backends but {len(models)} model names")
@@ -100,9 +121,19 @@ class StaticServiceDiscovery(ServiceDiscovery):
             for u, m in zip(urls, models)]
         self._probe = probe
         self._probe_interval = probe_interval
+        self._probe_failure_threshold = probe_failure_threshold
+        self._probe_failures: Dict[str, int] = {}
+        self._unroutable: set = set()
+        self._health = health_tracker
         self._probe_task: Optional[asyncio.Task] = None
 
     def get_endpoints(self) -> List[EndpointInfo]:
+        if not self._unroutable:
+            return list(self._endpoints)
+        return [ep for ep in self._endpoints
+                if ep.url not in self._unroutable]
+
+    def all_endpoints(self) -> List[EndpointInfo]:
         return list(self._endpoints)
 
     async def start(self) -> None:
@@ -136,7 +167,26 @@ class StaticServiceDiscovery(ServiceDiscovery):
             for ep in self._endpoints:
                 models = await probe_model_name(session, ep.url)
                 if not models:
+                    n = self._probe_failures.get(ep.url, 0) + 1
+                    self._probe_failures[ep.url] = n
+                    if n >= self._probe_failure_threshold and \
+                            ep.url not in self._unroutable:
+                        # stale aliases must not keep a dead endpoint
+                        # routable forever
+                        logger.warning(
+                            "backend %s unroutable: %d consecutive "
+                            "/v1/models probe failures", ep.url, n)
+                        self._unroutable.add(ep.url)
+                    if self._health is not None:
+                        self._health.record_probe_result(ep.url, False)
                     continue
+                if ep.url in self._unroutable:
+                    logger.info("backend %s recovered (probe ok); "
+                                "routable again", ep.url)
+                    self._unroutable.discard(ep.url)
+                self._probe_failures[ep.url] = 0
+                if self._health is not None:
+                    self._health.record_probe_result(ep.url, True)
                 if ep.model not in models:
                     logger.warning(
                         "backend %s reports models %s, flag says %s",

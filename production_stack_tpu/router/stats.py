@@ -325,12 +325,13 @@ class StatLogger:
 
     def __init__(self, get_endpoints, monitor: "RequestStatsMonitor",
                  scraper: "EngineStatsScraper", metrics=None,
-                 interval_s: float = 30.0):
+                 interval_s: float = 30.0, health_tracker=None):
         self.get_endpoints = get_endpoints
         self.monitor = monitor
         self.scraper = scraper
         self.metrics = metrics
         self.interval_s = interval_s
+        self.health_tracker = health_tracker
         self._task = None
 
     async def start(self) -> None:
@@ -383,5 +384,10 @@ class StatLogger:
                     f"kv_usage={es.kv_usage:.1%}")
             logger.info("stats: %s", " | ".join(parts))
         if self.metrics is not None:
-            self.metrics.refresh(request_stats,
-                                 len(list(self.get_endpoints())))
+            eps = list(self.get_endpoints())
+            tracker = self.health_tracker
+            healthy = len([ep for ep in eps if tracker is None
+                           or tracker.is_routable(ep.url)])
+            self.metrics.refresh(request_stats, healthy)
+            if tracker is not None:
+                self.metrics.refresh_resilience(tracker)

@@ -5,6 +5,25 @@ SURVEY.md §4.2: a mock engine enables router/stats/routing/benchmark work
 with no hardware). Serves /v1/chat/completions + /v1/completions with
 configurable tokens/s and TTFT, /v1/models, /health, and /metrics with
 settable vllm: gauge values.
+
+Fault injection (the router-resilience chaos rig's lever): a fault mode
+set via the constructor, CLI, or at runtime via ``POST /fault`` applies
+to the next ``count`` requests (-1 = until cleared):
+
+- ``reset``          — close the TCP connection before responding (what
+                       a dying pod looks like pre-stream)
+- ``error``          — answer HTTP 500 (backend 5xx burst)
+- ``stall``          — hang ``arg`` seconds (default 3600) before
+                       responding (drives the router's request timeout)
+- ``die_mid_stream`` — stream a couple of SSE chunks, then drop the
+                       connection (bytes already relayed: truncation)
+- ``slow_ttft``      — add ``arg`` seconds (default 1.0) before the
+                       first byte
+
+``scope: "all"`` extends reset/error/stall to ``/v1/models`` too, so
+health probes fail along with inference (a fully-dead engine); the
+default ``"inference"`` scope keeps probes answering (a sick engine
+that still looks alive to discovery).
 """
 
 import asyncio
@@ -16,9 +35,13 @@ from typing import Optional
 from aiohttp import web
 
 
+FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft")
+
+
 class FakeEngine:
     def __init__(self, model: str = "fake-model", ttft_s: float = 0.0,
-                 tokens_per_s: float = 0.0, num_tokens: int = 8):
+                 tokens_per_s: float = 0.0, num_tokens: int = 8,
+                 fault: Optional[dict] = None):
         self.model = model
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
@@ -34,6 +57,10 @@ class FakeEngine:
         self.last_chat_body = ""         # JSON text of the last chat request
         self.last_raw = b""              # exact bytes of the last POST body
         self._in_flight = 0
+        # {"mode": ..., "count": int (-1 = persistent), "arg": float,
+        #  "scope": "inference" | "all"}
+        self.fault: Optional[dict] = dict(fault) if fault else None
+        self.faults_served = 0
 
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -42,13 +69,103 @@ class FakeEngine:
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
         app.router.add_get("/metrics", self.metrics)
+        app.router.add_post("/fault", self.set_fault)
+        app.router.add_get("/fault", self.get_fault)
         return app
 
     async def _tick(self):
         if self.tokens_per_s > 0:
             await asyncio.sleep(1.0 / self.tokens_per_s)
 
+    # -- fault machinery ------------------------------------------------
+
+    def _take_fault(self, path: str) -> Optional[dict]:
+        """Consume one fault application if the active mode covers
+        ``path``; decrement the burst counter."""
+        f = self.fault
+        if f is None:
+            return None
+        mode = f.get("mode")
+        if mode not in FAULT_MODES:
+            return None
+        if path == "/v1/models":
+            if f.get("scope", "inference") != "all" or \
+                    mode in ("die_mid_stream", "slow_ttft"):
+                return None
+        count = f.get("count", -1)
+        if count == 0:
+            self.fault = None
+            return None
+        if count > 0:
+            f["count"] = count - 1
+        self.faults_served += 1
+        return dict(f)
+
+    async def _apply_fault(self, request: web.Request,
+                           fault: dict) -> Optional[web.StreamResponse]:
+        """Return a response (or kill the connection) per the fault;
+        None means fall through to normal handling (slow_ttft/stall
+        after their delay)."""
+        mode = fault["mode"]
+        if mode == "reset":
+            if request.transport is not None:
+                request.transport.close()
+            return web.Response(status=500)   # never reaches the client
+        if mode == "error":
+            return web.json_response(
+                {"error": {"message": "injected fault: internal error",
+                           "type": "server_error"}}, status=500)
+        if mode == "stall":
+            await asyncio.sleep(fault.get("arg") or 3600.0)
+            return None
+        if mode == "slow_ttft":
+            await asyncio.sleep(fault.get("arg") or 1.0)
+            return None
+        if mode == "die_mid_stream":
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"})
+            await resp.prepare(request)
+            for i in range(2):
+                chunk = {"id": "chatcmpl-fault", "object":
+                         "chat.completion.chunk", "model": self.model,
+                         "choices": [{"index": 0,
+                                      "delta": {"content": f"tok{i} "},
+                                      "finish_reason": None}]}
+                await resp.write(f"data: {json.dumps(chunk)}\n\n"
+                                 .encode())
+            if request.transport is not None:
+                request.transport.close()
+            return resp
+        return None
+
+    async def set_fault(self, request: web.Request) -> web.Response:
+        """POST /fault {"mode": "error", "count": 5, "arg": 1.0,
+        "scope": "all"} — mode null/absent clears."""
+        body = await request.json()
+        mode = body.get("mode")
+        if mode is None:
+            self.fault = None
+            return web.json_response({"fault": None})
+        if mode not in FAULT_MODES:
+            return web.json_response(
+                {"error": f"unknown fault mode {mode!r}; "
+                          f"options: {list(FAULT_MODES)}"}, status=400)
+        self.fault = {"mode": mode,
+                      "count": int(body.get("count", -1)),
+                      "arg": body.get("arg"),
+                      "scope": body.get("scope", "inference")}
+        return web.json_response({"fault": self.fault})
+
+    async def get_fault(self, request: web.Request) -> web.Response:
+        return web.json_response({"fault": self.fault,
+                                  "faults_served": self.faults_served})
+
     async def chat(self, request: web.Request) -> web.StreamResponse:
+        fault = self._take_fault("/v1/chat/completions")
+        if fault is not None:
+            faulted = await self._apply_fault(request, fault)
+            if faulted is not None:
+                return faulted
         # keep the exact wire bytes: the router's passthrough fast path
         # promises byte identity (tests/test_router_fastpath.py)
         self.last_raw = await request.read()
@@ -95,6 +212,11 @@ class FakeEngine:
             self.gauges["vllm:num_requests_running"] = float(self._in_flight)
 
     async def completions(self, request: web.Request) -> web.Response:
+        fault = self._take_fault("/v1/completions")
+        if fault is not None:
+            faulted = await self._apply_fault(request, fault)
+            if faulted is not None:
+                return faulted
         self.last_raw = await request.read()
         body = json.loads(self.last_raw)
         self.requests_seen.append(
@@ -111,6 +233,11 @@ class FakeEngine:
                       "total_tokens": 3 + n}})
 
     async def models(self, request: web.Request) -> web.Response:
+        fault = self._take_fault("/v1/models")
+        if fault is not None:
+            faulted = await self._apply_fault(request, fault)
+            if faulted is not None:
+                return faulted
         return web.json_response(
             {"object": "list", "data": [{"id": self.model,
                                          "object": "model"}]})
@@ -139,10 +266,25 @@ def main(argv=None) -> None:
     p.add_argument("--ttft", type=float, default=0.0)
     p.add_argument("--tokens-per-s", type=float, default=0.0)
     p.add_argument("--num-tokens", type=int, default=8)
+    p.add_argument("--fault", default=None, choices=FAULT_MODES,
+                   help="start with a fault mode active (also settable "
+                        "at runtime via POST /fault)")
+    p.add_argument("--fault-count", type=int, default=-1,
+                   help="requests the fault applies to (-1 = forever)")
+    p.add_argument("--fault-arg", type=float, default=None,
+                   help="seconds for stall/slow_ttft")
+    p.add_argument("--fault-scope", default="inference",
+                   choices=["inference", "all"],
+                   help="'all' makes reset/error/stall hit /v1/models "
+                        "(health probes) too")
     args = p.parse_args(argv)
+    fault = None
+    if args.fault:
+        fault = {"mode": args.fault, "count": args.fault_count,
+                 "arg": args.fault_arg, "scope": args.fault_scope}
     eng = FakeEngine(model=args.model, ttft_s=args.ttft,
                      tokens_per_s=args.tokens_per_s,
-                     num_tokens=args.num_tokens)
+                     num_tokens=args.num_tokens, fault=fault)
     web.run_app(eng.build_app(), host=args.host, port=args.port,
                 print=None)
 

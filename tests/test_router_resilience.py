@@ -1,0 +1,601 @@
+"""Router resilience layer: breaker transitions, pre-stream failover,
+retry budget, ring stability across health flaps, drain semantics, and
+the chaos rig's fake-engine smoke (the real-engine chaos run is behind
+the ``slow`` marker).
+
+Unit tier drives HealthTracker/RetryBudget with an injected clock; the
+e2e tier runs the real router app in-process against fault-injecting
+FakeEngines (tests/fake_engine.py fault modes).
+"""
+
+import asyncio
+import collections
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app, parse_args
+from production_stack_tpu.router.resilience import (CLOSED, HALF_OPEN,
+                                                    OPEN, HealthTracker,
+                                                    RetryBudget,
+                                                    backoff_s,
+                                                    wait_for_drain)
+from production_stack_tpu.router.routing import (LeastLoadedRouter,
+                                                 SessionRouter)
+from production_stack_tpu.router.service_discovery import (
+    EndpointInfo, StaticServiceDiscovery)
+from production_stack_tpu.router.stats import RequestStats
+from tests.fake_engine import FakeEngine
+
+URL = "http://e0:8100"
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------------- unit tier
+
+def test_breaker_opens_on_consecutive_failures_and_reprobes():
+    clock = Clock()
+    t = HealthTracker(failure_threshold=3, cooldown_s=5.0, now_fn=clock)
+    assert t.is_routable(URL)
+    t.record_failure(URL, "connect")
+    t.record_failure(URL, "connect")
+    assert t.is_routable(URL)          # under threshold
+    t.record_failure(URL, "timeout")
+    assert t.state_of(URL) == OPEN
+    assert not t.is_routable(URL)
+    assert t.breaker_opens == 1
+
+    # a success mid-open (fail-open fallback traffic) closes it
+    t.record_success(URL)
+    assert t.state_of(URL) == CLOSED and t.is_routable(URL)
+
+    # re-open, then the active-probe path: fail -> re-open; ok -> close
+    for _ in range(3):
+        t.record_failure(URL, "connect")
+    assert t.state_of(URL) == OPEN
+    t.record_probe_result(URL, False)
+    assert t.state_of(URL) == OPEN     # probe failure re-opens/extends
+    t.record_probe_result(URL, True)
+    assert t.state_of(URL) == CLOSED
+    assert t.recoveries >= 1
+
+
+def test_breaker_failure_rate_trip():
+    clock = Clock()
+    t = HealthTracker(failure_threshold=100, failure_rate=0.5,
+                      min_samples=20, window_s=30.0, now_fn=clock)
+    # alternate ok/fail: consecutive never reaches 100, but the rate
+    # hits 50% once min_samples accumulate (the very first success is
+    # a no-op — endpoints start healthy with no tracked state — so 11
+    # rounds yield 21 samples, 11 of them failures)
+    for _ in range(11):
+        t.record_success(URL)
+        t.record_failure(URL, "http_5xx")
+    assert t.state_of(URL) == OPEN
+
+
+def test_breaker_half_open_requires_probe():
+    clock = Clock()
+    t = HealthTracker(failure_threshold=1, cooldown_s=5.0, now_fn=clock)
+    t.record_failure(URL, "connect")
+    assert t.state_of(URL) == OPEN
+    clock.t = 10.0                     # cooldown long past
+    # probe pass flips to HALF_OPEN then records the (failed) probe;
+    # with no server, probe_model_name returns None -> re-open
+    async def probe():
+        await t.probe_open_endpoints(_DummyProbeSession(None))
+    asyncio.run(probe())
+    assert t.state_of(URL) == OPEN     # failed probe: open again
+    assert not t.is_routable(URL)
+
+
+class _DummyProbeSession:
+    """Stands in for aiohttp.ClientSession: .get raises (unreachable)
+    or returns a canned /v1/models response."""
+
+    def __init__(self, models):
+        self._models = models
+
+    def get(self, url, **kw):
+        models = self._models
+
+        class _Ctx:
+            async def __aenter__(self):
+                if models is None:
+                    import aiohttp
+                    raise aiohttp.ClientError("probe refused")
+
+                class _R:
+                    status = 200
+
+                    async def json(self):
+                        return {"data": [{"id": m} for m in models]}
+                return _R()
+
+            async def __aexit__(self, *exc):
+                return False
+        return _Ctx()
+
+
+def test_breaker_probe_success_closes():
+    clock = Clock()
+    t = HealthTracker(failure_threshold=1, cooldown_s=2.0, now_fn=clock)
+    t.record_failure(URL, "connect")
+    clock.t = 3.0
+    asyncio.run(t.probe_open_endpoints(_DummyProbeSession(["m"])))
+    assert t.state_of(URL) == CLOSED
+    assert t.is_routable(URL)
+
+
+def test_retry_budget_bounds_retry_storms():
+    b = RetryBudget(ratio=0.5, cap=2.0)
+    assert b.try_spend() and b.try_spend()   # burst allowance
+    assert not b.try_spend()                 # bucket empty
+    assert b.rejected == 1
+    b.on_request()                           # +0.5
+    assert not b.try_spend()
+    b.on_request()                           # +0.5 -> 1.0
+    assert b.try_spend()
+    # sustained: retries <= ratio * requests
+    b2 = RetryBudget(ratio=0.2, cap=1.0)
+    granted = 0
+    for _ in range(100):
+        b2.on_request()
+        if b2.try_spend():
+            granted += 1
+    assert granted <= 0.2 * 100 + 1.0 + 1
+
+
+def test_backoff_jitter_bounds():
+    import random
+    rng = random.Random(7)
+    for attempt in range(1, 6):
+        for _ in range(20):
+            s = backoff_s(attempt, base_s=0.05, cap_s=1.0, rng=rng)
+            assert 0.0 <= s <= min(1.0, 0.05 * 2 ** (attempt - 1))
+
+
+def test_healthy_endpoints_filter_and_fail_open():
+    t = HealthTracker(failure_threshold=1)
+    eps = [EndpointInfo(url=f"http://e{i}:8100", model="m")
+           for i in range(3)]
+    assert t.healthy_endpoints(eps) == eps
+    t.record_failure(eps[0].url, "connect")
+    assert [e.url for e in t.healthy_endpoints(eps)] == \
+        [eps[1].url, eps[2].url]
+    # all unroutable -> fail open to non-draining, then to everything
+    t.record_failure(eps[1].url, "connect")
+    t.record_failure(eps[2].url, "connect")
+    assert t.healthy_endpoints(eps) == eps
+    t.start_drain(eps[0].url)
+    assert [e.url for e in t.healthy_endpoints(eps)] == \
+        [eps[1].url, eps[2].url]
+
+
+def test_drain_state_machine():
+    t = HealthTracker()
+    t.start_drain(URL)
+    assert not t.is_routable(URL)
+    assert t.draining() == [URL]
+    assert t.snapshot()[URL]["draining"]
+    t.end_drain(URL)
+    assert t.is_routable(URL)
+    assert t.draining() == []
+
+
+def test_session_ring_stable_across_health_flaps():
+    """Health transitions remap ONLY the failed endpoint's sessions —
+    deterministically — and return them on recovery."""
+    router = SessionRouter()
+    eps = [EndpointInfo(url=f"http://e{i}:8100", model="m")
+           for i in range(4)]
+    users = [f"user{i}" for i in range(200)]
+
+    def mapping(pool):
+        return {u: router.route(pool, {}, {"x-user-id": u}, {})
+                for u in users}
+
+    before = mapping(eps)
+    dead = eps[1].url
+    survivors = [e for e in eps if e.url != dead]
+    during = mapping(survivors)
+    moved = [u for u in users if before[u] != during[u]]
+    # only the dead endpoint's sessions moved, each re-routed
+    # deterministically (same answer every time)
+    assert set(moved) == {u for u in users if before[u] == dead}
+    assert during == mapping(survivors)
+    # recovery: everyone returns to exactly the original endpoint
+    assert mapping(eps) == before
+
+
+def test_least_loaded_slow_start_ramp():
+    clock = Clock()
+    r = LeastLoadedRouter(slow_start_s=10.0, now_fn=clock)
+    e0 = EndpointInfo(url="http://e0:8100", model="m")
+    e1 = EndpointInfo(url="http://e1:8100", model="m")
+    stats = {"http://e0:8100": RequestStats(in_flight=6, qps=3.0)}
+    # warm the router on e0 alone (cold start ramps nothing)
+    r.route([e0], stats, {}, {})
+    # t=1: e1 joins the fleet — it carries a virtual load just above
+    # the busiest known endpoint, so it does NOT absorb the arrival
+    # burst the moment it appears
+    clock.t = 1.0
+    picks = collections.Counter(
+        r.route([e0, e1], stats, {}, {}) for _ in range(10))
+    assert picks["http://e1:8100"] == 0
+    # halfway through the ramp the virtual load decays below e0's real
+    # load and e1 starts winning
+    clock.t = 7.0
+    assert r.route([e0, e1], stats, {}, {}) == "http://e1:8100"
+    # slow start disabled -> old behavior (idle endpoint wins at once)
+    r0 = LeastLoadedRouter(slow_start_s=0.0, now_fn=clock)
+    assert r0.route([e0, e1], stats, {}, {}) == "http://e1:8100"
+
+
+def test_least_loaded_slow_start_after_breaker_recovery():
+    """An endpoint returning after a health-filtered absence ramps even
+    though it is still present in the stats snapshot (in_flight 0)."""
+    clock = Clock()
+    r = LeastLoadedRouter(slow_start_s=10.0, absent_reset_s=2.0,
+                          now_fn=clock)
+    e0 = EndpointInfo(url="http://e0:8100", model="m")
+    e1 = EndpointInfo(url="http://e1:8100", model="m")
+    stats = {"http://e0:8100": RequestStats(in_flight=4, qps=2.0),
+             "http://e1:8100": RequestStats(in_flight=0, qps=1.0)}
+    r.route([e0, e1], stats, {}, {})           # both known (no ramp)
+    # e1's breaker opens: 5s of routing happens without it
+    for t in (1.0, 2.0, 3.0, 4.0, 5.0):
+        clock.t = t
+        r.route([e0], stats, {}, {})
+    # e1 recovers at t=5: despite its idle snapshot entry it must NOT
+    # swallow the whole burst — the ramp restarts
+    picks = collections.Counter(
+        r.route([e0, e1], stats, {}, {}) for _ in range(10))
+    assert picks["http://e1:8100"] == 0
+    clock.t = 12.0                             # ramp decayed below e0
+    assert r.route([e0, e1], stats, {}, {}) == "http://e1:8100"
+    # an IDLE router (no calls at all for a while) resets nobody
+    clock.t = 30.0
+    assert r.route([e0, e1], stats, {}, {}) == "http://e1:8100"
+
+
+# -------------------------------------------------------------- e2e tier
+
+def _router_args(backends, models, extra=None):
+    argv = ["--service-discovery", "static",
+            "--static-backends", ",".join(backends),
+            "--static-models", ",".join(models),
+            "--engine-stats-interval", "0.2",
+            "--breaker-threshold", "2",
+            "--breaker-cooldown", "0.3",
+            "--breaker-probe-interval", "0.15"]
+    return parse_args(argv + (extra or []))
+
+
+async def _start_fakes(*fakes):
+    servers = []
+    for fake in fakes:
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        servers.append(server)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+def _chat(model="m", stream=False):
+    return {"model": model, "stream": stream,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+
+def test_failover_masks_dead_backend():
+    """A backend resetting every connection is failed over pre-stream:
+    clients always see 200, the breaker opens, and /metrics says so."""
+    async def body():
+        good, bad = FakeEngine(model="m"), FakeEngine(model="m")
+        bad.fault = {"mode": "reset", "count": -1, "scope": "inference"}
+        servers, urls = await _start_fakes(good, bad)
+        app = build_app(_router_args(urls, ["m", "m"]))
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(8):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat())
+                assert r.status == 200, await r.text()
+            assert len(good.requests_seen) == 8
+            tracker = app["state"]["health"]
+            assert tracker.state_of(urls[1]) in (OPEN, HALF_OPEN)
+            assert tracker.retries[urls[1]] >= 1
+
+            r = await client.get("/metrics")
+            text = (await r.read()).decode()
+            assert "vllm:upstream_failures_total" in text
+            assert "vllm:healthy_pods_total 1.0" in text
+            assert 'vllm:breaker_state{server="%s"}' % urls[1] in text
+
+            r = await client.get("/health")
+            h = await r.json()
+            assert h["healthy_endpoints"] == 1
+            assert h["breakers"][urls[1]]["state"] in ("open",
+                                                       "half_open")
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_backend_5xx_failover_and_accounting():
+    """Backend 500s before any byte reached the client are retried on
+    another endpoint; the 5xx is counted per endpoint, not relayed."""
+    async def body():
+        good, sick = FakeEngine(model="m"), FakeEngine(model="m")
+        sick.fault = {"mode": "error", "count": -1, "scope": "inference"}
+        servers, urls = await _start_fakes(good, sick)
+        app = build_app(_router_args(urls, ["m", "m"]))
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(6):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat())
+                assert r.status == 200, await r.text()
+            tracker = app["state"]["health"]
+            assert tracker.failures[(urls[1], "http_5xx")] >= 1
+            assert tracker.relayed_5xx.get(urls[1], 0) == 0
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_single_backend_failure_is_terminal():
+    """With no alternative candidate there is nothing to fail over to:
+    the client still gets the structured 502 (and quickly)."""
+    async def body():
+        app = build_app(_router_args(["http://127.0.0.1:1"], ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json=_chat())
+            assert r.status == 502
+            err = await r.json()
+            assert err["error"]["type"] == "server_error"
+    asyncio.run(body())
+
+
+def test_sticky_session_fails_over_and_returns():
+    """Acceptance pin: a sticky session re-routes off its dead endpoint
+    within one breaker-open interval and RETURNS to it on recovery."""
+    async def body():
+        f = [FakeEngine(model="m") for _ in range(2)]
+        servers, urls = await _start_fakes(*f)
+        app = build_app(_router_args(urls, ["m", "m"],
+                                     ["--routing-logic", "session"]))
+        async with TestClient(TestServer(app)) as client:
+            hdr = {"x-user-id": "alice"}
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat(), headers=hdr)
+                assert r.status == 200
+            home = 0 if len(f[0].requests_seen) == 3 else 1
+            away = 1 - home
+            assert len(f[home].requests_seen) == 3
+
+            # home engine dies (probes fail too: a fully dead pod)
+            f[home].fault = {"mode": "reset", "count": -1,
+                             "scope": "all"}
+            for _ in range(4):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat(), headers=hdr)
+                assert r.status == 200     # failover, not 502
+            assert len(f[away].requests_seen) == 4
+
+            # recovery: clear the fault, wait for the active re-probe
+            # (cooldown 0.3s + probe every 0.15s) to close the breaker
+            f[home].fault = None
+            tracker = app["state"]["health"]
+            for _ in range(40):
+                if tracker.state_of(urls[home]) == CLOSED:
+                    break
+                await asyncio.sleep(0.1)
+            assert tracker.state_of(urls[home]) == CLOSED
+
+            before = len(f[home].requests_seen)
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat(), headers=hdr)
+                assert r.status == 200
+            # the session went home (deterministic ring restoration)
+            assert len(f[home].requests_seen) == before + 3
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_client_abort_is_not_a_backend_failure():
+    """Clients hitting stop mid-stream must not feed the breaker: a
+    few aborts against one endpoint would otherwise pull a healthy
+    engine out of rotation (breaker threshold is 2 here)."""
+    async def body():
+        fake = FakeEngine(model="m", num_tokens=200, tokens_per_s=50.0)
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(4):
+                resp = await client.post("/v1/chat/completions",
+                                         json=_chat(stream=True))
+                assert resp.status == 200
+                await resp.content.read(10)   # stream is live...
+                resp.close()                  # ...client walks away
+            await asyncio.sleep(0.3)          # let relays notice
+            tracker = app["state"]["health"]
+            assert tracker.state_of(urls[0]) == CLOSED
+            assert tracker.failures.get((urls[0], "mid_stream"), 0) == 0
+            # and the endpoint still serves new requests
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat())
+            assert r.status == 200
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_admin_drain_endpoint():
+    """POST /admin/drain stops new admissions to an engine; ending the
+    drain readmits it."""
+    async def body():
+        f1, f2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(f1, f2)
+        app = build_app(_router_args(urls, ["m", "m"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/admin/drain",
+                                  json={"url": urls[0]})
+            assert r.status == 200
+            assert (await r.json())["draining"] == [urls[0]]
+            for _ in range(4):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat())
+                assert r.status == 200
+            assert len(f1.requests_seen) == 0
+            assert len(f2.requests_seen) == 4
+
+            r = await client.post("/admin/drain",
+                                  json={"url": urls[0],
+                                        "drain": False})
+            assert (await r.json())["draining"] == []
+            for _ in range(4):
+                await client.post("/v1/chat/completions", json=_chat())
+            assert len(f1.requests_seen) > 0   # readmitted (roundrobin)
+
+            r = await client.post("/admin/drain", json={"nope": 1})
+            assert r.status == 400
+            # a typo'd endpoint must not become a silent no-op drain
+            r = await client.post("/admin/drain",
+                                  json={"url": "http://typo:1234"})
+            assert r.status == 404
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_inflight_tracking_and_drain_wait():
+    """The app counts in-flight handlers; wait_for_drain resolves once
+    the last one finishes (the SIGTERM path's building blocks)."""
+    async def body():
+        fake = FakeEngine(model="m", num_tokens=6, tokens_per_s=20.0)
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            state = app["state"]
+            assert state["inflight"] == 0
+            task = asyncio.create_task(
+                client.post("/v1/chat/completions",
+                            json=_chat(stream=True)))
+            await asyncio.sleep(0.1)
+            assert state["inflight"] >= 1
+            drained = await wait_for_drain(lambda: state["inflight"],
+                                           timeout_s=10.0)
+            assert drained and state["inflight"] == 0
+            r = await task
+            assert r.status == 200
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_static_discovery_marks_probe_dead_unroutable():
+    """K consecutive /v1/models probe failures drop the endpoint from
+    discovery; a later successful probe readmits it (satellite)."""
+    async def body():
+        f1, f2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(f1, f2)
+        tracker = HealthTracker()
+        disco = StaticServiceDiscovery(
+            urls, ["m", "m"], probe=True, probe_interval=0.05,
+            probe_failure_threshold=2, health_tracker=tracker)
+        await disco.start()
+        try:
+            assert len(disco.get_endpoints()) == 2
+            f2.fault = {"mode": "error", "count": -1, "scope": "all"}
+            for _ in range(60):
+                if len(disco.get_endpoints()) == 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert [ep.url for ep in disco.get_endpoints()] == [urls[0]]
+
+            f2.fault = None
+            for _ in range(60):
+                if len(disco.get_endpoints()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(disco.get_endpoints()) == 2
+            # probe outcomes fed the shared health state
+            assert tracker.failures[(urls[1], "probe")] >= 2
+        finally:
+            await disco.close()
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_fake_engine_fault_control_endpoint():
+    """The /fault control surface: set, observe, burst-decrement,
+    clear."""
+    async def body():
+        fake = FakeEngine(model="m")
+        servers, urls = await _start_fakes(fake)
+        async with TestClient(TestServer(fake.build_app())) as client:
+            r = await client.post("/fault", json={"mode": "error",
+                                                  "count": 2})
+            assert r.status == 200
+            r = await client.post("/v1/chat/completions", json=_chat())
+            assert r.status == 500
+            r = await client.post("/v1/chat/completions", json=_chat())
+            assert r.status == 500
+            r = await client.post("/v1/chat/completions", json=_chat())
+            assert r.status == 200        # burst exhausted
+            r = await client.get("/fault")
+            assert (await r.json())["faults_served"] == 2
+
+            r = await client.post("/fault", json={"mode": "bogus"})
+            assert r.status == 400
+            r = await client.post("/fault", json={"mode": None})
+            assert (await r.json())["fault"] is None
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+# ------------------------------------------------------------ chaos tier
+
+def _assert_chaos_clean(record):
+    from production_stack_tpu.loadgen.chaos import chaos_violations
+    d = record["detail"]
+    assert record["unit"] == "%"
+    assert d["requests"]["launched"] > 0
+    assert d["kills"] >= 1 and d["restarts"] >= 1
+    violations = chaos_violations(record)
+    assert not violations, violations
+
+
+def test_chaos_smoke_fake_engines(tmp_path):
+    """Tier-1 chaos smoke: real router + 2 fake engine processes, one
+    scheduled kill/restart inside a short storm — zero client-visible
+    5xx, zero router transport errors."""
+    from production_stack_tpu.loadgen.chaos import run_chaos
+    record = asyncio.run(run_chaos(
+        engines=2, users=4, duration_s=10.0, kill_interval_s=3.0,
+        downtime_s=1.5, error_burst_interval_s=4.0, error_burst=3,
+        stream_fraction=0.3, num_tokens=4, seed=1,
+        log_dir=str(tmp_path / "logs")))
+    _assert_chaos_clean(record)
+
+
+@pytest.mark.slow
+def test_chaos_real_engine(tmp_path):
+    """The same churn against real debug-tiny engines on CPU."""
+    from production_stack_tpu.loadgen.chaos import run_chaos
+    record = asyncio.run(run_chaos(
+        engines=2, engine="debug-tiny", users=4, duration_s=45.0,
+        kill_interval_s=15.0, downtime_s=5.0,
+        error_burst_interval_s=None, num_tokens=8, seed=1,
+        log_dir=str(tmp_path / "logs")))
+    _assert_chaos_clean(record)
