@@ -1,0 +1,27 @@
+#!/bin/bash
+# Goodput under saturation (BASELINE.md Round 9): real router + N
+# real debug-tiny engines launched WITH overload protection
+# (--max-waiting-seqs / --max-queue-delay-ms), open-loop offered-QPS
+# sweep past the knee; every request carries an x-request-deadline-ms
+# budget. Exit 1 unless goodput plateaus (within 10% of its peak past
+# the knee), zero accepted requests violate their deadline, and
+# nothing 5xxes outside the structured sheds. Thin wrapper — all logic
+# lives in production_stack_tpu/loadgen/overload.py; this pins the
+# knobs the committed OVERLOAD_*.json numbers used.
+#
+#   benchmarks/run_overload.sh [engines] [qps-list] [out.json]
+#
+# Pass --unprotected through EXTRA_ARGS to record the collapse
+# baseline (engines without protection flags; no contract enforced):
+#   EXTRA_ARGS=--unprotected benchmarks/run_overload.sh 2 2,6,12,20 \
+#     OVERLOAD_unprotected.json
+set -euo pipefail
+
+ENGINES="${1:-2}"
+QPS="${2:-2,6,12,20}"
+OUT="${3:-OVERLOAD_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen overload \
+  --engines "$ENGINES" --engine debug-tiny --qps "$QPS" \
+  --duration 15s --deadline-ms 8000 --num-tokens 8 \
+  ${EXTRA_ARGS:-} --output "$OUT"

@@ -90,7 +90,9 @@ class AsyncLLMEngine:
     async def submit(self, prompt_tokens: List[int],
                      options: SamplingOptions,
                      seq_id: Optional[str] = None,
-                     model: Optional[str] = None) -> Tuple[str, asyncio.Queue]:
+                     model: Optional[str] = None,
+                     deadline: Optional[float] = None
+                     ) -> Tuple[str, asyncio.Queue]:
         # add_request takes the ENGINE LOCK (engine.py), which the
         # engine thread holds across whole steps — including lazy XLA
         # compiles of new executable variants (seconds each). Taking
@@ -123,7 +125,8 @@ class AsyncLLMEngine:
         try:
             cfut = self._lock_pool.submit(
                 lambda: self.engine.add_request(
-                    prompt_tokens, options, seq_id=seq_id, model=model))
+                    prompt_tokens, options, seq_id=seq_id, model=model,
+                    deadline=deadline))
         except RuntimeError:
             # pool already shut down (request raced stop()): the
             # request never entered the engine, but the registration
@@ -166,11 +169,43 @@ class AsyncLLMEngine:
             self._wake.notify_all()
         return seq_id, q
 
+    def abort(self, seq_id: str) -> None:
+        """Abort a live request: the result-queue registration is freed
+        SYNCHRONOUSLY (a shed/deadline abort of a still-WAITING sequence
+        must not leave its queue lingering until the engine loop next
+        notices), while the engine-side abort — which waits on the
+        engine lock — is dispatched to an executor thread and not
+        awaited. Cleanup paths may run under GeneratorExit where
+        awaiting is illegal; abort is idempotent and slot-guarded, so
+        ordering vs later admissions is safe."""
+        if seq_id not in self._queues:
+            return
+        self._queues.pop(seq_id, None)
+        try:
+            f = self._lock_pool.submit(self.engine.abort, seq_id)
+        except RuntimeError:
+            # stop() already shut the pool down (server shutdown with
+            # live streams): abort inline rather than lose it — the
+            # engine thread is stopping, so the brief lock wait here
+            # cannot stall a running loop.
+            try:
+                self.engine.abort(seq_id)
+            except Exception as e:
+                logger.warning("inline abort of %s failed: %s",
+                               seq_id, e)
+        else:
+            f.add_done_callback(
+                lambda f: f.exception() and logger.warning(
+                    "async abort of %s failed: %s", seq_id,
+                    f.exception()))
+
     async def stream(self, prompt_tokens: List[int],
                      options: SamplingOptions,
-                     model: Optional[str] = None
+                     model: Optional[str] = None,
+                     deadline: Optional[float] = None
                      ) -> AsyncIterator[StepOutput]:
-        seq_id, q = await self.submit(prompt_tokens, options, model=model)
+        seq_id, q = await self.submit(prompt_tokens, options, model=model,
+                                      deadline=deadline)
         try:
             while True:
                 out = await q.get()
@@ -178,31 +213,9 @@ class AsyncLLMEngine:
                 if out.finished:
                     return
         finally:
-            # client disconnected mid-stream: free the slot. Cleanup
-            # may run under GeneratorExit where awaiting is illegal, so
-            # the abort is DISPATCHED to an executor thread (same
-            # engine-lock rationale as submit) and not awaited; abort
-            # is idempotent and slot-guarded, so ordering vs later
-            # admissions is safe.
-            if seq_id in self._queues:
-                self._queues.pop(seq_id, None)
-                try:
-                    f = self._lock_pool.submit(self.engine.abort, seq_id)
-                except RuntimeError:
-                    # stop() already shut the pool down (server shutdown
-                    # with live streams): abort inline rather than lose
-                    # it — the engine thread is stopping, so the brief
-                    # lock wait here cannot stall a running loop.
-                    try:
-                        self.engine.abort(seq_id)
-                    except Exception as e:
-                        logger.warning("inline abort of %s failed: %s",
-                                       seq_id, e)
-                else:
-                    f.add_done_callback(
-                        lambda f: f.exception() and logger.warning(
-                            "async abort of %s failed: %s", seq_id,
-                            f.exception()))
+            # client disconnected mid-stream (or the consumer saw a
+            # terminal output, making this a no-op): free the slot
+            self.abort(seq_id)
 
     @property
     def tokenizer(self):

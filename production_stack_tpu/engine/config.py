@@ -101,6 +101,18 @@ class EngineConfig:
     lora_rank: int = 8
     lora_alpha: float = 16.0
     lora_targets: Tuple[str, ...] = ("q", "v")
+    # Overload protection (docs/engine.md "Overload protection"):
+    # bounded admission — add_request raises AdmissionRejected (the
+    # server answers 503 + Retry-After) once this many sequences are
+    # queued un-admitted, instead of growing the waiting deque without
+    # bound until every client times out at once. None = unbounded
+    # (the pre-overload-protection behavior).
+    max_waiting_seqs: Optional[int] = None
+    # queue-time cap: a sequence still waiting (never admitted, no
+    # output) after this many milliseconds is shed by the scheduler
+    # (finish_reason "queue_delay" -> 503 + Retry-After at the server)
+    # rather than serviced long after its useful-by time. None = never.
+    max_queue_delay_ms: Optional[float] = None
 
     def __post_init__(self):
         if self.dtype not in ("bfloat16", "float32"):
@@ -142,6 +154,13 @@ class EngineConfig:
             max(8, (self.max_model_len + 7) // 8 * 8))
         if self.kv_pool_tokens is not None and self.kv_pool_tokens <= 0:
             raise ValueError("kv_pool_tokens must be positive")
+        if self.max_waiting_seqs is not None and self.max_waiting_seqs < 0:
+            raise ValueError("max_waiting_seqs must be >= 0 "
+                             "(0 sheds anything that cannot be admitted "
+                             "immediately; None = unbounded)")
+        if self.max_queue_delay_ms is not None \
+                and self.max_queue_delay_ms <= 0:
+            raise ValueError("max_queue_delay_ms must be positive")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)

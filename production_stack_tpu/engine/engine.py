@@ -48,6 +48,27 @@ class StepOutput:
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
 _FINISHED_RETENTION = 1024
 
+
+class AdmissionRejected(Exception):
+    """Bounded admission (cfg.max_waiting_seqs): the waiting queue is
+    full, so the request is shed at submit time instead of queuing
+    forever. The server maps this to 503 + Retry-After; the router
+    treats that answer as shed-not-sick (router/resilience.py)."""
+
+    def __init__(self, queue_depth: int, retry_after_s: float):
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"engine overloaded: {queue_depth} sequences already "
+            f"waiting (max_waiting_seqs reached); retry in "
+            f"~{retry_after_s:.1f}s")
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline (x-request-deadline-ms) expired while it
+    was still WAITING; the scheduler dropped it before prefill. The
+    server maps this to 504 with an x-deadline-expired marker."""
+
 class LLMEngine:
     def __init__(self, engine_cfg: EngineConfig, params=None, mesh=None):
         self.cfg = engine_cfg
@@ -142,6 +163,11 @@ class LLMEngine:
                                  engine_cfg.max_blocks_per_seq), np.int32)
         self.scheduler.can_admit = self._try_admit
         self.scheduler.on_admit = self._on_admit
+        # advertised once: the router's per-endpoint concurrency cap
+        # reads this gauge (0 = unbounded admission, nothing to cap on)
+        self.metrics.capacity.set(
+            engine_cfg.max_num_seqs + engine_cfg.max_waiting_seqs
+            if engine_cfg.max_waiting_seqs is not None else 0)
         # KV tiering (HBM→host→disk→remote; kvcache/): the reference wires
         # the same capability through LMCache env + --kv-transfer-config
         # (reference: helm/templates/deployment-vllm-multi.yaml:94-99,154-178)
@@ -168,6 +194,10 @@ class LLMEngine:
         self.seqs: Dict[str, Sequence] = {}
         self._finished_order: List[str] = []
         self._id_counter = itertools.count()
+        # EWMA of finished-request wall time (arrival -> finish),
+        # seeding the load report's queue-delay estimate before any
+        # request has completed
+        self._service_ewma = 0.5
         # guards scheduler state across the engine-loop and server threads
         self._lock = threading.RLock()
         # per-slot host mirrors feeding the decode batch. Free/prefilling
@@ -264,7 +294,8 @@ class LLMEngine:
     def add_request(self, prompt_tokens: List[int],
                     options: Optional[SamplingOptions] = None,
                     seq_id: Optional[str] = None,
-                    model: Optional[str] = None) -> str:
+                    model: Optional[str] = None,
+                    deadline: Optional[float] = None) -> str:
         seq_id = seq_id or f"seq-{next(self._id_counter)}"
         options = options or SamplingOptions()
         if options.logit_bias:
@@ -313,6 +344,7 @@ class LLMEngine:
         seq = Sequence(seq_id=seq_id, prompt_tokens=list(prompt_tokens),
                        options=options,
                        adapter_id=self.resolve_model(model),
+                       deadline=deadline,
                        detok=DetokenizeStream(self.tokenizer))
         if seq.options.guided_regex:
             from production_stack_tpu.engine import guided
@@ -326,6 +358,30 @@ class LLMEngine:
             seq.kv_prefetch = self.connector.prefetch(
                 seq.prompt_tokens, salt=self._adapter_salt(seq.adapter_id))
         with self._lock:
+            # bounded admission: shed at submit rather than queue
+            # forever. Admission happens only at step time, so a fresh
+            # submit ALWAYS lands in waiting first — the bound is
+            # therefore on waiting beyond what the free slots will
+            # absorb on the next pass (max_waiting_seqs=0 = "shed
+            # anything that cannot be admitted immediately", not "shed
+            # everything"). Only never-admitted sequences count —
+            # preempted ones re-queue at the front and must not be
+            # double-counted against new arrivals (they already hold a
+            # client stream).
+            if self.cfg.max_waiting_seqs is not None:
+                depth = sum(1 for s in self.scheduler.waiting
+                            if not s.output_tokens)
+                # free slots absorb that much of the queue on the next
+                # pass — minus the preempted sequences queued ahead of
+                # everyone (recompute-first), which reclaim slots
+                # before any fresh arrival
+                preempted = len(self.scheduler.waiting) - depth
+                allowance = self.cfg.max_waiting_seqs + max(
+                    0, len(self.scheduler.free_slots) - preempted)
+                if depth >= allowance:
+                    self.metrics.admission_rejected.inc()
+                    raise AdmissionRejected(
+                        depth, self.estimated_queue_delay_s())
             self.scheduler.add(seq)
             self.seqs[seq_id] = seq
         return seq_id
@@ -353,8 +409,28 @@ class LLMEngine:
         --enable-chunked-prefill, reference:
         helm/templates/deployment-vllm-multi.yaml:69-72)."""
         with self._lock:
-            works, decode_seqs = self.scheduler.schedule()
             outputs: List[StepOutput] = []
+            # overload protection: drop expired-deadline / over-delayed
+            # sequences from the waiting queue BEFORE admission, so no
+            # prefill compute is burned on a request whose client has
+            # already given up (ISSUE 4; docs/engine.md)
+            delay_cap = self.cfg.max_queue_delay_ms
+            expired = self.scheduler.expire_waiting(
+                max_queue_delay_s=delay_cap / 1e3
+                if delay_cap is not None else None)
+            for seq in expired:
+                self._free_seq_blocks(seq)
+                self._remember(seq)
+                if seq.finish_reason == "deadline":
+                    self.metrics.deadline_expired.inc()
+                else:
+                    self.metrics.queue_delay_shed.inc()
+                logger.info("dropped %s while waiting (%s): queued "
+                            "%.0fms", seq.seq_id, seq.finish_reason,
+                            1e3 * (time.monotonic() - seq.arrival_time))
+                outputs.append(StepOutput(seq.seq_id, None, "", True,
+                                          seq.finish_reason))
+            works, decode_seqs = self.scheduler.schedule()
             if works:
                 # drain the in-flight window first: it was dispatched
                 # from pre-prefill state and stays valid; the prefill's
@@ -871,8 +947,13 @@ class LLMEngine:
             self.scheduler.finish(seq, reason)
             self._park_slot(slot)
             self._remember(seq)
-            self.metrics.e2e_latency.observe(
-                time.monotonic() - seq.arrival_time)
+            dur = time.monotonic() - seq.arrival_time
+            self.metrics.e2e_latency.observe(dur)
+            # service-time EWMA feeding the queue-delay estimate the
+            # load report / Retry-After are built on (includes queueing
+            # — deliberately: it is what the next queued client will
+            # actually wait through)
+            self._service_ewma = 0.8 * self._service_ewma + 0.2 * dur
             return [StepOutput(seq.seq_id, token, text_delta, True, reason,
                                logprob, top_alts)]
         self._sync_slot(seq)
@@ -1124,6 +1205,58 @@ class LLMEngine:
             self._refresh_gauges()
         return self.metrics.render()
 
+    # ------------------------------------------------- overload surface
+
+    def admission_full(self) -> bool:
+        """Lock-free fast-path hint: True when a new submit would very
+        likely be rejected by bounded admission right now. The
+        authoritative count (which excludes preempted sequences) stays
+        in add_request under the lock; this lets a shed storm be
+        refused BEFORE tokenization and the executor hop burn
+        event-loop CPU on requests that are going to 503 anyway. May
+        over-shed by up to the preempted-sequence count under combined
+        KV pressure + queue overflow — when both valves are blowing,
+        early shed is the right bias."""
+        cap = self.cfg.max_waiting_seqs
+        if cap is None:
+            return False
+        return len(self.scheduler.waiting) >= \
+            cap + len(self.scheduler.free_slots)
+
+    def estimated_queue_delay_s(self) -> float:
+        """Rough wait a newly queued request faces: queue depth ahead of
+        it over the batch width, paced by the recent per-request wall
+        time. Deliberately lock-free (len()/attribute reads are atomic
+        in CPython): the /load endpoint and Retry-After must answer
+        while the engine lock is held across a multi-second compile."""
+        waiting = len(self.scheduler.waiting)
+        return (waiting / max(1, self.cfg.max_num_seqs)) \
+            * self._service_ewma
+
+    def load_report(self) -> Dict[str, object]:
+        """Cheap point-in-time load signal (served on /load and as
+        x-engine-* response headers; the router scrapes the same
+        numbers from /metrics). Lock-free by design — see
+        estimated_queue_delay_s."""
+        sched = self.scheduler
+        cap = None
+        if self.cfg.max_waiting_seqs is not None:
+            cap = self.cfg.max_num_seqs + self.cfg.max_waiting_seqs
+        return {
+            "queue_depth": len(sched.waiting),
+            "running": len(sched.running) + len(sched._prefilling),
+            "max_num_seqs": self.cfg.max_num_seqs,
+            "max_waiting_seqs": self.cfg.max_waiting_seqs,
+            # total in-flight the engine will accept before shedding
+            # (None = unbounded admission); the router derives its
+            # per-endpoint concurrency cap from this
+            "capacity": cap,
+            "free_kv_blocks": self.block_mgr.available,
+            "kv_usage": round(self.block_mgr.usage, 4),
+            "est_queue_delay_ms": round(
+                1e3 * self.estimated_queue_delay_s(), 1),
+        }
+
     # ---------------------------------------------------- paged-KV host
 
     def _try_admit(self, seq: Sequence) -> bool:
@@ -1270,6 +1403,8 @@ class LLMEngine:
     def _refresh_gauges(self) -> None:
         self.metrics.num_running.set(self.scheduler.num_running)
         self.metrics.num_waiting.set(self.scheduler.num_waiting)
+        self.metrics.est_queue_delay.set(
+            1e3 * self.estimated_queue_delay_s())
         usage = self.block_mgr.usage
         self.metrics.kv_usage.set(usage)
         self.metrics.hbm_kv_usage.set(usage)

@@ -72,6 +72,28 @@ class EngineMetrics:
         self.spec_macro_steps = counter(
             "tpu:spec_macro_steps_total",
             "Speculative macro-steps executed by eligible rows")
+        # overload protection (docs/engine.md): shed/drop accounting
+        # plus the two load signals the router scrapes — advertised
+        # capacity (max_num_seqs + max_waiting_seqs; 0 = unbounded
+        # admission, no cap derivable) and the estimated queue delay
+        self.admission_rejected = counter(
+            "tpu:admission_rejected_total",
+            "Requests shed at submit (max_waiting_seqs reached, 503)")
+        self.deadline_expired = counter(
+            "tpu:deadline_expired_total",
+            "Requests dropped while WAITING (x-request-deadline-ms "
+            "elapsed before admission, 504)")
+        self.queue_delay_shed = counter(
+            "tpu:queue_delay_shed_total",
+            "Requests shed while WAITING (max_queue_delay_ms exceeded, "
+            "503)")
+        self.capacity = gauge(
+            "tpu:engine_capacity_seqs",
+            "Total sequences accepted before shedding (max_num_seqs + "
+            "max_waiting_seqs; 0 = unbounded admission)")
+        self.est_queue_delay = gauge(
+            "tpu:est_queue_delay_ms",
+            "Estimated wait for a newly queued request (ms)")
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

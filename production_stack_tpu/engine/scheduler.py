@@ -99,6 +99,12 @@ class Sequence:
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_reason: Optional[str] = None
+    # absolute monotonic deadline (from the client's
+    # x-request-deadline-ms header, engine/server.py): a sequence whose
+    # deadline expires while still WAITING is dropped by
+    # expire_waiting() before burning prefill compute on a request the
+    # client has abandoned. None = no deadline.
+    deadline: Optional[float] = None
     # host-side KV for a cached prompt prefix, fetched off the engine loop
     # at add time (kvcache/connector.py Prefetch); injected at admission
     kv_prefetch: object = None
@@ -210,6 +216,50 @@ class Scheduler:
         return False
 
     # ------------------------------------------------------------------
+
+    def expire_waiting(self, now: Optional[float] = None,
+                       max_queue_delay_s: Optional[float] = None
+                       ) -> List[Sequence]:
+        """Overload-protection sweep over the un-admitted queue, run by
+        the engine at the top of every step:
+
+        - a sequence whose ``deadline`` has passed is dropped with
+          finish_reason ``"deadline"`` (the client's budget elapsed
+          while it queued — prefilling it now serves nobody);
+        - with ``max_queue_delay_s`` set, a sequence queued longer than
+          the cap is shed with finish_reason ``"queue_delay"``.
+
+        Preempted sequences (ones with emitted output) are exempt from
+        the queue-delay shed — they were admitted once and their client
+        is mid-stream — but not from their own deadline. Returns the
+        dropped sequences so the engine can emit terminal StepOutputs.
+        """
+        if not self.waiting:
+            return []
+        if now is None:
+            now = time.monotonic()
+        dropped: List[Sequence] = []
+        kept: List[Sequence] = []
+        for seq in self.waiting:
+            if seq.deadline is not None and now >= seq.deadline:
+                reason = "deadline"
+            elif (max_queue_delay_s is not None
+                  and not seq.output_tokens
+                  and now - seq.arrival_time >= max_queue_delay_s):
+                reason = "queue_delay"
+            else:
+                kept.append(seq)
+                continue
+            seq.status = SeqStatus.FINISHED
+            seq.finish_reason = reason
+            seq.kv_prefetch = None   # release host KV buffers
+            dropped.append(seq)
+        if dropped:
+            # one rebuild, not one O(n) deque.remove per drop — a storm
+            # can expire thousands of queued sequences in a single pass
+            self.waiting.clear()
+            self.waiting.extend(kept)
+        return dropped
 
     def schedule(self) -> Tuple[List[PrefillWork], List[Sequence]]:
         """Pick this iteration's device work.

@@ -14,6 +14,8 @@ models from protocol.py and stream via chunked responses.
 import argparse
 import asyncio
 import json
+import math
+import time
 from contextlib import aclosing
 from typing import List, Optional
 
@@ -23,6 +25,8 @@ from pydantic import ValidationError
 from production_stack_tpu import protocol as proto
 from production_stack_tpu.engine.async_engine import AsyncLLMEngine
 from production_stack_tpu.engine.config import EngineConfig
+from production_stack_tpu.engine.engine import (AdmissionRejected,
+                                                DeadlineExceeded)
 from production_stack_tpu.engine.scheduler import SamplingOptions
 from production_stack_tpu.utils import (honor_platform_env, init_logger,
                                           set_ulimit)
@@ -32,11 +36,121 @@ logger = init_logger(__name__)
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLMEngine)
 
+# relative per-request budget in milliseconds; the router injects its
+# own --request-timeout here when the client sent none (docs/router.md
+# "Overload protection")
+DEADLINE_HEADER = "x-request-deadline-ms"
+# marks an engine 504 as "the CLIENT's deadline elapsed" — the router
+# relays it without a breaker signal or failover (retrying a request
+# whose budget is spent helps nobody)
+DEADLINE_MARKER = "x-deadline-expired"
 
-def _error(status: int, message: str) -> web.Response:
+
+def _error(status: int, message: str,
+           err_type: str = "invalid_request_error") -> web.Response:
     body = proto.ErrorResponse(
-        error=proto.ErrorInfo(message=message, code=status))
+        error=proto.ErrorInfo(message=message, type=err_type,
+                              code=status))
     return web.json_response(body.model_dump(), status=status)
+
+
+class _QueueDelayShed(Exception):
+    """The scheduler shed this request for exceeding max_queue_delay_ms
+    while WAITING (finish_reason "queue_delay")."""
+
+
+def _deadline_from(request: web.Request):
+    """Parse x-request-deadline-ms into an absolute monotonic deadline.
+    Returns (deadline_or_None, error_response_or_None)."""
+    raw = request.headers.get(DEADLINE_HEADER)
+    if raw is None:
+        return None, None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None, _error(400, f"{DEADLINE_HEADER} must be a number "
+                                 f"of milliseconds (got {raw!r})")
+    if not math.isfinite(ms):
+        return None, _error(400, f"{DEADLINE_HEADER} must be finite")
+    if ms <= 0:
+        # already expired on arrival: answer 504 before any engine work
+        return None, _deadline_error()
+    return time.monotonic() + ms / 1e3, None
+
+
+def _deadline_error() -> web.Response:
+    resp = _error(504, "request deadline expired while waiting for "
+                       "admission (x-request-deadline-ms elapsed before "
+                       "the engine could start it)",
+                  err_type="timeout_error")
+    resp.headers[DEADLINE_MARKER] = "1"
+    return resp
+
+
+def _shed_error(engine: AsyncLLMEngine,
+                message: Optional[str] = None) -> web.Response:
+    """Structured 503 + Retry-After: the overload shed the router's
+    resilience layer recognizes as shed-not-sick."""
+    retry_s = max(1.0, engine.engine.estimated_queue_delay_s())
+    resp = _error(503, message or "engine overloaded: request shed; "
+                                  "retry after the indicated delay",
+                  err_type="overloaded_error")
+    resp.headers["Retry-After"] = str(int(math.ceil(retry_s)))
+    return resp
+
+
+def _load_headers(engine: AsyncLLMEngine) -> dict:
+    """The per-response load report (cheap, lock-free): every reply
+    carries the engine's pressure signals so callers (and the router)
+    see load without an extra round trip."""
+    report = engine.engine.load_report()
+    return {
+        "x-engine-queue-depth": str(report["queue_depth"]),
+        "x-engine-running": str(report["running"]),
+        "x-engine-free-kv-blocks": str(report["free_kv_blocks"]),
+        "x-engine-est-queue-delay-ms": str(report["est_queue_delay_ms"]),
+    }
+
+
+def _check_overload_finish(out) -> None:
+    """Translate a WAITING-dropped sequence's terminal StepOutput
+    (engine.step's expire pass: no token, no text) into the structured
+    error the client contract promises."""
+    if not out.finished or out.new_token is not None or out.text_delta:
+        return
+    if out.finish_reason == "deadline":
+        raise DeadlineExceeded()
+    if out.finish_reason == "queue_delay":
+        raise _QueueDelayShed()
+
+
+async def _guarded_payloads(merged, lead_payloads, chunk_for):
+    """Shared streaming shape for the chat/completions SSE paths: pull
+    the FIRST engine output off ``merged`` before emitting the
+    ``lead_payloads`` (role/echo chunks), so an admission shed or a
+    WAITING-deadline drop surfaces pre-yield and _sse_stream can still
+    answer a structured 503/504 instead of a truncated stream; then
+    relay ``chunk_for(i, out)`` payloads. A drop arriving AFTER the
+    response started (another choice's shed, or a preempted sequence's
+    deadline) is NOT an error: the transport is healthy, so that choice
+    simply terminates with its finish_reason chunk ("deadline" /
+    "queue_delay") while its siblings stream on to [DONE]."""
+    try:
+        head = await merged.__anext__()
+    except StopAsyncIteration:
+        head = None
+    if head is not None:
+        _check_overload_finish(head[1])
+    for payload in lead_payloads:
+        yield payload
+    if head is not None:
+        payload = chunk_for(*head)
+        if payload is not None:
+            yield payload
+        async for i, out in merged:
+            payload = chunk_for(i, out)
+            if payload is not None:
+                yield payload
 
 
 def _logit_bias(req) -> Optional[dict]:
@@ -182,7 +296,7 @@ def _choice_jobs(prompts, options, n):
             for p, pids in enumerate(prompts) for j in range(n)]
 
 
-def _merged_streams(engine, jobs, model):
+def _merged_streams(engine, jobs, model, deadline=None):
     """Run the jobs [(choice_index, prompt_ids, options)] concurrently
     and yield (choice_index, StepOutput) in completion order — the
     OpenAI n>1 / batched-prompt streaming shape (each chunk carries its
@@ -195,7 +309,8 @@ def _merged_streams(engine, jobs, model):
         async def pump(idx, pids, opts):
             try:
                 async with aclosing(engine.stream(
-                        list(pids), opts, model=model)) as it:
+                        list(pids), opts, model=model,
+                        deadline=deadline)) as it:
                     async for out in it:
                         await q.put((idx, out))
             except BaseException as e:  # noqa: BLE001 — re-raised below
@@ -221,20 +336,54 @@ def _merged_streams(engine, jobs, model):
 
 
 async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
-    resp = web.StreamResponse(
-        status=200,
-        headers={"Content-Type": "text/event-stream",
-                 "Cache-Control": "no-cache",
-                 "X-Accel-Buffering": "no"})
-    await resp.prepare(request)
+    """Relay an SSE generator, preparing the response lazily: the 200
+    and its headers go out with the FIRST payload, so an admission shed
+    or a deadline expiry that surfaces before any byte is written
+    becomes a clean structured 503/504 instead of a truncated stream.
+    (Raised after bytes have been relayed, the same failures can only
+    truncate — the connection is dropped.)"""
+    engine = request.app[ENGINE_KEY]
+    resp: Optional[web.StreamResponse] = None
+
+    async def ensure_prepared() -> web.StreamResponse:
+        nonlocal resp
+        if resp is None:
+            resp = web.StreamResponse(
+                status=200,
+                headers={"Content-Type": "text/event-stream",
+                         "Cache-Control": "no-cache",
+                         "X-Accel-Buffering": "no",
+                         **_load_headers(engine)})
+            await resp.prepare(request)
+        return resp
+
     try:
         async for payload in gen:
+            await ensure_prepared()
             await resp.write(f"data: {payload}\n\n".encode())
+        await ensure_prepared()
         await resp.write(b"data: [DONE]\n\n")
         await resp.write_eof()
     except (ConnectionResetError, ConnectionError):
         # client went away mid-stream; generator cleanup aborts the request
         await gen.aclose()
+        if resp is None:
+            resp = web.Response(status=500)     # never reaches the client
+    except AdmissionRejected as e:
+        await gen.aclose()
+        if resp is None:
+            return _shed_error(engine, str(e))
+        resp.force_close()
+    except DeadlineExceeded:
+        await gen.aclose()
+        if resp is None:
+            return _deadline_error()
+        resp.force_close()
+    except _QueueDelayShed:
+        await gen.aclose()
+        if resp is None:
+            return _shed_error(engine)
+        resp.force_close()
     return resp
 
 
@@ -359,6 +508,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         engine.engine.resolve_model(req.model or None)
     except ValueError as e:
         return _error(404, str(e))
+    deadline, bad = _deadline_from(request)
+    if bad is not None:
+        return bad
+    if engine.engine.admission_full():
+        # cheap-shed fast path: refuse before tokenization/template
+        # work — under a shed storm the 503s must cost near-nothing
+        return _shed_error(engine)
 
     tok = engine.tokenizer
     prompt = tok.apply_chat_template(
@@ -385,45 +541,53 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             # "usage": null until the final usage chunk; without it the
             # field is omitted entirely
             exclude = None if include_usage else {"usage"}
-            for i in range(req.n):
-                first = proto.ChatCompletionChunk(
+            num_tokens = 0
+
+            def chunk_for(i, out):
+                nonlocal num_tokens
+                if out.new_token is not None:
+                    num_tokens += 1
+                lp_block = None
+                if (req.logprobs and out.new_token is not None
+                        and not _lp_skip(out)):
+                    lp_block = proto.ChatLogprobs(content=[
+                        _chat_lp_entry(tok, out.new_token,
+                                       out.logprob,
+                                       bool(req.top_logprobs),
+                                       out.top_alts)])
+                # a token can produce no text yet (partial UTF-8 in
+                # the detokenizer) — its logprob entry must still
+                # be delivered
+                if out.text_delta or out.finished or lp_block:
+                    chunk = proto.ChatCompletionChunk(
+                        id=rid, model=req.model,
+                        choices=[proto.ChatCompletionChunkChoice(
+                            index=i,
+                            delta=proto.DeltaMessage(
+                                content=out.text_delta or None),
+                            finish_reason=out.finish_reason if out.finished
+                            else None,
+                            logprobs=lp_block)])
+                    return chunk.model_dump_json(exclude=exclude)
+                return None
+
+            role_chunks = [
+                proto.ChatCompletionChunk(
                     id=rid, model=req.model,
                     choices=[proto.ChatCompletionChunkChoice(
                         index=i,
                         delta=proto.DeltaMessage(role="assistant",
-                                                 content=""))])
-                yield first.model_dump_json(exclude=exclude)
-            num_tokens = 0
+                                                 content=""))]
+                ).model_dump_json(exclude=exclude)
+                for i in range(req.n)]
             # aclosing => a dropped consumer deterministically runs
             # every stream's cleanup (slot aborts), not at GC's leisure
             async with aclosing(_merged_streams(
                     engine, _choice_jobs([prompt_ids], options, req.n),
-                    req.model or None)) as it:
-                async for i, out in it:
-                    if out.new_token is not None:
-                        num_tokens += 1
-                    lp_block = None
-                    if (req.logprobs and out.new_token is not None
-                            and not _lp_skip(out)):
-                        lp_block = proto.ChatLogprobs(content=[
-                            _chat_lp_entry(tok, out.new_token,
-                                           out.logprob,
-                                           bool(req.top_logprobs),
-                                           out.top_alts)])
-                    # a token can produce no text yet (partial UTF-8 in
-                    # the detokenizer) — its logprob entry must still
-                    # be delivered
-                    if out.text_delta or out.finished or lp_block:
-                        chunk = proto.ChatCompletionChunk(
-                            id=rid, model=req.model,
-                            choices=[proto.ChatCompletionChunkChoice(
-                                index=i,
-                                delta=proto.DeltaMessage(
-                                    content=out.text_delta or None),
-                                finish_reason=out.finish_reason if out.finished
-                                else None,
-                                logprobs=lp_block)])
-                        yield chunk.model_dump_json(exclude=exclude)
+                    req.model or None, deadline)) as it:
+                async for payload in _guarded_payloads(
+                        it, role_chunks, chunk_for):
+                    yield payload
             if include_usage:
                 # OpenAI semantics: one final chunk, empty choices, usage
                 tail = proto.ChatCompletionChunk(
@@ -442,8 +606,9 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         tokens = 0
         async with aclosing(engine.stream(
                 list(prompt_ids), _choice_options(options, i),
-                model=req.model or None)) as it:
+                model=req.model or None, deadline=deadline)) as it:
             async for out in it:
+                _check_overload_finish(out)
                 parts.append(out.text_delta)
                 if out.new_token is not None:
                     tokens += 1
@@ -461,8 +626,15 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                       if req.logprobs else None))
         return choice, tokens
 
-    results = await _gather_cancelling(
-        [collect_one(i) for i in range(req.n)])
+    try:
+        results = await _gather_cancelling(
+            [collect_one(i) for i in range(req.n)])
+    except AdmissionRejected as e:
+        return _shed_error(engine, str(e))
+    except DeadlineExceeded:
+        return _deadline_error()
+    except _QueueDelayShed:
+        return _shed_error(engine)
     num_tokens = sum(t for _, t in results)
     resp = proto.ChatCompletionResponse(
         id=rid, model=req.model,
@@ -486,6 +658,11 @@ async def completions(request: web.Request) -> web.StreamResponse:
         engine.engine.resolve_model(req.model or None)
     except ValueError as e:
         return _error(404, str(e))
+    deadline, bad = _deadline_from(request)
+    if bad is not None:
+        return bad
+    if engine.engine.admission_full():
+        return _shed_error(engine)
 
     tok = engine.tokenizer
     prompt = req.prompt
@@ -528,38 +705,46 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
         async def gen():
             exclude = None if include_usage else {"usage"}
-            for p, (echo_text, echo_lp) in enumerate(echo_blocks):
-                for j in range(req.n):
+            num_tokens = 0
+
+            def chunk_for(i, out):
+                nonlocal num_tokens
+                if out.new_token is not None:
+                    num_tokens += 1
+                lp_block = None
+                if (req.logprobs is not None
+                        and out.new_token is not None
+                        and not _lp_skip(out)):
+                    lp_block = _completion_logprobs(
+                        tok, [out.new_token], [out.logprob],
+                        req.logprobs > 0, [out.top_alts])
+                if out.text_delta or out.finished or lp_block:
                     chunk = proto.CompletionChunk(
                         id=rid, model=req.model,
                         choices=[proto.CompletionChunkChoice(
-                            index=p * req.n + j, text=echo_text,
-                            logprobs=echo_lp)])
-                    yield chunk.model_dump_json(exclude=exclude)
-            num_tokens = 0
+                            index=i,
+                            text=out.text_delta,
+                            finish_reason=out.finish_reason if out.finished
+                            else None,
+                            logprobs=lp_block)])
+                    return chunk.model_dump_json(exclude=exclude)
+                return None
+
+            echo_chunks = [
+                proto.CompletionChunk(
+                    id=rid, model=req.model,
+                    choices=[proto.CompletionChunkChoice(
+                        index=p * req.n + j, text=echo_text,
+                        logprobs=echo_lp)]
+                ).model_dump_json(exclude=exclude)
+                for p, (echo_text, echo_lp) in enumerate(echo_blocks)
+                for j in range(req.n)]
             async with aclosing(_merged_streams(
                     engine, _choice_jobs(prompts, options, req.n),
-                    req.model or None)) as it:
-                async for i, out in it:
-                    if out.new_token is not None:
-                        num_tokens += 1
-                    lp_block = None
-                    if (req.logprobs is not None
-                            and out.new_token is not None
-                            and not _lp_skip(out)):
-                        lp_block = _completion_logprobs(
-                            tok, [out.new_token], [out.logprob],
-                            req.logprobs > 0, [out.top_alts])
-                    if out.text_delta or out.finished or lp_block:
-                        chunk = proto.CompletionChunk(
-                            id=rid, model=req.model,
-                            choices=[proto.CompletionChunkChoice(
-                                index=i,
-                                text=out.text_delta,
-                                finish_reason=out.finish_reason if out.finished
-                                else None,
-                                logprobs=lp_block)])
-                        yield chunk.model_dump_json(exclude=exclude)
+                    req.model or None, deadline)) as it:
+                async for payload in _guarded_payloads(
+                        it, echo_chunks, chunk_for):
+                    yield payload
             if include_usage:
                 n_prompt = sum(len(p) for p in prompts)
                 tail = proto.CompletionChunk(
@@ -579,8 +764,10 @@ async def completions(request: web.Request) -> web.StreamResponse:
         tokens = 0
         finish_reason = None
         async with aclosing(engine.stream(
-                list(pids), opts, model=req.model or None)) as it:
+                list(pids), opts, model=req.model or None,
+                deadline=deadline)) as it:
             async for out in it:
+                _check_overload_finish(out)
                 parts.append(out.text_delta)
                 if out.new_token is not None:
                     tokens += 1
@@ -605,9 +792,16 @@ async def completions(request: web.Request) -> web.StreamResponse:
             logprobs=lp_block)
         return choice, tokens
 
-    results = await _gather_cancelling(
-        [collect_one(*job)
-         for job in _choice_jobs(prompts, options, req.n)])
+    try:
+        results = await _gather_cancelling(
+            [collect_one(*job)
+             for job in _choice_jobs(prompts, options, req.n)])
+    except AdmissionRejected as e:
+        return _shed_error(engine, str(e))
+    except DeadlineExceeded:
+        return _deadline_error()
+    except _QueueDelayShed:
+        return _shed_error(engine)
     num_tokens = sum(t for _, t in results)
     n_prompt = sum(len(p) for p in prompts)
     resp = proto.CompletionResponse(
@@ -805,6 +999,16 @@ async def health(request: web.Request) -> web.Response:
     return web.json_response({"status": "ok"})
 
 
+async def load(request: web.Request) -> web.Response:
+    """Cheap load report (queue depth, running seqs, free KV blocks,
+    estimated queue delay, advertised capacity) — lock-free, so it
+    answers even while the engine lock is held across a compile. The
+    same numbers ride on every reply as x-engine-* headers and on
+    /metrics as tpu: gauges."""
+    engine = request.app[ENGINE_KEY]
+    return web.json_response(engine.engine.load_report())
+
+
 async def version(request: web.Request) -> web.Response:
     return web.json_response({"version": __version__})
 
@@ -836,7 +1040,8 @@ async def detokenize(request: web.Request) -> web.Response:
 # parity: the stack's engines enforce VLLM_API_KEY on the OpenAI surface
 # while /health keeps answering probes,
 # helm/templates/deployment-vllm-multi.yaml:143-150 + probe blocks)
-AUTH_EXEMPT_PATHS = frozenset({"/health", "/metrics", "/version"})
+AUTH_EXEMPT_PATHS = frozenset({"/health", "/metrics", "/version",
+                               "/load"})
 
 
 def _auth_middleware(api_key: str):
@@ -874,6 +1079,19 @@ def build_app(engine: AsyncLLMEngine,
         logger.info("API-key enforcement on: all endpoints require "
                     "Bearer auth except %s",
                     ", ".join(sorted(AUTH_EXEMPT_PATHS)))
+    @web.middleware
+    async def stamp_load_headers(request: web.Request, handler):
+        # every reply carries the engine's pressure signals (SSE
+        # streams get theirs at prepare time in _sse_stream; a
+        # response already prepared by its handler cannot take more
+        # headers)
+        resp = await handler(request)
+        if not resp.prepared:
+            for k, v in _load_headers(engine).items():
+                resp.headers[k] = v
+        return resp
+    middlewares = [*middlewares, stamp_load_headers]
+
     app = web.Application(client_max_size=32 * 1024 * 1024,
                           middlewares=middlewares)
     app[ENGINE_KEY] = engine
@@ -885,6 +1103,7 @@ def build_app(engine: AsyncLLMEngine,
     app.router.add_post("/v1/score", score)
     app.router.add_get("/v1/models", list_models)
     app.router.add_get("/health", health)
+    app.router.add_get("/load", load)
     app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/tokenize", tokenize)
@@ -919,6 +1138,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--dtype", choices=["bfloat16", "float32"],
                    default="bfloat16")
     p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--max-waiting-seqs", type=int, default=None,
+                   help="bounded admission: shed (503 + Retry-After) "
+                        "once this many sequences queue un-admitted, "
+                        "instead of queuing forever (default: "
+                        "unbounded)")
+    p.add_argument("--max-queue-delay-ms", type=float, default=None,
+                   help="shed (503 + Retry-After) a request still "
+                        "waiting for admission after this long "
+                        "(default: never)")
     p.add_argument("--prefill-chunk", type=int, default=512)
     p.add_argument("--decode-window", type=int, default=8,
                    help="tokens generated per fused device dispatch: "
@@ -1019,6 +1247,8 @@ def main(argv=None) -> None:
         checkpoint=args.checkpoint, max_model_len=args.max_model_len,
         dtype=args.dtype, kv_dtype=args.kv_cache_dtype,
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
+        max_waiting_seqs=args.max_waiting_seqs,
+        max_queue_delay_ms=args.max_queue_delay_ms,
         decode_window=args.decode_window,
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),

@@ -21,8 +21,13 @@ Pieces (each importable on its own):
 - ``report``   — aggregation into BENCH-schema JSON and SCALEOUT_*.json
 - ``orchestrator`` — launches N engine processes + the router and
                  measures the aggregate-tokens/s-vs-replicas curve
+- ``overhead`` — router-vs-direct A/B storm (data-plane overhead ratio)
+- ``chaos``    — engine kill/restart churn under storm (availability)
+- ``overload`` — open-loop offered-QPS sweep past saturation (goodput
+                 plateau, deadline compliance, structured sheds)
 
-CLI: ``python -m production_stack_tpu.loadgen {run,soak,scaleout} ...``
+CLI: ``python -m production_stack_tpu.loadgen
+{run,soak,scaleout,overhead,chaos,overload} ...``
 (docs/benchmarks.md has the cookbook).
 
 Talks to the stack only through its public HTTP surfaces; no imports

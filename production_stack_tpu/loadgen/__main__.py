@@ -1,5 +1,5 @@
 """CLI: python -m production_stack_tpu.loadgen
-{run,soak,scaleout,overhead,chaos}
+{run,soak,scaleout,overhead,chaos,overload}
 
 run      — drive a workload (preset or --spec JSON file) against a
            running stack; print + write a BENCH-schema JSON report
@@ -15,6 +15,10 @@ chaos    — launch the router + N engines and kill/restart engines on
            a schedule while storming the router; exit 1 on any
            client-visible 5xx / router transport error
            (CHAOS_*.json)
+overload — launch router + N engines (with overload protection) and
+           sweep open-loop offered QPS past saturation; exit 1 unless
+           goodput plateaus, zero accepted requests violate their
+           deadline, and nothing 5xxes (OVERLOAD_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -30,6 +34,8 @@ from production_stack_tpu.loadgen import report as report_mod
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
+from production_stack_tpu.loadgen.overload import (overload_violations,
+                                                   run_overload)
 from production_stack_tpu.loadgen.runner import run_workload
 from production_stack_tpu.loadgen.spec import WorkloadSpec, preset
 
@@ -186,6 +192,40 @@ def cmd_chaos(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_overload(args) -> int:
+    qps = [float(x) for x in args.qps.split(",") if x.strip()]
+    record = asyncio.run(run_overload(
+        engines=args.engines, engine=args.engine, qps_points=qps,
+        duration_s=args.duration, deadline_ms=args.deadline_ms,
+        num_tokens=args.num_tokens, fake_capacity=args.fake_capacity,
+        fake_tokens_per_s=args.fake_tokens_per_s,
+        unprotected=args.unprotected,
+        plateau_tolerance=args.plateau_tolerance,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"OVERLOAD_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    if args.unprotected:
+        # the "before" curve EXISTS to show the collapse; don't fail it
+        print("unprotected baseline sweep recorded (no contract "
+              "enforced)", file=sys.stderr)
+        return 0
+    violations = overload_violations(
+        record, plateau_tolerance=args.plateau_tolerance)
+    for v in violations:
+        print(f"OVERLOAD VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        top = d["points"][-1]
+        print(f"overload PASSED: goodput peak {record['value']} qps, "
+              f"plateau held at {top['offered_qps']} qps offered "
+              f"({top['goodput_qps']} qps goodput, "
+              f"{top['shed']} shed, 0 late, 0 errors)")
+    return 1 if violations else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -327,6 +367,44 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write CHAOS_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_chaos)
+
+    sp = sub.add_parser("overload",
+                        help="router + N protected engines; sweep "
+                             "open-loop offered QPS past saturation "
+                             "and assert goodput plateaus")
+    sp.add_argument("--engines", type=int, default=2,
+                    help="engine replica count behind the router")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (overload fault mode = bounded queue) "
+                         "or a real engine model name (launched with "
+                         "--max-waiting-seqs/--max-queue-delay-ms)")
+    sp.add_argument("--qps", default="2,4,8,16",
+                    help="comma-separated offered-QPS sweep (open "
+                         "loop; the top rates should be well past "
+                         "saturation)")
+    sp.add_argument("--duration", type=parse_duration, default=15.0,
+                    help="measured window per point")
+    sp.add_argument("--deadline-ms", type=float, default=8000.0,
+                    help="x-request-deadline-ms each request carries")
+    sp.add_argument("--num-tokens", type=int, default=8)
+    sp.add_argument("--fake-capacity", type=int, default=4,
+                    help="fake engines: bounded-queue capacity")
+    sp.add_argument("--fake-tokens-per-s", type=float, default=50.0,
+                    help="fake engines: service pacing")
+    sp.add_argument("--unprotected", action="store_true",
+                    help="launch engines WITHOUT protection flags — "
+                         "the collapse baseline (no contract "
+                         "enforced, exit 0)")
+    sp.add_argument("--plateau-tolerance", type=float, default=0.10,
+                    help="goodput past the knee may dip this fraction "
+                         "under the peak")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write OVERLOAD_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_overload)
 
     return p
 

@@ -1,0 +1,319 @@
+"""Overload sweep: offered QPS pushed past saturation, goodput measured.
+
+The overload-protection layer's closed loop (ISSUE 4). The orchestrator
+launches the real router in front of N engines — real ``debug-tiny``
+processes started WITH the protection flags (``--max-waiting-seqs``,
+``--max-queue-delay-ms``), or fakes in ``overload`` fault mode — then
+drives an OPEN-loop arrival process (fixed offered QPS, concurrency
+unbounded: exactly the regime closed-loop storms cannot produce) at a
+sweep of rates from below to well past the knee. Every request carries
+an ``x-request-deadline-ms`` budget.
+
+Per-point outcome classes:
+
+- ``ok``          — HTTP 200, completed; *goodput* counts only the oks
+  that finished **within their deadline** (an accepted-then-late answer
+  is worthless to the client that set the budget).
+- ``ok_late``     — 200 but past the deadline. The protected stack's
+  contract is that this stays ZERO: anything the stack accepts, it
+  finishes in budget; everything else it sheds up front.
+- ``shed``        — 429/503 with Retry-After (router gate, endpoint
+  cap, or engine bounded admission / queue-delay cap — the headroom
+  valve) and 504 + x-deadline-expired (WAITING-drop). Expected and
+  healthy past the knee.
+- ``error``       — any other 5xx / transport failure. Always a bug.
+
+``overload_violations`` encodes the acceptance contract: goodput must
+plateau (within ``plateau_tolerance`` of its peak at every offered rate
+past the knee) instead of collapsing, zero accepted requests may
+violate their deadline, the sweep must actually saturate (sheds > 0 at
+the top rate), and nothing may 5xx. Committed records are
+``OVERLOAD_*.json`` (BENCH schema); reproduction one-liners live in
+docs/benchmarks.md "Overload: goodput under saturation".
+"""
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.loadgen.orchestrator import (_stop, free_port,
+                                                       launch_engine,
+                                                       launch_router,
+                                                       wait_healthy)
+from production_stack_tpu.loadgen.report import percentile
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+CHAT_PATH = "/v1/chat/completions"
+
+# protection knobs for the engines under test (real-engine mode); the
+# unprotected "before" curve launches without them
+ENGINE_PROTECTION_ARGS = ["--max-waiting-seqs", "8",
+                          "--max-queue-delay-ms", "4000"]
+ROUTER_OVERLOAD_ARGS = ["--failover-attempts", "3"]
+
+
+class _PointCounters:
+    def __init__(self):
+        self.launched = 0
+        self.ok = 0
+        self.ok_late = 0
+        self.shed_503 = 0
+        self.shed_429 = 0
+        self.shed_504_deadline = 0
+        self.errors = 0
+        self.latencies: List[float] = []     # e2e of in-deadline oks
+        self.samples: List[str] = []
+
+    def sample(self, text: str) -> None:
+        if len(self.samples) < 6:
+            self.samples.append(text[:160])
+
+
+async def _one_request(session: aiohttp.ClientSession, url: str,
+                       payload: bytes, deadline_ms: float,
+                       timeout: aiohttp.ClientTimeout,
+                       c: _PointCounters) -> None:
+    t0 = time.monotonic()
+    try:
+        async with session.post(
+                f"{url}{CHAT_PATH}", data=payload,
+                headers={"Content-Type": "application/json",
+                         "x-request-deadline-ms": str(int(deadline_ms))},
+                timeout=timeout) as resp:
+            body = await resp.read()
+            e2e = time.monotonic() - t0
+            if resp.status == 200:
+                if e2e <= deadline_ms / 1e3:
+                    c.ok += 1
+                    c.latencies.append(e2e)
+                else:
+                    c.ok_late += 1
+                    c.sample(f"accepted but late: {e2e * 1e3:.0f}ms > "
+                             f"{deadline_ms:.0f}ms budget")
+            elif resp.status in (429, 503) and \
+                    "Retry-After" in resp.headers:
+                if resp.status == 429:
+                    c.shed_429 += 1
+                else:
+                    c.shed_503 += 1
+            elif resp.status == 504 and \
+                    "x-deadline-expired" in resp.headers:
+                c.shed_504_deadline += 1
+            else:
+                c.errors += 1
+                c.sample(f"HTTP {resp.status}: "
+                         f"{body[:120].decode('utf-8', 'replace')}")
+    except (aiohttp.ClientError, ConnectionError, OSError,
+            asyncio.TimeoutError) as e:
+        c.errors += 1
+        c.sample(f"{type(e).__name__}: {e}")
+
+
+async def measure_point(url: str, model: str, *, qps: float,
+                        duration_s: float, deadline_ms: float,
+                        num_tokens: int,
+                        settle_s: float = 2.0) -> Dict:
+    """One open-loop point: fire at ``qps`` for ``duration_s`` (fixed
+    inter-arrival 1/qps — the rate, not the burstiness, is the variable
+    under test), then wait for stragglers up to the deadline."""
+    c = _PointCounters()
+    payload = json.dumps({
+        "model": model,
+        "messages": [{"role": "user", "content": "overload probe"}],
+        "max_tokens": num_tokens,
+    }).encode()
+    # client timeout well past the deadline: a stack that neither
+    # answers nor sheds within 5x budget shows up as an error, not a
+    # hang
+    timeout = aiohttp.ClientTimeout(
+        total=max(30.0, 5.0 * deadline_ms / 1e3))
+    tasks: List[asyncio.Task] = []
+    interval = 1.0 / qps
+    async with aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0)) as session:
+        t0 = time.monotonic()
+        next_at = t0
+        while True:
+            now = time.monotonic()
+            if now >= t0 + duration_s:
+                break
+            if now < next_at:
+                await asyncio.sleep(next_at - now)
+            next_at += interval
+            c.launched += 1
+            tasks.append(asyncio.create_task(_one_request(
+                session, url, payload, deadline_ms, timeout, c)))
+        # the offered window ends here; stragglers drain afterwards.
+        # Rates divide by the LAUNCH window, not launch+drain — drain
+        # length scales with queue depth, so folding it in would
+        # deflate the saturated points relative to the light ones and
+        # fake a plateau violation.
+        launch_elapsed = time.monotonic() - t0
+        if tasks:
+            await asyncio.wait(tasks,
+                               timeout=timeout.total + settle_s)
+        for t in tasks:
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        drain_elapsed = time.monotonic() - t0 - launch_elapsed
+    shed = c.shed_429 + c.shed_503 + c.shed_504_deadline
+    elapsed = launch_elapsed
+    return {
+        "offered_qps": round(qps, 3),
+        "duration_s": round(launch_elapsed, 2),
+        "drain_s": round(drain_elapsed, 2),
+        "launched": c.launched,
+        "ok": c.ok,
+        "ok_late": c.ok_late,
+        "shed": shed,
+        "shed_429": c.shed_429,
+        "shed_503": c.shed_503,
+        "shed_504_deadline": c.shed_504_deadline,
+        "errors": c.errors,
+        "goodput_qps": round(c.ok / max(elapsed, 1e-9), 3),
+        "shed_rate": round(shed / max(c.launched, 1), 4),
+        "accepted_p50_ms": round(
+            1e3 * percentile(c.latencies, 50), 1),
+        "accepted_p99_ms": round(
+            1e3 * percentile(c.latencies, 99), 1),
+        "error_samples": c.samples,
+    }
+
+
+def overload_violations(record: Dict,
+                        plateau_tolerance: float = 0.10) -> List[str]:
+    """The sweep's pass/fail contract (CLI exits 1 on any)."""
+    d = record["detail"]
+    points = d["points"]
+    out = []
+    late = sum(p["ok_late"] for p in points)
+    if late:
+        out.append(f"{late} accepted requests finished past their "
+                   f"deadline (accepted => in-budget must hold)")
+    errors = sum(p["errors"] for p in points)
+    if errors:
+        out.append(f"{errors} non-shed errors (sheds are structured "
+                   f"429/503/504; anything else is a bug)")
+    if not points:
+        return out + ["no points measured"]
+    if points[-1]["shed"] == 0:
+        out.append("the top offered rate never shed: the sweep did "
+                   "not reach saturation (raise --qps)")
+    peak = max(p["goodput_qps"] for p in points)
+    knee_idx = max(range(len(points)),
+                   key=lambda i: points[i]["goodput_qps"])
+    floor = (1.0 - plateau_tolerance) * peak
+    for p in points[knee_idx + 1:]:
+        if p["goodput_qps"] < floor:
+            out.append(
+                f"goodput collapsed past the knee: {p['goodput_qps']} "
+                f"qps at offered {p['offered_qps']} (< {floor:.2f}, "
+                f"{100 * plateau_tolerance:.0f}% under the "
+                f"{peak} peak)")
+    return out
+
+
+async def run_overload(*, engines: int = 2,
+                       engine: str = "fake",
+                       qps_points: Optional[List[float]] = None,
+                       duration_s: float = 15.0,
+                       deadline_ms: float = 8000.0,
+                       num_tokens: int = 8,
+                       fake_capacity: int = 4,
+                       fake_tokens_per_s: float = 50.0,
+                       unprotected: bool = False,
+                       plateau_tolerance: float = 0.10,
+                       platform: str = "cpu",
+                       log_dir: str = "loadgen-logs",
+                       startup_timeout_s: float = 420.0,
+                       router_extra_args: Optional[List[str]] = None
+                       ) -> Dict:
+    """Launch router + N engines and sweep offered QPS; return the
+    OVERLOAD record (BENCH schema; headline = peak goodput)."""
+    if qps_points is None:
+        qps_points = [2.0, 4.0, 8.0, 16.0]
+    procs = []
+    try:
+        extra = None
+        if engine == "fake":
+            # bounded fake queue: the overload fault mode IS the
+            # protection under test on the fake path. Service time is
+            # modeled as TTFT (the fake only paces token emission on
+            # streaming responses; the sweep posts non-streaming)
+            service_s = num_tokens / max(fake_tokens_per_s, 1e-9)
+            extra = ["--ttft", f"{service_s:.4f}",
+                     "--num-tokens", str(num_tokens)]
+            if not unprotected:
+                extra += ["--fault", "overload",
+                          "--fault-arg", str(fake_capacity)]
+        elif not unprotected:
+            extra = list(ENGINE_PROTECTION_ARGS)
+        engine_procs = [launch_engine(engine, free_port(),
+                                      log_dir=log_dir,
+                                      platform=platform,
+                                      extra_args=extra)
+                        for _ in range(engines)]
+        procs.extend(engine_procs)
+        await asyncio.gather(*[wait_healthy(e.url, startup_timeout_s)
+                               for e in engine_procs])
+        model = "fake-model" if engine == "fake" else engine
+        router = launch_router(
+            [e.url for e in engine_procs], model, free_port(),
+            routing="least_loaded", log_dir=log_dir,
+            extra_args=(ROUTER_OVERLOAD_ARGS
+                        + ["--engine-stats-interval", "1"]
+                        + (router_extra_args or [])))
+        procs.append(router)
+        await wait_healthy(router.url, 60.0, require_endpoints=engines)
+        if engine == "fake" and not unprotected:
+            # give the stats scraper one interval to pick up the
+            # advertised capacity before the first point
+            await asyncio.sleep(1.5)
+
+        points: List[Dict] = []
+        for qps in qps_points:
+            logger.info("overload point: %.1f qps offered for %.0fs "
+                        "(deadline %.0fms)", qps, duration_s,
+                        deadline_ms)
+            p = await measure_point(router.url, model, qps=qps,
+                                    duration_s=duration_s,
+                                    deadline_ms=deadline_ms,
+                                    num_tokens=num_tokens)
+            points.append(p)
+            logger.info("  -> goodput %.2f qps, %d ok / %d late / "
+                        "%d shed / %d errors, accepted p99 %.0fms",
+                        p["goodput_qps"], p["ok"], p["ok_late"],
+                        p["shed"], p["errors"], p["accepted_p99_ms"])
+            await asyncio.sleep(1.0)     # drain between points
+    finally:
+        _stop(procs)
+
+    peak = max((p["goodput_qps"] for p in points), default=0.0)
+    return {
+        "metric": "goodput (accepted-and-in-deadline qps) vs offered "
+                  "qps past saturation "
+                  + ("(UNPROTECTED baseline)" if unprotected else
+                     "(overload protection on)"),
+        "value": peak,
+        "unit": "goodput_qps",
+        "platform": platform,
+        "detail": {
+            "engine": engine, "engines": engines,
+            "protected": not unprotected,
+            "deadline_ms": deadline_ms,
+            "num_tokens": num_tokens,
+            "duration_s_per_point": duration_s,
+            "plateau_tolerance": plateau_tolerance,
+            "engine_args": (None if unprotected else
+                            (f"overload fault, capacity {fake_capacity}"
+                             if engine == "fake"
+                             else " ".join(ENGINE_PROTECTION_ARGS))),
+            "points": points,
+        },
+    }

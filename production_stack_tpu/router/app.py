@@ -88,6 +88,7 @@ async def health(request: web.Request) -> web.Response:
                                   if tracker is None
                                   or tracker.is_routable(ep.url)]),
         "breakers": tracker.snapshot() if tracker else {},
+        "sheds": dict(state.get("shed_counts") or {}),
         "draining": state.get("draining_listener", False),
         "dynamic_config": watcher.current.to_json()
         if watcher and watcher.current else None,
@@ -147,6 +148,7 @@ async def metrics(request: web.Request) -> web.Response:
     else:
         healthy = len(endpoints)
     state["metrics"].refresh(state["request_stats"].get(), healthy)
+    state["metrics"].refresh_overload(state["shed_counts"])
     if tracker is not None:
         state["metrics"].refresh_resilience(tracker)
     if state.get("semantic_cache") is not None:
@@ -169,6 +171,13 @@ def build_app(args: argparse.Namespace) -> web.Application:
         "client_timeout": aiohttp.ClientTimeout(
             total=args.request_timeout),
         "auth_overlay": engine_auth_headers(),
+        # downstream deadline injected when the client sent none: the
+        # engine may drop the request from its queue the moment the
+        # router's own --request-timeout would have fired anyway
+        # (proxy._forward_headers; engine/server.py DEADLINE_HEADER)
+        "deadline_overlay": {
+            "x-request-deadline-ms":
+                str(int(args.request_timeout * 1000))},
         "metrics": RouterMetrics(),
         "request_stats": RequestStatsMonitor(
             horizon_s=args.request_stats_window,
@@ -186,6 +195,13 @@ def build_app(args: argparse.Namespace) -> web.Application:
         "failover_attempts": max(1, args.failover_attempts),
         "inflight": 0,
         "draining_listener": False,
+        # overload protection (proxy.route_general_request): the
+        # router-wide admission gate, the per-endpoint concurrency cap
+        # override, and the shed accounting /metrics exports
+        "max_inflight": max(0, args.max_inflight),
+        "endpoint_cap": args.endpoint_inflight_cap,
+        "proxied_inflight": 0,
+        "shed_counts": {"admission": 0, "endpoint_cap": 0},
     }
     app["state"] = state
 
@@ -366,6 +382,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="max backend attempts per request for failures "
                         "occurring before any byte reaches the client "
                         "(1 disables failover)")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="router-wide admission gate: shed with 429 + "
+                        "Retry-After once this many proxied requests "
+                        "are in flight (0 = unlimited)")
+    p.add_argument("--endpoint-inflight-cap", type=int, default=0,
+                   help="static per-endpoint concurrency cap; 0 derives "
+                        "the cap from each engine's advertised capacity "
+                        "(tpu:engine_capacity_seqs on /metrics; engines "
+                        "with unbounded admission stay uncapped)")
     p.add_argument("--retry-budget", type=float, default=0.2,
                    help="failover retries allowed as a fraction of "
                         "request volume (token bucket; bounds retry "

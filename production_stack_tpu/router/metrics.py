@@ -57,6 +57,15 @@ class RouterMetrics:
         self.breaker_opens = Gauge(
             "vllm:breaker_opens_total",
             "Circuit-breaker open transitions", registry=self.registry)
+        # overload-protection surface: requests the ROUTER itself shed
+        # ("admission" = --max-inflight gate -> 429; "endpoint_cap" =
+        # every candidate at its concurrency cap -> 503). Upstream
+        # sheds the router observed live in
+        # vllm:upstream_failures_total{kind="shed"|"deadline"}.
+        self.router_sheds = Gauge(
+            "vllm:router_sheds_total",
+            "Requests shed by the router by scope",
+            ["scope"], registry=self.registry)
         # semantic-cache surface (reference:
         # semantic_cache_integration.py:25-44 gauge names)
         def plain(name, doc):
@@ -145,6 +154,10 @@ class RouterMetrics:
             lambda k: self.breaker_state.labels(server=k[0]).set(
                 state_code.get(snap[k[0]]["state"], 0)))
         self.breaker_opens.set(tracker.breaker_opens)
+
+    def refresh_overload(self, shed_counts: dict) -> None:
+        for scope, count in shed_counts.items():
+            self.router_sheds.labels(scope=scope).set(count)
 
     def refresh_semantic_cache(self, cache) -> None:
         self.semantic_hits.set(cache.hits)

@@ -68,6 +68,11 @@ CACHE_CONTROL_FIELDS = ("skip_cache", "cache_similarity_threshold")
 # or an event stream) goes through the chunk relay loop
 BUFFERED_RESPONSE_MAX = 4 * 1024 * 1024
 
+# overload-protection wire signals (mirrored in engine/server.py; the
+# router must not import the engine package)
+DEADLINE_HEADER = "x-request-deadline-ms"
+DEADLINE_MARKER = "x-deadline-expired"
+
 
 def _copy_backend_headers(resp: web.StreamResponse,
                           backend: aiohttp.ClientResponse) -> None:
@@ -115,7 +120,8 @@ def _can_retry(attempt: int, max_attempts: int, tried: set,
             and (budget is None or budget.try_spend()))
 
 
-def _forward_headers(request: web.Request, auth_overlay: dict) -> dict:
+def _forward_headers(request: web.Request, auth_overlay: dict,
+                     deadline_overlay: Optional[dict] = None) -> dict:
     headers = {k: v for k, v in request.headers.items()
                if k.lower() not in HOP_HEADERS}
     # membership test on the CIMultiDict (case-insensitive): a lowercase
@@ -126,12 +132,71 @@ def _forward_headers(request: web.Request, auth_overlay: dict) -> dict:
     # client-provided Bearer always passes through untouched
     if auth_overlay and "Authorization" not in request.headers:
         headers.update(auth_overlay)
+    # deadline propagation: the client's x-request-deadline-ms passes
+    # through untouched (it is not hop-by-hop); when the client sent
+    # none, the router's own --request-timeout becomes the downstream
+    # deadline so the engine can drop the request from its queue the
+    # moment the router would have given up on it anyway
+    if deadline_overlay and DEADLINE_HEADER not in request.headers:
+        headers.update(deadline_overlay)
     return headers
+
+
+def _endpoint_cap(state, url: str, scraper_stats=None) -> float:
+    """Concurrency cap for one endpoint: the static override
+    (--endpoint-inflight-cap) when set, else the capacity the engine
+    advertises on /metrics (tpu:engine_capacity_seqs, scraped by
+    EngineStatsScraper; 0 = unbounded admission -> no cap).
+    ``scraper_stats`` lets the failover loop snapshot the scraper once
+    per routing pass instead of once per candidate."""
+    static = state.get("endpoint_cap") or 0
+    if static > 0:
+        return float(static)
+    if scraper_stats is None:
+        scraper = state.get("scraper")
+        if scraper is None:
+            return float("inf")
+        scraper_stats = scraper.get()
+    es = scraper_stats.get(url)
+    if es is None or es.capacity <= 0:
+        return float("inf")
+    return es.capacity
+
+
+def _shed_response(status: int, message: str,
+                   retry_after_s: float = 1.0) -> web.Response:
+    resp = web.json_response(
+        {"error": {"message": message, "type": "overloaded_error"}},
+        status=status)
+    resp.headers["Retry-After"] = str(max(1, int(retry_after_s)))
+    return resp
 
 
 async def route_general_request(request: web.Request,
                                 endpoint_path: str) -> web.StreamResponse:
-    """Proxy `request` to an engine chosen by the app's routing policy."""
+    """Proxy `request` to an engine chosen by the app's routing policy.
+
+    Router-wide admission gate first (--max-inflight): past the bound,
+    shed with 429 + Retry-After BEFORE parsing the body — protecting
+    the router's own event loop is the last line of defense when every
+    engine-side bound has already been blown through."""
+    state = request.app["state"]
+    max_inflight = state.get("max_inflight") or 0
+    if max_inflight and state["proxied_inflight"] >= max_inflight:
+        state["shed_counts"]["admission"] += 1
+        return _shed_response(
+            429, f"router overloaded: {state['proxied_inflight']} "
+                 f"requests already in flight (--max-inflight "
+                 f"{max_inflight}); retry later")
+    state["proxied_inflight"] += 1
+    try:
+        return await _proxy_request(request, endpoint_path)
+    finally:
+        state["proxied_inflight"] -= 1
+
+
+async def _proxy_request(request: web.Request,
+                         endpoint_path: str) -> web.StreamResponse:
     app = request.app
     state = app["state"]
     t_route0 = time.monotonic()
@@ -218,7 +283,8 @@ async def route_general_request(request: web.Request,
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
-    fwd_headers = _forward_headers(request, state["auth_overlay"])
+    fwd_headers = _forward_headers(request, state["auth_overlay"],
+                                   state.get("deadline_overlay"))
     budget = state.get("retry_budget")
     if budget is not None:
         budget.on_request()
@@ -227,6 +293,9 @@ async def route_general_request(request: web.Request,
     attempt = 0
     last_failure = ""      # human-readable cause of the final attempt
     timed_out = False      # 504 vs 502 on exhaustion
+    shed_rerouted = False  # one re-route per request on upstream shed
+    prefer_least_loaded = False
+    last_was_shed = False  # exhaustion after a shed relays 503, not 502
 
     # bounded pre-stream failover loop: a connect error, refusal,
     # timeout, or backend 5xx *before any byte reached the client* marks
@@ -244,8 +313,38 @@ async def route_general_request(request: web.Request,
         # routing reads the TTL-cached snapshot: window aggregates at
         # most snapshot_ttl_s stale, in-flight counters live
         request_stats = state["request_stats"].snapshot()
-        url = state["router"].route(pool, request_stats,
-                                    request.headers, body)
+        # per-endpoint concurrency cap (advertised engine capacity or
+        # --endpoint-inflight-cap): endpoints already at their cap are
+        # invisible to routing; with EVERY candidate at its cap the
+        # router sheds here instead of piling more onto engines that
+        # would only shed it themselves one hop later
+        scraper = state.get("scraper")
+        scraper_stats = scraper.get() if scraper is not None else {}
+        under_cap = [ep for ep in pool
+                     if (request_stats.get(ep.url) is None
+                         or request_stats[ep.url].in_flight
+                         < _endpoint_cap(state, ep.url, scraper_stats))]
+        if under_cap:
+            pool = under_cap
+        elif attempt == 0:
+            state["shed_counts"]["endpoint_cap"] += 1
+            return _shed_response(
+                503, "all backends at their concurrency cap; retry "
+                     "after the indicated delay")
+        else:
+            break      # mid-failover: relay the recorded failure
+        if prefer_least_loaded:
+            # post-shed re-route: go straight to the least-loaded
+            # healthy endpoint (the policy's pick — e.g. a sticky
+            # session's home — is the one that just shed); the ring
+            # itself is untouched, so the session is NOT rehomed
+            prefer_least_loaded = False
+            url = min(pool, key=lambda ep:
+                      request_stats[ep.url].in_flight
+                      if ep.url in request_stats else 0).url
+        else:
+            url = state["router"].route(pool, request_stats,
+                                        request.headers, body)
         attempt += 1
         if attempt == 1:
             logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path,
@@ -260,13 +359,42 @@ async def route_general_request(request: web.Request,
                     headers=fwd_headers,
                     timeout=state["client_timeout"],
             ) as backend:
-                if backend.status >= 500:
+                shed = (backend.status in (429, 503)
+                        and "Retry-After" in backend.headers)
+                if shed:
+                    # overload shed: the engine is healthy but full.
+                    # NEVER a breaker signal (resilience.record_shed);
+                    # re-route ONCE to the least-loaded healthy
+                    # endpoint, then relay the 503/Retry-After so the
+                    # client backs off instead of the router amplifying
+                    # the overload with retries
+                    if health is not None:
+                        health.record_shed(url)
+                    last_failure = f"backend shed (HTTP {backend.status})"
+                    last_was_shed = True
+                    if not shed_rerouted and _can_retry(
+                            attempt, max_attempts, tried, candidates,
+                            budget):
+                        shed_rerouted = True
+                        prefer_least_loaded = True
+                        retry_cause = "shed"
+                        continue
+                elif (backend.status == 504
+                        and DEADLINE_MARKER in backend.headers):
+                    # the CLIENT's deadline expired in the engine's
+                    # queue: relay verbatim — re-trying a request whose
+                    # budget is spent helps nobody, and the engine did
+                    # nothing wrong (no breaker signal)
+                    if health is not None:
+                        health.record_deadline_relay(url)
+                elif backend.status >= 500:
                     # upstream failure that never reached the client:
                     # breaker signal, then either fail over or (when
                     # retries are exhausted) relay the backend's answer
                     if health is not None:
                         health.record_failure(url, "http_5xx")
                     last_failure = f"backend HTTP {backend.status}"
+                    last_was_shed = False
                     if _can_retry(attempt, max_attempts, tried,
                                   candidates, budget):
                         retry_cause = last_failure
@@ -353,6 +481,7 @@ async def route_general_request(request: web.Request,
             last_failure = (f"backend timed out after "
                             f"{state['request_timeout']:g}s")
             timed_out = True
+            last_was_shed = False
             if _can_retry(attempt, max_attempts, tried, candidates,
                           budget):
                 retry_cause = "timeout"
@@ -372,6 +501,7 @@ async def route_general_request(request: web.Request,
                 health.record_failure(url, "connect")
             last_failure = f"backend error: {e}"
             timed_out = False
+            last_was_shed = False
             if _can_retry(attempt, max_attempts, tried, candidates,
                           budget):
                 retry_cause = str(e)
@@ -392,6 +522,11 @@ async def route_general_request(request: web.Request,
         return web.json_response(
             {"error": {"message": last_failure or "backend timed out",
                        "type": "timeout_error"}}, status=504)
+    if last_was_shed:
+        # the final word was an overload shed (e.g. shed -> re-route ->
+        # every remaining candidate at its cap): the client must see
+        # the back-off signal, not a sick-fleet 502
+        return _shed_response(503, last_failure or "backend shed")
     return web.json_response(
         {"error": {"message": last_failure or "no routable backend",
                    "type": "server_error"}}, status=502)

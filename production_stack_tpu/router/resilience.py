@@ -41,8 +41,14 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
-# passive failure kinds the data plane reports (metrics label values)
+# passive failure kinds the data plane reports (metrics label values).
+# The first five feed the breaker; the INFORMATIONAL kinds are counted
+# in vllm:upstream_failures_total but NEVER enter breaker math —
+# "shed" (429/503 + Retry-After: the engine is healthy but full; see
+# record_shed) and "deadline" (engine 504 + x-deadline-expired: the
+# CLIENT's budget elapsed, nothing is wrong with the engine).
 FAILURE_KINDS = ("connect", "timeout", "http_5xx", "mid_stream", "probe")
+INFORMATIONAL_KINDS = ("shed", "deadline")
 
 
 class _EndpointHealth:
@@ -175,6 +181,21 @@ class HealthTracker:
                 self._note(h, True, self._now())
         else:
             self.record_failure(url, "probe")
+
+    def record_shed(self, url: str) -> None:
+        """An upstream 429/503-with-Retry-After: the engine shed the
+        request under overload protection. Shed ≠ sick — counted (for
+        vllm:upstream_failures_total{kind="shed"}) but deliberately
+        excluded from consecutive-failure and windowed-rate breaker
+        math: a full-but-healthy engine must never trip its breaker
+        open (that would dogpile its load onto the remaining fleet and
+        cascade the overload)."""
+        self.failures[(url, "shed")] += 1
+
+    def record_deadline_relay(self, url: str) -> None:
+        """An upstream 504 marked x-deadline-expired: the client's own
+        deadline elapsed while queued. Counter-only, same rationale."""
+        self.failures[(url, "deadline")] += 1
 
     def note_retry(self, url: str) -> None:
         self.retries[url] += 1

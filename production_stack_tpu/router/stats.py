@@ -235,12 +235,19 @@ class EngineStats:
     num_waiting: float = 0.0
     kv_usage: float = 0.0          # vllm:gpu_cache_usage_perc | tpu:hbm_kv
     prefix_hit_rate: float = 0.0
+    # overload-protection signals (engine/metrics.py): the total
+    # in-flight the engine accepts before shedding (0 = unbounded
+    # admission — no per-endpoint concurrency cap derivable) and its
+    # own queue-delay estimate
+    capacity: float = 0.0
+    est_queue_delay_ms: float = 0.0
     scraped_at: float = field(default_factory=time.time)
 
 
 _WANTED_GAUGES = ("vllm:num_requests_running", "vllm:num_requests_waiting",
                   "vllm:gpu_cache_usage_perc", "tpu:hbm_kv_usage_perc",
-                  "vllm:gpu_prefix_cache_hit_rate")
+                  "vllm:gpu_prefix_cache_hit_rate",
+                  "tpu:engine_capacity_seqs", "tpu:est_queue_delay_ms")
 
 
 def parse_engine_metrics(text: str) -> EngineStats:
@@ -257,6 +264,8 @@ def parse_engine_metrics(text: str) -> EngineStats:
         num_waiting=values.get("vllm:num_requests_waiting", 0.0),
         kv_usage=kv,
         prefix_hit_rate=values.get("vllm:gpu_prefix_cache_hit_rate", 0.0),
+        capacity=values.get("tpu:engine_capacity_seqs", 0.0),
+        est_queue_delay_ms=values.get("tpu:est_queue_delay_ms", 0.0),
     )
 
 

@@ -19,6 +19,21 @@ to the next ``count`` requests (-1 = until cleared):
                        connection (bytes already relayed: truncation)
 - ``slow_ttft``      — add ``arg`` seconds (default 1.0) before the
                        first byte
+- ``overload``       — bounded fake queue: at most ``arg`` (default 1)
+                       concurrent inference requests; overflow answers
+                       503 + ``Retry-After`` (the engine-side shed the
+                       router must treat as shed-not-sick). Persistent
+                       while set (``count`` is ignored); also
+                       advertises ``tpu:engine_capacity_seqs`` = arg
+                       so the router's capacity-derived endpoint cap
+                       is testable — except ``arg 0`` (shed
+                       everything), which cannot be advertised because
+                       gauge 0 is the "unbounded admission" sentinel;
+                       zero-capacity fakes rely on the engine-side
+                       shed alone. Pace service with ``tokens_per_s``.
+- ``deadline``       — answer 504 + ``x-deadline-expired`` (what a real
+                       engine returns when the client's
+                       x-request-deadline-ms expired in its queue)
 
 ``scope: "all"`` extends reset/error/stall to ``/v1/models`` too, so
 health probes fail along with inference (a fully-dead engine); the
@@ -35,7 +50,8 @@ from typing import Optional
 from aiohttp import web
 
 
-FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft")
+FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft",
+               "overload", "deadline")
 
 
 class FakeEngine:
@@ -52,14 +68,21 @@ class FakeEngine:
             "vllm:gpu_cache_usage_perc": 0.0,
             "tpu:hbm_kv_usage_perc": 0.0,
             "vllm:gpu_prefix_cache_hit_rate": 0.0,
+            "tpu:engine_capacity_seqs": 0.0,
+            "tpu:est_queue_delay_ms": 0.0,
         }
         self.requests_seen = []          # (path, user header, model)
         self.last_chat_body = ""         # JSON text of the last chat request
         self.last_raw = b""              # exact bytes of the last POST body
+        self.last_headers = {}           # headers of the last inference POST
         self._in_flight = 0
         # {"mode": ..., "count": int (-1 = persistent), "arg": float,
         #  "scope": "inference" | "all"}
         self.fault: Optional[dict] = dict(fault) if fault else None
+        if self.fault and self.fault.get("mode") == "overload":
+            arg = self.fault.get("arg")
+            self.gauges["tpu:engine_capacity_seqs"] = \
+                1.0 if arg is None else float(arg)
         self.faults_served = 0
 
     def build_app(self) -> web.Application:
@@ -68,6 +91,7 @@ class FakeEngine:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_get("/v1/models", self.models)
         app.router.add_get("/health", self.health)
+        app.router.add_get("/load", self.load)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/fault", self.set_fault)
         app.router.add_get("/fault", self.get_fault)
@@ -90,8 +114,19 @@ class FakeEngine:
             return None
         if path == "/v1/models":
             if f.get("scope", "inference") != "all" or \
-                    mode in ("die_mid_stream", "slow_ttft"):
+                    mode in ("die_mid_stream", "slow_ttft", "overload",
+                             "deadline"):
                 return None
+        if mode == "overload":
+            # persistent capacity gate, not a per-request burst: only
+            # an OVERFLOW consumes a fault application (and never the
+            # count — clearing is explicit via POST /fault). arg 0 is a
+            # zero-capacity engine (sheds everything).
+            cap = 1 if f.get("arg") is None else int(f["arg"])
+            if self._in_flight >= cap:
+                self.faults_served += 1
+                return dict(f)
+            return None
         count = f.get("count", -1)
         if count == 0:
             self.fault = None
@@ -115,6 +150,18 @@ class FakeEngine:
             return web.json_response(
                 {"error": {"message": "injected fault: internal error",
                            "type": "server_error"}}, status=500)
+        if mode == "overload":
+            resp = web.json_response(
+                {"error": {"message": "injected overload: queue full",
+                           "type": "overloaded_error"}}, status=503)
+            resp.headers["Retry-After"] = "1"
+            return resp
+        if mode == "deadline":
+            resp = web.json_response(
+                {"error": {"message": "injected deadline expiry",
+                           "type": "timeout_error"}}, status=504)
+            resp.headers["x-deadline-expired"] = "1"
+            return resp
         if mode == "stall":
             await asyncio.sleep(fault.get("arg") or 3600.0)
             return None
@@ -154,6 +201,14 @@ class FakeEngine:
                       "count": int(body.get("count", -1)),
                       "arg": body.get("arg"),
                       "scope": body.get("scope", "inference")}
+        # an overloaded fake advertises its capacity like a real engine
+        # with --max-waiting-seqs would (router cap derivation)
+        if mode == "overload":
+            arg = self.fault.get("arg")
+            self.gauges["tpu:engine_capacity_seqs"] = \
+                1.0 if arg is None else float(arg)
+        else:
+            self.gauges["tpu:engine_capacity_seqs"] = 0.0
         return web.json_response({"fault": self.fault})
 
     async def get_fault(self, request: web.Request) -> web.Response:
@@ -161,6 +216,7 @@ class FakeEngine:
                                   "faults_served": self.faults_served})
 
     async def chat(self, request: web.Request) -> web.StreamResponse:
+        self.last_headers = dict(request.headers)
         fault = self._take_fault("/v1/chat/completions")
         if fault is not None:
             faulted = await self._apply_fault(request, fault)
@@ -212,6 +268,7 @@ class FakeEngine:
             self.gauges["vllm:num_requests_running"] = float(self._in_flight)
 
     async def completions(self, request: web.Request) -> web.Response:
+        self.last_headers = dict(request.headers)
         fault = self._take_fault("/v1/completions")
         if fault is not None:
             faulted = await self._apply_fault(request, fault)
@@ -244,6 +301,23 @@ class FakeEngine:
 
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
+
+    async def load(self, request: web.Request) -> web.Response:
+        """Mirror of the real engine's /load report."""
+        f = self.fault or {}
+        cap = None
+        if f.get("mode") == "overload":
+            cap = 1 if f.get("arg") is None else int(f["arg"])
+        return web.json_response({
+            "queue_depth": 0,
+            "running": self._in_flight,
+            "max_num_seqs": cap if cap else 8,
+            "max_waiting_seqs": 0 if cap is not None else None,
+            "capacity": cap,
+            "free_kv_blocks": 1024,
+            "kv_usage": self.gauges["tpu:hbm_kv_usage_perc"],
+            "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
+        })
 
     async def metrics(self, request: web.Request) -> web.Response:
         lines = []

@@ -489,16 +489,26 @@ def test_disconnect_while_queued_aborts(engine):
             "ignore_eos": True}) for i in range(2)]   # fill both slots
         for r in busy:
             await r.content.readany()
-        queued = await client.post("/v1/chat/completions", json={
-            "model": "debug-tiny",
-            "messages": [{"role": "user", "content": "stuck in queue"}],
-            "max_tokens": 5, "temperature": 0.0, "stream": True})
+        # SSE responses are prepared lazily (headers ride with the
+        # first payload so pre-stream sheds stay structured 503/504):
+        # post() for a queued request does not return until admission,
+        # so drive it as a task and cancel it while still WAITING
+        queued_task = asyncio.ensure_future(client.post(
+            "/v1/chat/completions", json={
+                "model": "debug-tiny",
+                "messages": [{"role": "user",
+                              "content": "stuck in queue"}],
+                "max_tokens": 5, "temperature": 0.0, "stream": True}))
         for _ in range(100):
             if sched.num_waiting >= 1:
                 break
             await asyncio.sleep(0.05)
         assert sched.num_waiting >= 1
-        queued.close()                 # leave while still queued
+        queued_task.cancel()           # leave while still queued
+        try:
+            await queued_task
+        except asyncio.CancelledError:
+            pass
         for _ in range(200):
             if sched.num_waiting == 0:
                 break
@@ -593,7 +603,8 @@ def test_stream_disconnect_abort_survives_shutdown_pool():
     eng._lock_pool = ThreadPoolExecutor(max_workers=1)
     eng._lock_pool.shutdown()
 
-    async def fake_submit(prompt_tokens, options, model=None):
+    async def fake_submit(prompt_tokens, options, model=None,
+                          deadline=None):
         q = asyncio.Queue()
         eng._queues["s1"] = q
         return "s1", q
