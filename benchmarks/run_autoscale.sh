@@ -1,0 +1,28 @@
+#!/bin/bash
+# Closed-loop autoscaling (BASELINE.md Round 10): real router with
+# dynamic-config hot reload + an autoscaler-owned engine fleet, driven
+# through an open-loop offered-QPS ramp shaped up then down. Replicas
+# must track the ramp (1 -> N -> 1), every scale-down must drain
+# clean, zero client-visible 5xx across every scale event, peak-phase
+# goodput must track offered load AND beat the same ramp measured with
+# a fixed N=1 fleet by a clear margin (the comparison run is appended
+# to the record automatically). Exit 1 on any violation. Thin wrapper
+# — all logic lives in production_stack_tpu/loadgen/autoscale.py; this
+# pins the knobs the committed AUTOSCALE_*.json numbers used.
+#
+#   benchmarks/run_autoscale.sh [engine] [qps-profile] [out.json]
+#
+# Default engine is the bounded fake (the rig measures the control
+# loop, not model compute); pass debug-tiny for the real-engine ramp
+# (slow: each scale-up pays a real XLA warmup):
+#   benchmarks/run_autoscale.sh debug-tiny 0.5,1.5,3,1.5,0.5
+set -euo pipefail
+
+ENGINE="${1:-fake}"
+QPS="${2:-4,12,24,12,4}"
+OUT="${3:-AUTOSCALE_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen autoscale \
+  --engine "$ENGINE" --qps "$QPS" --phase-duration 15s \
+  --max-replicas 3 --deadline-ms 8000 \
+  ${EXTRA_ARGS:-} --output "$OUT"

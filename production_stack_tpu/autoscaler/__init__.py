@@ -1,0 +1,24 @@
+"""Closed-loop autoscaler: load-signal-driven replica scaling.
+
+The in-process alternative to the Kubernetes HPA shipped under
+``observability/`` — both drive replica count off the same engine
+signals (``tpu:est_queue_delay_ms``, ``tpu:engine_capacity_seqs``);
+this one closes the loop in-repo, testably, with drain-safe
+scale-down. See docs/autoscaling.md.
+"""
+
+from production_stack_tpu.autoscaler.actuator import (Actuator,
+                                                      KubernetesActuator,
+                                                      LocalProcessActuator)
+from production_stack_tpu.autoscaler.collector import SignalCollector
+from production_stack_tpu.autoscaler.controller import (Autoscaler,
+                                                        AutoscalerMetrics)
+from production_stack_tpu.autoscaler.policy import (AutoscalerPolicy,
+                                                    Decision, FleetSignal,
+                                                    PolicyConfig)
+
+__all__ = [
+    "Actuator", "Autoscaler", "AutoscalerMetrics", "AutoscalerPolicy",
+    "Decision", "FleetSignal", "KubernetesActuator",
+    "LocalProcessActuator", "PolicyConfig", "SignalCollector",
+]

@@ -1,0 +1,171 @@
+"""CLI: python -m production_stack_tpu.autoscaler — standalone controller.
+
+Runs the collect->decide->actuate loop against an already-running
+fleet. Two actuator modes:
+
+- ``--k8s-deployment NAME`` — KubernetesActuator. Dry-run by default:
+  every tick's would-be ``spec.replicas`` patch is logged instead of
+  applied, which makes this a zero-risk shadow controller to compare
+  against a live HPA on the same signals. ``--k8s-live`` applies
+  patches via ``kubectl``.
+- no deployment flag — observe-only: decisions are logged (and served
+  on ``/metrics``) but nothing actuates. The full local-process
+  actuator path (launching/retiring real engines) is exercised by
+  ``python -m production_stack_tpu.loadgen autoscale``, which owns the
+  whole stack's lifecycle.
+
+Signals come from ``--engines`` (comma-separated engine URLs, each
+polled on ``/load``) plus ``--router-url`` for the router's healthy
+count. ``--metrics-port`` serves tpu:autoscaler_* gauges.
+"""
+
+import argparse
+import asyncio
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.autoscaler.actuator import (Actuator,
+                                                      KubernetesActuator)
+from production_stack_tpu.autoscaler.collector import SignalCollector
+from production_stack_tpu.autoscaler.controller import (Autoscaler,
+                                                        AutoscalerMetrics)
+from production_stack_tpu.autoscaler.policy import (AutoscalerPolicy,
+                                                    PolicyConfig)
+from production_stack_tpu.utils import init_logger, parse_comma_separated
+
+logger = init_logger(__name__)
+
+
+class _ObserveOnlyActuator(Actuator):
+    """Records targets, changes nothing (decision shadow mode)."""
+
+    def __init__(self, initial: int):
+        self._replicas = initial
+        self.targets = []
+
+    @property
+    def replicas(self) -> int:
+        return self._replicas
+
+    async def apply(self, target: int, victims=None) -> None:
+        self.targets.append(target)
+        logger.info("observe-only: would scale %d -> %d",
+                    self._replicas, target)
+        self._replicas = target
+
+
+def add_policy_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--target-queue-delay-ms", type=float, default=500.0,
+                   help="scale up when any engine's est queue delay "
+                        "exceeds this")
+    p.add_argument("--down-queue-delay-ms", type=float, default=100.0,
+                   help="scale down only below this (hysteresis band)")
+    p.add_argument("--target-utilization", type=float, default=0.90,
+                   help="scale up when fleet in-flight / advertised "
+                        "capacity exceeds this")
+    p.add_argument("--down-utilization", type=float, default=0.50)
+    p.add_argument("--up-step", type=int, default=1)
+    p.add_argument("--down-step", type=int, default=1)
+    p.add_argument("--up-cooldown", type=float, default=15.0)
+    p.add_argument("--down-cooldown", type=float, default=60.0)
+    p.add_argument("--up-breach-ticks", type=int, default=2)
+    p.add_argument("--down-breach-ticks", type=int, default=3)
+
+
+def policy_config(args: argparse.Namespace) -> PolicyConfig:
+    return PolicyConfig(
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        target_queue_delay_ms=args.target_queue_delay_ms,
+        down_queue_delay_ms=args.down_queue_delay_ms,
+        target_utilization=args.target_utilization,
+        down_utilization=args.down_utilization,
+        up_step=args.up_step, down_step=args.down_step,
+        up_cooldown_s=args.up_cooldown,
+        down_cooldown_s=args.down_cooldown,
+        up_breach_ticks=args.up_breach_ticks,
+        down_breach_ticks=args.down_breach_ticks).validate()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "python -m production_stack_tpu.autoscaler",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--engines", required=True,
+                   help="comma-separated engine URLs to poll /load on")
+    p.add_argument("--router-url", default=None)
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="seconds between control ticks")
+    p.add_argument("--decision-log", default=None,
+                   help="append one JSON line per tick here")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve tpu:autoscaler_* on this port (0 = off)")
+    p.add_argument("--k8s-deployment", default=None,
+                   help="Deployment to patch (KubernetesActuator)")
+    p.add_argument("--k8s-namespace", default="default")
+    p.add_argument("--k8s-live", action="store_true",
+                   help="actually apply patches via kubectl (default: "
+                        "dry-run — log the patch, touch nothing)")
+    add_policy_args(p)
+    return p.parse_args(argv)
+
+
+async def serve_metrics(metrics: AutoscalerMetrics,
+                        port: int) -> Optional[web.AppRunner]:
+    if port <= 0:
+        return None
+
+    async def handler(request: web.Request) -> web.Response:
+        return web.Response(body=metrics.render(),
+                            content_type="text/plain")
+
+    app = web.Application()
+    app.router.add_get("/metrics", handler)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    await web.TCPSite(runner, "0.0.0.0", port).start()
+    logger.info("autoscaler metrics on :%d/metrics", port)
+    return runner
+
+
+async def amain(args: argparse.Namespace) -> None:
+    urls = parse_comma_separated(args.engines)
+    initial = len(urls)
+    if args.k8s_deployment:
+        actuator = KubernetesActuator(
+            deployment=args.k8s_deployment,
+            namespace=args.k8s_namespace,
+            initial_replicas=initial,
+            dry_run=not args.k8s_live)
+    else:
+        actuator = _ObserveOnlyActuator(initial)
+    collector = SignalCollector(lambda: urls,
+                                router_url=args.router_url,
+                                poll_interval_s=args.interval)
+    scaler = Autoscaler(AutoscalerPolicy(policy_config(args)), actuator,
+                        collector, interval_s=args.interval,
+                        decision_log_path=args.decision_log)
+    runner = await serve_metrics(scaler.metrics, args.metrics_port)
+    await scaler.start()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    finally:
+        await scaler.close()
+        if runner is not None:
+            await runner.cleanup()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(amain(parse_args(argv)))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

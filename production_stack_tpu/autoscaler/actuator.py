@@ -1,0 +1,362 @@
+"""Actuators: turn a replica-count decision into real fleet changes.
+
+Two implementations behind one interface:
+
+- ``LocalProcessActuator`` — owns real engine processes on this host.
+  Scale-up launches engines (loadgen orchestrator), waits for health,
+  and swaps the router's endpoint set by rewriting the
+  ``--dynamic-config-json`` file the router hot-reloads. Scale-down is
+  **loss-free by construction** and the ordering is the contract
+  (pinned by tests/test_autoscaler.py):
+
+      1. ``POST /admin/drain`` on the router — the victim takes no new
+         admissions while existing requests keep their connections;
+      2. wait until the victim's ``/load`` reports zero in-flight
+         (bounded by ``drain_timeout_s``);
+      3. rewrite the dynamic config without the victim and wait for
+         the router to apply it;
+      4. clear the (now pointless) drain flag and only then terminate
+         the process.
+
+  A client-visible 5xx during scale-down means step order was violated
+  somewhere; ``loadgen autoscale`` exits 1 on any.
+
+- ``KubernetesActuator`` — patches ``spec.replicas`` on a Deployment
+  (the cluster equivalent of the same decision). ``dry_run=True`` (the
+  default, and what tests exercise) only records the patch it *would*
+  apply; live mode shells out to ``kubectl patch``. Pod-level drain
+  safety is delegated to the chart's preStop hook +
+  ``terminationGracePeriodSeconds`` — the in-process actuator is the
+  path that proves the drain contract end to end in-repo.
+
+Every fleet mutation appends to ``self.events`` (ordered, inspectable)
+so scale events stay explainable after the fact.
+"""
+
+import asyncio
+import json
+import os
+import time
+from abc import ABC, abstractmethod
+from typing import Awaitable, Callable, Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class Actuator(ABC):
+    """The controller's view of a scalable fleet."""
+
+    @property
+    @abstractmethod
+    def replicas(self) -> int:
+        """Replicas currently owned/requested."""
+
+    def endpoint_urls(self) -> List[str]:
+        """Engine URLs this actuator manages ([] when the platform,
+        not the actuator, owns endpoints — e.g. Kubernetes)."""
+        return []
+
+    def draining_urls(self) -> List[str]:
+        return []
+
+    @abstractmethod
+    async def apply(self, target: int,
+                    victims: Optional[List[str]] = None) -> None:
+        """Drive the fleet to ``target`` replicas. ``victims`` is the
+        controller's least-loaded pick for scale-down; actuators that
+        cannot honour it may ignore it."""
+
+    async def close(self) -> None:
+        pass
+
+
+class LocalProcessActuator(Actuator):
+    """Real engine processes + the router's dynamic-config hot reload.
+
+    ``spawn``/``kill`` are injectable (tier-1 tests swap in in-process
+    fake-engine servers); the defaults launch real engine processes via
+    the loadgen orchestrator. ``router_url`` may be set after
+    construction — the orchestration order is engines first, router
+    (pointing at them) second, drains third.
+    """
+
+    def __init__(self, *, engine: str = "fake",
+                 dynamic_config_path: str,
+                 router_url: Optional[str] = None,
+                 routing_logic: str = "least_loaded",
+                 log_dir: str = "loadgen-logs",
+                 platform: str = "cpu",
+                 engine_extra_args: Optional[List[str]] = None,
+                 startup_timeout_s: float = 420.0,
+                 drain_timeout_s: float = 60.0,
+                 drain_poll_s: float = 0.25,
+                 config_apply_timeout_s: float = 30.0,
+                 spawn: Optional[Callable[[], Awaitable[object]]] = None,
+                 kill: Optional[
+                     Callable[[object], Awaitable[None]]] = None):
+        self.engine = engine
+        self.model = "fake-model" if engine == "fake" else engine
+        self.dynamic_config_path = dynamic_config_path
+        self.router_url = router_url
+        self.routing_logic = routing_logic
+        self.log_dir = log_dir
+        self.platform = platform
+        self.engine_extra_args = engine_extra_args
+        self.startup_timeout_s = startup_timeout_s
+        self.drain_timeout_s = drain_timeout_s
+        self.drain_poll_s = drain_poll_s
+        self.config_apply_timeout_s = config_apply_timeout_s
+        self._spawn = spawn or self._spawn_process
+        self._kill = kill or self._kill_process
+        self._handles: Dict[str, object] = {}     # url -> spawn handle
+        self._draining: set = set()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.events: List[tuple] = []             # ordered mutation log
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self, initial: int) -> List[str]:
+        """Launch the initial fleet and write the first config file.
+        Called before the router exists; returns the engine URLs to
+        hand the router as its ``--static-backends``."""
+        self._session = aiohttp.ClientSession()
+        await self._launch(initial)
+        self._write_config()
+        return self.endpoint_urls()
+
+    async def close(self) -> None:
+        for url in list(self._handles):
+            await self._kill(self._handles.pop(url))
+            self.events.append(("terminate", url))
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    # -- Actuator surface -----------------------------------------------
+
+    @property
+    def replicas(self) -> int:
+        return len(self._handles)
+
+    def endpoint_urls(self) -> List[str]:
+        return sorted(self._handles)
+
+    def draining_urls(self) -> List[str]:
+        return sorted(self._draining)
+
+    async def apply(self, target: int,
+                    victims: Optional[List[str]] = None) -> None:
+        if target > self.replicas:
+            await self._scale_up(target - self.replicas)
+        elif target < self.replicas:
+            want = self.replicas - target
+            victims = list(victims or [])[:want]
+            # the controller picks least-loaded victims; top up
+            # arbitrarily if it named fewer than the step needs
+            for url in self.endpoint_urls():
+                if len(victims) >= want:
+                    break
+                if url not in victims:
+                    victims.append(url)
+            for url in victims:
+                await self._retire(url)
+
+    # -- scale-up -------------------------------------------------------
+
+    async def _launch(self, count: int) -> List[str]:
+        handles = await asyncio.gather(
+            *(self._spawn() for _ in range(count)))
+        from production_stack_tpu.loadgen.orchestrator import wait_healthy
+        await asyncio.gather(*(
+            wait_healthy(h.url, self.startup_timeout_s) for h in handles))
+        for h in handles:
+            self._handles[h.url.rstrip("/")] = h
+            self.events.append(("launch", h.url.rstrip("/")))
+        return [h.url.rstrip("/") for h in handles]
+
+    async def _scale_up(self, count: int) -> None:
+        added = await self._launch(count)
+        self._write_config()
+        self.events.append(("config_swap", tuple(self.endpoint_urls())))
+        await self._wait_router_applied(len(self._handles))
+        logger.info("scale-up: +%d -> %d replicas (%s)", count,
+                    self.replicas, ", ".join(added))
+
+    # -- scale-down (the drain-safe ordering contract) -------------------
+
+    async def _retire(self, url: str) -> None:
+        url = url.rstrip("/")
+        handle = self._handles.get(url)
+        if handle is None:
+            return
+        self._draining.add(url)
+        try:
+            await self._set_drain(url, True)
+            self.events.append(("drain", url))
+            drained = await self._wait_drained(url)
+            self.events.append(("drained" if drained else "drain_timeout",
+                                url))
+            del self._handles[url]
+            self._write_config()
+            self.events.append(("config_swap",
+                                tuple(self.endpoint_urls())))
+            await self._wait_router_applied(len(self._handles))
+            # the endpoint is out of discovery; clear the stale flag so
+            # a future replica reusing the port is not born draining
+            await self._set_drain(url, False)
+            await self._kill(handle)
+            self.events.append(("terminate", url))
+            logger.info("scale-down: retired %s (%s) -> %d replicas",
+                        url, "drained clean" if drained else
+                        f"drain bound {self.drain_timeout_s:.0f}s hit",
+                        self.replicas)
+        finally:
+            self._draining.discard(url)
+
+    async def _set_drain(self, url: str, drain: bool) -> None:
+        if self.router_url is None:
+            return
+        try:
+            async with self._session.post(
+                    f"{self.router_url}/admin/drain",
+                    json={"url": url, "drain": drain},
+                    timeout=aiohttp.ClientTimeout(total=10)) as r:
+                if r.status != 200:
+                    logger.warning("drain(%s, %s) answered HTTP %d: %s",
+                                   url, drain, r.status,
+                                   (await r.text())[:200])
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            logger.warning("drain(%s, %s) failed: %s", url, drain, e)
+
+    async def _wait_drained(self, url: str) -> bool:
+        """Poll the victim's /load until nothing is queued or running."""
+        deadline = time.monotonic() + self.drain_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                async with self._session.get(
+                        f"{url}/load",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    if r.status == 200:
+                        body = await r.json()
+                        if (body.get("queue_depth") or 0) == 0 and \
+                                (body.get("running") or 0) == 0:
+                            return True
+            except (aiohttp.ClientConnectionError, ConnectionError):
+                return True          # nothing listening = nothing in flight
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ValueError):
+                pass                 # busy/garbled: keep polling to the bound
+            await asyncio.sleep(self.drain_poll_s)
+        return False
+
+    # -- dynamic-config swap --------------------------------------------
+
+    def _write_config(self) -> None:
+        urls = self.endpoint_urls()
+        cfg = {
+            "service_discovery": "static",
+            "routing_logic": self.routing_logic,
+            "static_backends": urls,
+            "static_models": [self.model] * len(urls),
+        }
+        # atomic replace: the router's watcher must never read half a
+        # JSON document
+        tmp = f"{self.dynamic_config_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(cfg, f, indent=1)
+        os.replace(tmp, self.dynamic_config_path)
+
+    async def _wait_router_applied(self, expect: int) -> None:
+        if self.router_url is None:
+            return
+        deadline = time.monotonic() + self.config_apply_timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                async with self._session.get(
+                        f"{self.router_url}/health",
+                        timeout=aiohttp.ClientTimeout(total=5)) as r:
+                    body = await r.json()
+                    last = body.get("endpoints")
+                    if last == expect:
+                        return
+            except (aiohttp.ClientError, asyncio.TimeoutError,
+                    ValueError):
+                pass
+            await asyncio.sleep(0.1)
+        logger.warning("router did not reach %d endpoints within %.0fs "
+                       "(last saw %s); proceeding", expect,
+                       self.config_apply_timeout_s, last)
+
+    # -- default process backend ----------------------------------------
+
+    async def _spawn_process(self):
+        from production_stack_tpu.loadgen.orchestrator import (free_port,
+                                                               launch_engine)
+        return launch_engine(self.engine, free_port(),
+                             log_dir=self.log_dir, platform=self.platform,
+                             extra_args=self.engine_extra_args)
+
+    async def _kill_process(self, proc) -> None:
+        from production_stack_tpu.loadgen.orchestrator import _stop
+        await asyncio.to_thread(_stop, [proc])
+
+
+class KubernetesActuator(Actuator):
+    """Patch a Deployment's ``spec.replicas`` (the HPA-shaped half of
+    the actuator abstraction).
+
+    ``dry_run=True`` records every patch in ``self.patches`` without
+    touching a cluster — deterministic for tests and usable as a
+    "what would the autoscaler do" shadow mode against production
+    signals. Live mode requires only ``kubectl`` on PATH (no python
+    kubernetes client dependency).
+    """
+
+    def __init__(self, *, deployment: str, namespace: str = "default",
+                 initial_replicas: int = 1, dry_run: bool = True,
+                 kubectl: str = "kubectl"):
+        self.deployment = deployment
+        self.namespace = namespace
+        self.dry_run = dry_run
+        self.kubectl = kubectl
+        self._replicas = initial_replicas
+        self.patches: List[dict] = []
+        self.events: List[tuple] = []
+
+    @property
+    def replicas(self) -> int:
+        return self._replicas
+
+    async def apply(self, target: int,
+                    victims: Optional[List[str]] = None) -> None:
+        patch = {"spec": {"replicas": target}}
+        record = {
+            "namespace": self.namespace,
+            "deployment": self.deployment,
+            "patch": patch,
+            "dry_run": self.dry_run,
+            "previous_replicas": self._replicas,
+        }
+        self.patches.append(record)
+        self.events.append(("patch", self.deployment, target))
+        if not self.dry_run:
+            cmd = [self.kubectl, "-n", self.namespace, "patch",
+                   "deployment", self.deployment, "--type", "merge",
+                   "-p", json.dumps(patch)]
+            proc = await asyncio.create_subprocess_exec(
+                *cmd, stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.STDOUT)
+            out, _ = await proc.communicate()
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"kubectl patch failed rc={proc.returncode}: "
+                    f"{out.decode(errors='replace')[:400]}")
+        logger.info("k8s actuator: %s/%s spec.replicas %d -> %d%s",
+                    self.namespace, self.deployment, self._replicas,
+                    target, " (dry-run)" if self.dry_run else "")
+        self._replicas = target

@@ -1,0 +1,120 @@
+"""Signal collection: per-engine /load + router health, one FleetSignal.
+
+The collector owns (or borrows) a ``signals.LoadPoller`` and reduces
+its per-engine reports into the single ``FleetSignal`` the policy
+consumes each tick:
+
+- ``queue_delay_ms``  — max of the engines' service-EWMA estimates
+  (the *worst* replica is what a newly routed request may hit);
+- ``in_flight`` / ``capacity`` — fleet sums; utilization is their
+  ratio when at least one engine advertises bounded admission;
+- ``ready``           — replicas with a *fresh* report (launched-but-
+  still-compiling replicas have none, which is exactly the policy's
+  settling gate);
+- ``router_healthy``  — the router's own healthy-endpoint count from
+  ``/health``, a cross-check that config swaps actually landed.
+
+Pass ``poller=`` to share an existing poller (e.g. the router's
+``EngineStatsScraper`` when the autoscaler runs in the router process)
+so each engine is scraped once per interval no matter how many
+consumers read it.
+"""
+
+import asyncio
+import time
+from typing import Callable, Dict, Iterable, Optional
+
+import aiohttp
+
+from production_stack_tpu.autoscaler.policy import FleetSignal
+from production_stack_tpu.signals import EngineLoad, LoadPoller, coerce_load
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class SignalCollector:
+    def __init__(self, get_urls: Callable[[], Iterable[str]], *,
+                 router_url: Optional[str] = None,
+                 poller: Optional[LoadPoller] = None,
+                 poll_interval_s: float = 5.0,
+                 freshness_s: float = 10.0):
+        self._get_urls = get_urls
+        self.router_url = router_url
+        self._owns_poller = poller is None
+        self.poller = poller if poller is not None else \
+            LoadPoller(get_urls, interval_s=poll_interval_s)
+        self.freshness_s = freshness_s
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        if self._owns_poller:
+            # on-demand, not interval: collect() polls at every control
+            # tick, so a background loop would just double each
+            # engine's scrape rate
+            self.poller.attach(self._session)
+
+    async def close(self) -> None:
+        if self._owns_poller:
+            await self.poller.close()
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    # -- reads ----------------------------------------------------------
+
+    def per_engine(self) -> Dict[str, EngineLoad]:
+        return {url: coerce_load(rec)
+                for url, rec in self.poller.get().items()}
+
+    async def collect(self,
+                      replicas: Optional[int] = None) -> FleetSignal:
+        """One fresh pass: poll every engine now, aggregate.
+
+        ``replicas`` overrides the fleet size when the actuator — not
+        the polled URL set — is authoritative (KubernetesActuator,
+        whose pods are not in ``get_urls``)."""
+        if self._owns_poller:
+            await self.poller.poll_now()
+        loads = self.per_engine()
+        urls = [u.rstrip("/") for u in self._get_urls()]
+        now = time.time()
+        fresh = {u: l for u, l in loads.items()
+                 if u in urls and now - l.scraped_at <= self.freshness_s}
+        in_flight = sum(l.in_flight for l in fresh.values())
+        bounded = {u: l for u, l in fresh.items()
+                   if l.capacity is not None and l.capacity > 0}
+        advertised = [l.capacity for l in bounded.values()]
+        n = len(urls) if replicas is None else replicas
+        # "ready" counts only OBSERVABLE replicas against freshness:
+        # replicas outside the polled URL set (a KubernetesActuator's
+        # pods, which get_urls cannot enumerate) are presumed ready,
+        # otherwise the policy's settling gate would hold forever the
+        # moment the actuator's count diverges from the static list
+        ready = max(0, min(n, n - (len(urls) - len(fresh))))
+        return FleetSignal(
+            replicas=n,
+            ready=ready,
+            in_flight=in_flight,
+            capacity=sum(advertised) if advertised else None,
+            bounded_in_flight=(sum(l.in_flight
+                                   for l in bounded.values())
+                               if advertised else None),
+            queue_delay_ms=max(
+                (l.est_queue_delay_ms for l in fresh.values()),
+                default=0.0),
+            router_healthy=await self._router_healthy(),
+        )
+
+    async def _router_healthy(self) -> Optional[int]:
+        if self.router_url is None or self._session is None:
+            return None
+        try:
+            async with self._session.get(
+                    f"{self.router_url}/health",
+                    timeout=aiohttp.ClientTimeout(total=3)) as r:
+                body = await r.json()
+                return body.get("healthy_endpoints")
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
+            return None

@@ -1,0 +1,222 @@
+"""The closed loop: collect -> decide -> actuate, every tick, explained.
+
+``Autoscaler`` is an asyncio task (same ownership idiom as the
+router's scraper/watcher tasks). Each tick it
+
+1. collects a fresh ``FleetSignal`` (collector polls every engine's
+   ``/load`` concurrently),
+2. asks the policy for a ``Decision``,
+3. appends a structured record to the decision log (ring buffer +
+   optional JSON-lines file + metrics) — **every** tick, holds
+   included, so "why didn't it scale?" is as answerable as "why did
+   it?", and
+4. applies non-hold decisions through the actuator, picking the
+   least-loaded replicas as scale-down victims, and confirms success
+   back to the policy (a failed actuation must not start a cooldown).
+
+Actuation is deliberately serialized with collection: while a drain-
+and-retire is in progress the loop does not evaluate new decisions, so
+cooldowns are measured from *completed* fleet changes and a slow drain
+can never overlap a concurrent scale-up on stale signals.
+
+Metrics (rendered by ``AutoscalerMetrics``, served by the standalone
+CLI's ``/metrics``):
+
+- ``tpu:autoscaler_replicas{state}``        — ready / starting / draining
+- ``tpu:autoscaler_decisions_total{direction,reason}``
+"""
+
+import asyncio
+import collections
+import json
+import time
+from typing import Dict, List, Optional
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                               generate_latest)
+
+from production_stack_tpu.autoscaler.actuator import Actuator
+from production_stack_tpu.autoscaler.collector import SignalCollector
+from production_stack_tpu.autoscaler.policy import (DOWN, HOLD,
+                                                    AutoscalerPolicy)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class AutoscalerMetrics:
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.replicas = Gauge(
+            "tpu:autoscaler_replicas",
+            "Replicas by lifecycle state (ready = fresh load report; "
+            "starting = launched, not yet reporting; draining = "
+            "scale-down in progress)",
+            ["state"], registry=self.registry)
+        self.decisions = Counter(
+            "tpu:autoscaler_decisions",
+            "Autoscaler decisions by direction and reason (holds "
+            "included — every tick is accounted for)",
+            ["direction", "reason"], registry=self.registry)
+
+    def observe(self, decision, *, ready: int, draining: int,
+                replicas: int) -> None:
+        self.decisions.labels(direction=decision.direction,
+                              reason=decision.reason).inc()
+        self.replicas.labels(state="ready").set(ready)
+        self.replicas.labels(state="draining").set(draining)
+        self.replicas.labels(state="starting").set(
+            max(0, replicas - ready - draining))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class Autoscaler:
+    """Owns the control loop; see module docstring."""
+
+    def __init__(self, policy: AutoscalerPolicy, actuator: Actuator,
+                 collector: SignalCollector, *,
+                 interval_s: float = 2.0,
+                 decision_log_path: Optional[str] = None,
+                 metrics: Optional[AutoscalerMetrics] = None,
+                 max_decisions: int = 4096):
+        self.policy = policy
+        self.actuator = actuator
+        self.collector = collector
+        self.interval_s = interval_s
+        self.decision_log_path = decision_log_path
+        self.metrics = metrics or AutoscalerMetrics()
+        self.decisions: collections.deque = collections.deque(
+            maxlen=max_decisions)
+        self.scale_events: List[dict] = []
+        self._task: Optional[asyncio.Task] = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.collector.start()
+        self._task = asyncio.create_task(self._loop(), name="autoscaler")
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.collector.close()
+
+    def healthy(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("autoscaler tick failed")
+            await asyncio.sleep(self.interval_s)
+
+    # -- one control tick (tests drive this directly) --------------------
+
+    async def tick(self, now: Optional[float] = None) -> dict:
+        wall0 = time.monotonic()
+        now = wall0 if now is None else now
+        sig = await self.collector.collect(
+            replicas=self.actuator.replicas)
+        decision = self.policy.decide(sig, now)
+        record = {"ts": round(time.time(), 3), **decision.to_json()}
+
+        if decision.direction != HOLD:
+            victims = None
+            if decision.direction == DOWN:
+                victims = self._pick_victims(
+                    decision.current - decision.target)
+                record["victims"] = victims
+            logger.info("autoscaler: %s %d -> %d (%s) signal=%s",
+                        decision.direction, decision.current,
+                        decision.target, decision.reason,
+                        decision.signal)
+            try:
+                await self.actuator.apply(decision.target,
+                                          victims=victims)
+            except Exception as e:
+                logger.exception("actuation %d -> %d failed",
+                                 decision.current, decision.target)
+                record["applied"] = False
+                record["error"] = f"{type(e).__name__}: {e}"
+            else:
+                record["applied"] = True
+                # only a COMPLETED fleet change starts a cooldown (a
+                # failed actuation must stay immediately retryable),
+                # and it starts when the change finished: a 30 s drain
+                # must not have silently consumed the down cooldown.
+                # Expressed as tick-clock + elapsed wall time so
+                # injected-clock tests and production agree.
+                self.policy.note_scaled(
+                    decision.direction,
+                    now + (time.monotonic() - wall0))
+                self.scale_events.append(record)
+
+        self._log(record, sig)
+        return record
+
+    def _pick_victims(self, count: int) -> List[str]:
+        """Least-loaded managed endpoints retire first: minimum
+        in-flight work to drain, minimum sessions disturbed."""
+        loads = self.collector.per_engine()
+        managed = self.actuator.endpoint_urls()
+        return sorted(
+            managed,
+            key=lambda u: (loads[u].in_flight if u in loads
+                           else float("-inf")))[:count]
+
+    def _log(self, record: dict, sig) -> None:
+        self.decisions.append(record)
+        self.metrics.observe(
+            _DecisionView(record["direction"], record["reason"]),
+            ready=sig.ready,
+            draining=len(self.actuator.draining_urls()),
+            replicas=sig.replicas)
+        if self.decision_log_path:
+            try:
+                with open(self.decision_log_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            except OSError:
+                logger.exception("decision log write failed")
+
+    # -- reporting ------------------------------------------------------
+
+    def timeline(self) -> List[dict]:
+        return list(self.decisions)
+
+    def summary(self) -> Dict:
+        ups = [e for e in self.scale_events if e["direction"] == "up"]
+        downs = [e for e in self.scale_events
+                 if e["direction"] == "down"]
+        return {
+            "ticks": len(self.decisions),
+            "scale_ups": len(ups),
+            "scale_downs": len(downs),
+            "failed_actuations": len(
+                [e for e in self.decisions
+                 if e.get("applied") is False]),
+            "max_replicas_observed": max(
+                (e["target"] for e in ups),
+                default=self.actuator.replicas),
+            "scale_events": self.scale_events,
+        }
+
+
+class _DecisionView:
+    """Just the two fields AutoscalerMetrics.observe reads."""
+
+    __slots__ = ("direction", "reason")
+
+    def __init__(self, direction, reason):
+        self.direction = direction
+        self.reason = reason

@@ -1,0 +1,227 @@
+"""Scaling policy: load signals in, bounded replica decisions out.
+
+Pure and clock-injected — every branch is unit-testable without a
+stack. The controller feeds one ``FleetSignal`` per tick; the policy
+answers with a ``Decision`` that is already clamped, stepped, cooled
+down, and hysteresis-filtered, so actuators never need judgement of
+their own.
+
+Anti-thrash machinery (the part an HPA gives you for free and an
+in-process controller must own):
+
+- **Hysteresis band** — scale-up and scale-down trigger on *different*
+  thresholds (``target_queue_delay_ms`` / ``down_queue_delay_ms``,
+  ``target_utilization`` / ``down_utilization``). Load sitting between
+  the bands holds.
+- **Consecutive-breach ticks** — one spiky sample never scales; the
+  breach must persist ``up_breach_ticks`` / ``down_breach_ticks``
+  consecutive ticks. A single in-band tick resets the streak.
+- **Cooldowns** — after a scale event the same direction is locked out
+  for ``up_cooldown_s`` / ``down_cooldown_s``; scale-down additionally
+  cools down after a scale-UP (a spike that just forced capacity up
+  must not reclaim it seconds later).
+- **Step limits** — one decision moves at most ``up_step`` /
+  ``down_step`` replicas; the loop re-evaluates on real signals
+  instead of extrapolating to a far-away target.
+- **Settling gate** — while launched replicas are not yet reporting
+  load (XLA warmup), scale-down holds: retiring capacity based on a
+  fleet that is not fully in service yet double-counts headroom.
+"""
+
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+UP = "up"
+DOWN = "down"
+HOLD = "hold"
+
+
+@dataclass
+class PolicyConfig:
+    """Knobs; defaults suit a small interactive fleet (docs/autoscaling.md)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # queue-delay band (ms): the engine's own service-EWMA estimate of
+    # how long a new arrival waits before prefill (tpu:est_queue_delay_ms)
+    target_queue_delay_ms: float = 500.0
+    down_queue_delay_ms: float = 100.0
+    # utilization band: fleet in-flight / fleet advertised capacity
+    target_utilization: float = 0.90
+    down_utilization: float = 0.50
+    up_step: int = 1
+    down_step: int = 1
+    up_cooldown_s: float = 15.0
+    down_cooldown_s: float = 60.0
+    up_breach_ticks: int = 2
+    down_breach_ticks: int = 3
+    # backstop on the settling gate: after this many CONSECUTIVE ticks
+    # with ready < replicas, decisions resume on the signals of the
+    # replicas that ARE reporting. Without it, one crashed replica
+    # (stale /load forever) would wedge both directions permanently.
+    # Size it to comfortably exceed engine warmup at your tick
+    # interval (default 120 ticks = 4 min at the 2 s default).
+    settling_grace_ticks: int = 120
+
+    def validate(self) -> "PolicyConfig":
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.up_step < 1 or self.down_step < 1:
+            raise ValueError("scale steps must be >= 1")
+        if self.down_queue_delay_ms > self.target_queue_delay_ms:
+            raise ValueError("down_queue_delay_ms must not exceed "
+                             "target_queue_delay_ms (hysteresis band)")
+        if self.down_utilization > self.target_utilization:
+            raise ValueError("down_utilization must not exceed "
+                             "target_utilization (hysteresis band)")
+        if self.up_breach_ticks < 1 or self.down_breach_ticks < 1:
+            raise ValueError("breach tick counts must be >= 1")
+        if self.settling_grace_ticks < 1:
+            raise ValueError("settling_grace_ticks must be >= 1")
+        return self
+
+
+@dataclass
+class FleetSignal:
+    """One tick's aggregated input (autoscaler/collector.py)."""
+
+    replicas: int                       # endpoints the actuator owns
+    ready: int                          # of those, reporting fresh /load
+    in_flight: float = 0.0              # sum queued+running across fleet
+    capacity: Optional[float] = None    # sum advertised; None = unknown
+    # in-flight on the capacity-advertising engines only: utilization's
+    # numerator must cover the same engines as its denominator, or a
+    # mixed fleet (one bounded, one unbounded engine) reads as
+    # over-utilized forever. None = same as in_flight (uniform fleet).
+    bounded_in_flight: Optional[float] = None
+    queue_delay_ms: float = 0.0         # max est_queue_delay_ms
+    router_healthy: Optional[int] = None  # router's own healthy count
+
+    @property
+    def utilization(self) -> Optional[float]:
+        if self.capacity is None or self.capacity <= 0:
+            return None
+        numerator = (self.in_flight if self.bounded_in_flight is None
+                     else self.bounded_in_flight)
+        return numerator / self.capacity
+
+
+@dataclass
+class Decision:
+    direction: str                      # "up" | "down" | "hold"
+    current: int
+    target: int
+    reason: str
+    signal: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+class AutoscalerPolicy:
+    """Stateful (breach streaks + cooldown stamps), side-effect free.
+
+    ``decide`` never mutates the fleet; the controller applies the
+    decision and confirms it back via ``note_scaled`` so a failed
+    actuation does not start a cooldown.
+    """
+
+    def __init__(self, cfg: PolicyConfig):
+        self.cfg = cfg.validate()
+        self._up_streak = 0
+        self._down_streak = 0
+        self._settling_streak = 0
+        self._last_up_at = float("-inf")
+        self._last_down_at = float("-inf")
+
+    # -- controller feedback -------------------------------------------
+
+    def note_scaled(self, direction: str,
+                    now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        if direction == UP:
+            self._last_up_at = now
+        elif direction == DOWN:
+            self._last_down_at = now
+        self._up_streak = 0
+        self._down_streak = 0
+
+    # -- the decision ---------------------------------------------------
+
+    def decide(self, sig: FleetSignal,
+               now: Optional[float] = None) -> Decision:
+        now = time.monotonic() if now is None else now
+        cfg = self.cfg
+        util = sig.utilization
+
+        def hold(reason):
+            return self._decision(HOLD, sig, sig.replicas, reason, util)
+
+        breach_up = (sig.queue_delay_ms > cfg.target_queue_delay_ms or
+                     (util is not None and util > cfg.target_utilization))
+        breach_down = (sig.queue_delay_ms < cfg.down_queue_delay_ms and
+                       (util is None or util < cfg.down_utilization))
+        self._up_streak = self._up_streak + 1 if breach_up else 0
+        self._down_streak = self._down_streak + 1 if breach_down else 0
+        # the settling gate, with a grace bound: a replica that stays
+        # unready past the grace window (crashed, not warming) must not
+        # wedge the controller — decisions resume on what IS reporting
+        self._settling_streak = (self._settling_streak + 1
+                                 if sig.ready < sig.replicas else 0)
+        settling = (sig.ready < sig.replicas and
+                    self._settling_streak <= cfg.settling_grace_ticks)
+
+        if breach_up:
+            if sig.replicas >= cfg.max_replicas:
+                return hold("at_max")
+            if settling:
+                # capacity already on the way up is still warming; its
+                # effect is not in the signal yet
+                return hold("settling")
+            if self._up_streak < cfg.up_breach_ticks:
+                return hold("breach_pending_up")
+            if now - self._last_up_at < cfg.up_cooldown_s:
+                return hold("cooldown_up")
+            target = min(sig.replicas + cfg.up_step, cfg.max_replicas)
+            reason = ("queue_delay"
+                      if sig.queue_delay_ms > cfg.target_queue_delay_ms
+                      else "utilization")
+            return self._decision(UP, sig, target, reason, util)
+
+        if breach_down:
+            if sig.replicas <= cfg.min_replicas:
+                return hold("at_min")
+            if settling:
+                return hold("settling")
+            if self._down_streak < cfg.down_breach_ticks:
+                return hold("breach_pending_down")
+            # scale-down cools down after ANY scale event: reclaiming
+            # capacity seconds after a spike forced it up is the thrash
+            # this controller exists to prevent
+            if now - max(self._last_up_at,
+                         self._last_down_at) < cfg.down_cooldown_s:
+                return hold("cooldown_down")
+            target = max(sig.replicas - cfg.down_step, cfg.min_replicas)
+            return self._decision(DOWN, sig, target, "idle", util)
+
+        return hold("in_band")
+
+    def _decision(self, direction: str, sig: FleetSignal, target: int,
+                  reason: str, util: Optional[float]) -> Decision:
+        return Decision(
+            direction=direction, current=sig.replicas, target=target,
+            reason=reason,
+            signal={
+                "replicas": sig.replicas,
+                "ready": sig.ready,
+                "in_flight": round(sig.in_flight, 2),
+                "capacity": sig.capacity,
+                "utilization": None if util is None else round(util, 4),
+                "queue_delay_ms": round(sig.queue_delay_ms, 1),
+                "router_healthy": sig.router_healthy,
+                "up_streak": self._up_streak,
+                "down_streak": self._down_streak,
+            })

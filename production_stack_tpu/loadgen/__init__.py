@@ -25,9 +25,12 @@ Pieces (each importable on its own):
 - ``chaos``    — engine kill/restart churn under storm (availability)
 - ``overload`` — open-loop offered-QPS sweep past saturation (goodput
                  plateau, deadline compliance, structured sheds)
+- ``autoscale`` — offered-QPS ramp against the closed-loop autoscaler
+                 (replicas track the ramp, drain-safe scale-down,
+                 fixed-N comparison)
 
 CLI: ``python -m production_stack_tpu.loadgen
-{run,soak,scaleout,overhead,chaos,overload} ...``
+{run,soak,scaleout,overhead,chaos,overload,autoscale} ...``
 (docs/benchmarks.md has the cookbook).
 
 Talks to the stack only through its public HTTP surfaces; no imports

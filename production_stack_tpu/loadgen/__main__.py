@@ -19,6 +19,12 @@ overload — launch router + N engines (with overload protection) and
            sweep open-loop offered QPS past saturation; exit 1 unless
            goodput plateaus, zero accepted requests violate their
            deadline, and nothing 5xxes (OVERLOAD_*.json)
+autoscale — launch router + autoscaler-owned engines and drive an
+           open-loop QPS ramp up then down; replicas must track the
+           ramp (1 -> N -> 1) with zero client-visible 5xx across
+           every scale-up and drain-based scale-down, goodput at the
+           peak must track offered load and beat the fixed-N
+           comparison baseline (AUTOSCALE_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -31,6 +37,8 @@ import sys
 import time
 
 from production_stack_tpu.loadgen import report as report_mod
+from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
+                                                    run_autoscale)
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
@@ -226,6 +234,59 @@ def cmd_overload(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_autoscale(args) -> int:
+    qps = [float(x) for x in args.qps.split(",") if x.strip()]
+
+    def ramp(fixed_replicas=None):
+        return run_autoscale(
+            engine=args.engine, qps_profile=qps,
+            phase_duration_s=args.phase_duration,
+            min_replicas=args.min_replicas,
+            max_replicas=args.max_replicas,
+            initial_replicas=args.min_replicas,
+            deadline_ms=args.deadline_ms, num_tokens=args.num_tokens,
+            fake_capacity=args.fake_capacity,
+            fake_tokens_per_s=args.fake_tokens_per_s,
+            tick_interval_s=args.tick_interval,
+            target_utilization=args.target_utilization,
+            down_utilization=args.down_utilization,
+            target_queue_delay_ms=args.target_queue_delay_ms,
+            down_queue_delay_ms=args.down_queue_delay_ms,
+            up_cooldown_s=args.up_cooldown,
+            down_cooldown_s=args.down_cooldown,
+            fixed_replicas=fixed_replicas,
+            drain_timeout_s=args.drain_timeout,
+            platform=args.platform, log_dir=args.log_dir,
+            startup_timeout_s=args.startup_timeout)
+
+    record = asyncio.run(ramp())
+    if args.compare_fixed > 0:
+        print(f"autoscale ramp done; measuring the fixed-N="
+              f"{args.compare_fixed} comparison baseline...",
+              file=sys.stderr)
+        record["detail"]["comparison"] = asyncio.run(
+            ramp(fixed_replicas=args.compare_fixed))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"AUTOSCALE_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = autoscale_violations(
+        record, track_fraction=args.track_fraction,
+        compare_margin=args.compare_margin)
+    for v in violations:
+        print(f"AUTOSCALE VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        print(f"autoscale PASSED: replicas "
+              f"{d['replicas_initial']} -> "
+              f"{d['max_replicas_observed']} -> "
+              f"{d['final_replicas']} tracking the ramp, "
+              f"{d['scale_ups']} scale-up(s) / {d['scale_downs']} "
+              f"drain-safe scale-down(s), peak goodput "
+              f"{record['value']} qps, zero client-visible errors")
+    return 1 if violations else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -405,6 +466,58 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write OVERLOAD_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_overload)
+
+    sp = sub.add_parser("autoscale",
+                        help="router + autoscaler-owned engines; drive "
+                             "a QPS ramp up then down and assert "
+                             "replicas track it with zero "
+                             "client-visible 5xx")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (bounded mock — measures the control "
+                         "loop, not the model) or a real engine model "
+                         "name (launched with protection flags)")
+    sp.add_argument("--qps", default="4,12,24,12,4",
+                    help="comma-separated offered-QPS phases, shaped "
+                         "up then down")
+    sp.add_argument("--phase-duration", type=parse_duration,
+                    default=15.0, help="seconds per ramp phase")
+    sp.add_argument("--min-replicas", type=int, default=1)
+    sp.add_argument("--max-replicas", type=int, default=3)
+    sp.add_argument("--deadline-ms", type=float, default=8000.0)
+    sp.add_argument("--num-tokens", type=int, default=4)
+    sp.add_argument("--fake-capacity", type=int, default=4,
+                    help="fake engines: bounded-queue capacity "
+                         "(advertised; drives utilization)")
+    sp.add_argument("--fake-tokens-per-s", type=float, default=10.0,
+                    help="fake engines: service pacing")
+    sp.add_argument("--tick-interval", type=float, default=1.0,
+                    help="autoscaler control-tick seconds")
+    sp.add_argument("--target-utilization", type=float, default=0.85)
+    sp.add_argument("--down-utilization", type=float, default=0.45)
+    sp.add_argument("--target-queue-delay-ms", type=float,
+                    default=500.0)
+    sp.add_argument("--down-queue-delay-ms", type=float, default=100.0)
+    sp.add_argument("--up-cooldown", type=float, default=4.0)
+    sp.add_argument("--down-cooldown", type=float, default=8.0)
+    sp.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="seconds a scale-down waits for the victim's "
+                         "in-flight work before proceeding")
+    sp.add_argument("--compare-fixed", type=int, default=1,
+                    help="also measure the same ramp with this many "
+                         "FIXED replicas as the baseline (0 skips)")
+    sp.add_argument("--track-fraction", type=float, default=0.7,
+                    help="peak-phase goodput must reach this fraction "
+                         "of offered QPS")
+    sp.add_argument("--compare-margin", type=float, default=1.3,
+                    help="autoscale peak goodput must beat the fixed "
+                         "baseline by this factor")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write AUTOSCALE_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_autoscale)
 
     return p
 

@@ -1,0 +1,300 @@
+"""Autoscale ramp: offered QPS up then down, replicas must follow.
+
+The autoscaler's closed loop (ISSUE 5). The rig launches the real
+router with ``--dynamic-config-json`` hot reload in front of an
+initial engine fleet owned by a ``LocalProcessActuator``, starts the
+``Autoscaler`` control loop against per-engine ``/load`` signals, and
+drives an OPEN-loop QPS ramp through a phase profile shaped up then
+down (e.g. 4 -> 12 -> 24 -> 12 -> 4). Requests are classified exactly
+like the overload sweep (ok / ok_late / shed / error).
+
+The acceptance contract (``autoscale_violations``; CLI exits 1 on any):
+
+- **zero errors** — no raw 5xx / transport failure may reach a client
+  across any scale-up or drain-based scale-down event (structured
+  429/503 + Retry-After sheds are counted separately: transient sheds
+  while a scale-up is still launching are the system working, not a
+  bug);
+- the controller actually **scaled up and back down** (replicas
+  1 -> N -> 1 tracks the ramp; the fleet ends at min_replicas);
+- **goodput tracks offered load** at the ramp's peak: peak-phase
+  goodput >= ``track_fraction`` x offered (a fixed fleet saturates at
+  one replica's capacity instead);
+- when a fixed-N comparison run is attached, autoscale peak goodput
+  beats it by ``compare_margin`` x;
+- **zero drain timeouts** — every retired replica drained clean.
+
+The committed record is ``AUTOSCALE_*.json`` (BENCH schema; headline =
+peak-phase goodput). Reproduction one-liners: docs/benchmarks.md
+"Autoscaling: replicas track the ramp".
+"""
+
+import asyncio
+import os
+import time
+from typing import Dict, List, Optional
+
+from production_stack_tpu.autoscaler.actuator import LocalProcessActuator
+from production_stack_tpu.autoscaler.collector import SignalCollector
+from production_stack_tpu.autoscaler.controller import Autoscaler
+from production_stack_tpu.autoscaler.policy import (AutoscalerPolicy,
+                                                    PolicyConfig)
+from production_stack_tpu.loadgen.orchestrator import (_stop, free_port,
+                                                       launch_router,
+                                                       wait_healthy)
+from production_stack_tpu.loadgen.overload import (ENGINE_PROTECTION_ARGS,
+                                                   measure_point)
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+ROUTER_AUTOSCALE_ARGS = ["--failover-attempts", "3",
+                         "--engine-stats-interval", "1",
+                         "--dynamic-config-interval", "0.3"]
+
+
+def autoscale_violations(record: Dict, *,
+                         track_fraction: float = 0.7,
+                         compare_margin: float = 1.3) -> List[str]:
+    """The ramp's pass/fail contract (CLI exits 1 on any)."""
+    d = record["detail"]
+    phases = d["phases"]
+    out = []
+    if not phases:
+        return ["no phases measured"]
+    errors = sum(p["errors"] for p in phases)
+    if errors:
+        out.append(f"{errors} client-visible errors (raw 5xx or "
+                   f"transport failures) — scale events must be "
+                   f"loss-free")
+    late = sum(p["ok_late"] for p in phases)
+    if late:
+        out.append(f"{late} accepted requests finished past their "
+                   f"deadline")
+    if not d["fixed"]:
+        if d["scale_ups"] == 0:
+            out.append("replicas never scaled up: the controller did "
+                       "not track the ramp")
+        if d["scale_downs"] == 0:
+            out.append("replicas never scaled down: ramp-down load "
+                       "should have retired capacity")
+        if d["final_replicas"] > d["min_replicas"]:
+            out.append(f"fleet ended at {d['final_replicas']} replicas "
+                       f"(> min {d['min_replicas']}): scale-down never "
+                       f"converged")
+        if d["drain_timeouts"]:
+            out.append(f"{d['drain_timeouts']} scale-downs hit the "
+                       f"drain bound instead of draining clean")
+    peak = max(phases, key=lambda p: p["offered_qps"])
+    floor = track_fraction * peak["offered_qps"]
+    if not d["fixed"] and peak["goodput_qps"] < floor:
+        out.append(
+            f"goodput failed to track offered load at the peak: "
+            f"{peak['goodput_qps']} qps at offered "
+            f"{peak['offered_qps']} (< {floor:.1f} = "
+            f"{100 * track_fraction:.0f}%)")
+    comp = d.get("comparison")
+    if comp is not None:
+        comp_errors = sum(p["errors"]
+                          for p in comp["detail"]["phases"])
+        if comp_errors:
+            out.append(f"{comp_errors} client-visible errors in the "
+                       f"fixed-N comparison run (same stack, same "
+                       f"loss-free contract)")
+        fixed_peak = max(comp["detail"]["phases"],
+                         key=lambda p: p["offered_qps"])
+        need = compare_margin * fixed_peak["goodput_qps"]
+        if peak["goodput_qps"] < need:
+            out.append(
+                f"autoscale peak goodput {peak['goodput_qps']} qps is "
+                f"not a clear win over the fixed-N="
+                f"{comp['detail']['replicas_initial']} baseline "
+                f"{fixed_peak['goodput_qps']} qps (need >= "
+                f"{need:.1f} = {compare_margin}x)")
+    return out
+
+
+async def run_autoscale(*, engine: str = "fake",
+                        qps_profile: Optional[List[float]] = None,
+                        phase_duration_s: float = 15.0,
+                        min_replicas: int = 1,
+                        max_replicas: int = 3,
+                        initial_replicas: int = 1,
+                        deadline_ms: float = 8000.0,
+                        num_tokens: int = 4,
+                        fake_capacity: int = 4,
+                        fake_tokens_per_s: float = 10.0,
+                        tick_interval_s: float = 1.0,
+                        target_utilization: float = 0.85,
+                        down_utilization: float = 0.45,
+                        target_queue_delay_ms: float = 500.0,
+                        down_queue_delay_ms: float = 100.0,
+                        up_cooldown_s: float = 4.0,
+                        down_cooldown_s: float = 8.0,
+                        up_breach_ticks: int = 2,
+                        down_breach_ticks: int = 3,
+                        fixed_replicas: Optional[int] = None,
+                        settle_timeout_s: float = 45.0,
+                        drain_timeout_s: float = 30.0,
+                        platform: str = "cpu",
+                        log_dir: str = "loadgen-logs",
+                        startup_timeout_s: float = 420.0) -> Dict:
+    """Launch router + actuator-owned engines (+ the autoscaler unless
+    ``fixed_replicas`` pins the fleet) and drive the ramp; return the
+    AUTOSCALE record."""
+    if qps_profile is None:
+        qps_profile = [4.0, 12.0, 24.0, 12.0, 4.0]
+    fixed = fixed_replicas is not None
+    initial = fixed_replicas if fixed else initial_replicas
+
+    extra = None
+    if engine == "fake":
+        # bounded fake queue, same modeling as the overload sweep:
+        # service time as TTFT, capacity advertised for the router's
+        # endpoint cap AND the autoscaler's utilization signal
+        service_s = num_tokens / max(fake_tokens_per_s, 1e-9)
+        extra = ["--ttft", f"{service_s:.4f}",
+                 "--num-tokens", str(num_tokens),
+                 "--fault", "overload",
+                 "--fault-arg", str(fake_capacity)]
+    else:
+        extra = list(ENGINE_PROTECTION_ARGS)
+
+    os.makedirs(log_dir, exist_ok=True)
+    config_path = os.path.join(
+        log_dir, f"autoscale-config{'-fixed' if fixed else ''}.json")
+    decision_log = os.path.join(log_dir, "autoscale-decisions.jsonl")
+
+    actuator = LocalProcessActuator(
+        engine=engine, dynamic_config_path=config_path,
+        routing_logic="least_loaded", log_dir=log_dir,
+        platform=platform, engine_extra_args=extra,
+        startup_timeout_s=startup_timeout_s,
+        drain_timeout_s=drain_timeout_s)
+    model = actuator.model
+    router = None
+    scaler = None
+    phases: List[Dict] = []
+    try:
+        urls = await actuator.start(initial)
+        router = launch_router(
+            urls, model, free_port(), routing="least_loaded",
+            log_dir=log_dir,
+            extra_args=ROUTER_AUTOSCALE_ARGS
+            + ["--dynamic-config-json", config_path])
+        actuator.router_url = router.url
+        await wait_healthy(router.url, 60.0, require_endpoints=initial)
+
+        if not fixed:
+            policy = AutoscalerPolicy(PolicyConfig(
+                min_replicas=min_replicas, max_replicas=max_replicas,
+                target_queue_delay_ms=target_queue_delay_ms,
+                down_queue_delay_ms=down_queue_delay_ms,
+                target_utilization=target_utilization,
+                down_utilization=down_utilization,
+                up_cooldown_s=up_cooldown_s,
+                down_cooldown_s=down_cooldown_s,
+                up_breach_ticks=up_breach_ticks,
+                down_breach_ticks=down_breach_ticks))
+            collector = SignalCollector(actuator.endpoint_urls,
+                                        router_url=router.url,
+                                        poll_interval_s=tick_interval_s)
+            scaler = Autoscaler(policy, actuator, collector,
+                                interval_s=tick_interval_s,
+                                decision_log_path=decision_log)
+            await scaler.start()
+            # one settled tick before traffic so the first decision
+            # sees real (idle) signals, not an empty poller
+            await asyncio.sleep(tick_interval_s)
+
+        for qps in qps_profile:
+            replicas_at_start = actuator.replicas
+            logger.info("autoscale phase: %.1f qps offered for %.0fs "
+                        "(replicas=%d)", qps, phase_duration_s,
+                        replicas_at_start)
+            p = await measure_point(router.url, model, qps=qps,
+                                    duration_s=phase_duration_s,
+                                    deadline_ms=deadline_ms,
+                                    num_tokens=num_tokens)
+            p["replicas_at_start"] = replicas_at_start
+            p["replicas_at_end"] = actuator.replicas
+            phases.append(p)
+            logger.info("  -> goodput %.2f qps, %d ok / %d shed / "
+                        "%d errors, replicas %d -> %d",
+                        p["goodput_qps"], p["ok"], p["shed"],
+                        p["errors"], replicas_at_start,
+                        actuator.replicas)
+
+        # ramp is over; give the controller time to retire idle
+        # capacity back down to the floor (drain-safe, so this also
+        # exercises the scale-down path even on short profiles)
+        final_replicas = actuator.replicas
+        if not fixed:
+            deadline = time.monotonic() + settle_timeout_s
+            while time.monotonic() < deadline:
+                if actuator.replicas <= min_replicas:
+                    break
+                await asyncio.sleep(0.5)
+            final_replicas = actuator.replicas
+            await scaler.close()
+            scaler_summary = scaler.summary()
+        else:
+            scaler_summary = {"ticks": 0, "scale_ups": 0,
+                              "scale_downs": 0, "failed_actuations": 0,
+                              "max_replicas_observed": initial,
+                              "scale_events": []}
+    finally:
+        if scaler is not None and scaler.healthy():
+            await scaler.close()
+        if router is not None:
+            _stop([router])
+        await actuator.close()
+
+    peak = max((p["goodput_qps"] for p in phases), default=0.0)
+    drain_timeouts = len([e for e in actuator.events
+                          if e[0] == "drain_timeout"])
+    return {
+        "metric": "goodput under an offered-QPS ramp with "
+                  + ("a FIXED fleet (comparison baseline)" if fixed
+                     else "closed-loop replica autoscaling"),
+        "value": peak,
+        "unit": "goodput_qps",
+        "platform": platform,
+        "detail": {
+            "engine": engine,
+            "fixed": fixed,
+            "qps_profile": qps_profile,
+            "phase_duration_s": phase_duration_s,
+            "deadline_ms": deadline_ms,
+            "num_tokens": num_tokens,
+            "replicas_initial": initial,
+            "min_replicas": min_replicas,
+            "max_replicas": max_replicas,
+            "final_replicas": final_replicas,
+            "max_replicas_observed": scaler_summary[
+                "max_replicas_observed"],
+            "scale_ups": scaler_summary["scale_ups"],
+            "scale_downs": scaler_summary["scale_downs"],
+            "failed_actuations": scaler_summary["failed_actuations"],
+            "drain_timeouts": drain_timeouts,
+            "decision_ticks": scaler_summary["ticks"],
+            "scale_events": scaler_summary["scale_events"],
+            "actuator_events": [list(e) for e in actuator.events],
+            "policy": (None if fixed else {
+                "target_utilization": target_utilization,
+                "down_utilization": down_utilization,
+                "target_queue_delay_ms": target_queue_delay_ms,
+                "down_queue_delay_ms": down_queue_delay_ms,
+                "up_cooldown_s": up_cooldown_s,
+                "down_cooldown_s": down_cooldown_s,
+                "up_breach_ticks": up_breach_ticks,
+                "down_breach_ticks": down_breach_ticks,
+                "tick_interval_s": tick_interval_s,
+            }),
+            "engine_args": (f"overload fault, capacity {fake_capacity}, "
+                            f"{fake_tokens_per_s} tok/s"
+                            if engine == "fake"
+                            else " ".join(ENGINE_PROTECTION_ARGS)),
+            "phases": phases,
+        },
+    }

@@ -16,6 +16,7 @@ from typing import Deque, Dict, Optional, Tuple
 import aiohttp
 from prometheus_client.parser import text_string_to_metric_families
 
+from production_stack_tpu.signals import LoadPoller, parse_load_report
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -269,57 +270,48 @@ def parse_engine_metrics(text: str) -> EngineStats:
     )
 
 
-class EngineStatsScraper:
-    """Polls every engine's /metrics on an interval (asyncio task)."""
+class EngineStatsScraper(LoadPoller):
+    """Polls every engine's /load on an interval (asyncio task).
+
+    Built on the shared ``signals.LoadPoller`` so the endpoint-cap
+    derivation (proxy._endpoint_cap), the stat logger, and an embedded
+    autoscaler all read ONE scrape per engine per interval. The /load
+    report is a purpose-built JSON answer — far cheaper on both sides
+    than parsing a full Prometheus exposition — and carries everything
+    EngineStats needs; engines that do not serve /load (a foreign
+    vLLM pod behind the same router) fall back to the /metrics parse.
+    """
 
     def __init__(self, get_endpoints, interval_s: float = 10.0):
-        self._get_endpoints = get_endpoints
-        self.interval = interval_s
+        super().__init__(
+            lambda: [ep.url for ep in get_endpoints()],
+            interval_s=interval_s)
         self._stats: Dict[str, EngineStats] = {}
-        self._task: Optional[asyncio.Task] = None
-        self._session: Optional[aiohttp.ClientSession] = None
 
-    async def start(self) -> None:
-        self._session = aiohttp.ClientSession()
-        self._task = asyncio.create_task(self._loop(), name="engine-scraper")
+    def _build(self, data: dict) -> EngineStats:
+        load = parse_load_report(data)
+        return EngineStats(
+            num_running=load.running,
+            num_waiting=load.queue_depth,
+            kv_usage=load.kv_usage,
+            # EngineStats keeps 0.0 as its unbounded-admission sentinel
+            # (pre-/load consumers pin it: see proxy._endpoint_cap)
+            capacity=load.capacity if load.capacity is not None else 0.0,
+            est_queue_delay_ms=load.est_queue_delay_ms,
+        )
 
-    async def close(self) -> None:
-        if self._task:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-        if self._session:
-            await self._session.close()
-
-    def healthy(self) -> bool:
-        return self._task is not None and not self._task.done()
+    async def _fetch_fallback(self, url: str) -> Optional[EngineStats]:
+        try:
+            async with self._session.get(f"{url}/metrics",
+                                         timeout=self._timeout) as r:
+                if r.status == 200:
+                    return parse_engine_metrics(await r.text())
+        except (aiohttp.ClientError, asyncio.TimeoutError):
+            pass
+        return None
 
     def get(self) -> Dict[str, EngineStats]:
         return dict(self._stats)
-
-    async def _loop(self) -> None:
-        while True:
-            await self._scrape_once()
-            await asyncio.sleep(self.interval)
-
-    async def _scrape_one(self, url: str) -> None:
-        try:
-            async with self._session.get(
-                    f"{url}/metrics",
-                    timeout=aiohttp.ClientTimeout(total=5)) as r:
-                if r.status == 200:
-                    self._stats[url] = parse_engine_metrics(await r.text())
-        except (aiohttp.ClientError, asyncio.TimeoutError):
-            self._stats.pop(url, None)   # stale engine drops out
-
-    async def _scrape_once(self) -> None:
-        urls = {ep.url for ep in self._get_endpoints()}
-        # concurrent: one slow/unreachable engine must not stall the rest
-        await asyncio.gather(*(self._scrape_one(u) for u in urls))
-        for gone in set(self._stats) - urls:
-            del self._stats[gone]
 
 
 class StatLogger:

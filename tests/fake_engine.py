@@ -39,6 +39,16 @@ to the next ``count`` requests (-1 = until cleared):
 health probes fail along with inference (a fully-dead engine); the
 default ``"inference"`` scope keeps probes answering (a sick engine
 that still looks alive to discovery).
+
+Load-signal overrides (the autoscaler's lever): ``POST /fault`` also
+accepts ``capacity`` and ``queue_delay_ms`` keys — runtime-settable
+advertised capacity (``tpu:engine_capacity_seqs`` + /load
+``capacity``) and reported queue delay (``tpu:est_queue_delay_ms`` +
+/load ``est_queue_delay_ms``) — so scale-up/down decisions can be
+exercised without generating real load. A body carrying ONLY these
+keys adjusts signals without touching the active fault mode; ``null``
+clears an override (capacity falls back to the overload-fault-derived
+value, queue delay to 0).
 """
 
 import asyncio
@@ -76,6 +86,10 @@ class FakeEngine:
         self.last_raw = b""              # exact bytes of the last POST body
         self.last_headers = {}           # headers of the last inference POST
         self._in_flight = 0
+        # runtime-settable load signals (POST /fault): advertised
+        # capacity and reported queue delay, None = not overridden
+        self.capacity_override: Optional[float] = None
+        self.queue_delay_override: Optional[float] = None
         # {"mode": ..., "count": int (-1 = persistent), "arg": float,
         #  "scope": "inference" | "all"}
         self.fault: Optional[dict] = dict(fault) if fault else None
@@ -185,13 +199,55 @@ class FakeEngine:
             return resp
         return None
 
+    def set_load_signals(self, **overrides) -> None:
+        """Direct (no-HTTP) equivalent of POSTing ``capacity`` /
+        ``queue_delay_ms`` to /fault, for in-process tests holding the
+        object."""
+        self._apply_signal_overrides(overrides)
+
+    def _apply_signal_overrides(self, body: dict) -> None:
+        if "capacity" in body:
+            v = body["capacity"]
+            self.capacity_override = None if v is None else float(v)
+            if self.capacity_override is None:
+                # cleared: the gauge falls back to the fault-derived
+                # value so /metrics and /load keep agreeing
+                f = self.fault or {}
+                if f.get("mode") == "overload":
+                    arg = f.get("arg")
+                    self.gauges["tpu:engine_capacity_seqs"] = \
+                        1.0 if arg is None else float(arg)
+                else:
+                    self.gauges["tpu:engine_capacity_seqs"] = 0.0
+        if "queue_delay_ms" in body:
+            v = body["queue_delay_ms"]
+            self.queue_delay_override = None if v is None else float(v)
+            # written only when the key was sent: a fault-mode POST
+            # must not clobber a gauge a test set directly
+            self.gauges["tpu:est_queue_delay_ms"] = \
+                self.queue_delay_override or 0.0
+        if self.capacity_override is not None:
+            self.gauges["tpu:engine_capacity_seqs"] = \
+                self.capacity_override
+
     async def set_fault(self, request: web.Request) -> web.Response:
         """POST /fault {"mode": "error", "count": 5, "arg": 1.0,
-        "scope": "all"} — mode null/absent clears."""
+        "scope": "all"} — mode null/absent clears. ``capacity`` /
+        ``queue_delay_ms`` keys set load-signal overrides; a body with
+        ONLY those keys leaves the fault mode alone."""
         body = await request.json()
+        signal_only = bool(body) and set(body) <= {"capacity",
+                                                   "queue_delay_ms"}
+        if signal_only:
+            self._apply_signal_overrides(body)
+            return web.json_response(
+                {"fault": self.fault,
+                 "capacity": self.capacity_override,
+                 "queue_delay_ms": self.queue_delay_override})
         mode = body.get("mode")
         if mode is None:
             self.fault = None
+            self._apply_signal_overrides(body)
             return web.json_response({"fault": None})
         if mode not in FAULT_MODES:
             return web.json_response(
@@ -209,6 +265,7 @@ class FakeEngine:
                 1.0 if arg is None else float(arg)
         else:
             self.gauges["tpu:engine_capacity_seqs"] = 0.0
+        self._apply_signal_overrides(body)
         return web.json_response({"fault": self.fault})
 
     async def get_fault(self, request: web.Request) -> web.Response:
@@ -303,19 +360,28 @@ class FakeEngine:
         return web.json_response({"status": "ok"})
 
     async def load(self, request: web.Request) -> web.Response:
-        """Mirror of the real engine's /load report."""
+        """Mirror of the real engine's /load report. The capacity /
+        queue-delay overrides (POST /fault) win over fault-derived
+        values so autoscaler tests can steer decisions directly."""
         f = self.fault or {}
         cap = None
         if f.get("mode") == "overload":
             cap = 1 if f.get("arg") is None else int(f["arg"])
+        if self.capacity_override is not None:
+            cap = self.capacity_override
+        # /load and /metrics must agree like a real engine's do: tests
+        # set gauges directly and read either surface
         return web.json_response({
-            "queue_depth": 0,
+            "queue_depth": self.gauges["vllm:num_requests_waiting"],
             "running": self._in_flight,
             "max_num_seqs": cap if cap else 8,
             "max_waiting_seqs": 0 if cap is not None else None,
             "capacity": cap,
             "free_kv_blocks": 1024,
-            "kv_usage": self.gauges["tpu:hbm_kv_usage_perc"],
+            # the /metrics exposition always carries both KV spellings,
+            # so parse_engine_metrics always prefers the vllm one —
+            # report exactly that value here for surface agreement
+            "kv_usage": self.gauges["vllm:gpu_cache_usage_perc"],
             "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
         })
 
