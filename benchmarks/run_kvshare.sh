@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Cross-replica KV sharing: the committed KVSHARE_r11.json recipe plus
+# the r7 router-overhead no-regression guard with cache-aware scoring.
+#
+#   ./benchmarks/run_kvshare.sh            # fake engines (data path)
+#   ENGINE=debug-tiny ./benchmarks/run_kvshare.sh   # real engines (CPU)
+#
+# Exit 1 if the kvshare contract fails (hit rate <= 60%, no TTFT win,
+# any client-visible error) OR the overhead ratio with cache-aware
+# prefix routing on cold-prefix traffic exceeds the 2.5x r7 band.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+OUT="${OUT:-KVSHARE_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen kvshare \
+  --engine "$ENGINE" --engines "${ENGINES:-2}" \
+  --sessions "${SESSIONS:-4}" --rounds "${ROUNDS:-6}" \
+  --output "$OUT" "$@"
+
+echo "kvshare record: $OUT"
+
+# r7 band guard: cache-aware scoring must not regress the router's
+# data-plane overhead on traffic it can never help (cold prefixes)
+python -m production_stack_tpu.loadgen overhead \
+  --routing prefix --unique-prompts \
+  --users "${OVERHEAD_USERS:-64}" --duration "${OVERHEAD_DURATION:-15s}" \
+  --max-ratio 2.5 \
+  --output "${OVERHEAD_OUT:-ROUTER_OVERHEAD_kvshare_guard.json}"

@@ -1203,6 +1203,10 @@ class LLMEngine:
     def render_metrics(self) -> bytes:
         with self._lock:
             self._refresh_gauges()
+            if self.connector is not None:
+                # totals -> counter deltas + tier occupancy gauges, at
+                # scrape frequency (never on the step loop)
+                self.metrics.sync_kv(self.connector.stats_report())
         return self.metrics.render()
 
     # ------------------------------------------------- overload surface
@@ -1242,7 +1246,7 @@ class LLMEngine:
         cap = None
         if self.cfg.max_waiting_seqs is not None:
             cap = self.cfg.max_num_seqs + self.cfg.max_waiting_seqs
-        return {
+        report = {
             "queue_depth": len(sched.waiting),
             "running": len(sched.running) + len(sched._prefilling),
             "max_num_seqs": self.cfg.max_num_seqs,
@@ -1256,6 +1260,12 @@ class LLMEngine:
             "est_queue_delay_ms": round(
                 1e3 * self.estimated_queue_delay_s(), 1),
         }
+        if self.connector is not None:
+            # tier hit/miss/bytes counters (all in-memory totals — no
+            # I/O): the cache-aware router scores endpoints on these,
+            # and the kvshare rig reads them for its pass/fail contract
+            report["kv_cache"] = self.connector.stats_report()
+        return report
 
     # ---------------------------------------------------- paged-KV host
 

@@ -94,6 +94,79 @@ class EngineMetrics:
         self.est_queue_delay = gauge(
             "tpu:est_queue_delay_ms",
             "Estimated wait for a newly queued request (ms)")
+        # KV tiering (kvcache/connector.py): hit/miss/bytes counters
+        # plus per-tier occupancy gauges. The connector keeps running
+        # totals; sync_kv() converts them to counter increments at
+        # scrape time (render path), so the hot loop never touches
+        # prometheus objects.
+        self.kv_query_tokens = counter(
+            "tpu:kvcache_query_tokens_total",
+            "Prompt tokens looked up against the KV tiers")
+        self.kv_hit_tokens = counter(
+            "tpu:kvcache_hit_tokens_total",
+            "Prompt tokens served from the KV tiers (prefill skipped)")
+        self.kv_foreign_hit_tokens = counter(
+            "tpu:kvcache_foreign_hit_tokens_total",
+            "Tier-hit tokens from chunks this process never published "
+            "(produced by another replica — cross-replica sharing)")
+        self.kv_chunk_hits = counter(
+            "tpu:kvcache_chunk_hits_total", "Tier chunk lookups that hit")
+        self.kv_chunk_misses = counter(
+            "tpu:kvcache_chunk_misses_total",
+            "Tier chunk lookups that ended the prefix walk")
+        self.kv_bytes_loaded = counter(
+            "tpu:kvcache_bytes_loaded_total",
+            "Bytes materialized from the tiers by prefetch")
+        self.kv_bytes_saved = counter(
+            "tpu:kvcache_bytes_saved_total",
+            "Bytes written through the tiers by the publish path")
+        self.kv_rejected_chunks = counter(
+            "tpu:kvcache_rejected_chunks_total",
+            "Tier values rejected (size/checksum validation) and evicted")
+        self.kv_dropped_saves = counter(
+            "tpu:kvcache_dropped_saves_total",
+            "Publish batches dropped by writer-queue backpressure")
+        self.kv_remote_breaker_open = gauge(
+            "tpu:kvcache_remote_breaker_open",
+            "1 while the remote cache-server tier is breaker-skipped")
+        self._kv_tier_bytes = Gauge(
+            "tpu:kvcache_tier_bytes", "KV tier occupancy in bytes",
+            list(labels) + ["tier"], registry=self.registry)
+        self._kv_tier_items = Gauge(
+            "tpu:kvcache_tier_items", "KV tier chunk count",
+            list(labels) + ["tier"], registry=self.registry)
+        self._labels = labels
+        self._kv_last: dict = {}
+
+    _KV_COUNTER_KEYS = (
+        ("query_tokens", "kv_query_tokens"),
+        ("hit_tokens", "kv_hit_tokens"),
+        ("foreign_hit_tokens", "kv_foreign_hit_tokens"),
+        ("chunk_hits", "kv_chunk_hits"),
+        ("chunk_misses", "kv_chunk_misses"),
+        ("bytes_loaded", "kv_bytes_loaded"),
+        ("bytes_saved", "kv_bytes_saved"),
+        ("rejected_chunks", "kv_rejected_chunks"),
+        ("dropped_saves", "kv_dropped_saves"),
+    )
+
+    def sync_kv(self, report: dict) -> None:
+        """Fold a connector ``stats_report()`` into the exposition:
+        counters advance by the delta since the last sync, tier gauges
+        are set absolutely."""
+        for src, attr in self._KV_COUNTER_KEYS:
+            total = report.get(src, 0)
+            delta = total - self._kv_last.get(src, 0)
+            if delta > 0:
+                getattr(self, attr).inc(delta)
+            self._kv_last[src] = total
+        self.kv_remote_breaker_open.set(
+            1.0 if report.get("remote_breaker_open") else 0.0)
+        for tier, st in (report.get("tiers") or {}).items():
+            self._kv_tier_bytes.labels(tier=tier, **self._labels).set(
+                st.get("bytes", 0))
+            self._kv_tier_items.labels(tier=tier, **self._labels).set(
+                st.get("count", 0))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

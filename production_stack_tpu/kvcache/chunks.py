@@ -16,14 +16,40 @@ int32 packing, never Python's salted hash().
 
 import hashlib
 import struct
-from typing import List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, List, Optional, Sequence, Tuple
 
-from production_stack_tpu.models.config import ModelConfig
+if TYPE_CHECKING:   # annotation only — keep this module import-light:
+    # the ROUTER hashes prompt chunks through chain_digest_bytes, and
+    # models.config would drag jax into its process
+    from production_stack_tpu.models.config import ModelConfig
 
 DEFAULT_CHUNK_SIZE = 256
 
 
-def model_fingerprint(cfg: ModelConfig, kv_dtype: str = "bfloat16") -> str:
+def chain_digest_bytes(data: bytes, chunk_bytes: int,
+                       digest_size: int = 12) -> List[bytes]:
+    """Chained digests of ``data``'s full ``chunk_bytes`` chunks.
+
+    The byte-level analogue of ``ChunkHasher.chain_keys``: digest i
+    folds digest i-1, so two byte strings produce identical digests
+    exactly up to their longest common chunk-aligned prefix, and a
+    match on digest i implies the whole leading prefix matches. Shared
+    by the router's cache-aware prefix ring and the fake engine's KV
+    simulation (tests/fake_engine.py) so the two sides of the kvshare
+    rig can never drift apart."""
+    out: List[bytes] = []
+    prev = b""
+    for i in range(0, len(data) - chunk_bytes + 1, chunk_bytes):
+        h = hashlib.blake2b(digest_size=digest_size)
+        h.update(prev)
+        h.update(data[i:i + chunk_bytes])
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
+def model_fingerprint(cfg: "ModelConfig",
+                      kv_dtype: str = "bfloat16") -> str:
     """Cache-key namespace: everything the KV layout/values depend on."""
     raw = (f"{cfg.name}|L{cfg.num_layers}|H{cfg.num_kv_heads}"
            f"|D{cfg.head_dim_}|rope{cfg.rope_theta}|{kv_dtype}")

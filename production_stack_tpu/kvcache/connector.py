@@ -48,6 +48,19 @@ class KVTransferConfig:
     local_disk_path: Optional[str] = None
     local_disk_gb: float = 16.0
     remote_url: Optional[str] = None    # tpukv://host:port
+    # remote-tier failure bounds: a dead/hung cache server must degrade
+    # to recompute, never stall admission — per-op socket timeouts plus
+    # a breaker that short-circuits every remote call after
+    # `remote_breaker_threshold` consecutive failures for
+    # `remote_breaker_cooldown_s` (kvcache/store.RemoteStore)
+    remote_connect_timeout_s: float = 2.0
+    remote_io_timeout_s: float = 5.0
+    remote_breaker_threshold: int = 3
+    remote_breaker_cooldown_s: float = 10.0
+    # hard wall-clock budget for one prefetch's tier walk: past it the
+    # walk stops and the request prefills the rest (bounds TTFT under a
+    # slow tier; the per-op timeouts bound each individual chunk read)
+    prefetch_timeout_s: float = 2.0
 
     @classmethod
     def from_dict(cls, d: dict) -> "KVTransferConfig":
@@ -99,7 +112,11 @@ class KVConnector:
             local_cpu_bytes=int(cfg.local_cpu_gb * (1 << 30)),
             local_disk_path=cfg.local_disk_path,
             local_disk_bytes=int(cfg.local_disk_gb * (1 << 30)),
-            remote_url=cfg.remote_url)
+            remote_url=cfg.remote_url,
+            remote_connect_timeout_s=cfg.remote_connect_timeout_s,
+            remote_io_timeout_s=cfg.remote_io_timeout_s,
+            remote_breaker_threshold=cfg.remote_breaker_threshold,
+            remote_breaker_cooldown_s=cfg.remote_breaker_cooldown_s)
         if self.store is None:
             raise ValueError("KV transfer enabled but no tier configured")
         shape = (model_cfg.num_layers, cfg.chunk_size,
@@ -133,6 +150,17 @@ class KVConnector:
         self.queries = 0
         self.query_tokens = 0
         self.hit_tokens = 0
+        # hits on chunks this process never published or fetched before:
+        # another replica produced them (the cross-replica share the
+        # kvshare rig measures). Re-fetches of a chunk this process has
+        # already seen count as plain hits only.
+        self.foreign_hit_tokens = 0
+        self.chunk_hits = 0
+        self.chunk_misses = 0       # walk-terminating misses
+        self.bytes_loaded = 0       # tier bytes materialized by prefetch
+        self.bytes_saved = 0        # tier bytes written through
+        self.rejected_chunks = 0    # size/checksum-invalid values
+        self.prefetch_deadline_hits = 0
         self.dropped_saves = 0
 
     # -- consumer path --------------------------------------------------
@@ -149,25 +177,42 @@ class KVConnector:
         """
         if not self.cfg.is_consumer:
             return None
+        import time
         n = len(prompt_tokens)
         self.queries += 1
         self.query_tokens += n
         keys = self.hasher.chunk_keys(prompt_tokens, salt=salt)
         chunks: List[Tuple[np.ndarray, np.ndarray]] = []
         hit_keys: List[bytes] = []
+        foreign: List[bool] = []
+        # hard budget on the whole walk: each chunk read is already
+        # bounded by the store's own timeouts, but a *slow-not-dead*
+        # tier must not stack N of those onto one request's TTFT
+        deadline = time.monotonic() + self.cfg.prefetch_timeout_s
         for key in keys:
+            if time.monotonic() >= deadline:
+                self.prefetch_deadline_hits += 1
+                break
             val = self.store.get(key)
             if val is None:
+                self.chunk_misses += 1
                 break
-            kv = self._deserialize(val)
+            kv = self._deserialize(key, val)
             if kv is None:
                 break
+            self.chunk_hits += 1
+            self.bytes_loaded += len(val)
+            foreign.append(key not in self._seen_keys)
             chunks.append(kv)
             hit_keys.append(key)
         if not chunks:
             return None
         cached = min(len(chunks) * self.chunk_size, n - 1)
         self.hit_tokens += cached
+        for i, is_foreign in enumerate(foreign):
+            if is_foreign:
+                self.foreign_hit_tokens += max(
+                    0, min(self.chunk_size, cached - i * self.chunk_size))
         return Prefetch(keys=hit_keys, chunks=chunks, cached_tokens=cached)
 
     def inject(self, prefetch: Prefetch, slot: int) -> None:
@@ -254,7 +299,8 @@ class KVConnector:
                 for key, k_dev, v_dev in work:
                     try:
                         val = self._serialize(k_dev, v_dev)
-                        self.store.put(key, val)
+                        if self.store.put(key, val):
+                            self.bytes_saved += len(val)
                     except Exception as e:   # never kill the writer
                         logger.warning("KV save failed: %s", e)
             finally:
@@ -262,16 +308,36 @@ class KVConnector:
 
     # -- serialization ---------------------------------------------------
 
+    # trailing full-chunk integrity digest: a torn or bit-flipped value
+    # surfacing from any tier (a killed replica mid-publish, a corrupt
+    # disk file) must read as a MISS, never inject garbage KV
+    _DIGEST_BYTES = 8
+
+    @staticmethod
+    def _digest(data) -> bytes:
+        import hashlib
+        return hashlib.blake2b(
+            data, digest_size=KVConnector._DIGEST_BYTES).digest()
+
     def _serialize(self, k_dev, v_dev) -> bytes:
         k = np.asarray(k_dev)     # blocks until D2H completes
         v = np.asarray(v_dev)
-        return k.tobytes() + v.tobytes()
+        body = k.tobytes() + v.tobytes()
+        return body + self._digest(body)
 
-    def _deserialize(self, val: bytes) -> \
+    def _deserialize(self, key: bytes, val: bytes) -> \
             Optional[Tuple[np.ndarray, np.ndarray]]:
-        if len(val) != 2 * self._chunk_bytes:
-            logger.warning("KV chunk size mismatch: %d != %d", len(val),
-                           2 * self._chunk_bytes)
+        want = 2 * self._chunk_bytes + self._DIGEST_BYTES
+        if len(val) != want:
+            logger.warning("KV chunk size mismatch: %d != %d (evicting "
+                           "%s)", len(val), want, key.hex()[:16])
+            self._reject(key)
+            return None
+        body, digest = val[:-self._DIGEST_BYTES], val[-self._DIGEST_BYTES:]
+        if self._digest(body) != digest:
+            logger.warning("KV chunk checksum mismatch (evicting %s)",
+                           key.hex()[:16])
+            self._reject(key)
             return None
         k = np.frombuffer(val, self._np_dtype, count=int(
             np.prod(self._chunk_shape))).reshape(self._chunk_shape)
@@ -279,6 +345,16 @@ class KVConnector:
                           count=int(np.prod(self._chunk_shape))).reshape(
                               self._chunk_shape)
         return k, v
+
+    def _reject(self, key: bytes) -> None:
+        """Invalid tier value: count it and delete the poisoned key so
+        the next producer pass can republish a good copy."""
+        self.rejected_chunks += 1
+        try:
+            self.store.delete(key)
+        except Exception:      # deletion is best-effort cleanup
+            pass
+        self._seen_keys.pop(key, None)
 
     # -- misc ------------------------------------------------------------
 
@@ -291,6 +367,48 @@ class KVConnector:
     def hit_rate(self) -> float:
         return self.hit_tokens / self.query_tokens if self.query_tokens \
             else 0.0
+
+    def remote_breaker_open(self) -> bool:
+        """True while the remote tier (if any) is being skipped."""
+        from production_stack_tpu.kvcache.store import (RemoteStore,
+                                                        TieredStore)
+        stores = self.store.tiers if isinstance(self.store, TieredStore) \
+            else [self.store]
+        return any(s.breaker_open() for s in stores
+                   if isinstance(s, RemoteStore))
+
+    def tier_stats(self) -> dict:
+        """{tier_name: {bytes, count, ...}} for the occupancy gauges."""
+        try:
+            return self.store.tier_stats()
+        except Exception as e:    # a sick tier must not break /load
+            logger.warning("KV tier stats failed: %s", e)
+            return {}
+
+    def stats_report(self) -> dict:
+        """Counters surfaced on /load (and deltas fed to /metrics):
+        everything the cache-aware router and the kvshare rig read."""
+        return {
+            "queries": self.queries,
+            "query_tokens": self.query_tokens,
+            "hit_tokens": self.hit_tokens,
+            "foreign_hit_tokens": self.foreign_hit_tokens,
+            "hit_rate": round(self.hit_rate, 4),
+            "chunk_hits": self.chunk_hits,
+            "chunk_misses": self.chunk_misses,
+            "bytes_loaded": self.bytes_loaded,
+            "bytes_saved": self.bytes_saved,
+            "rejected_chunks": self.rejected_chunks,
+            "dropped_saves": self.dropped_saves,
+            "prefetch_deadline_hits": self.prefetch_deadline_hits,
+            "remote_breaker_open": self.remote_breaker_open(),
+            # remote occupancy lives on the cache server's own surface;
+            # its local entry carries only breaker state (no bytes)
+            "tiers": {name: {"bytes": st.get("bytes", 0),
+                             "count": st.get("count", 0)}
+                      for name, st in self.tier_stats().items()
+                      if "bytes" in st},
+        }
 
     def flush(self, timeout: float = 30.0) -> None:
         """Block until queued saves are written (tests/shutdown)."""

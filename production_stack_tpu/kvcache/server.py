@@ -26,12 +26,35 @@ logger = init_logger(__name__)
 
 
 class CacheServer:
-    """Asyncio TPKV server over a HostMemoryStore."""
+    """Asyncio TPKV server over a HostMemoryStore (+ optional disk spill).
+
+    Write atomicity: a PUT mutates the store only after the ENTIRE value
+    frame has been received (``readexactly``) — a replica killed
+    mid-publish tears the connection, not the shared tier (pinned by
+    tests/test_kvcache.py). Concurrent same-key PUTs are last-writer-wins
+    full-value swaps: memory-tier puts replace under the store lock, and
+    the disk tier writes tmp-file + rename. Consumers additionally
+    validate a full-chunk checksum (kvcache/connector.py), so even a
+    corrupt value degrades to a miss, never to poisoned KV.
+    """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 8100,
-                 capacity_bytes: int = 4 << 30):
+                 capacity_bytes: int = 4 << 30,
+                 disk_path: Optional[str] = None,
+                 disk_capacity_bytes: int = 1 << 34):
         self.host, self.port = host, port
         self.store = HostMemoryStore(capacity_bytes)
+        # with a disk tier, store ops do real file I/O (plus eviction
+        # scans) — run them on worker threads so one replica's publish
+        # burst can never stall every other client's GET on the event
+        # loop (the stores are lock-protected and thread-safe)
+        self._offload_ops = bool(disk_path)
+        if disk_path:
+            from production_stack_tpu.kvcache.store import (DiskStore,
+                                                            TieredStore)
+            self.store = TieredStore([self.store,
+                                      DiskStore(disk_path,
+                                                disk_capacity_bytes)])
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
@@ -39,8 +62,10 @@ class CacheServer:
                                                   self.port)
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
-        logger.info("TPKV cache server on %s:%d (backend=%s)", self.host,
-                    self.port, self.store.backend)
+        logger.info("TPKV cache server on %s:%d (backend=%s, tiers=%s)",
+                    self.host, self.port,
+                    getattr(self.store, "backend", "tiered"),
+                    list(self.store.tier_stats()))
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -65,7 +90,12 @@ class CacheServer:
                 op, klen, vlen = protocol.decode_request_header(hdr)
                 key = await reader.readexactly(klen) if klen else b""
                 val = await reader.readexactly(vlen) if vlen else b""
-                writer.write(self._dispatch(op, key, val))
+                if self._offload_ops:
+                    resp = await asyncio.to_thread(self._dispatch, op,
+                                                   key, val)
+                else:
+                    resp = self._dispatch(op, key, val)
+                writer.write(resp)
                 await writer.drain()
         except (ValueError, ConnectionError, OSError):
             pass
@@ -105,12 +135,21 @@ def main(argv=None) -> int:
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8100)
     parser.add_argument("--capacity-gb", type=float, default=4.0)
+    parser.add_argument("--disk-path", default=None,
+                        help="spill tier: evicted/overflow chunks "
+                             "persist here (tmp-file + rename writes; "
+                             "python backend only)")
+    parser.add_argument("--disk-gb", type=float, default=16.0)
     parser.add_argument("--backend", choices=["auto", "native", "python"],
                         default="auto",
                         help="native = exec the C++ pskv-server binary")
     args = parser.parse_args(argv)
 
-    if args.backend in ("auto", "native"):
+    if args.backend == "native" and args.disk_path:
+        logger.error("--disk-path requires --backend python (the native "
+                     "pskv-server is memory-only)")
+        return 1
+    if args.backend in ("auto", "native") and not args.disk_path:
         binary = server_binary()
         if binary is not None:
             os.execv(binary, [binary, "--host", args.host,
@@ -121,7 +160,9 @@ def main(argv=None) -> int:
             return 1
 
     server = CacheServer(args.host, args.port,
-                         int(args.capacity_gb * (1 << 30)))
+                         int(args.capacity_gb * (1 << 30)),
+                         disk_path=args.disk_path,
+                         disk_capacity_bytes=int(args.disk_gb * (1 << 30)))
     loop = asyncio.new_event_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, loop.stop)

@@ -30,6 +30,9 @@ logger = init_logger(__name__)
 class KVStore(ABC):
     """get/put/exists/delete over opaque byte values."""
 
+    #: short name used as the ``tier`` label on occupancy gauges
+    tier_name = "unknown"
+
     @abstractmethod
     def get(self, key: bytes) -> Optional[bytes]: ...
 
@@ -44,6 +47,11 @@ class KVStore(ABC):
 
     def stats(self) -> Dict[str, int]:
         return {}
+
+    def tier_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-tier occupancy: {tier_name: stats}. Single stores report
+        themselves; TieredStore fans out."""
+        return {self.tier_name: self.stats()}
 
     def close(self) -> None:
         pass
@@ -109,9 +117,16 @@ class _PyLruStore:
 
 
 class HostMemoryStore(KVStore):
-    """Host-DRAM tier (the LMCACHE_LOCAL_CPU equivalent), native-backed."""
+    """Host-DRAM tier (the LMCACHE_LOCAL_CPU equivalent), native-backed.
+
+    The configured byte budget is a hard bound enforced by LRU eviction
+    (both backends), so a long soak can grow the tier only up to
+    ``capacity_bytes`` — never into the host OOM killer."""
+
+    tier_name = "cpu"
 
     def __init__(self, capacity_bytes: int, force_python: bool = False):
+        self.capacity = capacity_bytes
         if not force_python and load() is not None:
             self._impl = NativeLruStore(capacity_bytes)
             self.backend = "native"
@@ -135,7 +150,9 @@ class HostMemoryStore(KVStore):
         self._impl.clear()
 
     def stats(self) -> Dict[str, int]:
-        return self._impl.stats()
+        out = dict(self._impl.stats())
+        out.setdefault("capacity", self.capacity)
+        return out
 
 
 class DiskStore(KVStore):
@@ -143,13 +160,31 @@ class DiskStore(KVStore):
 
     One file per chunk under `root`, LRU by mtime, byte-bounded. Writes are
     tmp-file + rename so a crash never leaves a torn chunk visible.
+    Occupancy is accounted incrementally (seeded by one startup scan) so
+    ``stats()`` — polled by /load and /metrics — never walks the
+    directory on the serving path.
     """
+
+    tier_name = "disk"
 
     def __init__(self, root: str, capacity_bytes: int = 1 << 34):
         self.root = root
         self.capacity = capacity_bytes
         self._lock = threading.Lock()
         os.makedirs(root, exist_ok=True)
+        self._bytes, self._count = self._scan()
+
+    def _scan(self):
+        total = count = 0
+        try:
+            with os.scandir(self.root) as it:
+                for e in it:
+                    if e.name.endswith(".kv"):
+                        count += 1
+                        total += e.stat().st_size
+        except OSError:
+            pass
+        return total, count
 
     def _path(self, key: bytes) -> str:
         return os.path.join(self.root, key.hex() + ".kv")
@@ -168,13 +203,36 @@ class DiskStore(KVStore):
         if len(val) > self.capacity:
             return False
         path = self._path(key)
-        tmp = path + ".tmp"
+        # per-writer tmp name: concurrent same-key PUTs (the threaded
+        # --disk-path cache server) each write their own file and race
+        # only on the atomic rename — last writer wins with a FULL
+        # value, never interleaved bytes
+        tmp = f"{path}.{os.getpid()}.{threading.get_ident()}.tmp"
         try:
             with open(tmp, "wb") as f:
                 f.write(val)
-            os.replace(tmp, path)
         except OSError:
             return False
+        # stat + replace + accounting are one atomic step: a racing
+        # delete() (prefetch-side eviction of a poisoned chunk) between
+        # them would otherwise leave _bytes under-counted and eviction
+        # deferred past the configured budget
+        with self._lock:
+            try:
+                old = os.stat(path).st_size
+            except OSError:
+                old = -1          # new key
+            try:
+                os.replace(tmp, path)
+            except OSError:
+                try:
+                    os.remove(tmp)     # never leak a stray tmp
+                except OSError:
+                    pass
+                return False
+            self._bytes += len(val) - max(old, 0)
+            if old < 0:
+                self._count += 1
         self._evict()
         return True
 
@@ -182,14 +240,21 @@ class DiskStore(KVStore):
         return os.path.exists(self._path(key))
 
     def delete(self, key: bytes) -> bool:
-        try:
-            os.remove(self._path(key))
-            return True
-        except OSError:
-            return False
+        path = self._path(key)
+        with self._lock:
+            try:
+                size = os.stat(path).st_size
+                os.remove(path)
+            except OSError:
+                return False
+            self._bytes -= size
+            self._count -= 1
+        return True
 
     def _evict(self) -> None:
         with self._lock:
+            if self._bytes <= self.capacity:
+                return
             try:
                 entries = []
                 total = 0
@@ -201,28 +266,27 @@ class DiskStore(KVStore):
                         entries.append((st.st_mtime, st.st_size, e.path))
                         total += st.st_size
                 entries.sort()  # oldest first
+                removed_b = removed_n = 0
                 for _, size, path in entries:
-                    if total <= self.capacity:
+                    if total - removed_b <= self.capacity:
                         break
                     try:
                         os.remove(path)
-                        total -= size
+                        removed_b += size
+                        removed_n += 1
                     except OSError:
                         pass
+                # re-anchor on the scan (heals drift from external
+                # deletions too)
+                self._bytes = total - removed_b
+                self._count = len(entries) - removed_n
             except OSError:
                 pass
 
     def stats(self) -> Dict[str, int]:
-        total = count = 0
-        try:
-            with os.scandir(self.root) as it:
-                for e in it:
-                    if e.name.endswith(".kv"):
-                        count += 1
-                        total += e.stat().st_size
-        except OSError:
-            pass
-        return {"bytes": total, "count": count}
+        with self._lock:
+            return {"bytes": self._bytes, "count": self._count,
+                    "capacity": self.capacity}
 
 
 class RemoteStore(KVStore):
@@ -233,17 +297,62 @@ class RemoteStore(KVStore):
     multi-megabyte chunk batches, and serializing the admission-path
     prefetch reads behind those writes would add the write time straight to
     TTFT on cache hits.
+
+    Failure behavior is *bounded and breaker-guarded*: every operation is
+    soft (a dead or hung cache server degrades to a miss/no-op inside
+    ``connect_timeout``/``io_timeout``), and after
+    ``breaker_threshold`` consecutive failures the store short-circuits
+    every call for ``breaker_cooldown_s`` — a sick cache server costs
+    each request at most the breaker probe, never a per-chunk timeout
+    walk on the TTFT path (ISSUE 6 chaos contract; docs/kv-tiering.md).
     """
 
+    tier_name = "remote"
+
     def __init__(self, url: str, connect_timeout: float = 5.0,
-                 io_timeout: float = 30.0):
+                 io_timeout: float = 30.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 10.0):
         self.host, self.port = protocol.parse_url(url)
         self.url = url
         self.connect_timeout = connect_timeout
         self.io_timeout = io_timeout
+        self.breaker_threshold = max(1, breaker_threshold)
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._fail_lock = threading.Lock()
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._breaker_trips = 0
         self._local = threading.local()
         self._all_socks: List[socket.socket] = []
         self._all_lock = threading.Lock()
+
+    # -- breaker --------------------------------------------------------
+
+    def breaker_open(self) -> bool:
+        """True while calls are being short-circuited. The first caller
+        past the cooldown closes the window and probes for real."""
+        import time
+        with self._fail_lock:
+            return time.monotonic() < self._open_until
+
+    def _record_failure(self) -> None:
+        import time
+        with self._fail_lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self.breaker_threshold:
+                self._open_until = time.monotonic() + \
+                    self.breaker_cooldown_s
+                self._breaker_trips += 1
+                self._consecutive_failures = 0
+                logger.warning(
+                    "remote KV %s breaker open for %.1fs (%d consecutive "
+                    "failures)", self.url, self.breaker_cooldown_s,
+                    self.breaker_threshold)
+
+    def _record_success(self) -> None:
+        with self._fail_lock:
+            self._consecutive_failures = 0
 
     def _connect(self) -> socket.socket:
         sock = getattr(self._local, "sock", None)
@@ -280,7 +389,10 @@ class RemoteStore(KVStore):
 
     def _call(self, op: int, key: bytes = b"", val: bytes = b""):
         """-> (status, payload); one reconnect retry on a dead socket.
-        Thread-safe: each thread drives its own connection."""
+        Thread-safe: each thread drives its own connection. Raises
+        ConnectionError immediately while the breaker is open."""
+        if self.breaker_open():
+            raise ConnectionError(f"remote KV {self.url} breaker open")
         for attempt in (0, 1):
             try:
                 sock = self._connect()
@@ -288,10 +400,12 @@ class RemoteStore(KVStore):
                 hdr = self._recv_all(sock, protocol.RESP_HDR_SIZE)
                 status, vlen = protocol.decode_response_header(hdr)
                 payload = self._recv_all(sock, vlen) if vlen else b""
+                self._record_success()
                 return status, payload
             except (OSError, ConnectionError) as e:
                 self._drop()
                 if attempt:
+                    self._record_failure()
                     logger.warning("remote KV %s unreachable: %s",
                                    self.url, e)
                     raise
@@ -334,13 +448,28 @@ class RemoteStore(KVStore):
 
     def stats(self) -> Dict[str, int]:
         import json
+        out: Dict[str, int] = {}
         try:
             status, payload = self._call(protocol.OP_STATS)
             if status == protocol.STATUS_OK:
-                return json.loads(payload)
+                out = json.loads(payload)
         except (OSError, ConnectionError, ValueError):
             pass
-        return {}
+        import time
+        with self._fail_lock:
+            out["breaker_open"] = int(time.monotonic() < self._open_until)
+            out["breaker_trips"] = self._breaker_trips
+        return out
+
+    def tier_stats(self) -> Dict[str, Dict[str, int]]:
+        """Local-only view (NO network round trip — tier_stats feeds
+        load_report, which runs per response): breaker state here, the
+        server's own occupancy on the server's side."""
+        import time
+        with self._fail_lock:
+            return {self.tier_name: {
+                "breaker_open": int(time.monotonic() < self._open_until),
+                "breaker_trips": self._breaker_trips}}
 
     def close(self) -> None:
         with self._all_lock:
@@ -391,6 +520,12 @@ class TieredStore(KVStore):
                 out[f"tier{i}_{type(tier).__name__}_{k}"] = v
         return out
 
+    def tier_stats(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for tier in self.tiers:
+            out.update(tier.tier_stats())
+        return out
+
     def close(self) -> None:
         for tier in self.tiers:
             tier.close()
@@ -398,7 +533,12 @@ class TieredStore(KVStore):
 
 def make_store(local_cpu_bytes: int = 0, local_disk_path: Optional[str] = None,
                local_disk_bytes: int = 1 << 34,
-               remote_url: Optional[str] = None) -> Optional[KVStore]:
+               remote_url: Optional[str] = None,
+               remote_connect_timeout_s: float = 2.0,
+               remote_io_timeout_s: float = 5.0,
+               remote_breaker_threshold: int = 3,
+               remote_breaker_cooldown_s: float = 10.0
+               ) -> Optional[KVStore]:
     """Build the tier stack from config; None when all tiers are off."""
     tiers: List[KVStore] = []
     if local_cpu_bytes > 0:
@@ -406,7 +546,12 @@ def make_store(local_cpu_bytes: int = 0, local_disk_path: Optional[str] = None,
     if local_disk_path:
         tiers.append(DiskStore(local_disk_path, local_disk_bytes))
     if remote_url:
-        tiers.append(RemoteStore(remote_url))
+        tiers.append(RemoteStore(
+            remote_url,
+            connect_timeout=remote_connect_timeout_s,
+            io_timeout=remote_io_timeout_s,
+            breaker_threshold=remote_breaker_threshold,
+            breaker_cooldown_s=remote_breaker_cooldown_s))
     if not tiers:
         return None
     return tiers[0] if len(tiers) == 1 else TieredStore(tiers)

@@ -25,6 +25,11 @@ autoscale — launch router + autoscaler-owned engines and drive an
            every scale-up and drain-based scale-down, goodput at the
            peak must track offered load and beat the fixed-N
            comparison baseline (AUTOSCALE_*.json)
+kvshare  — launch a shared TPKV cache server + N engines wired to it
+           + the router with session affinity deliberately broken;
+           drive multi-round QA and exit 1 unless the cross-replica
+           tier hit rate clears 60% AND follow-up-round TTFT beats
+           the recompute baseline (KVSHARE_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -40,6 +45,8 @@ from production_stack_tpu.loadgen import report as report_mod
 from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
                                                     run_autoscale)
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
+from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
+                                                  run_kvshare)
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
 from production_stack_tpu.loadgen.overload import (overload_violations,
@@ -159,7 +166,9 @@ def cmd_overhead(args) -> int:
         num_tokens=args.num_tokens, stream=args.stream,
         routing=args.routing, platform=args.platform,
         log_dir=args.log_dir, startup_timeout_s=args.startup_timeout,
-        snapshot_ttl=args.snapshot_ttl))
+        snapshot_ttl=args.snapshot_ttl,
+        unique_prompts=args.unique_prompts,
+        prompt_chars=args.prompt_chars))
     print(json.dumps(record, indent=2))
     if args.output:
         report_mod.write_json(args.output, record)
@@ -168,7 +177,13 @@ def cmd_overhead(args) -> int:
     if bad:
         print(f"{bad} requests errored — the A/B is suspect",
               file=sys.stderr)
-    return 1 if bad else 0
+        return 1
+    ratio = d["overhead_ratio"]
+    if args.max_ratio and ratio and ratio > args.max_ratio:
+        print(f"OVERHEAD VIOLATION: ratio {ratio:.2f}x exceeds the "
+              f"--max-ratio {args.max_ratio:g}x band", file=sys.stderr)
+        return 1
+    return 0
 
 
 def cmd_chaos(args) -> int:
@@ -182,7 +197,10 @@ def cmd_chaos(args) -> int:
         num_tokens=args.num_tokens, routing=args.routing,
         seed=args.seed, p99_bound_s=args.p99_bound,
         platform=args.platform, log_dir=args.log_dir,
-        startup_timeout_s=args.startup_timeout))
+        startup_timeout_s=args.startup_timeout,
+        cache_server_kill=args.cache_server_kill,
+        cache_kill_interval_s=args.cache_kill_interval,
+        cache_downtime_s=args.cache_downtime))
     print(json.dumps(record, indent=2))
     output = args.output or f"CHAOS_{time.strftime('%Y%m%d_%H%M%S')}.json"
     report_mod.write_json(output, record)
@@ -287,6 +305,37 @@ def cmd_autoscale(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_kvshare(args) -> int:
+    record = asyncio.run(run_kvshare(
+        engines=args.engines, engine=args.engine,
+        sessions=args.sessions, rounds=args.rounds,
+        system_chars=args.system_chars, round_chars=args.round_chars,
+        num_tokens=args.num_tokens,
+        prefill_ms_per_char=args.prefill_ms_per_char,
+        kv_chunk_chars=args.kv_chunk_chars, routing=args.routing,
+        seed=args.seed, no_cache=args.no_cache,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"KVSHARE_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = kvshare_violations(record,
+                                    min_hit_rate=args.min_hit_rate)
+    for v in violations:
+        print(f"KVSHARE VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        ttft = d["ttft_followup_mean_ms"]
+        print(f"kvshare PASSED: {record['value']}% tier hit rate with "
+              f"affinity broken across {d['engines']} replicas "
+              f"(foreign share "
+              f"{d['cached']['foreign_share']:.0%}), follow-up TTFT "
+              f"{ttft['cached']:.0f}ms vs {ttft['recompute']:.0f}ms "
+              f"recompute ({ttft['improvement_pct']:.0f}% faster)")
+    return 1 if violations else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -384,6 +433,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--snapshot-ttl", type=float, default=None,
                     help="router --request-stats-snapshot-ttl override "
                          "(seconds; 0 disables snapshot caching)")
+    sp.add_argument("--unique-prompts", action="store_true",
+                    help="per-request unique long prompts — the "
+                         "cold-prefix worst case for cache-aware "
+                         "routing (the r11 no-regression guard pairs "
+                         "this with --routing prefix)")
+    sp.add_argument("--prompt-chars", type=int, default=768,
+                    help="unique-prompt length in chars")
+    sp.add_argument("--max-ratio", type=float, default=None,
+                    help="exit 1 if the overhead ratio exceeds this "
+                         "band (e.g. 2.5 = the r7 band)")
     sp.add_argument("--output", default=None,
                     help="write the JSON report here "
                          "(e.g. ROUTER_OVERHEAD_r07.json)")
@@ -424,6 +483,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--platform", default="cpu")
     sp.add_argument("--log-dir", default="loadgen-logs")
     sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--cache-server-kill", action="store_true",
+                    help="also launch a shared TPKV cache server wired "
+                         "into the (fake) engines as their remote KV "
+                         "tier and SIGKILL/restart it on its own "
+                         "schedule — a dead cache server must cost "
+                         "recompute, never a client-visible error")
+    sp.add_argument("--cache-kill-interval", type=parse_duration,
+                    default=7.0,
+                    help="seconds between cache-server SIGKILLs")
+    sp.add_argument("--cache-downtime", type=parse_duration, default=2.0,
+                    help="seconds the cache server stays down")
     sp.add_argument("--output", default=None,
                     help="write CHAOS_*.json here (default: "
                          "timestamped)")
@@ -518,6 +588,54 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write AUTOSCALE_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_autoscale)
+
+    sp = sub.add_parser("kvshare",
+                        help="shared cache server + N engines + router "
+                             "with affinity broken; multi-round QA "
+                             "must show >60%% cross-replica hit rate "
+                             "and TTFT beating recompute")
+    sp.add_argument("--engines", type=int, default=2,
+                    help="engine replica count behind the router")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (KV simulation against a real cache "
+                         "server — measures the sharing data path) or "
+                         "a real engine model name (launched with "
+                         "--kv-transfer-config; TTFT then includes "
+                         "real prefill compute)")
+    sp.add_argument("--sessions", type=int, default=4,
+                    help="concurrent multi-round QA sessions")
+    sp.add_argument("--rounds", type=int, default=6,
+                    help="rounds per session (round 1 is cold)")
+    sp.add_argument("--system-chars", type=int, default=384,
+                    help="per-session system prompt length")
+    sp.add_argument("--round-chars", type=int, default=160,
+                    help="new user content per round")
+    sp.add_argument("--num-tokens", type=int, default=8)
+    sp.add_argument("--prefill-ms-per-char", type=float, default=0.5,
+                    help="fake engines: TTFT pacing per uncached char")
+    sp.add_argument("--kv-chunk-chars", type=int, default=64,
+                    help="fake engines: chunk granularity (chars)")
+    sp.add_argument("--routing", default="session",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"],
+                    help="affinity is broken by ROTATING the session "
+                         "key every round; 'session' (default) then "
+                         "scatters rounds deterministically across "
+                         "replicas")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--no-cache", action="store_true",
+                    help="launch the fleet WITHOUT the cache tier: the "
+                         "contract must then fail (exit 1) — the "
+                         "anti-vacuity check")
+    sp.add_argument("--min-hit-rate", type=float, default=0.6,
+                    help="cross-replica hit-rate bar")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write KVSHARE_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_kvshare)
 
     return p
 

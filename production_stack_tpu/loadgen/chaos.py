@@ -43,8 +43,10 @@ import aiohttp
 
 from production_stack_tpu.loadgen.orchestrator import (Proc, _stop,
                                                        free_port,
+                                                       launch_cache_server,
                                                        launch_engine,
                                                        launch_router,
+                                                       wait_cache_ready,
                                                        wait_healthy)
 from production_stack_tpu.loadgen.report import percentile
 from production_stack_tpu.utils import init_logger
@@ -173,7 +175,9 @@ async def _churn_loop(engines: List[Proc], *, engine_kind: str,
                       kill_interval_s: float, downtime_s: float,
                       deadline: float, log_dir: str, t0: float,
                       events: List[Dict],
-                      platform: str = "cpu") -> None:
+                      platform: str = "cpu",
+                      engine_extra_args: Optional[List[str]] = None
+                      ) -> None:
     """Kill one engine (SIGKILL — no goodbye), wait ``downtime_s``,
     restart it on the same port, round-robin over the fleet."""
     i = 0
@@ -192,9 +196,9 @@ async def _churn_loop(engines: List[Proc], *, engine_kind: str,
                        "event": "kill", "url": victim.url})
         logger.info("chaos: killed %s", victim.url)
         await asyncio.sleep(downtime_s)
-        engines[victim_idx] = launch_engine(engine_kind, port,
-                                            log_dir=log_dir,
-                                            platform=platform)
+        engines[victim_idx] = launch_engine(
+            engine_kind, port, log_dir=log_dir, platform=platform,
+            extra_args=engine_extra_args)
         events.append({"t_s": round(time.monotonic() - t0, 2),
                        "event": "restart", "url": victim.url})
         logger.info("chaos: restarted %s", victim.url)
@@ -203,6 +207,37 @@ async def _churn_loop(engines: List[Proc], *, engine_kind: str,
         except TimeoutError:
             logger.warning("chaos: %s not healthy after restart",
                            engines[victim_idx].url)
+
+
+async def _cache_churn_loop(holder: Dict[str, Proc], *,
+                            kill_interval_s: float, downtime_s: float,
+                            deadline: float, log_dir: str, t0: float,
+                            events: List[Dict]) -> None:
+    """SIGKILL/restart the shared TPKV cache server on a schedule — a
+    replica mid-transfer must degrade to recompute (bounded remote
+    timeouts + breaker in kvcache/store.RemoteStore), never surface a
+    client-visible error."""
+    while True:
+        await asyncio.sleep(kill_interval_s)
+        if time.monotonic() + downtime_s + 2.0 >= deadline:
+            return
+        victim = holder["proc"]
+        port = int(victim.url.rsplit(":", 1)[1])
+        victim.popen.kill()
+        victim.popen.wait()
+        events.append({"t_s": round(time.monotonic() - t0, 2),
+                       "event": "cache_kill", "url": victim.url})
+        logger.info("chaos: killed cache server %s", victim.url)
+        await asyncio.sleep(downtime_s)
+        holder["proc"] = launch_cache_server(port, log_dir=log_dir)
+        events.append({"t_s": round(time.monotonic() - t0, 2),
+                       "event": "cache_restart", "url": victim.url})
+        logger.info("chaos: restarted cache server %s", victim.url)
+        try:
+            await wait_cache_ready(holder["proc"].url, 30.0)
+        except TimeoutError:
+            logger.warning("chaos: cache server %s not answering after "
+                           "restart", holder["proc"].url)
 
 
 async def _error_burst_loop(engine_urls: List[str], *,
@@ -276,18 +311,41 @@ async def run_chaos(*, engines: int = 3,
                     platform: str = "cpu",
                     log_dir: str = "loadgen-logs",
                     startup_timeout_s: float = 420.0,
-                    router_extra_args: Optional[List[str]] = None
+                    router_extra_args: Optional[List[str]] = None,
+                    cache_server_kill: bool = False,
+                    cache_kill_interval_s: float = 7.0,
+                    cache_downtime_s: float = 2.0,
+                    prefill_ms_per_char: float = 0.2
                     ) -> Dict:
     """Launch router + N engines, storm the router while killing and
-    restarting engines on a schedule; return the CHAOS record."""
+    restarting engines on a schedule; return the CHAOS record.
+
+    ``cache_server_kill`` additionally launches a shared TPKV cache
+    server wired into (fake) engines as their remote KV tier and
+    SIGKILLs/restarts IT on its own schedule — the r11 extension: a
+    dying cache server mid-transfer must cost TTFT (recompute), never a
+    client-visible error."""
     procs: List[Proc] = []
     engine_procs: List[Proc] = []
     events: List[Dict] = []
+    engine_extra_args: Optional[List[str]] = None
+    cache_holder: Dict[str, Proc] = {}
     try:
+        if cache_server_kill:
+            if engine != "fake":
+                raise ValueError("cache_server_kill currently drives "
+                                 "the fake-engine KV simulation")
+            cache = launch_cache_server(free_port(), log_dir=log_dir)
+            procs.append(cache)
+            cache_holder["proc"] = cache
+            await wait_cache_ready(cache.url)
+            engine_extra_args = [
+                "--kv-remote-url", cache.url,
+                "--prefill-ms-per-char", str(prefill_ms_per_char)]
         for _ in range(engines):
-            engine_procs.append(launch_engine(engine, free_port(),
-                                              log_dir=log_dir,
-                                              platform=platform))
+            engine_procs.append(launch_engine(
+                engine, free_port(), log_dir=log_dir, platform=platform,
+                extra_args=engine_extra_args))
         procs.extend(engine_procs)
         await asyncio.gather(*[wait_healthy(e.url, startup_timeout_s)
                                for e in engine_procs])
@@ -309,7 +367,12 @@ async def run_chaos(*, engines: int = 3,
             engine_procs, engine_kind=engine,
             kill_interval_s=kill_interval_s, downtime_s=downtime_s,
             deadline=deadline, log_dir=log_dir, t0=t0, events=events,
-            platform=platform))]
+            platform=platform, engine_extra_args=engine_extra_args))]
+        if cache_server_kill:
+            tasks.append(asyncio.create_task(_cache_churn_loop(
+                cache_holder, kill_interval_s=cache_kill_interval_s,
+                downtime_s=cache_downtime_s, deadline=deadline,
+                log_dir=log_dir, t0=t0, events=events)))
         if engine == "fake" and error_burst_interval_s:
             tasks.append(asyncio.create_task(_error_burst_loop(
                 [e.url for e in engine_procs],
@@ -326,16 +389,24 @@ async def run_chaos(*, engines: int = 3,
             await asyncio.gather(*tasks, return_exceptions=True)
         elapsed = time.monotonic() - t0
         router_counters = await _scrape_router_resilience(router.url)
+        engine_kv = None
+        if cache_server_kill:
+            from production_stack_tpu.loadgen.kvshare import _scrape_kv
+            engine_kv = await _scrape_kv([e.url for e in engine_procs])
     finally:
-        # the churn loop swaps engine Procs in place; stop the CURRENT
-        # processes plus anything from the launch-time snapshot (the
-        # router, and already-dead originals — _stop skips exited pids)
+        # the churn loops swap engine/cache Procs in place; stop the
+        # CURRENT processes plus anything from the launch-time snapshot
+        # (the router, and already-dead originals — _stop skips exited
+        # pids)
         current = list(engine_procs)
+        if cache_holder.get("proc") is not None:
+            current.append(cache_holder["proc"])
         current.extend(p for p in procs if p not in current)
         _stop(current)
 
     kills = len([e for e in events if e["event"] == "kill"])
     restarts = len([e for e in events if e["event"] == "restart"])
+    cache_kills = len([e for e in events if e["event"] == "cache_kill"])
     done = c.ok + c.http_5xx + c.http_4xx + c.truncated_streams + \
         c.transport_errors
     availability = 100.0 * c.ok / done if done else 0.0
@@ -361,6 +432,9 @@ async def run_chaos(*, engines: int = 3,
             "error_burst_interval_s": error_burst_interval_s
             if engine == "fake" else None,
             "kills": kills, "restarts": restarts,
+            "cache_server_kill": cache_server_kill,
+            "cache_kills": cache_kills,
+            "engine_kv": engine_kv,
             "requests": {
                 "launched": c.launched, "ok": c.ok,
                 "http_5xx": c.http_5xx, "http_4xx": c.http_4xx,
@@ -396,6 +470,9 @@ def chaos_violations(record: Dict) -> List[str]:
     if not d["kills"]:
         out.append("churn never killed an engine (window too short "
                    "for kill_interval?)")
+    if d.get("cache_server_kill") and not d.get("cache_kills"):
+        out.append("cache churn never killed the cache server (window "
+                   "too short for cache_kill_interval?)")
     bound = d.get("p99_bound_s")
     if bound and d["latency_ms"]["p99"] > bound * 1e3:
         out.append(f"p99 {d['latency_ms']['p99']:.0f}ms exceeds the "

@@ -90,6 +90,35 @@ def launch_engine(kind: str, port: int, *, log_dir: str,
     return _spawn(f"engine-{kind}-{port}", cmd, url, log_dir, env=env)
 
 
+def launch_cache_server(port: int, *, log_dir: str,
+                        capacity_gb: float = 1.0) -> Proc:
+    """Shared TPKV cache server (python backend — the rigs measure the
+    serving stack, not the C++ store). Proc.url is the tpukv:// URL
+    engines take as their remote tier."""
+    cmd = [sys.executable, "-m", "production_stack_tpu.kvcache.server",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--capacity-gb", str(capacity_gb), "--backend", "python"]
+    return _spawn(f"cache-server-{port}", cmd,
+                  f"tpukv://127.0.0.1:{port}", log_dir)
+
+
+async def wait_cache_ready(url: str, timeout_s: float = 30.0) -> None:
+    """Poll a TPKV server with PING until it answers."""
+    from production_stack_tpu.kvcache.store import RemoteStore
+    client = RemoteStore(url, connect_timeout=0.5, io_timeout=2.0,
+                         breaker_threshold=1 << 30)
+    deadline = time.monotonic() + timeout_s
+    try:
+        while time.monotonic() < deadline:
+            if await asyncio.to_thread(client.ping):
+                return
+            await asyncio.sleep(0.3)
+    finally:
+        client.close()
+    raise TimeoutError(f"cache server {url} not answering PING "
+                       f"after {timeout_s:.0f}s")
+
+
 def launch_router(backend_urls: List[str], model: str, port: int, *,
                   routing: str = "session", log_dir: str,
                   snapshot_ttl: Optional[float] = None,

@@ -17,6 +17,7 @@ equally but caps the ceiling well below the fake engine's.)
 """
 
 import asyncio
+import itertools
 import json
 import time
 from typing import Dict, List, Optional
@@ -46,6 +47,30 @@ def overhead_payload(model: str, num_tokens: int = 8,
     }).encode()
 
 
+def unique_payload_factory(model: str, num_tokens: int = 8,
+                           stream: bool = False,
+                           prompt_chars: int = 768):
+    """Per-request UNIQUE long prompts — the cold-prefix worst case for
+    cache-aware routing (every request hashes `prompt_chars` of text,
+    walks the prefix ring, misses, and falls back to hash affinity).
+    The r11 no-regression guard drives this against --routing prefix
+    and asserts the r7 overhead band still holds."""
+    counter = itertools.count()
+    filler = "pad " * (prompt_chars // 4 + 1)
+
+    def make() -> bytes:
+        i = next(counter)
+        return json.dumps({
+            "model": model,
+            "messages": [{"role": "user",
+                          "content": f"cold-{i:08d} {filler}"
+                                     [:prompt_chars]}],
+            "max_tokens": num_tokens,
+            "stream": stream,
+        }).encode()
+    return make
+
+
 async def measure_side(url: str, payload: bytes, *,
                        users: int = 64,
                        duration_s: float = 15.0,
@@ -54,12 +79,14 @@ async def measure_side(url: str, payload: bytes, *,
                        api_key: Optional[str] = None,
                        extra_headers: Optional[Dict] = None) -> Dict:
     """Closed-loop storm at one URL: ``users`` workers re-posting
-    ``payload`` back to back for ``duration_s``. Returns the side's
-    summary (req/s + latency/TTFT percentiles)."""
+    ``payload`` back to back for ``duration_s``. ``payload`` may be a
+    zero-arg callable producing per-request bodies (cold-prefix mode).
+    Returns the side's summary (req/s + latency/TTFT percentiles)."""
     headers = {"Content-Type": "application/json", **(extra_headers or {})}
     if api_key:
         headers["Authorization"] = f"Bearer {api_key}"
     target = f"{url}{CHAT_PATH}"
+    make_payload = payload if callable(payload) else (lambda: payload)
     latencies: List[float] = []
     ttfts: List[float] = []
     errors: List[str] = []
@@ -71,7 +98,7 @@ async def measure_side(url: str, payload: bytes, *,
         async def one_request(record: bool) -> None:
             t0 = time.monotonic()
             try:
-                async with session.post(target, data=payload,
+                async with session.post(target, data=make_payload(),
                                         headers=headers,
                                         timeout=timeout) as resp:
                     if resp.status != 200:
@@ -155,7 +182,9 @@ async def run_overhead(*, engine: str = "fake",
                        log_dir: str = "loadgen-logs",
                        startup_timeout_s: float = 420.0,
                        snapshot_ttl: Optional[float] = None,
-                       warmup_requests: int = 32) -> Dict:
+                       warmup_requests: int = 32,
+                       unique_prompts: bool = False,
+                       prompt_chars: int = 768) -> Dict:
     """Launch engine + router, measure both sides, return the A/B
     record (BENCH schema; headline value = router-side req/s)."""
     procs = []
@@ -176,8 +205,13 @@ async def run_overhead(*, engine: str = "fake",
         procs.append(router)
         await wait_healthy(router.url, 60.0, require_endpoints=1)
 
-        payload = overhead_payload(model, num_tokens=num_tokens,
-                                   stream=stream)
+        if unique_prompts:
+            payload = unique_payload_factory(model, num_tokens=num_tokens,
+                                             stream=stream,
+                                             prompt_chars=prompt_chars)
+        else:
+            payload = overhead_payload(model, num_tokens=num_tokens,
+                                       stream=stream)
         # secured deployments (ENGINE_API_KEY exported): the direct
         # side hits the engine without the router's Bearer injection,
         # so carry the engine key on both sides (the router passes a
@@ -222,6 +256,7 @@ async def run_overhead(*, engine: str = "fake",
             "num_tokens": num_tokens,
             "stream": stream,
             "routing": routing,
+            "unique_prompts": unique_prompts,
             "direct": direct,
             "router": via,
             "overhead_ratio": round(ratio, 3) if ratio else None,

@@ -155,6 +155,7 @@ async def metrics(request: web.Request) -> web.Response:
         state["metrics"].refresh_semantic_cache(state["semantic_cache"])
     if state.get("pii_middleware") is not None:
         state["metrics"].refresh_pii(state["pii_middleware"])
+    state["metrics"].refresh_routing(state["router"])
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
 
@@ -239,7 +240,15 @@ def build_app(args: argparse.Namespace) -> web.Application:
         raise ValueError("--routing-logic prefix requires the "
                          "KVAwareRouting feature gate (BETA, on by "
                          "default; it was explicitly disabled)")
-    state["router"] = make_router(args.routing_logic, args.session_key)
+    # kept in state so a dynamic-config router swap preserves the
+    # CLI-configured prefix knobs (dynamic_config._apply)
+    state["router_kwargs"] = {
+        "prefix_chunk_chars": args.prefix_chunk_chars,
+        "prefix_ring_entries": args.prefix_ring_entries,
+        "prefix_cache_aware": not args.no_prefix_cache_aware,
+    }
+    state["router"] = make_router(args.routing_logic, args.session_key,
+                                  **state["router_kwargs"])
 
     if state["feature_gates"].enabled("PIIDetection"):
         from production_stack_tpu.router.pii import PIIConfig, PIIMiddleware
@@ -266,6 +275,10 @@ def build_app(args: argparse.Namespace) -> web.Application:
     state["scraper"] = EngineStatsScraper(
         lambda: state["discovery"].get_endpoints(),
         interval_s=args.engine_stats_interval)
+    # cache-aware prefix routing breaks warm-endpoint ties on the
+    # scraped per-engine tier hit rate (routing.PrefixAwareRouter)
+    if hasattr(state["router"], "attach_scraper"):
+        state["router"].attach_scraper(state["scraper"].get)
 
     if args.dynamic_config_json:
         state["config_watcher"] = DynamicConfigWatcher(
@@ -350,6 +363,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                             "prefix"],
                    default="roundrobin")
     p.add_argument("--session-key", default="x-user-id")
+    p.add_argument("--prefix-chunk-chars", type=int, default=256,
+                   help="prefix-router ring granularity: prompt text is "
+                        "chain-hashed in chunks of this many chars; one "
+                        "ring entry per chunk (should roughly track the "
+                        "engine-side kv chunk_size in text terms)")
+    p.add_argument("--prefix-ring-entries", type=int, default=65536,
+                   help="max chunk digests the prefix router tracks "
+                        "(LRU)")
+    p.add_argument("--no-prefix-cache-aware", action="store_true",
+                   help="disable expected-hit-bytes scoring: the prefix "
+                        "policy falls back to pure hash affinity "
+                        "(pre-r11 behavior)")
     p.add_argument("--engine-stats-interval", type=float, default=10.0)
     p.add_argument("--log-stats-interval", type=float, default=0.0,
                    help="seconds between periodic per-engine stat log "

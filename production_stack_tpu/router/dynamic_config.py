@@ -107,6 +107,28 @@ class DynamicConfigWatcher:
             self.state["discovery"] = new
             if old is not None:
                 await old.close()
-        self.state["router"] = make_router(cfg.routing_logic,
-                                           cfg.session_key)
+        # rebuild the router ONLY when its own fields changed: the
+        # autoscaler rewrites this file on every scale event, and a
+        # gratuitous rebuild would wipe the policy's learned state —
+        # the prefix router's warm-endpoint ring, least-loaded's
+        # slow-start ramps — exactly when the fleet is in motion
+        old_router = self.state.get("router")
+        unchanged = (
+            old_router is not None
+            and old_router.name == cfg.routing_logic
+            and getattr(old_router, "session_key",
+                        cfg.session_key) == cfg.session_key)
+        if not unchanged:
+            metrics = self.state.get("metrics")
+            if metrics is not None and old_router is not None:
+                # fold the outgoing router's routing counters into the
+                # exposition before its totals vanish with it
+                metrics.refresh_routing(old_router)
+            self.state["router"] = make_router(
+                cfg.routing_logic, cfg.session_key,
+                **self.state.get("router_kwargs", {}))
+            scraper = self.state.get("scraper")
+            if scraper is not None and \
+                    hasattr(self.state["router"], "attach_scraper"):
+                self.state["router"].attach_scraper(scraper.get)
         self.current = cfg

@@ -80,6 +80,22 @@ class RouterMetrics:
                                    "Semantic cache entries")
         self.semantic_latency = plain("vllm:semantic_cache_latency",
                                       "Last semantic cache lookup seconds")
+        # cache-aware prefix routing surface (routing.PrefixAwareRouter):
+        # how often scoring found a warm endpoint vs fell back to hash
+        # affinity on a cold prefix. Real counters (exposition name
+        # gains the _total suffix) fed by delta-sync in refresh_routing
+        # so a dynamic-config router swap never reads as an unflagged
+        # gauge reset.
+        from prometheus_client import Counter
+        self.prefix_warm_routes = Counter(
+            "tpu:router_prefix_warm_routes",
+            "Routing decisions scored onto a warm endpoint "
+            "(expected prefix-hit bytes > 0)", registry=self.registry)
+        self.prefix_cold_routes = Counter(
+            "tpu:router_prefix_cold_routes",
+            "Routing decisions that fell back to hash affinity "
+            "(cold prefix)", registry=self.registry)
+        self._prefix_last = {"warm": 0, "cold": 0}
         # PII surface (reference: pii/middleware.py:20-39 counters)
         self.pii_scanned = plain("vllm:pii_requests_scanned",
                                  "Requests scanned for PII")
@@ -158,6 +174,25 @@ class RouterMetrics:
     def refresh_overload(self, shed_counts: dict) -> None:
         for scope, count in shed_counts.items():
             self.router_sheds.labels(scope=scope).set(count)
+
+    def refresh_routing(self, router) -> None:
+        """Export cache-aware routing counters when the active policy
+        carries them (PrefixAwareRouter). Delta-synced: a dynamic-config
+        swap resets the router object's totals, so fresh totals below
+        the last sync are treated as new increments."""
+        warm = getattr(router, "warm_routes", None)
+        if warm is None:
+            return
+        cold = router.cold_routes
+        for key, total, counter in (
+                ("warm", warm, self.prefix_warm_routes),
+                ("cold", cold, self.prefix_cold_routes)):
+            delta = total - self._prefix_last[key]
+            if delta < 0:         # router swapped: totals restarted
+                delta = total
+            if delta > 0:
+                counter.inc(delta)
+            self._prefix_last[key] = total
 
     def refresh_semantic_cache(self, cache) -> None:
         self.semantic_hits.set(cache.hits)

@@ -188,18 +188,67 @@ class SessionRouter(Router):
 
 
 class PrefixAwareRouter(Router):
-    """KV-affinity: hash the first `prefix_chars` of the prompt/messages.
+    """Cache-aware KV-affinity routing (ISSUE 6 / ROADMAP item 1).
 
-    Conversations sharing a long system prompt + history map to the same
-    engine, whose KV tiers (HBM/host) already hold those blocks.
+    Scores candidate endpoints by *expected prefix-hit bytes*, not just
+    a prefix hash. Two signals feed the score:
+
+    - a **chunk-granularity prefix ring**: the prompt text is chained
+      into fixed-size chunk digests (the router-side mirror of
+      kvcache/chunks.ChunkHasher — chunk i's digest folds chunk i-1's,
+      so a digest match implies the whole leading prefix matches), and
+      every routing decision records the chosen endpoint against the
+      prompt's digests. Because the chosen engine prefills and then
+      *publishes* exactly those chunks through its tiers
+      (connector.on_prefill_progress/on_finish), the ring is a
+      router-side view of the producer-publish path. Expected hit bytes
+      for an endpoint = longest leading digest run it has served ×
+      chunk size.
+    - **scraped per-endpoint hit stats**: ties between equally-warm
+      endpoints break on the engine-reported tier hit rate
+      (EngineStats.kv_hit_rate from /load), then on live in-flight.
+
+    Cold prefixes (no endpoint has served any leading chunk) fall back
+    to consistent-hash affinity over the full configured prefix — the
+    pre-cache-aware behavior — so repeated cold prefixes still converge
+    onto one replica, and a replica that never saw the session still
+    hits through the shared remote tier (kvcache/server.py as the
+    cross-replica rendezvous).
+
+    The ring is bounded (``ring_entries`` digests, LRU) and each digest
+    remembers at most ``_URLS_PER_CHUNK`` recent servers — with a shared
+    remote tier EVERY replica can serve a published chunk, but host-RAM
+    locality (and therefore TTFT) is best on the replicas that computed
+    or recently fetched it.
     """
 
     name = "prefix"
 
-    def __init__(self, prefix_chars: int = 1024, vnodes: int = 128):
+    _URLS_PER_CHUNK = 4
+
+    def __init__(self, prefix_chars: int = 1024, vnodes: int = 128,
+                 chunk_chars: int = 256, ring_entries: int = 65536,
+                 max_track_chars: int = 8192, cache_aware: bool = True):
         self.prefix_chars = prefix_chars
+        self.chunk_chars = max(1, chunk_chars)
+        self.ring_entries = ring_entries
+        self.max_track_chars = max_track_chars
+        self.cache_aware = cache_aware
         self._ring = HashRing(vnodes)
         self._fallback = LeastLoadedRouter()
+        # digest -> list of recent server URLs (most recent last); LRU
+        # over digests via OrderedDict move_to_end
+        import collections
+        self._chunks: "collections.OrderedDict[bytes, List[str]]" = \
+            collections.OrderedDict()
+        self._get_engine_stats = None    # attach_scraper
+        self.warm_routes = 0
+        self.cold_routes = 0
+
+    def attach_scraper(self, get_stats) -> None:
+        """``get_stats() -> {url: EngineStats}`` (the router app passes
+        EngineStatsScraper.get) — enables the hit-rate tiebreak."""
+        self._get_engine_stats = get_stats
 
     @staticmethod
     def _prompt_text(body: dict) -> str:
@@ -211,13 +260,103 @@ class PrefixAwareRouter(Router):
         prompt = body.get("prompt", "")
         return prompt if isinstance(prompt, str) else json.dumps(prompt)
 
+    def _chunk_digests(self, text: str) -> List[bytes]:
+        """Chained digests of the prompt's full chunk_chars chunks
+        (bounded by max_track_chars; a partial tail chunk is skipped,
+        mirroring chunk-granular tier storage)."""
+        from production_stack_tpu.kvcache.chunks import chain_digest_bytes
+        data = text[:self.max_track_chars].encode("utf-8", "ignore")
+        return chain_digest_bytes(data, self.chunk_chars)
+
+    def _record(self, digests: List[bytes], url: str) -> None:
+        """Feed the ring: the chosen engine will prefill-and-publish
+        these chunks (producer path), or already held them."""
+        for d in digests:
+            urls = self._chunks.get(d)
+            if urls is None:
+                self._chunks[d] = [url]
+            else:
+                if url in urls:
+                    urls.remove(url)
+                urls.append(url)
+                del urls[:-self._URLS_PER_CHUNK]
+                self._chunks.move_to_end(d)
+        while len(self._chunks) > self.ring_entries:
+            self._chunks.popitem(last=False)
+
+    def _expected_hit_chunks(self, digests: List[bytes],
+                             urls) -> Dict[str, int]:
+        """Deepest recorded digest membership per candidate. A chained
+        digest at depth i matches only if the WHOLE prefix through i
+        matches, and an endpoint was recorded for depth i only by
+        serving a prompt covering depths 0..i — so one deep membership
+        is complete evidence for the full leading run. Scoring by the
+        deepest membership (not a leading-run intersection) keeps the
+        per-chunk holder cap harmless: a popular fleet-wide system
+        prompt may evict an endpoint from the crowded EARLY chunks'
+        holder lists while its session-specific deep chunks still
+        name it."""
+        score = {u: 0 for u in urls}
+        for i, d in enumerate(digests):
+            holders = self._chunks.get(d)
+            if not holders:
+                continue   # LRU-evicted or never seen; deeper evidence
+                           # (if any) still stands on its own
+            for u in holders:
+                if u in score:
+                    score[u] = i + 1
+        return score
+
     def route(self, endpoints, request_stats, headers, body) -> str:
         self._ring.rebuild([e.url for e in endpoints])
-        text = self._prompt_text(body)[:self.prefix_chars]
+        text = self._prompt_text(body)
         if not text:
             return self._fallback.route(endpoints, request_stats, headers,
                                         body)
-        return self._ring.lookup(text)
+        if not self.cache_aware:
+            return self._ring.lookup(text[:self.prefix_chars])
+        digests = self._chunk_digests(text)
+        score = self._expected_hit_chunks(
+            digests, [e.url for e in endpoints]) if digests else {}
+        best = max(score.values(), default=0)
+        if best > 0:
+            self.warm_routes += 1
+            warm = [u for u, s in score.items() if s == best]
+            url = warm[0] if len(warm) == 1 else self._tiebreak(
+                warm, request_stats)
+        else:
+            # cold prefix: consistent-hash affinity so repeats converge
+            self.cold_routes += 1
+            url = self._ring.lookup(text[:self.prefix_chars])
+        self._record(digests, url)
+        return url
+
+    def _tiebreak(self, urls: List[str], request_stats) -> str:
+        """Equally-warm endpoints: prefer the higher engine-reported
+        tier hit rate, then the lower live in-flight, then URL order
+        (deterministic)."""
+        stats = {}
+        if self._get_engine_stats is not None:
+            try:
+                stats = self._get_engine_stats() or {}
+            except Exception:
+                stats = {}
+
+        def key(u: str):
+            es = stats.get(u)
+            rs = request_stats.get(u)
+            return (-(es.kv_hit_rate if es is not None else 0.0),
+                    rs.in_flight if rs is not None else 0,
+                    u)
+        return min(urls, key=key)
+
+    def expected_hit_bytes(self, body: dict, url: str,
+                           bytes_per_chunk: Optional[int] = None) -> int:
+        """Introspection/debug: the score the router would assign
+        ``url`` for this body, in (approximate) bytes."""
+        digests = self._chunk_digests(self._prompt_text(body))
+        score = self._expected_hit_chunks(digests, [url]).get(url, 0)
+        return score * (bytes_per_chunk or self.chunk_chars)
 
 
 _ROUTERS = {
@@ -228,10 +367,17 @@ _ROUTERS = {
 }
 
 
-def make_router(name: str, session_key: str = "x-user-id") -> Router:
+def make_router(name: str, session_key: str = "x-user-id",
+                prefix_chunk_chars: int = 256,
+                prefix_ring_entries: int = 65536,
+                prefix_cache_aware: bool = True) -> Router:
     if name not in _ROUTERS:
         raise ValueError(f"unknown routing logic {name!r}; "
                          f"options: {sorted(_ROUTERS)}")
     if name == "session":
         return SessionRouter(session_key=session_key)
+    if name == "prefix":
+        return PrefixAwareRouter(chunk_chars=prefix_chunk_chars,
+                                 ring_entries=prefix_ring_entries,
+                                 cache_aware=prefix_cache_aware)
     return _ROUTERS[name]()

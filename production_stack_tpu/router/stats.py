@@ -242,6 +242,12 @@ class EngineStats:
     # own queue-delay estimate
     capacity: float = 0.0
     est_queue_delay_ms: float = 0.0
+    # tier-share signals (/load "kv_cache" block; zero for engines
+    # without KV tiering): the cache-aware prefix router breaks scoring
+    # ties on kv_hit_rate (routing.PrefixAwareRouter)
+    kv_hit_rate: float = 0.0
+    kv_hit_tokens: float = 0.0
+    kv_foreign_hit_tokens: float = 0.0
     scraped_at: float = field(default_factory=time.time)
 
 
@@ -267,6 +273,9 @@ def parse_engine_metrics(text: str) -> EngineStats:
         prefix_hit_rate=values.get("vllm:gpu_prefix_cache_hit_rate", 0.0),
         capacity=values.get("tpu:engine_capacity_seqs", 0.0),
         est_queue_delay_ms=values.get("tpu:est_queue_delay_ms", 0.0),
+        # foreign backends (no /load): the exported prefix hit rate is
+        # the closest available proxy for tier-hit likelihood
+        kv_hit_rate=values.get("vllm:gpu_prefix_cache_hit_rate", 0.0),
     )
 
 
@@ -298,6 +307,9 @@ class EngineStatsScraper(LoadPoller):
             # (pre-/load consumers pin it: see proxy._endpoint_cap)
             capacity=load.capacity if load.capacity is not None else 0.0,
             est_queue_delay_ms=load.est_queue_delay_ms,
+            kv_hit_rate=load.kv_hit_rate,
+            kv_hit_tokens=load.kv_hit_tokens,
+            kv_foreign_hit_tokens=load.kv_foreign_hit_tokens,
         )
 
     async def _fetch_fallback(self, url: str) -> Optional[EngineStats]:

@@ -50,6 +50,14 @@ class EngineLoad:
     est_queue_delay_ms: float = 0.0
     kv_usage: float = 0.0
     free_kv_blocks: float = 0.0
+    # KV-tier sharing signals (engines running --kv-transfer-config
+    # publish a "kv_cache" block in /load; zeros otherwise): the
+    # cache-aware router reads the hit rate, the kvshare rig reads the
+    # token counters
+    kv_hit_rate: float = 0.0
+    kv_query_tokens: float = 0.0
+    kv_hit_tokens: float = 0.0
+    kv_foreign_hit_tokens: float = 0.0
     scraped_at: float = field(default_factory=time.time)
 
     @property
@@ -73,6 +81,12 @@ def parse_load_report(data: dict) -> EngineLoad:
         return default if v is None else float(v)
 
     cap = data.get("capacity")
+    kv = data.get("kv_cache") or {}
+
+    def knum(key):
+        v = kv.get(key)
+        return 0.0 if v is None else float(v)
+
     return EngineLoad(
         queue_depth=num("queue_depth"),
         running=num("running"),
@@ -81,6 +95,10 @@ def parse_load_report(data: dict) -> EngineLoad:
         est_queue_delay_ms=num("est_queue_delay_ms"),
         kv_usage=num("kv_usage"),
         free_kv_blocks=num("free_kv_blocks"),
+        kv_hit_rate=knum("hit_rate"),
+        kv_query_tokens=knum("query_tokens"),
+        kv_hit_tokens=knum("hit_tokens"),
+        kv_foreign_hit_tokens=knum("foreign_hit_tokens"),
     )
 
 

@@ -49,6 +49,14 @@ exercised without generating real load. A body carrying ONLY these
 keys adjusts signals without touching the active fault mode; ``null``
 clears an override (capacity falls back to the overload-fault-derived
 value, queue delay to 0).
+
+Shared-KV simulation (the kvshare rig's lever): ``--kv-remote-url
+tpukv://host:port`` makes every chat request chunk-hash its prompt
+text, walk a REAL TPKV cache server for the cached prefix, pace TTFT by
+the uncached remainder (``--prefill-ms-per-char``), and publish served
+chunks back — a fleet of fakes behind one cache server reproduces the
+cross-replica prefix-reuse TTFT behavior (hit/miss counters on /load
+``kv_cache`` and /metrics ``tpu:kvcache_*``) with no model compute.
 """
 
 import asyncio
@@ -65,13 +73,40 @@ FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft",
 
 
 class FakeEngine:
+    """See module docstring; ``kv_remote_url`` additionally enables the
+    shared-KV simulation (the kvshare rig's lever): prompt text is
+    chain-hashed in ``kv_chunk_chars`` chunks against a real TPKV cache
+    server, TTFT is paced by the UNCACHED prefix length
+    (``prefill_s_per_char``), and served chunks are published back — so
+    a fleet of fakes behind one cache server reproduces the
+    cross-replica prefix-reuse TTFT curve without model compute. The
+    TPKV client is the real ``kvcache.store.RemoteStore`` (bounded
+    timeouts + breaker), so a killed cache server degrades to
+    full-recompute pacing, never to errors."""
+
     def __init__(self, model: str = "fake-model", ttft_s: float = 0.0,
                  tokens_per_s: float = 0.0, num_tokens: int = 8,
-                 fault: Optional[dict] = None):
+                 fault: Optional[dict] = None,
+                 kv_remote_url: Optional[str] = None,
+                 kv_chunk_chars: int = 64,
+                 prefill_s_per_char: float = 0.0):
         self.model = model
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
         self.num_tokens = num_tokens
+        self.kv_chunk_chars = max(1, kv_chunk_chars)
+        self.prefill_s_per_char = prefill_s_per_char
+        self._kv_store = None
+        if kv_remote_url:
+            from production_stack_tpu.kvcache.store import RemoteStore
+            self._kv_store = RemoteStore(
+                kv_remote_url, connect_timeout=0.5, io_timeout=1.0,
+                breaker_threshold=2, breaker_cooldown_s=2.0)
+        self._kv_published = set()       # digests this replica published
+        self.kv_counters = {
+            "queries": 0, "query_tokens": 0, "hit_tokens": 0,
+            "foreign_hit_tokens": 0, "bytes_loaded": 0, "bytes_saved": 0,
+        }
         self.gauges = {
             "vllm:num_requests_running": 0.0,
             "vllm:num_requests_waiting": 0.0,
@@ -114,6 +149,103 @@ class FakeEngine:
     async def _tick(self):
         if self.tokens_per_s > 0:
             await asyncio.sleep(1.0 / self.tokens_per_s)
+
+    # -- shared-KV simulation -------------------------------------------
+
+    def _kv_digests(self, text: str):
+        """Chained chunk digests of the prompt text (full chunks only) —
+        the shared helper keeps this in lockstep with the router's
+        prefix ring (kvcache/chunks.chain_digest_bytes)."""
+        from production_stack_tpu.kvcache.chunks import chain_digest_bytes
+        return chain_digest_bytes(text.encode("utf-8", "ignore"),
+                                  self.kv_chunk_chars)
+
+    def _kv_prefetch_sync(self, digests):
+        """Walk the shared tier until the first miss (sync; runs in a
+        worker thread). Returns (hit_chunks, foreign_chunks, bytes)."""
+        hits = foreign = loaded = 0
+        deadline = time.monotonic() + 1.0     # whole-walk budget
+        for d in digests:
+            if time.monotonic() >= deadline:
+                break
+            val = self._kv_store.get(d)
+            if val is None:
+                break
+            hits += 1
+            loaded += len(val)
+            if d not in self._kv_published:
+                foreign += 1
+        # a digest we remember publishing that now MISSES means the
+        # cache server restarted empty (chaos kill cycle): forget the
+        # remainder so the publish path re-publishes instead of
+        # serving a permanently cold tier from a stale memory
+        for d in digests[hits:]:
+            self._kv_published.discard(d)
+        return hits, foreign, loaded
+
+    def _kv_publish_sync(self, digests, text: str):
+        if len(self._kv_published) > (1 << 16):
+            # bounded memory: losing dedup just means a one-time
+            # republish (and foreign re-count) per chunk
+            self._kv_published.clear()
+        data = text.encode("utf-8", "ignore")
+        for i, d in enumerate(digests):
+            if d in self._kv_published:
+                continue
+            chunk = data[i * self.kv_chunk_chars:
+                         (i + 1) * self.kv_chunk_chars]
+            if self._kv_store.put(d, chunk):
+                self.kv_counters["bytes_saved"] += len(chunk)
+                self._kv_published.add(d)
+
+    async def _kv_prefill_delay(self, text: str):
+        """Tier lookup + TTFT pacing by the UNCACHED prefix; returns the
+        digests so the handler can publish after serving."""
+        digests = self._kv_digests(text)
+        n = len(text)
+        self.kv_counters["queries"] += 1
+        self.kv_counters["query_tokens"] += n
+        hits = foreign = 0
+        if digests:
+            hits, foreign, loaded = await asyncio.to_thread(
+                self._kv_prefetch_sync, digests)
+            hit_chars = min(hits * self.kv_chunk_chars, max(n - 1, 0))
+            self.kv_counters["hit_tokens"] += hit_chars
+            self.kv_counters["foreign_hit_tokens"] += min(
+                foreign * self.kv_chunk_chars, hit_chars)
+            self.kv_counters["bytes_loaded"] += loaded
+            for d in digests[:hits]:
+                self._kv_published.add(d)   # now locally warm
+        else:
+            hit_chars = 0
+        uncached = n - hit_chars
+        if self.prefill_s_per_char > 0 and uncached > 0:
+            await asyncio.sleep(self.prefill_s_per_char * uncached)
+        return digests
+
+    def _kv_publish(self, prompt_text: str, reply: str) -> None:
+        """Producer path: publish the full chunks of prompt + reply —
+        the reply is rendered exactly as the NEXT round's history will
+        render it, so follow-up rounds hit on it too. Fire-and-forget
+        (like the real connector's background writer thread): a slow or
+        dead cache server must stall the publish, never the response
+        the client is timing."""
+        if self._kv_store is None or not prompt_text:
+            return
+        pub_text = f"{prompt_text}\nassistant: {reply}"
+        asyncio.get_running_loop().run_in_executor(
+            None, self._kv_publish_sync, self._kv_digests(pub_text),
+            pub_text)
+
+    @staticmethod
+    def _kv_prompt_text(body: dict) -> str:
+        msgs = body.get("messages")
+        if isinstance(msgs, list):
+            return "\n".join(
+                f"{m.get('role', '')}: {m.get('content', '')}"
+                for m in msgs if isinstance(m, dict))
+        prompt = body.get("prompt", "")
+        return prompt if isinstance(prompt, str) else json.dumps(prompt)
 
     # -- fault machinery ------------------------------------------------
 
@@ -294,7 +426,19 @@ class FakeEngine:
                     self.num_tokens)
             if self.ttft_s:
                 await asyncio.sleep(self.ttft_s)
+            prompt_text = ""
+            if self._kv_store is not None:
+                # shared-KV simulation: TTFT paced by the uncached
+                # prefix (tier walk against the real cache server)
+                prompt_text = self._kv_prompt_text(body)
+                await self._kv_prefill_delay(prompt_text)
+            elif self.prefill_s_per_char > 0:
+                # no tier: the whole prompt "prefills" — the recompute
+                # baseline the kvshare rig compares against
+                await asyncio.sleep(self.prefill_s_per_char *
+                                    len(self._kv_prompt_text(body)))
             rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
+            reply = " ".join(f"tok{i}" for i in range(n))
             if body.get("stream"):
                 resp = web.StreamResponse(
                     headers={"Content-Type": "text/event-stream"})
@@ -310,13 +454,14 @@ class FakeEngine:
                                      .encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
+                self._kv_publish(prompt_text, reply)
                 return resp
-            text = " ".join(f"tok{i}" for i in range(n))
+            self._kv_publish(prompt_text, reply)
             return web.json_response({
                 "id": rid, "object": "chat.completion", "model": self.model,
                 "choices": [{"index": 0,
                              "message": {"role": "assistant",
-                                         "content": text},
+                                         "content": reply},
                              "finish_reason": "length"}],
                 "usage": {"prompt_tokens": 3, "completion_tokens": n,
                           "total_tokens": 3 + n}})
@@ -371,7 +516,7 @@ class FakeEngine:
             cap = self.capacity_override
         # /load and /metrics must agree like a real engine's do: tests
         # set gauges directly and read either surface
-        return web.json_response({
+        report = {
             "queue_depth": self.gauges["vllm:num_requests_waiting"],
             "running": self._in_flight,
             "max_num_seqs": cap if cap else 8,
@@ -383,13 +528,31 @@ class FakeEngine:
             # report exactly that value here for surface agreement
             "kv_usage": self.gauges["vllm:gpu_cache_usage_perc"],
             "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
-        })
+        }
+        if self._kv_store is not None:
+            c = self.kv_counters
+            report["kv_cache"] = {
+                **c,
+                "hit_rate": round(c["hit_tokens"] / c["query_tokens"], 4)
+                if c["query_tokens"] else 0.0,
+                "remote_breaker_open": self._kv_store.breaker_open(),
+            }
+        return web.json_response(report)
 
     async def metrics(self, request: web.Request) -> web.Response:
         lines = []
         for name, value in self.gauges.items():
             lines.append(f"# TYPE {name.replace(':', '_')} gauge")
             lines.append(f'{name}{{model_name="{self.model}"}} {value}')
+        if self._kv_store is not None:
+            # surface parity with the real engine's tpu:kvcache_* family
+            for key in ("query_tokens", "hit_tokens",
+                        "foreign_hit_tokens", "bytes_loaded",
+                        "bytes_saved"):
+                name = f"tpu:kvcache_{key}_total"
+                lines.append(f"# TYPE {name.replace(':', '_')} counter")
+                lines.append(f'{name}{{model_name="{self.model}"}} '
+                             f'{self.kv_counters[key]}')
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -417,6 +580,14 @@ def main(argv=None) -> None:
                    choices=["inference", "all"],
                    help="'all' makes reset/error/stall hit /v1/models "
                         "(health probes) too")
+    p.add_argument("--kv-remote-url", default=None,
+                   help="tpukv://host:port — enable the shared-KV "
+                        "simulation against a real cache server")
+    p.add_argument("--kv-chunk-chars", type=int, default=64,
+                   help="chunk granularity (chars) of the KV simulation")
+    p.add_argument("--prefill-ms-per-char", type=float, default=0.0,
+                   help="TTFT pacing per UNCACHED prompt char (the "
+                        "lever that makes tier hits measurable)")
     args = p.parse_args(argv)
     fault = None
     if args.fault:
@@ -424,7 +595,10 @@ def main(argv=None) -> None:
                  "arg": args.fault_arg, "scope": args.fault_scope}
     eng = FakeEngine(model=args.model, ttft_s=args.ttft,
                      tokens_per_s=args.tokens_per_s,
-                     num_tokens=args.num_tokens, fault=fault)
+                     num_tokens=args.num_tokens, fault=fault,
+                     kv_remote_url=args.kv_remote_url,
+                     kv_chunk_chars=args.kv_chunk_chars,
+                     prefill_s_per_char=args.prefill_ms_per_char / 1e3)
     web.run_app(eng.build_app(), host=args.host, port=args.port,
                 print=None)
 

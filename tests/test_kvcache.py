@@ -164,6 +164,157 @@ def test_remote_store_unreachable_is_soft():
     assert not client.ping()
 
 
+def test_remote_store_breaker_short_circuits():
+    """After `breaker_threshold` consecutive failures every call is
+    skipped for the cooldown — a sick cache server costs each request
+    the breaker probe, never a per-chunk connect-timeout walk."""
+    client = RemoteStore("tpukv://127.0.0.1:1", connect_timeout=0.2,
+                         breaker_threshold=2, breaker_cooldown_s=30.0)
+    assert client.get(b"a") is None
+    assert not client.breaker_open()
+    assert client.get(b"b") is None          # second consecutive failure
+    assert client.breaker_open()
+    t0 = time.monotonic()
+    assert client.get(b"c") is None
+    assert not client.put(b"k", b"v")
+    assert time.monotonic() - t0 < 0.05      # short-circuited, no socket
+    stats = client.stats()
+    assert stats["breaker_open"] == 1 and stats["breaker_trips"] == 1
+
+
+def test_remote_store_breaker_recovers():
+    """The breaker closes after its cooldown and calls flow again."""
+    with python_cache_server() as server:
+        url = f"tpukv://127.0.0.1:{server.port}"
+        client = RemoteStore(url, connect_timeout=0.5,
+                             breaker_threshold=1,
+                             breaker_cooldown_s=0.05)
+        # force one failure by pointing at a dead port first
+        dead = RemoteStore("tpukv://127.0.0.1:1", connect_timeout=0.2,
+                           breaker_threshold=1, breaker_cooldown_s=0.05)
+        assert dead.get(b"k") is None and dead.breaker_open()
+        time.sleep(0.1)
+        assert not dead.breaker_open()       # cooldown elapsed
+        # a healthy server never opens the breaker
+        assert client.put(b"k", b"v") and client.get(b"k") == b"v"
+        assert not client.breaker_open()
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# cache-server write atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_server_torn_put_never_lands():
+    """A client killed mid-PUT (partial value frame on the wire) must
+    not poison the shared tier: the server only applies a PUT after the
+    ENTIRE frame arrived."""
+    from production_stack_tpu.kvcache import protocol
+    with python_cache_server() as server:
+        frame = protocol.encode_request(protocol.OP_PUT, b"torn-key",
+                                        b"x" * 4096)
+        sock = socket.create_connection(("127.0.0.1", server.port),
+                                        timeout=5)
+        sock.sendall(frame[:len(frame) // 2])   # half the value frame
+        sock.close()                            # die mid-publish
+        client = RemoteStore(f"tpukv://127.0.0.1:{server.port}")
+        for _ in range(20):                     # let the server notice
+            if client.ping():
+                break
+            time.sleep(0.05)
+        assert not client.exists(b"torn-key")
+        assert client.get(b"torn-key") is None
+        client.close()
+
+
+def test_server_concurrent_same_key_puts_last_writer_wins():
+    """Racing same-key PUTs from many connections end with ONE of the
+    full values — never an interleaving."""
+    with python_cache_server() as server:
+        url = f"tpukv://127.0.0.1:{server.port}"
+        values = [bytes([i]) * 2048 for i in range(8)]
+        errors = []
+
+        def writer(val: bytes) -> None:
+            try:
+                client = RemoteStore(url)
+                for _ in range(10):
+                    assert client.put(b"hot-key", val)
+                client.close()
+            except Exception as e:       # surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer, args=(v,))
+                   for v in values]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+        assert not errors
+        client = RemoteStore(url)
+        final = client.get(b"hot-key")
+        client.close()
+        assert final in values          # a full value, no tearing
+
+
+def test_disk_store_concurrent_same_key_puts(tmp_path):
+    """The disk tier's own last-writer-wins contract (what the threaded
+    --disk-path server dispatch actually races): per-writer tmp files +
+    atomic rename mean the final file is always ONE full value, with no
+    stray tmps and accounting that still matches the directory."""
+    st = DiskStore(str(tmp_path), capacity_bytes=1 << 20)
+    values = [bytes([i]) * 4096 for i in range(6)]
+    errors = []
+
+    def writer(val: bytes) -> None:
+        try:
+            for _ in range(25):
+                assert st.put(b"hot", val)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(v,))
+               for v in values]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert not errors
+    assert st.get(b"hot") in values          # full value, no tearing
+    leftovers = [p for p in tmp_path.iterdir()
+                 if p.name.endswith(".tmp")]
+    assert leftovers == []
+    assert st.stats()["count"] == 1
+    assert st.stats()["bytes"] == 4096
+
+
+def test_server_disk_spill_tier(tmp_path):
+    """--disk-path composes a DiskStore behind the memory tier
+    (tmp+rename writes); values overflow into it and survive."""
+    loop = asyncio.new_event_loop()
+    server = CacheServer(host="127.0.0.1", port=0,
+                         capacity_bytes=4096,
+                         disk_path=str(tmp_path / "spill"))
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    asyncio.run_coroutine_threadsafe(server.start(), loop).result(5)
+    try:
+        client = RemoteStore(f"tpukv://127.0.0.1:{server.port}")
+        # three 2 KiB values through a 4 KiB memory tier: the oldest
+        # falls out of memory but remains served from disk
+        for i in range(3):
+            assert client.put(b"k%d" % i, bytes([i]) * 2048)
+        assert client.get(b"k0") == b"\x00" * 2048
+        tiers = server.store.tier_stats()
+        assert tiers["disk"]["count"] >= 1
+        client.close()
+    finally:
+        asyncio.run_coroutine_threadsafe(server.stop(), loop).result(5)
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(5)
+
+
 # ---------------------------------------------------------------------------
 # engine-level prefix reuse
 # ---------------------------------------------------------------------------
@@ -218,10 +369,115 @@ def test_engine_prefix_reuse_via_remote_server():
             producer.connector.flush()
             second = _run(consumer, PROMPT)
             assert consumer.connector.hit_tokens == 96
+            # the consumer never published these chunks: every hit
+            # token is foreign-origin (the cross-replica counter the
+            # kvshare rig aggregates)
+            assert consumer.connector.foreign_hit_tokens == 96
+            assert producer.connector.foreign_hit_tokens == 0
             assert second == first
         finally:
             producer.close()
             consumer.close()
+
+
+def test_connector_publish_roundtrip_byte_identical():
+    """Producer publish -> fresh-engine consumer prefetch yields
+    byte-identical KV: an independent engine computing the same prompt
+    writes the SAME bytes under the SAME keys, and a fresh consumer's
+    prefetch materializes arrays that re-serialize to those bytes."""
+    import numpy as np
+    with python_cache_server() as server:
+        url = f"tpukv://127.0.0.1:{server.port}"
+        producer = _make_engine({"remote_url": url, "chunk_size": 32})
+        independent = _make_engine({"local_cpu_gb": 0.25,
+                                    "chunk_size": 32})
+        try:
+            _run(producer, PROMPT)
+            producer.connector.flush()
+            _run(independent, PROMPT)
+            independent.connector.flush()
+            keys = producer.connector.hasher.chunk_keys(PROMPT)
+            assert len(keys) == 3            # 100 tokens, 32-chunks
+            for key in keys:
+                via_remote = producer.connector.store.get(key)
+                via_local = independent.connector.store.get(key)
+                assert via_remote is not None and via_local is not None
+                assert via_remote == via_local   # byte-identical KV
+            # fresh consumer: prefetch arrays round-trip to the bytes
+            consumer = _make_engine({"remote_url": url,
+                                     "chunk_size": 32})
+            try:
+                pf = consumer.connector.prefetch(PROMPT)
+                assert pf is not None and len(pf.chunks) == 3
+                # chunk-boundary contract: hits are capped at len-1 and
+                # full chunks only (3 * 32 = 96 <= 99)
+                assert pf.cached_tokens == 96
+                for key, (k, v) in zip(pf.keys, pf.chunks):
+                    assert consumer.connector._serialize(
+                        np.asarray(k), np.asarray(v)) == \
+                        producer.connector.store.get(key)
+            finally:
+                consumer.close()
+        finally:
+            producer.close()
+            independent.close()
+
+
+def test_connector_boundary_fingerprint_and_checksum():
+    """Chunk-boundary cap, fingerprint namespacing, corrupt-value
+    rejection, deadline bail-out, and the /load + /metrics surface —
+    one engine build covers the r11 satellite contracts."""
+    engine = _make_engine({"local_cpu_gb": 0.25, "chunk_size": 32})
+    try:
+        conn = engine.connector
+        boundary = PROMPT[:64]               # exactly two full chunks
+        _run(engine, boundary)
+        conn.flush()
+        pf = conn.prefetch(boundary)
+        # the last prompt token must prefill (first-token logits):
+        # hits cap at len-1 = 63 even though 64 tokens are stored
+        assert pf is not None and pf.cached_tokens == 63
+        assert conn.bytes_saved > 0 and conn.bytes_loaded > 0
+
+        # fingerprint mismatch: a different kv wire dtype namespaces
+        # different keys — an incompatible replica can never hit
+        from production_stack_tpu.kvcache.chunks import (ChunkHasher,
+                                                         model_fingerprint)
+        other = ChunkHasher(32, namespace=model_fingerprint(
+            engine.model_cfg, "float32"))
+        for key in other.chunk_keys(boundary):
+            assert conn.store.get(key) is None
+
+        # corrupt value: right key, flipped byte -> checksum rejection,
+        # counted AND evicted so a later publish can heal it
+        key0 = conn.hasher.chunk_keys(boundary)[0]
+        val = bytearray(conn.store.get(key0))
+        val[7] ^= 0xFF
+        conn.store.put(key0, bytes(val))
+        rejected_before = conn.rejected_chunks
+        assert conn.prefetch(boundary) is None
+        assert conn.rejected_chunks == rejected_before + 1
+        assert conn.store.get(key0) is None  # poisoned chunk evicted
+
+        # prefetch deadline: a zero budget bails before the first
+        # chunk read (the bounded-TTFT lever under a slow tier)
+        conn.cfg.prefetch_timeout_s = 0.0
+        assert conn.prefetch(boundary) is None
+        assert conn.prefetch_deadline_hits == 1
+        conn.cfg.prefetch_timeout_s = 2.0
+
+        # observability surface: /load kv_cache block + tier gauges
+        report = engine.load_report()
+        kv = report["kv_cache"]
+        assert kv["hit_tokens"] > 0 and kv["query_tokens"] > 0
+        assert kv["tiers"]["cpu"]["bytes"] > 0
+        assert kv["remote_breaker_open"] is False
+        exposition = engine.render_metrics().decode()
+        assert "tpu:kvcache_hit_tokens_total" in exposition
+        assert 'tpu:kvcache_tier_bytes{' in exposition
+        assert "tpu:kvcache_rejected_chunks_total" in exposition
+    finally:
+        engine.close()
 
 
 def test_engine_divergent_prompt_partial_hit():

@@ -92,6 +92,150 @@ def test_prefix_router_affinity():
                                                             body2)
 
 
+def _chat_body(*contents):
+    return {"messages": [{"role": "user", "content": c}
+                         for c in contents]}
+
+
+def test_prefix_router_cache_aware_scoring():
+    """Expected-hit-bytes scoring: the endpoint that served a prefix
+    keeps winning its extensions even when hash affinity disagrees —
+    and a deeper-prefix endpoint beats a shallower one."""
+    router = PrefixAwareRouter(chunk_chars=32)
+    eps = _eps(4)
+    base = _chat_body("shared agent scaffold " + "x" * 300)
+    home = router.route(eps, {}, {}, base)       # cold: ring affinity
+    assert router.cold_routes == 1
+    ext = _chat_body("shared agent scaffold " + "x" * 300,
+                     "round 2 question")
+    assert router.route(eps, {}, {}, ext) == home
+    assert router.warm_routes == 1
+    # a longer recorded prefix on another endpoint must outscore home:
+    # record the deep extension on e-deep by routing it there directly
+    deep = _chat_body("shared agent scaffold " + "x" * 300,
+                      "round 2 question", "round 2 answer " * 8)
+    other = [e for e in eps if e.url != home]
+    deep_home = router.route(other, {}, {}, deep)   # home unavailable
+    assert deep_home != home
+    # now both are candidates: the deep-prefix holder wins for the
+    # deep prompt's further extension
+    deeper = _chat_body("shared agent scaffold " + "x" * 300,
+                        "round 2 question", "round 2 answer " * 8,
+                        "round 3")
+    assert router.route(eps, {}, {}, deeper) == deep_home
+
+
+def test_prefix_router_cold_falls_back_to_ring():
+    """Cold prefixes route by consistent hash (deterministic), so
+    repeated cold traffic still converges per prefix."""
+    router = PrefixAwareRouter(chunk_chars=64)
+    eps = _eps(4)
+    short = _chat_body("hi")                 # under one chunk: cold
+    urls = {router.route(eps, {}, {}, short) for _ in range(5)}
+    assert len(urls) == 1
+    assert router.warm_routes == 0 and router.cold_routes == 5
+
+
+def test_prefix_router_hit_rate_tiebreak():
+    """Equally-warm endpoints break the tie on the scraped tier hit
+    rate (attach_scraper), then in-flight."""
+    from production_stack_tpu.router.stats import EngineStats
+    router = PrefixAwareRouter(chunk_chars=32)
+    eps = _eps(2)
+    body = _chat_body("tied prefix " + "y" * 200)
+    # record the same prefix on BOTH endpoints
+    for ep in eps:
+        router.route([ep], {}, {}, body)
+    router.attach_scraper(lambda: {
+        "http://e0:8100": EngineStats(kv_hit_rate=0.1),
+        "http://e1:8100": EngineStats(kv_hit_rate=0.9),
+    })
+    assert router.route(eps, {}, {}, body) == "http://e1:8100"
+
+
+def test_prefix_router_dead_endpoint_reroutes_and_ring_bounded():
+    """A warm endpoint filtered out by health vanishes from scoring;
+    the ring stays bounded under churn (LRU)."""
+    router = PrefixAwareRouter(chunk_chars=32, ring_entries=8)
+    eps = _eps(3)
+    body = _chat_body("warm home prefix " + "z" * 200)
+    home = router.route(eps, {}, {}, body)
+    survivors = [e for e in eps if e.url != home]
+    moved = router.route(survivors, {}, {}, body)
+    assert moved != home
+    # the re-route recorded the survivors' copy: it stays warm there
+    assert router.route(survivors, {}, {}, body) == moved
+    # LRU bound: hammering distinct prefixes cannot grow past the cap
+    for i in range(50):
+        router.route(eps, {}, {}, _chat_body(f"unique-{i} " + "q" * 200))
+    assert len(router._chunks) <= 8
+
+
+def test_prefix_router_cache_aware_off_is_pure_ring():
+    """--no-prefix-cache-aware: scoring disabled, pure hash affinity
+    (the pre-r11 behavior)."""
+    plain = PrefixAwareRouter(cache_aware=False)
+    eps = _eps(4)
+    body = _chat_body("some long prompt " * 40)
+    urls = {plain.route(eps, {}, {}, body) for _ in range(5)}
+    assert len(urls) == 1
+    assert plain.warm_routes == 0 and plain.cold_routes == 0
+
+
+def test_prefix_router_deep_membership_survives_crowded_chunks():
+    """A fleet-wide shared system prompt crowds the EARLY chunks'
+    holder lists past the per-chunk cap; the replica that served a
+    session's deep prefix must still win on its deep membership."""
+    router = PrefixAwareRouter(chunk_chars=32)
+    eps = _eps(6)
+    shared = "fleet shared system prompt " + "s" * 64   # > 2 chunks
+    deep_body = _chat_body(shared, "session deep history " * 6)
+    deep_home = router.route([eps[0]], {}, {}, deep_body)
+    # five other replicas each serve a prompt sharing ONLY the system
+    # prefix — more than _URLS_PER_CHUNK, evicting deep_home from the
+    # early chunks' holder lists
+    for i, ep in enumerate(eps[1:6]):
+        router.route([ep], {}, {}, _chat_body(shared, f"other-{i}"))
+    assert router.route(eps, {}, {}, deep_body) == deep_home
+
+
+def test_dynamic_config_swap_preserves_router_state():
+    """A dynamic-config apply that does not change the routing fields
+    (the autoscaler rewrites backends on every scale event) must keep
+    the same router instance — the prefix ring's warm-endpoint
+    knowledge survives fleet swaps."""
+    import asyncio
+
+    from production_stack_tpu.router.dynamic_config import (
+        DynamicConfigWatcher, DynamicRouterConfig)
+    state = {"router": PrefixAwareRouter(),
+             "router_kwargs": {"prefix_chunk_chars": 64,
+                               "prefix_ring_entries": 16,
+                               "prefix_cache_aware": False}}
+    watcher = DynamicConfigWatcher.__new__(DynamicConfigWatcher)
+    watcher.state = state
+    original = state["router"]
+    cfg = DynamicRouterConfig(routing_logic="prefix")
+    asyncio.run(watcher._apply(cfg))
+    assert state["router"] is original          # instance preserved
+    asyncio.run(watcher._apply(
+        DynamicRouterConfig(routing_logic="roundrobin")))
+    assert state["router"] is not original      # real change rebuilds
+    asyncio.run(watcher._apply(
+        DynamicRouterConfig(routing_logic="prefix")))
+    # rebuilt prefix router honors the CLI knobs stashed in state
+    assert state["router"].chunk_chars == 64
+    assert state["router"].cache_aware is False
+
+
+def test_make_router_prefix_knobs():
+    r = make_router("prefix", prefix_chunk_chars=128,
+                    prefix_ring_entries=16, prefix_cache_aware=False)
+    assert isinstance(r, PrefixAwareRouter)
+    assert r.chunk_chars == 128 and r.ring_entries == 16
+    assert r.cache_aware is False
+
+
 def test_least_loaded_prefers_idle():
     router = LeastLoadedRouter()
     eps = _eps(2)
