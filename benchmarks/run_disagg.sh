@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Disaggregated prefill/decode: the committed DISAGG_r12.json recipe —
+# split topology (prefill pool + decode pool + shared TPKV tier) vs
+# aggregated serving at EQUAL engine count under a mixed long-prefill/
+# short-decode storm, with a prefill-pod SIGKILL mid-run.
+#
+#   ./benchmarks/run_disagg.sh             # fake engines (role sim)
+#   ENGINE=debug-tiny ./benchmarks/run_disagg.sh    # real engines (CPU)
+#   ./benchmarks/run_disagg.sh --no-split  # anti-vacuity: MUST exit 1
+#
+# Exit 1 if the disagg contract fails: any raw 5xx / transport error in
+# either phase, chat ITL p99 not improving >=10% split-vs-aggregated,
+# a decode pool that never consumed tier KV, producers that never
+# published mid-prefill, or a scheduled prefill kill that didn't fire.
+# Real engines skip the ITL gate (debug-tiny CPU ITL is noise-
+# dominated; the fake A/B + committed record hold the latency claim —
+# the data-path gates still apply), mirroring the slow-tier test.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+OUT="${OUT:-DISAGG_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ "$ENGINE" != "fake" ]; then
+  EXTRA+=(--min-itl-improvement -1)
+fi
+
+python -m production_stack_tpu.loadgen disagg \
+  --engine "$ENGINE" \
+  --prefill-engines "${PREFILL_ENGINES:-2}" \
+  --decode-engines "${DECODE_ENGINES:-2}" \
+  --chat-users "${CHAT_USERS:-8}" --rag-users "${RAG_USERS:-4}" \
+  --duration "${DURATION:-30s}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "disagg record: $OUT"

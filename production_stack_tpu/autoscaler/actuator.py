@@ -95,6 +95,7 @@ class LocalProcessActuator(Actuator):
                  drain_timeout_s: float = 60.0,
                  drain_poll_s: float = 0.25,
                  config_apply_timeout_s: float = 30.0,
+                 extra_config: Optional[Dict] = None,
                  spawn: Optional[Callable[[], Awaitable[object]]] = None,
                  kill: Optional[
                      Callable[[object], Awaitable[None]]] = None):
@@ -110,6 +111,14 @@ class LocalProcessActuator(Actuator):
         self.drain_timeout_s = drain_timeout_s
         self.drain_poll_s = drain_poll_s
         self.config_apply_timeout_s = config_apply_timeout_s
+        # pool-label pass-through: keys merged verbatim into every
+        # dynamic-config write (e.g. prefill_backends/prefill_models of
+        # a disaggregated deployment) — an autoscaler that owns only
+        # the decode pool must not wipe the router's prefill pool on
+        # each scale event (router/dynamic_config.py treats an ABSENT
+        # prefill key as "leave the pool alone", so the default None
+        # is also safe)
+        self.extra_config = dict(extra_config or {})
         self._spawn = spawn or self._spawn_process
         self._kill = kill or self._kill_process
         self._handles: Dict[str, object] = {}     # url -> spawn handle
@@ -262,6 +271,7 @@ class LocalProcessActuator(Actuator):
             "routing_logic": self.routing_logic,
             "static_backends": urls,
             "static_models": [self.model] * len(urls),
+            **self.extra_config,
         }
         # atomic replace: the router's watcher must never read half a
         # JSON document
@@ -319,11 +329,16 @@ class KubernetesActuator(Actuator):
 
     def __init__(self, *, deployment: str, namespace: str = "default",
                  initial_replicas: int = 1, dry_run: bool = True,
-                 kubectl: str = "kubectl"):
+                 kubectl: str = "kubectl", pool: Optional[str] = None):
         self.deployment = deployment
         self.namespace = namespace
         self.dry_run = dry_run
         self.kubectl = kubectl
+        # named pool this deployment backs (disaggregated topologies
+        # run one policy loop per pool — prefill and decode deployments
+        # scale independently); recorded on every patch so decision
+        # logs stay attributable
+        self.pool = pool
         self._replicas = initial_replicas
         self.patches: List[dict] = []
         self.events: List[tuple] = []
@@ -342,6 +357,8 @@ class KubernetesActuator(Actuator):
             "dry_run": self.dry_run,
             "previous_replicas": self._replicas,
         }
+        if self.pool:
+            record["pool"] = self.pool
         self.patches.append(record)
         self.events.append(("patch", self.deployment, target))
         if not self.dry_run:

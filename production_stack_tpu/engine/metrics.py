@@ -126,6 +126,22 @@ class EngineMetrics:
         self.kv_dropped_saves = counter(
             "tpu:kvcache_dropped_saves_total",
             "Publish batches dropped by writer-queue backpressure")
+        # disaggregated-prefill role surface (docs/disagg.md): which
+        # side of the P/D split this engine is on, plus the producer's
+        # publish counters the split's observability reads
+        self.kv_published_chunks = counter(
+            "tpu:kvcache_published_chunks_total",
+            "Chunks written through the tiers by the producer path")
+        self.kv_progress_published_chunks = counter(
+            "tpu:kvcache_progress_published_chunks_total",
+            "Published chunks that became tier-visible mid-prefill "
+            "(the eager-publish path disaggregated decode overlaps "
+            "with)")
+        self._kv_role = Gauge(
+            "tpu:engine_kv_role",
+            "KV transfer role (1 on the engine's role label: "
+            "kv_producer, kv_consumer, or kv_both)",
+            list(labels) + ["role"], registry=self.registry)
         self.kv_remote_breaker_open = gauge(
             "tpu:kvcache_remote_breaker_open",
             "1 while the remote cache-server tier is breaker-skipped")
@@ -148,6 +164,8 @@ class EngineMetrics:
         ("bytes_saved", "kv_bytes_saved"),
         ("rejected_chunks", "kv_rejected_chunks"),
         ("dropped_saves", "kv_dropped_saves"),
+        ("published_chunks", "kv_published_chunks"),
+        ("progress_published_chunks", "kv_progress_published_chunks"),
     )
 
     def sync_kv(self, report: dict) -> None:
@@ -162,6 +180,9 @@ class EngineMetrics:
             self._kv_last[src] = total
         self.kv_remote_breaker_open.set(
             1.0 if report.get("remote_breaker_open") else 0.0)
+        role = report.get("role")
+        if role:
+            self._kv_role.labels(role=role, **self._labels).set(1.0)
         for tier, st in (report.get("tiers") or {}).items():
             self._kv_tier_bytes.labels(tier=tier, **self._labels).set(
                 st.get("bytes", 0))

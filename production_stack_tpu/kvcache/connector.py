@@ -159,6 +159,8 @@ class KVConnector:
         self.chunk_misses = 0       # walk-terminating misses
         self.bytes_loaded = 0       # tier bytes materialized by prefetch
         self.bytes_saved = 0        # tier bytes written through
+        self.published_chunks = 0   # producer: chunks written through
+        self.progress_published_chunks = 0   # ...of which mid-prefill
         self.rejected_chunks = 0    # size/checksum-invalid values
         self.prefetch_deadline_hits = 0
         self.dropped_saves = 0
@@ -244,7 +246,7 @@ class KVConnector:
         if not self.cfg.is_producer:
             return
         self._publish(seq, seq.prompt_tokens[:seq.num_prefilled],
-                      getattr(seq, "slot", -1), salt)
+                      getattr(seq, "slot", -1), salt, progress=True)
 
     def on_finish(self, seq, salt: str = "") -> None:
         """Queue full-chunk KV of a finished sequence for write-through.
@@ -259,7 +261,8 @@ class KVConnector:
         self._publish(seq, (seq.prompt_tokens + seq.output_tokens)[:-1],
                       getattr(seq, "slot", -1), salt)
 
-    def _publish(self, seq, tokens, slot: int, salt: str) -> None:
+    def _publish(self, seq, tokens, slot: int, salt: str,
+                 progress: bool = False) -> None:
         n_chunks = self.hasher.num_full_chunks(len(tokens))
         if n_chunks == 0 or slot < 0:
             return
@@ -277,7 +280,11 @@ class KVConnector:
                 continue
             k_dev, v_dev = self.runner.extract_chunk(
                 slot, i * self.chunk_size, self.chunk_size)
-            work.append((key, k_dev, v_dev))
+            # the progress flag rides to the writer: a chunk only
+            # counts as progress-published once its put SUCCEEDS (a
+            # dropped batch or failed save must not satisfy the
+            # overlap evidence the disagg rig gates on)
+            work.append((key, k_dev, v_dev, progress))
             self._mark_seen(key)
         if not work:
             return
@@ -285,7 +292,7 @@ class KVConnector:
             self._save_q.put_nowait(work)
         except queue.Full:
             self.dropped_saves += len(work)
-            for key, _, _ in work:      # allow a retry on a later finish
+            for key, _, _, _ in work:   # allow a retry on a later finish
                 self._seen_keys.pop(key, None)
 
     def _writer_loop(self) -> None:
@@ -296,11 +303,16 @@ class KVConnector:
                 continue
             self._inflight.set()
             try:
-                for key, k_dev, v_dev in work:
+                for key, k_dev, v_dev, progress in work:
                     try:
                         val = self._serialize(k_dev, v_dev)
                         if self.store.put(key, val):
                             self.bytes_saved += len(val)
+                            self.published_chunks += 1
+                            if progress:
+                                # tier-visible while later chunks were
+                                # still prefilling (disagg overlap)
+                                self.progress_published_chunks += 1
                     except Exception as e:   # never kill the writer
                         logger.warning("KV save failed: %s", e)
             finally:
@@ -389,6 +401,9 @@ class KVConnector:
         """Counters surfaced on /load (and deltas fed to /metrics):
         everything the cache-aware router and the kvshare rig read."""
         return {
+            # the engine's disagg role: the router's pool wiring and
+            # the disagg rig read it off /load for topology checks
+            "role": self.cfg.kv_role,
             "queries": self.queries,
             "query_tokens": self.query_tokens,
             "hit_tokens": self.hit_tokens,
@@ -398,6 +413,8 @@ class KVConnector:
             "chunk_misses": self.chunk_misses,
             "bytes_loaded": self.bytes_loaded,
             "bytes_saved": self.bytes_saved,
+            "published_chunks": self.published_chunks,
+            "progress_published_chunks": self.progress_published_chunks,
             "rejected_chunks": self.rejected_chunks,
             "dropped_saves": self.dropped_saves,
             "prefetch_deadline_hits": self.prefetch_deadline_hits,

@@ -30,6 +30,12 @@ kvshare  — launch a shared TPKV cache server + N engines wired to it
            drive multi-round QA and exit 1 unless the cross-replica
            tier hit rate clears 60% AND follow-up-round TTFT beats
            the recompute baseline (KVSHARE_*.json)
+disagg   — launch the P/D split (cache server + prefill pool + decode
+           pool + router with --prefill-backends) AND the aggregated
+           baseline at equal engine count; drive a mixed long-prefill/
+           short-decode storm at both (SIGKILLing a prefill pod
+           mid-run) and exit 1 unless chat ITL p99 improves with zero
+           client-visible errors (DISAGG_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -45,6 +51,8 @@ from production_stack_tpu.loadgen import report as report_mod
 from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
                                                     run_autoscale)
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
+from production_stack_tpu.loadgen.disagg import (disagg_violations,
+                                                 run_disagg)
 from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
@@ -333,6 +341,58 @@ def cmd_kvshare(args) -> int:
               f"{d['cached']['foreign_share']:.0%}), follow-up TTFT "
               f"{ttft['cached']:.0f}ms vs {ttft['recompute']:.0f}ms "
               f"recompute ({ttft['improvement_pct']:.0f}% faster)")
+    return 1 if violations else 0
+
+
+def cmd_disagg(args) -> int:
+    record = asyncio.run(run_disagg(
+        prefill_engines=args.prefill_engines,
+        decode_engines=args.decode_engines, engine=args.engine,
+        chat_users=args.chat_users, rag_users=args.rag_users,
+        duration_s=args.duration,
+        chat_prompt_chars=args.chat_prompt_chars,
+        chat_tokens=args.chat_tokens,
+        rag_prompt_chars=args.rag_prompt_chars,
+        rag_tokens=args.rag_tokens,
+        tokens_per_s=args.fake_tokens_per_s,
+        prefill_ms_per_char=args.prefill_ms_per_char,
+        interference=args.interference,
+        kv_chunk_chars=args.kv_chunk_chars,
+        headstart_s=args.headstart,
+        min_prompt_chars=args.min_prompt_chars,
+        routing=args.routing, seed=args.seed, no_split=args.no_split,
+        prefill_kill=not args.no_prefill_kill,
+        kill_downtime_s=args.kill_downtime,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"DISAGG_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = disagg_violations(
+        record,
+        min_itl_improvement=(args.min_itl_improvement
+                             if args.min_itl_improvement >= 0 else None))
+    for v in violations:
+        print(f"DISAGG VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        itl = d["chat_itl_p99_ms"]
+        chaos = d["split_phase"].get("chaos") or {}
+        if itl.get("improvement_pct") is not None:
+            itl_msg = (f"chat ITL p99 {itl['split']:.1f}ms split vs "
+                       f"{itl['aggregated']:.1f}ms aggregated "
+                       f"({itl['improvement_pct']:.0f}% better)")
+        else:
+            # single-chunk chat streams yield no ITL samples; only
+            # reachable with the gate disabled (negative
+            # --min-itl-improvement), where the data-path gates carry
+            # the contract
+            itl_msg = "chat ITL not sampled (single-chunk streams)"
+        print(f"disagg PASSED: {itl_msg} at equal engine "
+              f"count ({d['prefill_engines']}P+{d['decode_engines']}D), "
+              f"{chaos.get('kills', 0)} prefill-pod kill(s) with zero "
+              f"client-visible errors")
     return 1 if violations else 0
 
 
@@ -636,6 +696,81 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write KVSHARE_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_kvshare)
+
+    sp = sub.add_parser("disagg",
+                        help="P/D split (prefill pool + decode pool + "
+                             "shared cache) vs aggregated serving at "
+                             "equal engine count; mixed storm with a "
+                             "prefill-pod SIGKILL must show chat ITL "
+                             "p99 improving with zero errors")
+    sp.add_argument("--prefill-engines", type=int, default=2,
+                    help="kv_producer pool size")
+    sp.add_argument("--decode-engines", type=int, default=2,
+                    help="kv_consumer pool size (the aggregated "
+                         "baseline runs prefill+decode engines total)")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (role simulation over the real TPKV "
+                         "tier protocol — measures router "
+                         "orchestration + transfer path) or a real "
+                         "engine model name (--kv-transfer-config "
+                         "roles)")
+    sp.add_argument("--chat-users", type=int, default=8,
+                    help="closed-loop short-prompt/long-decode users "
+                         "(the ITL-gated class)")
+    sp.add_argument("--rag-users", type=int, default=4,
+                    help="closed-loop long-prefill/short-decode users "
+                         "(the head-of-line blockers)")
+    sp.add_argument("--duration", type=parse_duration, default=30.0,
+                    help="measured window per phase (p99 gates want "
+                         ">=30s of samples)")
+    sp.add_argument("--chat-prompt-chars", type=int, default=96)
+    sp.add_argument("--chat-tokens", type=int, default=24)
+    sp.add_argument("--rag-prompt-chars", type=int, default=2400)
+    sp.add_argument("--rag-tokens", type=int, default=4)
+    sp.add_argument("--fake-tokens-per-s", type=float, default=40.0,
+                    help="fake engines: decode pacing")
+    sp.add_argument("--prefill-ms-per-char", type=float, default=0.4,
+                    help="fake engines: prefill pacing per uncached "
+                         "char")
+    sp.add_argument("--interference", type=float, default=1.5,
+                    help="fake engines: decode ticks stretch by "
+                         "(1 + this * concurrently-prefilling "
+                         "requests) — the contention the split "
+                         "removes")
+    sp.add_argument("--kv-chunk-chars", type=int, default=64,
+                    help="fake engines: chunk granularity (chars)")
+    sp.add_argument("--headstart", type=float, default=3.0,
+                    help="router --prefill-headstart (should cover one "
+                         "long prefill so decode finds the prefix "
+                         "published)")
+    sp.add_argument("--min-prompt-chars", type=int, default=512,
+                    help="router --disagg-min-prompt-chars: chat "
+                         "prompts below this skip the prefill stage")
+    sp.add_argument("--routing", default="least_loaded",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--no-split", action="store_true",
+                    help="run BOTH phases aggregated: the ITL gate "
+                         "must then fail (exit 1) — the anti-vacuity "
+                         "check")
+    sp.add_argument("--no-prefill-kill", action="store_true",
+                    help="skip the mid-run prefill-pod SIGKILL")
+    sp.add_argument("--kill-downtime", type=parse_duration, default=3.0,
+                    help="seconds the killed prefill pod stays down")
+    sp.add_argument("--min-itl-improvement", type=float, default=0.1,
+                    help="chat ITL p99 must improve split-vs-"
+                         "aggregated by this fraction; negative "
+                         "disables the ITL gate (real debug-tiny CPU "
+                         "engines are ITL-noise-dominated — the data-"
+                         "path gates still apply)")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write DISAGG_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_disagg)
 
     return p
 

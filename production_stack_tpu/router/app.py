@@ -25,7 +25,8 @@ from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
 from production_stack_tpu.router.feature_gates import FeatureGates
 from production_stack_tpu.router.metrics import RouterMetrics
 from production_stack_tpu.router.proxy import route_general_request
-from production_stack_tpu.router.resilience import (HealthTracker,
+from production_stack_tpu.router.resilience import (CLOSED,
+                                                    HealthTracker,
                                                     RetryBudget,
                                                     wait_for_drain)
 from production_stack_tpu.router.rewriter import make_rewriter
@@ -93,6 +94,9 @@ async def health(request: web.Request) -> web.Response:
         "dynamic_config": watcher.current.to_json()
         if watcher and watcher.current else None,
     }
+    disagg = state.get("disagg")
+    if disagg is not None:
+        body["prefill_pool"] = disagg.pool_snapshot()
     return web.json_response(body, status=200 if not problems else 503)
 
 
@@ -147,6 +151,21 @@ async def metrics(request: web.Request) -> web.Response:
                        if tracker.is_routable(ep.url)])
     else:
         healthy = len(endpoints)
+    disagg = state.get("disagg")
+    if disagg is not None and disagg.selector is not None:
+        # discovery-driven decode churn (k8s) never passes through a
+        # dynamic-config apply, so the scrape is the only hook where a
+        # departed decode URL can lose its warm locality evidence — a
+        # scale-up reusing the URL starts a COLD process the ring
+        # would otherwise score at zero transfer cost. Breaker-open
+        # counts as departed for the same reason: an in-place restart
+        # on the same URL comes back with empty tiers (pessimistic
+        # costs pay a refetch; optimistic phantom-zero costs misroute).
+        # Draining keeps its evidence — the process, and its KV, lives.
+        live = [ep.url for ep in configured]
+        if tracker is not None:
+            live = [u for u in live if tracker.state_of(u) == CLOSED]
+        disagg.selector.evict_except(live)
     state["metrics"].refresh(state["request_stats"].get(), healthy)
     state["metrics"].refresh_overload(state["shed_counts"])
     if tracker is not None:
@@ -156,6 +175,8 @@ async def metrics(request: web.Request) -> web.Response:
     if state.get("pii_middleware") is not None:
         state["metrics"].refresh_pii(state["pii_middleware"])
     state["metrics"].refresh_routing(state["router"])
+    if disagg is not None:
+        state["metrics"].refresh_disagg(disagg)
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
 
@@ -264,12 +285,18 @@ def build_app(args: argparse.Namespace) -> web.Application:
             threshold=args.semantic_cache_threshold,
             max_entries=args.semantic_cache_max_entries,
             persist_dir=args.semantic_cache_dir)
-    from production_stack_tpu.router.disagg import make_orchestrator
-    disagg = make_orchestrator(args)
+    from production_stack_tpu.router.disagg import (make_orchestrator,
+                                                    orchestrator_kwargs)
+    # kept in state so a dynamic-config pool swap (or late creation)
+    # preserves the CLI-configured disagg knobs (dynamic_config._apply);
+    # built once and shared with the startup orchestrator
+    state["disagg_kwargs"] = orchestrator_kwargs(args)
+    disagg = make_orchestrator(args, kwargs=state["disagg_kwargs"])
     if disagg is not None:
         state["disagg"] = disagg
-        logger.info("disaggregated prefill: %d prefill backends",
-                    len(disagg.endpoints))
+        logger.info("disaggregated prefill: %d prefill backends, "
+                    "decode selection %s", len(disagg.endpoints),
+                    "on" if disagg.selector is not None else "off")
 
     # indirect through state so dynamic-config discovery swaps are followed
     state["scraper"] = EngineStatsScraper(
@@ -463,6 +490,34 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "circuit opens")
     p.add_argument("--prefill-breaker-cooldown", type=float, default=30.0,
                    help="seconds an open prefill circuit stays open")
+    p.add_argument("--disagg-min-prompt-chars", type=int, default=0,
+                   help="prompts shorter than this skip the prefill "
+                        "stage entirely (a 1-token pass costs more "
+                        "than prefilling a short prompt on the decode "
+                        "engine; 0 = disaggregate everything)")
+    p.add_argument("--disagg-chunk-chars", type=int, default=256,
+                   help="decode-selection chunk granularity: prompt "
+                        "text is chain-hashed in chunks of this many "
+                        "chars for the transfer-cost model (should "
+                        "roughly track the engine-side kv chunk_size "
+                        "in text terms)")
+    p.add_argument("--disagg-transfer-weight", type=float, default=1.0,
+                   help="decode-selection weight on expected KV "
+                        "transfer bytes")
+    p.add_argument("--disagg-load-weight", type=float, default=1.0,
+                   help="decode-selection weight on scraped decode "
+                        "load (in-flight / advertised capacity)")
+    p.add_argument("--disagg-remote-cost", type=float, default=1.0,
+                   help="per-byte cost of pulling a chunk from the "
+                        "shared remote tier (relative units)")
+    p.add_argument("--disagg-recompute-cost", type=float, default=2.0,
+                   help="per-byte cost of recomputing a chunk the "
+                        "tiers don't hold (relative units; > remote "
+                        "cost when the DCN link beats prefill compute)")
+    p.add_argument("--no-disagg-decode-selection", action="store_true",
+                   help="disable transfer-cost decode selection: the "
+                        "configured routing policy picks the decode "
+                        "engine unassisted")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

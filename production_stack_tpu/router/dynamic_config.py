@@ -29,6 +29,13 @@ class DynamicRouterConfig:
     static_backends: List[str] = field(default_factory=list)
     static_models: List[str] = field(default_factory=list)
     session_key: str = "x-user-id"
+    # disaggregated-prefill pool (router/disagg.py). Tri-state: None =
+    # key absent from the config file, leave the running pool alone
+    # (an autoscaler managing only the decode pool must not wipe the
+    # prefill pool on every scale event); [] = explicitly disable
+    # disaggregation; non-empty = swap the pool in place.
+    prefill_backends: Optional[List[str]] = None
+    prefill_models: Optional[List[str]] = None
 
     @staticmethod
     def from_json(data: dict) -> "DynamicRouterConfig":
@@ -41,16 +48,29 @@ class DynamicRouterConfig:
             static_backends=listify(data.get("static_backends")),
             static_models=listify(data.get("static_models")),
             session_key=data.get("session_key", "x-user-id"),
+            prefill_backends=(listify(data["prefill_backends"])
+                              if "prefill_backends" in data else None),
+            prefill_models=(listify(data["prefill_models"])
+                            if "prefill_models" in data else None),
         )
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "service_discovery": self.service_discovery,
             "routing_logic": self.routing_logic,
             "static_backends": self.static_backends,
             "static_models": self.static_models,
             "session_key": self.session_key,
         }
+        # each key echoed only as provided: synthesizing prefill_models
+        # [] next to non-empty backends would render a length-mismatched
+        # document (which _apply_prefill_pool rejects) as if it were
+        # the live config on /health
+        if self.prefill_backends is not None:
+            out["prefill_backends"] = self.prefill_backends
+        if self.prefill_models is not None:
+            out["prefill_models"] = self.prefill_models
+        return out
 
 
 class DynamicConfigWatcher:
@@ -131,4 +151,64 @@ class DynamicConfigWatcher:
             if scraper is not None and \
                     hasattr(self.state["router"], "attach_scraper"):
                 self.state["router"].attach_scraper(scraper.get)
+        self._apply_prefill_pool(cfg)
+        # decode-fleet membership may have changed above (static swap)
+        # even when the prefill key was absent — the decode-only-
+        # autoscaler case. Locality evidence for departed decode
+        # engines must go either way: a later scale-up reusing the URL
+        # starts a COLD process the ring would otherwise score warm.
+        disagg = self.state.get("disagg")
+        if disagg is not None and disagg.selector is not None:
+            discovery = self.state.get("discovery")
+            if discovery is not None:
+                disagg.selector.evict_except(
+                    ep.url for ep in discovery.all_endpoints())
         self.current = cfg
+
+    def _apply_prefill_pool(self, cfg: DynamicRouterConfig) -> None:
+        """Swap/create/disable the disagg prefill pool. The running
+        orchestrator is mutated IN PLACE (set_pool) so breaker and
+        rotation state survive for pool members present on both sides
+        of the swap — replacing the object would amnesty a sick prefill
+        backend exactly when the fleet is in motion (the bug class r11
+        fixed for prefix rings)."""
+        if cfg.prefill_backends is None:
+            return                    # key absent: leave the pool alone
+        disagg = self.state.get("disagg")
+        if not cfg.prefill_backends:
+            if disagg is not None:
+                # fold the outgoing orchestrator's counters into the
+                # exposition before its totals vanish with it, then
+                # reset the delta baseline: a later enable starts a
+                # fresh orchestrator from zero
+                metrics = self.state.get("metrics")
+                if metrics is not None:
+                    metrics.refresh_disagg(disagg)
+                    metrics.reset_disagg_baseline()
+                del self.state["disagg"]
+                logger.info("dynamic config: disaggregated prefill "
+                            "disabled")
+            return
+        models = cfg.prefill_models or []
+        if len(models) != len(cfg.prefill_backends):
+            # an operator (or an actuator extra_config) shipping a
+            # mismatched pool must not kill the watcher — or router
+            # startup, where _check_once runs unwrapped — nor leave
+            # the apply half-done: log loudly, keep the running pool
+            logger.error(
+                "dynamic config: %d prefill_backends but %d "
+                "prefill_models — prefill pool left unchanged",
+                len(cfg.prefill_backends), len(models))
+            return
+        if disagg is None:
+            from production_stack_tpu.router.disagg import (
+                build_orchestrator)
+            self.state["disagg"] = build_orchestrator(
+                cfg.prefill_backends, models,
+                self.state.get("disagg_kwargs"))
+            logger.info("dynamic config: disaggregated prefill enabled "
+                        "(%d backends)", len(cfg.prefill_backends))
+        else:
+            # (_apply evicts departed decode engines from the selector
+            # locality ring after this, for every config shape)
+            disagg.set_pool(cfg.prefill_backends, models)

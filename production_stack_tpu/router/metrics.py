@@ -96,6 +96,42 @@ class RouterMetrics:
             "Routing decisions that fell back to hash affinity "
             "(cold prefix)", registry=self.registry)
         self._prefix_last = {"warm": 0, "cold": 0}
+        # disaggregated prefill surface (router/disagg.py): prefill
+        # dispatches/failures, per-reason fallbacks to aggregated
+        # serving, breaker opens, and decode-selection outcomes. Real
+        # counters, delta-synced in refresh_disagg so a dynamic-config
+        # pool swap (which may replace the orchestrator) never reads as
+        # an unflagged counter reset.
+        self.disagg_prefills = Counter(
+            "tpu:router_disagg_prefills",
+            "Prefill passes dispatched to the prefill pool",
+            registry=self.registry)
+        self.disagg_prefill_errors = Counter(
+            "tpu:router_disagg_prefill_errors",
+            "Prefill passes that failed (decode recomputed)",
+            registry=self.registry)
+        self.disagg_fallbacks = Counter(
+            "tpu:router_disagg_fallbacks",
+            "Requests degraded to aggregated serving by reason "
+            "(no_pool, breaker_open, shed, http_error, timeout, "
+            "connect)", ["reason"], registry=self.registry)
+        self.disagg_breaker_opens = Counter(
+            "tpu:router_disagg_breaker_opens",
+            "Prefill-backend circuit-breaker open transitions",
+            registry=self.registry)
+        self.disagg_headstart_elapsed = Counter(
+            "tpu:router_disagg_headstart_elapsed",
+            "Decode routed while the prefill pass was still running",
+            registry=self.registry)
+        self.disagg_decode_cost_routes = Counter(
+            "tpu:router_disagg_decode_cost_routes",
+            "Decode selections made by the transfer-cost model",
+            registry=self.registry)
+        self.disagg_decode_abstains = Counter(
+            "tpu:router_disagg_decode_abstains",
+            "Decode selections deferred to the routing policy "
+            "(cold prefix)", registry=self.registry)
+        self._disagg_last: dict = {}
         # PII surface (reference: pii/middleware.py:20-39 counters)
         self.pii_scanned = plain("vllm:pii_requests_scanned",
                                  "Requests scanned for PII")
@@ -193,6 +229,42 @@ class RouterMetrics:
             if delta > 0:
                 counter.inc(delta)
             self._prefix_last[key] = total
+
+    def refresh_disagg(self, orch) -> None:
+        """Export the disagg orchestrator's counters. Delta-synced like
+        refresh_routing: a dynamic-config swap may replace the
+        orchestrator (totals restart), so fresh totals below the last
+        sync are treated as new increments."""
+        def bump(key, total, counter):
+            delta = total - self._disagg_last.get(key, 0)
+            if delta < 0:             # orchestrator swapped: restarted
+                delta = total
+            if delta > 0:
+                counter.inc(delta)
+            self._disagg_last[key] = total
+
+        bump("prefills", orch.prefills, self.disagg_prefills)
+        bump("errors", orch.prefill_errors, self.disagg_prefill_errors)
+        bump("breaker_opens", orch.breaker_opens,
+             self.disagg_breaker_opens)
+        bump("headstart", orch.headstart_elapsed,
+             self.disagg_headstart_elapsed)
+        for reason, total in orch.fallbacks.items():
+            bump(f"fb:{reason}", total,
+                 self.disagg_fallbacks.labels(reason=reason))
+        sel = orch.selector
+        if sel is not None:
+            bump("cost_routes", sel.cost_routes,
+                 self.disagg_decode_cost_routes)
+            bump("abstains", sel.abstains, self.disagg_decode_abstains)
+
+    def reset_disagg_baseline(self) -> None:
+        """Called after a final refresh_disagg fold when the
+        orchestrator is removed (dynamic-config disable): the next
+        orchestrator starts its totals from zero, and a stale baseline
+        would swallow its first increments whenever they happen to
+        pass the old totals between scrapes."""
+        self._disagg_last = {}
 
     def refresh_semantic_cache(self, cache) -> None:
         self.semantic_hits.set(cache.hits)

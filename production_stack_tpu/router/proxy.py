@@ -163,6 +163,16 @@ def _endpoint_cap(state, url: str, scraper_stats=None) -> float:
     return es.capacity
 
 
+def _under_cap(state, ep, request_stats, scraper_stats) -> bool:
+    """Endpoint below its concurrency cap (or uncapped / never seen).
+    Shared by the routing loop's under-cap filter and the disagg
+    saturation pre-check — the pre-check exists to predict the loop's
+    shed decision, so the two must never diverge."""
+    rs = request_stats.get(ep.url)
+    return rs is None or \
+        rs.in_flight < _endpoint_cap(state, ep.url, scraper_stats)
+
+
 def _shed_response(status: int, message: str,
                    retry_after_s: float = 1.0) -> web.Response:
     resp = web.json_response(
@@ -266,9 +276,27 @@ async def _proxy_request(request: web.Request,
     # disaggregated prefill: the prefill pool computes the prompt KV into
     # the shared tier (publishing chunk-by-chunk as it goes) while decode
     # routing proceeds after a bounded head-start; failures (or an open
-    # breaker) degrade to a normal full prefill on the decode engine
+    # breaker) degrade to a normal full prefill on the decode engine.
+    # Decode selection then goes through the orchestrator's NetKV-style
+    # transfer-cost scoring (disagg.DecodeSelector) for the FIRST
+    # attempt; failover re-routing stays with the normal policy.
     disagg = state.get("disagg")
-    if disagg is not None:
+    disagg_active = disagg is not None and \
+        disagg.should_run(endpoint_path, body)
+    disagg_digests = None
+    if disagg_active:
+        # decode-side saturation pre-check: with EVERY candidate at its
+        # concurrency cap the routing loop below sheds 503 — dispatching
+        # the prefill first would burn a producer pass on a request
+        # that is never served AND delay that shed by the head-start
+        # (defeating Retry-After's fast-backoff intent)
+        _stats0 = state["request_stats"].snapshot()
+        _scraper0 = state.get("scraper")
+        _sstats0 = _scraper0.get() if _scraper0 is not None else {}
+        if not any(_under_cap(state, ep, _stats0, _sstats0)
+                   for ep in candidates):
+            disagg_active = False
+    if disagg_active:
         request_id = request.headers.get("x-request-id") or \
             uuid.uuid4().hex
         prefill_headers = {"x-request-id": request_id}
@@ -277,9 +305,13 @@ async def _proxy_request(request: web.Request,
                 request.headers["Authorization"]
         else:
             prefill_headers.update(state["auth_overlay"])
+        # hash the prompt once; the same digest list feeds the prefill
+        # dispatch, decode selection, and the locality-ring record
+        disagg_digests = disagg.digests(body)
         await disagg.run_with_headstart(state["client"], endpoint_path,
                                         model, body,
-                                        headers=prefill_headers)
+                                        headers=prefill_headers,
+                                        digests=disagg_digests)
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
@@ -321,9 +353,8 @@ async def _proxy_request(request: web.Request,
         scraper = state.get("scraper")
         scraper_stats = scraper.get() if scraper is not None else {}
         under_cap = [ep for ep in pool
-                     if (request_stats.get(ep.url) is None
-                         or request_stats[ep.url].in_flight
-                         < _endpoint_cap(state, ep.url, scraper_stats))]
+                     if _under_cap(state, ep, request_stats,
+                                   scraper_stats)]
         if under_cap:
             pool = under_cap
         elif attempt == 0:
@@ -343,8 +374,27 @@ async def _proxy_request(request: web.Request,
                       request_stats[ep.url].in_flight
                       if ep.url in request_stats else 0).url
         else:
-            url = state["router"].route(pool, request_stats,
-                                        request.headers, body)
+            url = None
+            if disagg_active and attempt == 0:
+                # two-stage decode selection: expected KV transfer
+                # bytes vs scraped load; None (cold prefix / selection
+                # disabled) falls through to the routing policy
+                url = disagg.select_decode(body, pool, request_stats,
+                                           scraper_stats,
+                                           digests=disagg_digests)
+            if url is None:
+                url = state["router"].route(pool, request_stats,
+                                            request.headers, body)
+        if disagg_active:
+            # the chosen decode engine will fetch-or-compute the
+            # prompt chunks and hold them locally afterwards. Recorded
+            # on EVERY attempt — failover re-routes and post-shed
+            # least-loaded picks included (like the prefix ring, which
+            # records inside route()) — and taken back out by
+            # on_decode_failed when the attempt dies before a byte
+            # reaches the client: only the engine that actually pulled
+            # the KV stays credited
+            disagg.on_decode_routed(body, url, digests=disagg_digests)
         attempt += 1
         if attempt == 1:
             logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path,
@@ -353,6 +403,7 @@ async def _proxy_request(request: web.Request,
         rec = monitor.on_new_request(url)
         resp: Optional[web.StreamResponse] = None
         retry_cause: Optional[str] = None
+        decode_failed = False   # pre-stream failure: un-credit locality
         try:
             async with session.post(
                     f"{url}{endpoint_path}", data=raw,
@@ -372,6 +423,7 @@ async def _proxy_request(request: web.Request,
                         health.record_shed(url)
                     last_failure = f"backend shed (HTTP {backend.status})"
                     last_was_shed = True
+                    decode_failed = True
                     if not shed_rerouted and _can_retry(
                             attempt, max_attempts, tried, candidates,
                             budget):
@@ -395,6 +447,7 @@ async def _proxy_request(request: web.Request,
                         health.record_failure(url, "http_5xx")
                     last_failure = f"backend HTTP {backend.status}"
                     last_was_shed = False
+                    decode_failed = True
                     if _can_retry(attempt, max_attempts, tried,
                                   candidates, budget):
                         retry_cause = last_failure
@@ -481,6 +534,7 @@ async def _proxy_request(request: web.Request,
             last_failure = (f"backend timed out after "
                             f"{state['request_timeout']:g}s")
             timed_out = True
+            decode_failed = True
             last_was_shed = False
             if _can_retry(attempt, max_attempts, tried, candidates,
                           budget):
@@ -501,6 +555,7 @@ async def _proxy_request(request: web.Request,
                 health.record_failure(url, "connect")
             last_failure = f"backend error: {e}"
             timed_out = False
+            decode_failed = True
             last_was_shed = False
             if _can_retry(attempt, max_attempts, tried, candidates,
                           budget):
@@ -508,6 +563,13 @@ async def _proxy_request(request: web.Request,
                 continue
         finally:
             monitor.on_request_complete(rec)
+            if decode_failed and disagg_active:
+                # a shed/failed pick never pulled the KV: take the
+                # route-time credit back out, or its low in-flight
+                # keeps winning the load tiebreak at phantom-zero
+                # transfer cost for the prefixes it keeps refusing
+                disagg.on_decode_failed(body, url,
+                                        digests=disagg_digests)
             if retry_cause is not None:
                 tried.add(url)
                 if health is not None:

@@ -35,6 +35,49 @@ from production_stack_tpu.utils import init_logger
 logger = init_logger(__name__)
 
 
+def prompt_text(body: dict) -> str:
+    """Canonical prompt rendering for chunk hashing — shared by the
+    prefix ring and the disagg DecodeSelector. Both rings must chunk
+    the SAME text or affinity and transfer-cost scoring silently
+    diverge on identical requests."""
+    if "messages" in body:
+        try:
+            return json.dumps(body["messages"])
+        except (TypeError, ValueError):
+            return ""
+    prompt = body.get("prompt", "")
+    return prompt if isinstance(prompt, str) else json.dumps(prompt)
+
+
+def prompt_chunk_digests(text: str, chunk_chars: int,
+                         max_track_chars: int) -> List[bytes]:
+    """Chained digests of the prompt's full chunk_chars chunks
+    (bounded by max_track_chars; a partial tail chunk is skipped,
+    mirroring chunk-granular tier storage)."""
+    from production_stack_tpu.kvcache.chunks import chain_digest_bytes
+    data = text[:max_track_chars].encode("utf-8", "ignore")
+    return chain_digest_bytes(data, chunk_chars)
+
+
+def record_chunk_holders(ring, digests, url: str, *,
+                         urls_per_chunk: int, max_entries: int) -> None:
+    """Record ``url`` as a recent holder of each digest in an
+    OrderedDict ring (most recent last, LRU over digests, at most
+    ``urls_per_chunk`` holders per digest)."""
+    for d in digests:
+        urls = ring.get(d)
+        if urls is None:
+            ring[d] = [url]
+        else:
+            if url in urls:
+                urls.remove(url)
+            urls.append(url)
+            del urls[:-urls_per_chunk]
+            ring.move_to_end(d)
+    while len(ring) > max_entries:
+        ring.popitem(last=False)
+
+
 class Router(ABC):
     name = "abstract"
 
@@ -250,39 +293,18 @@ class PrefixAwareRouter(Router):
         EngineStatsScraper.get) — enables the hit-rate tiebreak."""
         self._get_engine_stats = get_stats
 
-    @staticmethod
-    def _prompt_text(body: dict) -> str:
-        if "messages" in body:
-            try:
-                return json.dumps(body["messages"])
-            except (TypeError, ValueError):
-                return ""
-        prompt = body.get("prompt", "")
-        return prompt if isinstance(prompt, str) else json.dumps(prompt)
+    _prompt_text = staticmethod(prompt_text)
 
     def _chunk_digests(self, text: str) -> List[bytes]:
-        """Chained digests of the prompt's full chunk_chars chunks
-        (bounded by max_track_chars; a partial tail chunk is skipped,
-        mirroring chunk-granular tier storage)."""
-        from production_stack_tpu.kvcache.chunks import chain_digest_bytes
-        data = text[:self.max_track_chars].encode("utf-8", "ignore")
-        return chain_digest_bytes(data, self.chunk_chars)
+        return prompt_chunk_digests(text, self.chunk_chars,
+                                    self.max_track_chars)
 
     def _record(self, digests: List[bytes], url: str) -> None:
         """Feed the ring: the chosen engine will prefill-and-publish
         these chunks (producer path), or already held them."""
-        for d in digests:
-            urls = self._chunks.get(d)
-            if urls is None:
-                self._chunks[d] = [url]
-            else:
-                if url in urls:
-                    urls.remove(url)
-                urls.append(url)
-                del urls[:-self._URLS_PER_CHUNK]
-                self._chunks.move_to_end(d)
-        while len(self._chunks) > self.ring_entries:
-            self._chunks.popitem(last=False)
+        record_chunk_holders(self._chunks, digests, url,
+                             urls_per_chunk=self._URLS_PER_CHUNK,
+                             max_entries=self.ring_entries)
 
     def _expected_hit_chunks(self, digests: List[bytes],
                              urls) -> Dict[str, int]:

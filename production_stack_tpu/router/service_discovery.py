@@ -37,6 +37,10 @@ class EndpointInfo:
     added_at: float = field(default_factory=time.time)
     pod_name: Optional[str] = None
     model_aliases: List[str] = field(default_factory=list)
+    # named pool membership (disaggregated serving, router/disagg.py):
+    # discovery-managed endpoints are the "decode" pool; the prefill
+    # orchestrator's endpoints carry "prefill"
+    pool: str = "decode"
 
     def serves(self, model: str) -> bool:
         return model == self.model or model in self.model_aliases

@@ -248,6 +248,10 @@ class EngineStats:
     kv_hit_rate: float = 0.0
     kv_hit_tokens: float = 0.0
     kv_foreign_hit_tokens: float = 0.0
+    # disagg role from /load ("kv_producer"/"kv_consumer"/"kv_both";
+    # "" = no KV tiering): surfaced in the stat log so a mis-wired
+    # pool (a producer in the decode set) is visible at a glance
+    kv_role: str = ""
     scraped_at: float = field(default_factory=time.time)
 
 
@@ -310,6 +314,7 @@ class EngineStatsScraper(LoadPoller):
             kv_hit_rate=load.kv_hit_rate,
             kv_hit_tokens=load.kv_hit_tokens,
             kv_foreign_hit_tokens=load.kv_foreign_hit_tokens,
+            kv_role=load.kv_role,
         )
 
     async def _fetch_fallback(self, url: str) -> Optional[EngineStats]:

@@ -58,6 +58,9 @@ class EngineLoad:
     kv_query_tokens: float = 0.0
     kv_hit_tokens: float = 0.0
     kv_foreign_hit_tokens: float = 0.0
+    # disagg role advertised in the kv_cache block ("kv_producer",
+    # "kv_consumer", "kv_both"; "" = no KV tiering / unknown)
+    kv_role: str = ""
     scraped_at: float = field(default_factory=time.time)
 
     @property
@@ -99,6 +102,7 @@ def parse_load_report(data: dict) -> EngineLoad:
         kv_query_tokens=knum("query_tokens"),
         kv_hit_tokens=knum("hit_tokens"),
         kv_foreign_hit_tokens=knum("foreign_hit_tokens"),
+        kv_role=str(kv.get("role") or ""),
     )
 
 

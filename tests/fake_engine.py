@@ -57,6 +57,17 @@ the uncached remainder (``--prefill-ms-per-char``), and publish served
 chunks back — a fleet of fakes behind one cache server reproduces the
 cross-replica prefix-reuse TTFT behavior (hit/miss counters on /load
 ``kv_cache`` and /metrics ``tpu:kvcache_*``) with no model compute.
+
+Disagg-role simulation (the disagg rig's lever): ``--kv-role producer``
+paces the FULL prompt and publishes each chunk the moment its prefill
+segment completes (the real connector's ``on_prefill_progress``);
+``--kv-role consumer`` prefetches before prefill (TTFT collapses by the
+cached-prefix fraction) and never publishes; the default ``both`` keeps
+the r11 kvshare behavior. ``--prefill-decode-interference B`` stretches
+decode ticks by ``(1 + B × concurrently-prefilling requests)`` — the
+head-of-line contention a real engine shows when long prompts
+chunk-prefill between decode steps, and exactly the term the P/D split
+removes from the decode pool.
 """
 
 import asyncio
@@ -89,13 +100,34 @@ class FakeEngine:
                  fault: Optional[dict] = None,
                  kv_remote_url: Optional[str] = None,
                  kv_chunk_chars: int = 64,
-                 prefill_s_per_char: float = 0.0):
+                 prefill_s_per_char: float = 0.0,
+                 kv_role: str = "kv_both",
+                 prefill_decode_interference: float = 0.0):
         self.model = model
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
         self.num_tokens = num_tokens
         self.kv_chunk_chars = max(1, kv_chunk_chars)
         self.prefill_s_per_char = prefill_s_per_char
+        # disagg role simulation (docs/disagg.md): a kv_producer paces
+        # the FULL prompt and publishes each chunk the moment its
+        # prefill segment completes (the real connector's
+        # on_prefill_progress); a kv_consumer prefetches before prefill
+        # (TTFT collapses by the cached-prefix fraction) and never
+        # publishes; kv_both (default) does both — the r11 kvshare
+        # behavior
+        self.kv_role = {"producer": "kv_producer",
+                        "consumer": "kv_consumer",
+                        "both": "kv_both"}.get(kv_role, kv_role)
+        if self.kv_role not in ("kv_producer", "kv_consumer", "kv_both"):
+            raise ValueError(f"unknown kv role {kv_role!r}")
+        # head-of-line interference: while n requests are in paced
+        # prefill on this engine, decode ticks stretch by
+        # (1 + interference * n) — the fused-step contention a real
+        # engine shows when long prompts chunk-prefill between decode
+        # steps. The disagg A/B measures exactly this term's removal.
+        self.prefill_decode_interference = prefill_decode_interference
+        self._n_prefilling = 0
         self._kv_store = None
         if kv_remote_url:
             from production_stack_tpu.kvcache.store import RemoteStore
@@ -106,6 +138,7 @@ class FakeEngine:
         self.kv_counters = {
             "queries": 0, "query_tokens": 0, "hit_tokens": 0,
             "foreign_hit_tokens": 0, "bytes_loaded": 0, "bytes_saved": 0,
+            "published_chunks": 0, "progress_published_chunks": 0,
         }
         self.gauges = {
             "vllm:num_requests_running": 0.0,
@@ -148,7 +181,20 @@ class FakeEngine:
 
     async def _tick(self):
         if self.tokens_per_s > 0:
-            await asyncio.sleep(1.0 / self.tokens_per_s)
+            stretch = 1.0 + (self.prefill_decode_interference
+                             * self._n_prefilling)
+            await asyncio.sleep(stretch / self.tokens_per_s)
+
+    async def _paced_sleep(self, seconds: float):
+        """A prefill-pacing sleep: counted so concurrent decode ticks
+        feel the interference."""
+        if seconds <= 0:
+            return
+        self._n_prefilling += 1
+        try:
+            await asyncio.sleep(seconds)
+        finally:
+            self._n_prefilling -= 1
 
     # -- shared-KV simulation -------------------------------------------
 
@@ -196,15 +242,25 @@ class FakeEngine:
                          (i + 1) * self.kv_chunk_chars]
             if self._kv_store.put(d, chunk):
                 self.kv_counters["bytes_saved"] += len(chunk)
+                self.kv_counters["published_chunks"] += 1
                 self._kv_published.add(d)
 
     async def _kv_prefill_delay(self, text: str):
-        """Tier lookup + TTFT pacing by the UNCACHED prefix; returns the
-        digests so the handler can publish after serving."""
+        """Tier lookup + TTFT pacing by the UNCACHED prefix (consumer
+        path) or full-prompt pacing with chunk-by-chunk progressive
+        publish (producer path); returns the digests so the handler can
+        publish after serving."""
         digests = self._kv_digests(text)
         n = len(text)
         self.kv_counters["queries"] += 1
         self.kv_counters["query_tokens"] += n
+        if self.kv_role == "kv_producer":
+            # producer: no prefetch — pace the FULL prompt, publishing
+            # each chunk the moment its prefill segment completes, so a
+            # consumer that starts mid-way already finds the leading
+            # chunks in the tier (on_prefill_progress behavior)
+            await self._kv_produce_progressively(digests, text)
+            return digests
         hits = foreign = 0
         if digests:
             hits, foreign, loaded = await asyncio.to_thread(
@@ -220,8 +276,32 @@ class FakeEngine:
             hit_chars = 0
         uncached = n - hit_chars
         if self.prefill_s_per_char > 0 and uncached > 0:
-            await asyncio.sleep(self.prefill_s_per_char * uncached)
+            await self._paced_sleep(self.prefill_s_per_char * uncached)
         return digests
+
+    async def _kv_produce_progressively(self, digests, text: str):
+        """Producer prefill: per-chunk pacing, each full chunk published
+        right after its segment (write in a worker thread so pacing
+        stays honest under a slow cache server)."""
+        data = text.encode("utf-8", "ignore")
+        per_chunk_s = self.prefill_s_per_char * self.kv_chunk_chars
+        covered = 0
+        for i, d in enumerate(digests):
+            await self._paced_sleep(per_chunk_s)
+            covered = (i + 1) * self.kv_chunk_chars
+            if d in self._kv_published:
+                continue
+            chunk = data[i * self.kv_chunk_chars:
+                         (i + 1) * self.kv_chunk_chars]
+            ok = await asyncio.to_thread(self._kv_store.put, d, chunk)
+            if ok:
+                self.kv_counters["bytes_saved"] += len(chunk)
+                self.kv_counters["published_chunks"] += 1
+                self.kv_counters["progress_published_chunks"] += 1
+                self._kv_published.add(d)
+        tail = len(text) - covered
+        if tail > 0:
+            await self._paced_sleep(self.prefill_s_per_char * tail)
 
     def _kv_publish(self, prompt_text: str, reply: str) -> None:
         """Producer path: publish the full chunks of prompt + reply —
@@ -229,8 +309,9 @@ class FakeEngine:
         render it, so follow-up rounds hit on it too. Fire-and-forget
         (like the real connector's background writer thread): a slow or
         dead cache server must stall the publish, never the response
-        the client is timing."""
-        if self._kv_store is None or not prompt_text:
+        the client is timing. Pure consumers never publish."""
+        if self._kv_store is None or not prompt_text or \
+                self.kv_role == "kv_consumer":
             return
         pub_text = f"{prompt_text}\nassistant: {reply}"
         asyncio.get_running_loop().run_in_executor(
@@ -434,9 +515,10 @@ class FakeEngine:
                 await self._kv_prefill_delay(prompt_text)
             elif self.prefill_s_per_char > 0:
                 # no tier: the whole prompt "prefills" — the recompute
-                # baseline the kvshare rig compares against
-                await asyncio.sleep(self.prefill_s_per_char *
-                                    len(self._kv_prompt_text(body)))
+                # baseline the kvshare/disagg rigs compare against
+                # (paced, so it interferes with concurrent decode)
+                await self._paced_sleep(self.prefill_s_per_char *
+                                        len(self._kv_prompt_text(body)))
             rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
             reply = " ".join(f"tok{i}" for i in range(n))
             if body.get("stream"):
@@ -533,6 +615,7 @@ class FakeEngine:
             c = self.kv_counters
             report["kv_cache"] = {
                 **c,
+                "role": self.kv_role,
                 "hit_rate": round(c["hit_tokens"] / c["query_tokens"], 4)
                 if c["query_tokens"] else 0.0,
                 "remote_breaker_open": self._kv_store.breaker_open(),
@@ -548,11 +631,15 @@ class FakeEngine:
             # surface parity with the real engine's tpu:kvcache_* family
             for key in ("query_tokens", "hit_tokens",
                         "foreign_hit_tokens", "bytes_loaded",
-                        "bytes_saved"):
+                        "bytes_saved", "published_chunks",
+                        "progress_published_chunks"):
                 name = f"tpu:kvcache_{key}_total"
                 lines.append(f"# TYPE {name.replace(':', '_')} counter")
                 lines.append(f'{name}{{model_name="{self.model}"}} '
                              f'{self.kv_counters[key]}')
+            lines.append("# TYPE tpu_engine_kv_role gauge")
+            lines.append(f'tpu:engine_kv_role{{model_name='
+                         f'"{self.model}",role="{self.kv_role}"}} 1.0')
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
 
@@ -588,6 +675,20 @@ def main(argv=None) -> None:
     p.add_argument("--prefill-ms-per-char", type=float, default=0.0,
                    help="TTFT pacing per UNCACHED prompt char (the "
                         "lever that makes tier hits measurable)")
+    p.add_argument("--kv-role", default="both",
+                   choices=["producer", "consumer", "both",
+                            "kv_producer", "kv_consumer", "kv_both"],
+                   help="disagg role of the KV simulation: a producer "
+                        "paces the full prompt and publishes each "
+                        "chunk mid-prefill; a consumer prefetches "
+                        "before prefill and never publishes; both "
+                        "(default) is the r11 kvshare behavior")
+    p.add_argument("--prefill-decode-interference", type=float,
+                   default=0.0,
+                   help="decode ticks stretch by (1 + this * "
+                        "concurrently-prefilling requests) — the "
+                        "head-of-line contention the disagg split "
+                        "removes from the decode pool")
     args = p.parse_args(argv)
     fault = None
     if args.fault:
@@ -598,7 +699,10 @@ def main(argv=None) -> None:
                      num_tokens=args.num_tokens, fault=fault,
                      kv_remote_url=args.kv_remote_url,
                      kv_chunk_chars=args.kv_chunk_chars,
-                     prefill_s_per_char=args.prefill_ms_per_char / 1e3)
+                     prefill_s_per_char=args.prefill_ms_per_char / 1e3,
+                     kv_role=args.kv_role,
+                     prefill_decode_interference=args.
+                     prefill_decode_interference)
     web.run_app(eng.build_app(), host=args.host, port=args.port,
                 print=None)
 

@@ -1,7 +1,9 @@
-"""Disaggregated prefill tests: orchestrator units + a full in-process
-stack — router -> prefill (kv_producer) engine -> shared disk tier ->
-decode (kv_consumer) engine (green-field feature; the reference only
-roadmaps disagg prefill, README.md:56)."""
+"""Disaggregated prefill tests: orchestrator units (rotation, breaker,
+pool swap, fallback accounting), NetKV-style decode-selection scoring
+units, the proxy's two-stage path over fake engines, and a full
+in-process stack — router -> prefill (kv_producer) engine -> shared
+disk tier -> decode (kv_consumer) engine (green-field feature; the
+reference only roadmaps disagg prefill, README.md:56)."""
 
 import asyncio
 
@@ -13,7 +15,8 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.server import build_app as build_engine_app
 from production_stack_tpu.router.app import build_app as build_router_app
 from production_stack_tpu.router.app import parse_args
-from production_stack_tpu.router.disagg import DisaggPrefillOrchestrator
+from production_stack_tpu.router.disagg import (DecodeSelector,
+                                                DisaggPrefillOrchestrator)
 
 
 # ---------------------------------------------------------------- units
@@ -254,3 +257,441 @@ def test_progressive_kv_publish_during_prefill(tmp_path):
     while sid not in done:
         done.update(o.seq_id for o in eng.step() if o.finished)
     eng.connector.flush()
+
+
+# ------------------------------------------------- pool swap + fallbacks
+
+def test_set_pool_preserves_breaker_and_rotation_state():
+    """Dynamic-config fleet swaps must not amnesty a sick prefill
+    backend or reset a rotation mid-cycle (the r11 prefix-ring bug
+    class); departed members' state is dropped."""
+    orch = DisaggPrefillOrchestrator(
+        ["http://a:1", "http://b:1"], ["m", "m"],
+        breaker_threshold=3, breaker_cooldown_s=60.0)
+    orch._record("http://a:1", False)
+    orch._record("http://a:1", False)
+    assert orch._consecutive_failures["http://a:1"] == 2
+    orch._open_until["http://b:1"] = orch._now() + 60.0   # b's circuit open
+    # swap keeps a and b, adds c: state survives
+    orch.set_pool(["http://a:1", "http://b:1", "http://c:1"],
+                  ["m", "m", "m"])
+    assert orch._consecutive_failures["http://a:1"] == 2
+    assert {orch.pick("m") for _ in range(6)} == {"http://a:1",
+                                                  "http://c:1"}
+    # swap drops a: its failure streak goes with it
+    orch.set_pool(["http://b:1", "http://c:1"], ["m", "m"])
+    assert "http://a:1" not in orch._consecutive_failures
+    assert orch._open_until.get("http://b:1", 0) > 0   # b still open
+    # mismatched swap rejected, pool unchanged
+    with pytest.raises(ValueError):
+        orch.set_pool(["http://x:1"], ["m", "m"])
+    assert [ep.url for ep in orch.endpoints] == ["http://b:1",
+                                                 "http://c:1"]
+
+
+def test_fallback_reasons_counted():
+    """Prefill failures must not vanish: every degradation path maps to
+    one tpu:router_disagg_fallbacks_total{reason} increment."""
+    orch = DisaggPrefillOrchestrator(["http://a:1"], ["m"])
+    assert orch.pick("other-model") is None
+    assert orch.fallbacks["no_pool"] == 1
+    orch._open_until["http://a:1"] = orch._now() + 60.0
+    assert orch.pick("m") is None
+    assert orch.fallbacks["breaker_open"] == 1
+
+
+def test_prefill_shed_is_fallback_not_breaker_signal():
+    """Prefill-queue pressure must not shed decode-bound traffic: a
+    prefill 429/503+Retry-After degrades to aggregated serving (client
+    sees 200 via decode) and NEVER feeds the prefill breaker."""
+    from tests.fake_engine import FakeEngine
+
+    async def body():
+        decode = FakeEngine(model="fake-model")
+        # overload arg 0: a zero-capacity engine that sheds everything
+        prefill = FakeEngine(model="fake-model",
+                             fault={"mode": "overload", "arg": 0})
+        decode_srv = TestServer(decode.build_app())
+        prefill_srv = TestServer(prefill.build_app())
+        await decode_srv.start_server()
+        await prefill_srv.start_server()
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"http://127.0.0.1:{decode_srv.port}",
+            "--static-models", "fake-model",
+            "--prefill-backends", f"http://127.0.0.1:{prefill_srv.port}",
+            "--prefill-models", "fake-model"])
+        router = build_router_app(args)
+        async with TestClient(TestServer(router)) as client:
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "fake-model", "max_tokens": 4,
+                    "messages": [{"role": "user", "content": "hi " * 40}]})
+                assert r.status == 200      # decode proceeded regardless
+            orch = router["state"]["disagg"]
+            assert orch.fallbacks["shed"] == 3
+            assert orch.breaker_opens == 0               # shed != sick
+            assert orch.pool_snapshot()["open_breakers"] == []
+            assert prefill.faults_served == 3
+        await decode_srv.close()
+        await prefill_srv.close()
+    asyncio.run(body())
+
+
+def test_min_prompt_chars_gates_prefill_stage():
+    orch = DisaggPrefillOrchestrator(["http://a:1"], ["m"],
+                                     min_prompt_chars=100)
+    short = {"model": "m",
+             "messages": [{"role": "user", "content": "hi"}]}
+    long = {"model": "m",
+            "messages": [{"role": "user", "content": "x" * 200}]}
+    # JSON framing must not count: 6 tiny turns carry ~240 chars of
+    # role/key scaffolding but only 24 chars of CONTENT
+    scaffolded = {"model": "m",
+                  "messages": [{"role": "user", "content": "abcd"}] * 6}
+    assert not orch.should_run("/v1/chat/completions", short)
+    assert not orch.should_run("/v1/chat/completions", scaffolded)
+    assert orch.should_run("/v1/chat/completions", long)
+    assert not orch.should_run("/v1/embeddings", long)
+    # a model the pool was never configured for is inert, not a
+    # fallback: a healthy multi-model deployment must not read as
+    # permanently degrading on tpu:router_disagg_fallbacks_total
+    other = {**long, "model": "other-model"}
+    assert not orch.should_run("/v1/chat/completions", other)
+    assert orch.fallbacks["no_pool"] == 0
+
+
+# ------------------------------------------------ decode-selection units
+
+def _sel_body(chars=16):
+    return {"prompt": "abcdefghijklmnopqrstuvwxyz0123456789"[:chars]}
+
+
+def test_selector_cold_prefix_abstains():
+    """No locality signal -> the routing policy (hash affinity)
+    decides, so repeated cold prefixes still converge onto one
+    replica."""
+    sel = DecodeSelector(chunk_chars=4)
+    assert sel.select(_sel_body(), ["http://a", "http://b"], {}, {}) \
+        is None
+    assert sel.abstains == 1
+    # all-remote with equal load is still signal-free
+    sel.on_prefill_dispatched(sel.digests(_sel_body()))
+    assert sel.select(_sel_body(), ["http://a", "http://b"], {}, {}) \
+        is None
+
+
+def test_selector_locality_beats_remote():
+    """A decode engine holding the chunks locally costs 0 transfer; a
+    cold one would pull every chunk from the remote tier."""
+    sel = DecodeSelector(chunk_chars=4)
+    sel.on_prefill_dispatched(sel.digests(_sel_body()))
+    sel.on_decode_routed(sel.digests(_sel_body()), "http://a")
+    assert sel.select(_sel_body(), ["http://a", "http://b"], {}, {}) \
+        == "http://a"
+    assert sel.cost_routes == 1
+
+
+def test_selector_transfer_cost_vs_load_tradeoff():
+    """The NetKV point: transfer bytes are weighed AGAINST load, not
+    locality-always-wins. A warm-but-saturated engine loses to a
+    cold-but-idle one when the load weight dominates, and wins when
+    the transfer weight dominates."""
+    from production_stack_tpu.router.stats import (EngineStats,
+                                                   RequestStats)
+    rs = {"http://warm": RequestStats(in_flight=10),
+          "http://cold": RequestStats(in_flight=0)}
+    es = {"http://warm": EngineStats(capacity=4),
+          "http://cold": EngineStats(capacity=4)}
+    urls = ["http://cold", "http://warm"]
+
+    def make(load_weight):
+        sel = DecodeSelector(chunk_chars=4, load_weight=load_weight)
+        sel.on_prefill_dispatched(sel.digests(_sel_body()))
+        sel.on_decode_routed(sel.digests(_sel_body()), "http://warm")
+        return sel
+
+    assert make(load_weight=5.0).select(_sel_body(), urls, rs, es) \
+        == "http://cold"
+    assert make(load_weight=0.1).select(_sel_body(), urls, rs, es) \
+        == "http://warm"
+
+
+def test_selector_deeper_locality_wins_tiebreak():
+    """Both candidates warm, one holds a deeper leading run: fewer
+    expected transfer bytes wins."""
+    sel = DecodeSelector(chunk_chars=4)
+    digests = sel.digests(_sel_body(16))          # 4 chunks
+    sel.on_prefill_dispatched(digests)
+    sel.on_decode_routed(digests, "http://deep")
+    sel.on_decode_routed(digests[:1], "http://shallow")
+    assert sel.select(_sel_body(16),
+                      ["http://deep", "http://shallow"], {}, {}) \
+        == "http://deep"
+
+
+def test_selector_recompute_costs_more_than_remote():
+    """An unpublished chunk breaks the consumer's tier walk: everything
+    after it recomputes. A fully-published prompt must therefore score
+    better than an unpublished one on a cold candidate pair vs a
+    half-local one."""
+    sel = DecodeSelector(chunk_chars=4, remote_fetch_cost=1.0,
+                         recompute_cost=2.0)
+    digests = sel.digests(_sel_body(16))
+    # nothing published: walk breaks at chunk 0 -> full recompute
+    assert sel.transfer_cost(digests, "http://x") == 4 * 4 * 2.0
+    sel.on_prefill_dispatched(digests)
+    assert sel.transfer_cost(digests, "http://x") == 4 * 4 * 1.0
+    sel.on_decode_routed(digests[:2], "http://x")
+    assert sel.transfer_cost(digests, "http://x") == 2 * 4 * 1.0
+
+
+def test_selector_evict_except_drops_departed_engines():
+    sel = DecodeSelector(chunk_chars=4)
+    digests = sel.digests(_sel_body())
+    sel.on_prefill_dispatched(digests)
+    sel.on_decode_routed(digests, "http://gone")
+    sel.evict_except(["http://alive"])
+    # the departed engine's locality evidence is gone: costs equalize
+    # and the selector abstains instead of routing to a dead URL
+    assert sel.select(_sel_body(), ["http://alive", "http://other"],
+                      {}, {}) is None
+    assert sel._seen_urls == set()
+
+
+def test_selector_on_decode_failed_uncredits():
+    """A pick that sheds/dies pre-stream never pulled the KV: its
+    route-time credit must come back out or its low in-flight keeps
+    winning the load tiebreak at phantom-zero transfer cost."""
+    sel = DecodeSelector(chunk_chars=4)
+    digests = sel.digests(_sel_body())
+    sel.on_prefill_dispatched(digests)
+    sel.on_decode_routed(digests, "http://shedder")
+    sel.on_decode_failed(digests, "http://shedder")
+    # all evidence gone -> costs equalize -> abstain (not a route back
+    # to the shedder)
+    assert sel.select(_sel_body(), ["http://shedder", "http://other"],
+                      {}, {}) is None
+    # un-crediting one URL leaves another's evidence alone
+    sel.on_decode_routed(digests, "http://good")
+    sel.on_decode_routed(digests[:1], "http://shedder")
+    sel.on_decode_failed(digests, "http://shedder")
+    assert sel.select(_sel_body(), ["http://shedder", "http://good"],
+                      {}, {}) == "http://good"
+
+
+def test_selector_evict_except_noops_when_nobody_departed():
+    """evict_except runs on every /metrics scrape: the common case
+    (fleet unchanged) must skip the full-ring scan."""
+    sel = DecodeSelector(chunk_chars=4)
+    sel.on_decode_routed([b"d1"], "http://alive")
+    # plant evidence the scan WOULD remove; the fast path must not
+    sel._chunks[b"d1"].append("http://stale")
+    sel.evict_except(["http://alive"])
+    assert sel._chunks[b"d1"] == ["http://alive", "http://stale"]
+
+
+def test_metrics_scrape_evicts_departed_decode_locality():
+    """Discovery-driven decode churn (k8s) never passes through a
+    dynamic-config apply, so the /metrics scrape is where a departed
+    decode URL must lose its locality evidence — a later scale-up
+    reusing the URL starts a cold process the ring would otherwise
+    score at zero transfer cost. A breaker-open member (a crash the
+    data plane observed) counts as departed for the same reason: an
+    in-place restart comes back with empty tiers."""
+    async def body():
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", "http://127.0.0.1:1",
+            "--static-models", "fake-model",
+            "--prefill-backends", "http://127.0.0.1:2",
+            "--prefill-models", "fake-model"])
+        router = build_router_app(args)
+        sel = router["state"]["disagg"].selector
+        sel.on_decode_routed([b"d1"], "http://departed:1")
+        sel.on_decode_routed([b"d2"], "http://127.0.0.1:1")
+        async with TestClient(TestServer(router)) as client:
+            r = await client.get("/metrics")
+            assert r.status == 200
+            # departed URL evicted; the configured breaker-closed
+            # member keeps its evidence
+            assert list(sel._chunks) == [b"d2"]
+            # breaker opens on the configured member -> next scrape
+            # drops its evidence too
+            tracker = router["state"]["health"]
+            for _ in range(10):
+                tracker.record_failure("http://127.0.0.1:1", "connect")
+            r = await client.get("/metrics")
+            assert r.status == 200
+        assert not sel._chunks
+    asyncio.run(body())
+
+
+def test_proxy_uncredits_failed_decode_pick():
+    """e2e through the failover funnel: the pinned decode engine dies
+    (HTTP 500 pre-stream), the request fails over and succeeds — only
+    the engine that served it stays in the locality ring."""
+    from tests.fake_engine import FakeEngine
+
+    async def body():
+        d1, d2 = FakeEngine(model="fake-model"), \
+            FakeEngine(model="fake-model")
+        prefill = FakeEngine(model="fake-model")
+        srvs = [TestServer(e.build_app()) for e in (d1, d2, prefill)]
+        for s in srvs:
+            await s.start_server()
+        urls = [f"http://127.0.0.1:{s.port}" for s in srvs]
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends", f"{urls[0]},{urls[1]}",
+            "--static-models", "fake-model,fake-model",
+            "--routing-logic", "roundrobin",
+            "--prefill-backends", urls[2],
+            "--prefill-models", "fake-model",
+            "--disagg-chunk-chars", "32"])
+        router = build_router_app(args)
+        sel = router["state"]["disagg"].selector
+        req = {"model": "fake-model", "max_tokens": 4,
+               "messages": [{"role": "user", "content": "long " * 64}]}
+        async with TestClient(TestServer(router)) as client:
+            r = await client.post("/v1/chat/completions", json=req)
+            assert r.status == 200
+            pinned, other = (d1, d2) if d1.requests_seen else (d2, d1)
+            pinned_url, other_url = (urls[0], urls[1]) \
+                if pinned is d1 else (urls[1], urls[0])
+            pinned.fault = {"mode": "error"}
+            r = await client.post("/v1/chat/completions", json=req)
+            assert r.status == 200            # failed over, not relayed
+            assert len(other.requests_seen) == 1
+        holders = {u for us in sel._chunks.values() for u in us}
+        assert pinned_url not in holders      # un-credited on the 500
+        assert other_url in holders           # the engine that served
+        for s in srvs:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_proxy_routes_decode_via_selector():
+    """Two decode fakes behind roundrobin: without the selector the
+    second identical long prompt would alternate engines; with it the
+    locality ring pins both to the first pick."""
+    from tests.fake_engine import FakeEngine
+
+    async def body():
+        d1, d2 = FakeEngine(model="fake-model"), \
+            FakeEngine(model="fake-model")
+        prefill = FakeEngine(model="fake-model")
+        srvs = [TestServer(e.build_app()) for e in (d1, d2, prefill)]
+        for s in srvs:
+            await s.start_server()
+        args = parse_args([
+            "--service-discovery", "static",
+            "--static-backends",
+            f"http://127.0.0.1:{srvs[0].port},"
+            f"http://127.0.0.1:{srvs[1].port}",
+            "--static-models", "fake-model,fake-model",
+            "--routing-logic", "roundrobin",
+            "--prefill-backends", f"http://127.0.0.1:{srvs[2].port}",
+            "--prefill-models", "fake-model",
+            "--disagg-chunk-chars", "32"])
+        router = build_router_app(args)
+        req = {"model": "fake-model", "max_tokens": 4,
+               "messages": [{"role": "user", "content": "long " * 64}]}
+        async with TestClient(TestServer(router)) as client:
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions", json=req)
+                assert r.status == 200
+        decode_counts = [len([x for x in e.requests_seen]) for e in
+                         (d1, d2)]
+        # all three decode passes landed on ONE engine (selector
+        # locality), not alternating 2/1
+        assert sorted(decode_counts) == [0, 3], decode_counts
+        assert len(prefill.requests_seen) == 3      # prefill each time
+        for s in srvs:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_dynamic_config_prefill_pool_lifecycle():
+    """Dynamic config swaps the pool in place (state survives), absent
+    keys leave it alone, an explicit [] disables it, and a late
+    non-empty config creates it."""
+    from production_stack_tpu.router.dynamic_config import (
+        DynamicConfigWatcher, DynamicRouterConfig)
+    from production_stack_tpu.router.metrics import RouterMetrics
+
+    async def body():
+        orch = DisaggPrefillOrchestrator(["http://p1:1"], ["m"])
+        orch._consecutive_failures["http://p1:1"] = 2
+        state = {"disagg": orch, "metrics": RouterMetrics(),
+                 "disagg_kwargs": {"headstart_s": 0.5}}
+        watcher = DynamicConfigWatcher(state, "/nonexistent")
+        # absent keys: pool untouched
+        await watcher._apply(DynamicRouterConfig())
+        assert state["disagg"] is orch
+        # swap: same object, breaker state survives
+        await watcher._apply(DynamicRouterConfig(
+            prefill_backends=["http://p1:1", "http://p2:1"],
+            prefill_models=["m", "m"]))
+        assert state["disagg"] is orch
+        assert orch._consecutive_failures["http://p1:1"] == 2
+        assert len(orch.endpoints) == 2
+        # explicit []: disabled
+        await watcher._apply(DynamicRouterConfig(prefill_backends=[]))
+        assert "disagg" not in state
+        # late creation picks up the CLI-configured knobs
+        await watcher._apply(DynamicRouterConfig(
+            prefill_backends=["http://p3:1"], prefill_models=["m"]))
+        assert state["disagg"].headstart_s == 0.5
+        assert [ep.url for ep in state["disagg"].endpoints] == \
+            ["http://p3:1"]
+        # a mismatched pool (actuator extra_config typo) must not kill
+        # the watcher NOR half-apply: logged, pool left unchanged
+        await watcher._apply(DynamicRouterConfig(
+            prefill_backends=["http://p4:1", "http://p5:1"],
+            prefill_models=["m"]))
+        assert [ep.url for ep in state["disagg"].endpoints] == \
+            ["http://p3:1"]
+    asyncio.run(body())
+
+
+def test_disable_enable_cycle_gets_fresh_selector():
+    """disagg_kwargs carries a selector FACTORY: a dynamic-config
+    disable->enable cycle must not inherit the previous incarnation's
+    locality ring (it may name dead engines)."""
+    from production_stack_tpu.router.disagg import (build_orchestrator,
+                                                    orchestrator_kwargs)
+    import argparse
+    kwargs = orchestrator_kwargs(argparse.Namespace())
+    o1 = build_orchestrator(["http://p:1"], ["m"], kwargs)
+    o1.selector.on_decode_routed([b"d1"], "http://dead:1")
+    o2 = build_orchestrator(["http://p:1"], ["m"], kwargs)
+    assert o2.selector is not None and o2.selector is not o1.selector
+    assert not o2.selector._chunks          # fresh, no inherited state
+
+
+def test_disagg_metrics_exported():
+    """tpu:router_disagg_* counters (incl. the per-reason fallback
+    family) survive an orchestrator swap via delta-sync."""
+    from production_stack_tpu.router.metrics import RouterMetrics
+    metrics = RouterMetrics()
+    orch = DisaggPrefillOrchestrator(["http://a:1"], ["m"])
+    orch.prefills = 5
+    orch.fallbacks["shed"] = 2
+    metrics.refresh_disagg(orch)
+    text = metrics.render().decode()
+    assert "tpu:router_disagg_prefills_total 5.0" in text
+    assert 'tpu:router_disagg_fallbacks_total{reason="shed"} 2.0' in text
+    # swapped orchestrator restarts its totals: counters must not reset
+    orch2 = DisaggPrefillOrchestrator(["http://b:1"], ["m"])
+    orch2.prefills = 1
+    metrics.refresh_disagg(orch2)
+    assert "tpu:router_disagg_prefills_total 6.0" in \
+        metrics.render().decode()
+
+
+def test_endpoint_info_pool_labels():
+    orch = DisaggPrefillOrchestrator(["http://a:1"], ["m"])
+    assert orch.endpoints[0].pool == "prefill"
+    from production_stack_tpu.router.service_discovery import EndpointInfo
+    assert EndpointInfo(url="http://d", model="m").pool == "decode"
