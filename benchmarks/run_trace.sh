@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Request tracing closed loop: the committed TRACE_r13.json recipe —
+# disagg split topology (cache server + producer pool + consumer pool
+# + real router with --prefill-backends) under a mixed chat/rag storm,
+# client x-trace-ids joined against every process's /debug/traces
+# ring, plus the tracing-on re-run of the r7 router-overhead A/B.
+#
+#   ./benchmarks/run_trace.sh                    # disagg split (fakes)
+#   DISAGG=0 ./benchmarks/run_trace.sh           # aggregated topology
+#   ENGINE=debug-tiny DISAGG=0 ./benchmarks/run_trace.sh  # real engines
+#
+# Exit 1 if the tracing contract fails: <95% of sampled requests with
+# a complete router->engine span chain (router->prefill->decode for
+# the gated class when split), unattributed time >=10% at p50, any
+# client-visible error, a producer pool whose rings hold no
+# router-issued trace ids, or (with the guard on) a tracing-on
+# overhead ratio above the 2.5x r7 band.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+DISAGG="${DISAGG:-1}"
+OUT="${OUT:-TRACE_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ "$DISAGG" = "1" ]; then
+  EXTRA+=(--disagg)
+fi
+if [ "${GUARD:-1}" = "1" ]; then
+  EXTRA+=(--overhead-guard)
+fi
+
+python -m production_stack_tpu.loadgen trace \
+  --engine "$ENGINE" \
+  --chat-users "${CHAT_USERS:-8}" --rag-users "${RAG_USERS:-4}" \
+  --duration "${DURATION:-30s}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "trace record: $OUT"

@@ -89,6 +89,7 @@ MODULES = {
     "Shared": [
         "production_stack_tpu.protocol",
         "production_stack_tpu.signals",
+        "production_stack_tpu.tracing",
         "production_stack_tpu.utils",
         "production_stack_tpu.version",
     ],

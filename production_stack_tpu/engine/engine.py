@@ -43,6 +43,11 @@ class StepOutput:
     logprob: Optional[float] = None
     # top_logprobs alternatives [(token_id, logprob)] when requested
     top_alts: Optional[list] = None
+    # terminal outputs only: the sequence's phase timeline (monotonic
+    # stamps + KV prefetch cost) so the server can render engine-side
+    # trace spans without reaching into scheduler internals
+    # (tracing.py; docs/observability.md "Tracing")
+    timing: Optional[dict] = None
 
 
 # finished sequences kept for post-hoc inspection (bounded; see _remember)
@@ -179,6 +184,10 @@ class LLMEngine:
             if tcfg.enabled:
                 self.connector = KVConnector(self.runner, self.model_cfg,
                                              engine_cfg, tcfg)
+                # kv_prefetch / kv_publish durations land in the same
+                # phase family as queue_wait/prefill/decode
+                self.connector.phase_recorder = \
+                    self.metrics.engine_phases
         # rolling KV: models whose EVERY layer is windowed (Mistral
         # v0.1-style) never attend positions behind the window again, so
         # their blocks are freed as generation advances — live-context
@@ -357,6 +366,9 @@ class LLMEngine:
             # thread — never on the engine loop
             seq.kv_prefetch = self.connector.prefetch(
                 seq.prompt_tokens, salt=self._adapter_salt(seq.adapter_id))
+            if seq.kv_prefetch is not None:
+                seq.kv_prefetch_wait_s = seq.kv_prefetch.wait_s
+                seq.kv_cached_tokens = seq.kv_prefetch.cached_tokens
         with self._lock:
             # bounded admission: shed at submit rather than queue
             # forever. Admission happens only at step time, so a fresh
@@ -428,8 +440,16 @@ class LLMEngine:
                 logger.info("dropped %s while waiting (%s): queued "
                             "%.0fms", seq.seq_id, seq.finish_reason,
                             1e3 * (time.monotonic() - seq.arrival_time))
-                outputs.append(StepOutput(seq.seq_id, None, "", True,
-                                          seq.finish_reason))
+                drop_now = time.monotonic()
+                # a WAITING-dropped request's whole remaining life IS
+                # queue wait — close its open interval so shed storms
+                # show up in the phase histograms, not just counters
+                seq.queue_wait_s += drop_now - seq.enqueued_time
+                self.metrics.engine_phases.observe(
+                    "queue_wait", seq.queue_wait_s)
+                outputs.append(StepOutput(
+                    seq.seq_id, None, "", True, seq.finish_reason,
+                    timing=self._seq_timing(seq, drop_now)))
             works, decode_seqs = self.scheduler.schedule()
             if works:
                 # drain the in-flight window first: it was dispatched
@@ -465,7 +485,15 @@ class LLMEngine:
                 # mid-processing mirrors lag the device (uploading them
                 # would rewind live rows and duplicate tokens).
                 self._top_up_pipeline()
-                outputs.extend(self._process_window(self._sync_inflight()))
+                t_win = time.monotonic()
+                synced = self._sync_inflight()
+                # per-window host-visible decode latency: the blocking
+                # device sync for one fused window — the batching-level
+                # signal (how long a window takes end to end) the
+                # roofline work reads next to the per-request phases
+                self.metrics.engine_phases.observe(
+                    "decode_window", time.monotonic() - t_win)
+                outputs.extend(self._process_window(synced))
                 if not self._inflight:
                     decode_seqs = list(self.scheduler.running.values())
                     if decode_seqs:
@@ -895,6 +923,23 @@ class LLMEngine:
                 break
         return outputs
 
+    @staticmethod
+    def _seq_timing(seq: Sequence, end: float) -> dict:
+        """Terminal StepOutput timing payload: the monotonic phase
+        stamps the SERVER turns into engine-side trace spans (it holds
+        the HTTP context — traceparent — that this layer must not)."""
+        return {
+            "arrival": seq.arrival_time,
+            "admit": seq.admit_time,
+            "first_token": seq.first_token_time,
+            "queue_wait_s": seq.queue_wait_s,
+            "end": end,
+            "prompt_tokens": len(seq.prompt_tokens),
+            "output_tokens": len(seq.output_tokens),
+            "kv_prefetch_wait_s": seq.kv_prefetch_wait_s,
+            "kv_cached_tokens": seq.kv_cached_tokens,
+        }
+
     def _accept_token(self, seq: Sequence, token: int,
                       logprob: Optional[float] = None,
                       top_alts=None) -> List[StepOutput]:
@@ -947,15 +992,35 @@ class LLMEngine:
             self.scheduler.finish(seq, reason)
             self._park_slot(slot)
             self._remember(seq)
-            dur = time.monotonic() - seq.arrival_time
+            now = time.monotonic()
+            dur = now - seq.arrival_time
             self.metrics.e2e_latency.observe(dur)
             # service-time EWMA feeding the queue-delay estimate the
             # load report / Retry-After are built on (includes queueing
             # — deliberately: it is what the next queued client will
             # actually wait through)
             self._service_ewma = 0.8 * self._service_ewma + 0.2 * dur
+            # phase attribution: where this request's engine wall time
+            # went (tracing.py; tpu:engine_phase_seconds). Plain-int
+            # bucket increments — no prometheus objects on the loop.
+            # queue_wait is the CUMULATIVE wait across admissions
+            # (scheduler stamps it), so a preempted-and-requeued
+            # sequence never counts an interval twice; a first token
+            # emitted BEFORE the last admission (preemption after
+            # first token) zeroes prefill and folds the re-prefill
+            # into decode — the phases stay disjoint and sum to at
+            # most the request's wall time.
+            phases = self.metrics.engine_phases
+            admit = seq.admit_time if seq.admit_time is not None \
+                else seq.arrival_time
+            first = seq.first_token_time if seq.first_token_time \
+                is not None else now
+            phases.observe("queue_wait", seq.queue_wait_s)
+            phases.observe("prefill", max(0.0, first - admit))
+            phases.observe("decode", max(0.0, now - max(first, admit)))
             return [StepOutput(seq.seq_id, token, text_delta, True, reason,
-                               logprob, top_alts)]
+                               logprob, top_alts,
+                               timing=self._seq_timing(seq, now))]
         self._sync_slot(seq)
         return [StepOutput(seq.seq_id, token, text_delta, False, None,
                            logprob, top_alts)]

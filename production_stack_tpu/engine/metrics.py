@@ -11,6 +11,9 @@ exported under `tpu:` (HBM KV usage) for the Grafana dashboard.
 from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
                                generate_latest)
 
+from production_stack_tpu.tracing import (PhaseHistogramCollector,
+                                          PhaseHistograms)
+
 # Engine metrics get their own registry so multiple in-process engines
 # (tests) don't collide in the global default registry.
 
@@ -151,6 +154,24 @@ class EngineMetrics:
         self._kv_tier_items = Gauge(
             "tpu:kvcache_tier_items", "KV tier chunk count",
             list(labels) + ["tier"], registry=self.registry)
+        # per-tier chunk-hit attribution (connector stats_report
+        # "tier_hits": which tier actually served prefetch hits — cpu
+        # promotion vs disk vs the remote DCN round trip)
+        self._kv_tier_hits = Counter(
+            "tpu:kvcache_tier_chunk_hits",
+            "Prefetch chunk hits by the tier that served them",
+            list(labels) + ["tier"], registry=self.registry)
+        # phase-latency attribution (tracing.py): where a request's
+        # engine-side wall time goes — queue_wait / prefill / decode
+        # per request, kv_prefetch / kv_publish per tier operation,
+        # decode_window per fused device window. Fed by plain-int
+        # bucket increments on the engine loop; rendered at scrape by
+        # the custom collector (the sync_kv idiom for histograms).
+        self.engine_phases = PhaseHistograms(("phase",))
+        self.registry.register(PhaseHistogramCollector(
+            "tpu:engine_phase_seconds",
+            "Engine-side request phase durations (docs/observability.md "
+            "'Tracing' phase glossary)", self.engine_phases))
         self._labels = labels
         self._kv_last: dict = {}
 
@@ -178,6 +199,13 @@ class EngineMetrics:
             if delta > 0:
                 getattr(self, attr).inc(delta)
             self._kv_last[src] = total
+        for tier, total in (report.get("tier_hits") or {}).items():
+            key = f"tier_hits:{tier}"
+            delta = total - self._kv_last.get(key, 0)
+            if delta > 0:
+                self._kv_tier_hits.labels(tier=tier,
+                                          **self._labels).inc(delta)
+            self._kv_last[key] = total
         self.kv_remote_breaker_open.set(
             1.0 if report.get("remote_breaker_open") else 0.0)
         role = report.get("role")

@@ -97,8 +97,22 @@ class Sequence:
     output_top: List[Optional[list]] = field(default_factory=list)
     num_prefilled: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
+    # phase attribution (tracing.py): queue time accumulates across
+    # admissions so a preempted-and-requeued sequence never
+    # double-counts wall time — enqueued_time stamps each entry into
+    # the waiting queue (creation + every preemption), schedule() folds
+    # the closed interval into queue_wait_s at slot assignment, and
+    # admit_time keeps the LAST admission stamp.
+    enqueued_time: float = 0.0          # set from arrival in __post_init__
+    queue_wait_s: float = 0.0
+    admit_time: Optional[float] = None
     first_token_time: Optional[float] = None
     finish_reason: Optional[str] = None
+    # KV-tier prefetch cost paid for this request at add time
+    # (kvcache/connector.py): wall seconds of the tier walk and the
+    # prompt tokens it served — the kv_prefetch trace span
+    kv_prefetch_wait_s: float = 0.0
+    kv_cached_tokens: int = 0
     # absolute monotonic deadline (from the client's
     # x-request-deadline-ms header, engine/server.py): a sequence whose
     # deadline expires while still WAITING is dropped by
@@ -123,6 +137,9 @@ class Sequence:
     output_text: str = ""       # stable decoded text, stop-truncated
     chars_emitted: int = 0      # prefix of output_text already delivered
     detok: object = None
+
+    def __post_init__(self):
+        self.enqueued_time = self.arrival_time
 
     @property
     def num_tokens(self) -> int:
@@ -280,6 +297,8 @@ class Scheduler:
             self.waiting.popleft()
             seq.slot = self.free_slots.pop()
             seq.status = SeqStatus.PREFILLING
+            seq.admit_time = time.monotonic()
+            seq.queue_wait_s += seq.admit_time - seq.enqueued_time
             self._prefilling[seq.slot] = seq
             if self.on_admit is not None:
                 self.on_admit(seq)
@@ -315,6 +334,7 @@ class Scheduler:
         seq.slot = -1
         seq.status = SeqStatus.WAITING
         seq.num_prefilled = 0
+        seq.enqueued_time = time.monotonic()   # new queue-wait interval
         self.waiting.appendleft(seq)
 
     def finish(self, seq: Sequence, reason: str) -> None:

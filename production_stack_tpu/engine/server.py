@@ -28,6 +28,8 @@ from production_stack_tpu.engine.config import EngineConfig
 from production_stack_tpu.engine.engine import (AdmissionRejected,
                                                 DeadlineExceeded)
 from production_stack_tpu.engine.scheduler import SamplingOptions
+from production_stack_tpu.tracing import (TraceRecorder,
+                                          debug_traces_handler)
 from production_stack_tpu.utils import (honor_platform_env, init_logger,
                                           set_ulimit)
 from production_stack_tpu.version import __version__
@@ -35,6 +37,11 @@ from production_stack_tpu.version import __version__
 logger = init_logger(__name__)
 
 ENGINE_KEY = web.AppKey("engine", AsyncLLMEngine)
+TRACER_KEY = web.AppKey("tracer", TraceRecorder)
+
+# paths whose requests get an engine-side trace (tracing.py): the
+# generation endpoints the router's span chain continues into
+TRACED_PATHS = frozenset({"/v1/chat/completions", "/v1/completions"})
 
 # relative per-request budget in milliseconds; the router injects its
 # own --request-timeout here when the client sent none (docs/router.md
@@ -44,6 +51,90 @@ DEADLINE_HEADER = "x-request-deadline-ms"
 # relays it without a breaker signal or failover (retrying a request
 # whose budget is spent helps nobody)
 DEADLINE_MARKER = "x-deadline-expired"
+
+
+def _stash_timing(request: web.Request, out) -> None:
+    """Capture a terminal StepOutput's phase timeline for the trace
+    middleware (one attribute write on the stream path; the LAST
+    finishing choice wins for n>1 requests)."""
+    if out.finished and out.timing is not None:
+        request["seq_timing"] = out.timing
+
+
+def _seal_engine_trace(tracer: TraceRecorder, trace, request: web.Request,
+                       status: str) -> None:
+    """Build the engine-side span set from what the handlers stashed:
+
+    - ``preprocess``: HTTP entry -> engine arrival (parse, chat
+      template, tokenize, guided compile, KV-tier prefetch) — tokenize
+      and kv_prefetch ride inside it as EVENT spans so the phase sum
+      never double-counts;
+    - ``queue_wait`` / ``prefill`` / ``decode``: from the terminal
+      StepOutput's timing stamps (engine._seq_timing);
+    - ``postprocess``: last engine output -> response done.
+
+    Requests that never produced a sequence (400s, sheds, deadline
+    504s) get a single ``preprocess`` phase covering their whole life.
+    """
+    now = time.monotonic()
+    timing = request.get("seq_timing")
+    tok_s = request.get("trace_tokenize_s")
+    if timing is not None:
+        arrival = timing["arrival"]
+        admit = timing["admit"]
+        end = timing["end"]
+        trace.add_phase("preprocess", trace.t0, arrival)
+        if admit is None:
+            # never admitted (WAITING-dropped: deadline / queue-delay
+            # shed): the whole engine-side life is queue wait — it must
+            # NOT render as prefill, or a shed storm's traces point the
+            # operator at the wrong phase
+            trace.add_phase("queue_wait", arrival, end)
+        else:
+            # queue_wait_s is cumulative across admissions (preemption
+            # re-queues); render it anchored at arrival so the span
+            # layout stays readable while the durations stay honest
+            qw = timing.get("queue_wait_s") or max(0.0, admit - arrival)
+            trace.add_span("queue_wait", arrival, qw, "phase")
+            first = timing["first_token"] if timing["first_token"] \
+                is not None else end
+            trace.add_phase("prefill", admit, max(admit, first))
+            trace.add_phase("decode", max(admit, first), end)
+        trace.add_phase("postprocess", end, now)
+        if timing.get("kv_prefetch_wait_s"):
+            trace.add_event(
+                "kv_prefetch", None, timing["kv_prefetch_wait_s"],
+                attrs={"cached_tokens": timing.get("kv_cached_tokens",
+                                                   0)})
+        trace.attrs["prompt_tokens"] = timing.get("prompt_tokens")
+        trace.attrs["output_tokens"] = timing.get("output_tokens")
+    else:
+        trace.add_phase("preprocess", trace.t0, now)
+    if tok_s:
+        trace.add_event("tokenize", None, tok_s)
+    tracer.finish(trace, status)
+
+
+def _trace_middleware(tracer: TraceRecorder):
+    @web.middleware
+    async def record_trace(request: web.Request, handler):
+        if request.path not in TRACED_PATHS:
+            return await handler(request)
+        trace = tracer.begin(request.headers.get("traceparent"),
+                             name=request.path)
+        request["trace"] = trace
+        try:
+            resp = await handler(request)
+        except BaseException:
+            _seal_engine_trace(tracer, trace, request, "exception")
+            raise
+        if not resp.prepared:
+            resp.headers["x-trace-id"] = trace.trace_id
+        status = request.get("trace_status") or (
+            "ok" if resp.status < 400 else f"http_{resp.status}")
+        _seal_engine_trace(tracer, trace, request, status)
+        return resp
+    return record_trace
 
 
 def _error(status: int, message: str,
@@ -348,12 +439,16 @@ async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
     async def ensure_prepared() -> web.StreamResponse:
         nonlocal resp
         if resp is None:
-            resp = web.StreamResponse(
-                status=200,
-                headers={"Content-Type": "text/event-stream",
-                         "Cache-Control": "no-cache",
-                         "X-Accel-Buffering": "no",
-                         **_load_headers(engine)})
+            headers = {"Content-Type": "text/event-stream",
+                       "Cache-Control": "no-cache",
+                       "X-Accel-Buffering": "no",
+                       **_load_headers(engine)}
+            trace = request.get("trace")
+            if trace is not None:
+                # streams take their trace id at prepare time (the
+                # middleware can no longer add headers then)
+                headers["x-trace-id"] = trace.trace_id
+            resp = web.StreamResponse(status=200, headers=headers)
             await resp.prepare(request)
         return resp
 
@@ -366,6 +461,7 @@ async def _sse_stream(request: web.Request, gen) -> web.StreamResponse:
         await resp.write_eof()
     except (ConnectionResetError, ConnectionError):
         # client went away mid-stream; generator cleanup aborts the request
+        request["trace_status"] = "client_disconnect"
         await gen.aclose()
         if resp is None:
             resp = web.Response(status=500)     # never reaches the client
@@ -517,9 +613,11 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         return _shed_error(engine)
 
     tok = engine.tokenizer
+    t_tok = time.monotonic()
     prompt = tok.apply_chat_template(
         [m.model_dump() for m in req.messages])
     prompt_ids = tok.encode(prompt)
+    request["trace_tokenize_s"] = time.monotonic() - t_tok
     if len(prompt_ids) >= engine.engine.cfg.max_model_len:
         return _error(400, f"prompt has {len(prompt_ids)} tokens, which "
                            f"exceeds max_model_len "
@@ -545,6 +643,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
             def chunk_for(i, out):
                 nonlocal num_tokens
+                _stash_timing(request, out)
                 if out.new_token is not None:
                     num_tokens += 1
                 lp_block = None
@@ -609,6 +708,7 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                 model=req.model or None, deadline=deadline)) as it:
             async for out in it:
                 _check_overload_finish(out)
+                _stash_timing(request, out)
                 parts.append(out.text_delta)
                 if out.new_token is not None:
                     tokens += 1
@@ -673,7 +773,9 @@ async def completions(request: web.Request) -> web.StreamResponse:
             and len(prompt) * req.n > 128):
         return _error(400, "len(prompt) * n must be <= 128")
     try:
+        t_tok = time.monotonic()
         prompts = _as_token_lists(engine, prompt)
+        request["trace_tokenize_s"] = time.monotonic() - t_tok
     except ValueError as e:
         return _error(400, str(e))
     if not prompts or any(not p for p in prompts):
@@ -709,6 +811,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
 
             def chunk_for(i, out):
                 nonlocal num_tokens
+                _stash_timing(request, out)
                 if out.new_token is not None:
                     num_tokens += 1
                 lp_block = None
@@ -768,6 +871,7 @@ async def completions(request: web.Request) -> web.StreamResponse:
                 deadline=deadline)) as it:
             async for out in it:
                 _check_overload_finish(out)
+                _stash_timing(request, out)
                 parts.append(out.text_delta)
                 if out.new_token is not None:
                     tokens += 1
@@ -1042,6 +1146,9 @@ async def detokenize(request: web.Request) -> web.Response:
 # helm/templates/deployment-vllm-multi.yaml:143-150 + probe blocks)
 AUTH_EXEMPT_PATHS = frozenset({"/health", "/metrics", "/version",
                                "/load"})
+# NOTE: /debug/traces is deliberately NOT exempt — unlike the probe
+# endpoints it carries per-request data (trace ids, timings, token
+# counts); readers on a secured deployment present the engine key
 
 
 def _auth_middleware(api_key: str):
@@ -1067,13 +1174,17 @@ def _auth_middleware(api_key: str):
 
 
 def build_app(engine: AsyncLLMEngine,
-              api_key: Optional[str] = None) -> web.Application:
+              api_key: Optional[str] = None,
+              trace_ring_entries: int = 2048,
+              trace_sample_rate: float = 1.0) -> web.Application:
     """api_key None reads ENGINE_API_KEY from the environment (the
     chart's secret delivery, helm/templates/deployment-engine.yaml);
     empty/unset disables enforcement."""
     import os
     if api_key is None:
         api_key = os.environ.get("ENGINE_API_KEY", "")
+    tracer = TraceRecorder("engine", ring_entries=trace_ring_entries,
+                           sample_rate=trace_sample_rate)
     middlewares = [_auth_middleware(api_key)] if api_key else []
     if middlewares:
         logger.info("API-key enforcement on: all endpoints require "
@@ -1090,11 +1201,15 @@ def build_app(engine: AsyncLLMEngine,
             for k, v in _load_headers(engine).items():
                 resp.headers[k] = v
         return resp
-    middlewares = [*middlewares, stamp_load_headers]
+    middlewares = [*middlewares, stamp_load_headers,
+                   _trace_middleware(tracer)]
 
     app = web.Application(client_max_size=32 * 1024 * 1024,
                           middlewares=middlewares)
     app[ENGINE_KEY] = engine
+    app[TRACER_KEY] = tracer
+    app.router.add_get("/debug/traces",
+                       debug_traces_handler(lambda: tracer))
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
@@ -1226,6 +1341,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--lora-targets", default="q,v",
                    help="comma-separated projections to adapt "
                         "(q,k,v,o,gate,up,down)")
+    p.add_argument("--trace-ring-entries", type=int, default=2048,
+                   help="completed request traces kept in memory "
+                        "(bounded ring served on GET /debug/traces)")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of DIRECT requests traced into the "
+                        "ring; an inbound traceparent's sampled flag "
+                        "(the router's decision) always wins")
     p.add_argument("--kv-transfer-config", default=None,
                    help="JSON dict enabling KV tiering, e.g. "
                         '\'{"kv_role": "kv_both", "local_cpu_gb": 4, '
@@ -1276,7 +1398,9 @@ def main(argv=None) -> None:
         engine.engine.runner.warmup()
 
     async def _serve():
-        app = build_app(engine)
+        app = build_app(engine,
+                        trace_ring_entries=args.trace_ring_entries,
+                        trace_sample_rate=args.trace_sample_rate)
         # cancel handlers when the peer disconnects (aiohttp >= 3.9
         # defaults this OFF): a request whose client has gone must
         # abort its engine-side generation even if it is still QUEUED —

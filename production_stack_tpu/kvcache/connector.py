@@ -91,6 +91,9 @@ class Prefetch:
     keys: List[bytes]
     chunks: List[Tuple[np.ndarray, np.ndarray]]   # per-chunk (k, v)
     cached_tokens: int                            # capped, == num_prefilled
+    # wall seconds the tier walk took (the kv_prefetch trace span —
+    # the request paid this before it could even queue)
+    wait_s: float = 0.0
 
 
 class KVConnector:
@@ -164,6 +167,14 @@ class KVConnector:
         self.rejected_chunks = 0    # size/checksum-invalid values
         self.prefetch_deadline_hits = 0
         self.dropped_saves = 0
+        # chunk hits by the tier that served them (cpu / disk / remote)
+        self.tier_hits: "dict[str, int]" = {}
+        # phase-latency sink (tracing.PhaseHistograms, ("phase",) keyed)
+        # — the owning engine attaches its metrics.engine_phases so
+        # kv_prefetch / kv_publish durations land next to the request
+        # phases; None (tests constructing a bare connector) records
+        # nothing
+        self.phase_recorder = None
 
     # -- consumer path --------------------------------------------------
 
@@ -190,12 +201,13 @@ class KVConnector:
         # hard budget on the whole walk: each chunk read is already
         # bounded by the store's own timeouts, but a *slow-not-dead*
         # tier must not stack N of those onto one request's TTFT
-        deadline = time.monotonic() + self.cfg.prefetch_timeout_s
+        t0 = time.monotonic()
+        deadline = t0 + self.cfg.prefetch_timeout_s
         for key in keys:
             if time.monotonic() >= deadline:
                 self.prefetch_deadline_hits += 1
                 break
-            val = self.store.get(key)
+            val, tier = self.store.get_with_tier(key)
             if val is None:
                 self.chunk_misses += 1
                 break
@@ -203,10 +215,15 @@ class KVConnector:
             if kv is None:
                 break
             self.chunk_hits += 1
+            if tier:
+                self.tier_hits[tier] = self.tier_hits.get(tier, 0) + 1
             self.bytes_loaded += len(val)
             foreign.append(key not in self._seen_keys)
             chunks.append(kv)
             hit_keys.append(key)
+        wait_s = time.monotonic() - t0
+        if self.phase_recorder is not None:
+            self.phase_recorder.observe("kv_prefetch", wait_s)
         if not chunks:
             return None
         cached = min(len(chunks) * self.chunk_size, n - 1)
@@ -215,7 +232,8 @@ class KVConnector:
             if is_foreign:
                 self.foreign_hit_tokens += max(
                     0, min(self.chunk_size, cached - i * self.chunk_size))
-        return Prefetch(keys=hit_keys, chunks=chunks, cached_tokens=cached)
+        return Prefetch(keys=hit_keys, chunks=chunks, cached_tokens=cached,
+                        wait_s=wait_s)
 
     def inject(self, prefetch: Prefetch, slot: int) -> None:
         """Dispatch cached chunks into the slot (engine loop; device work
@@ -302,6 +320,8 @@ class KVConnector:
             except queue.Empty:
                 continue
             self._inflight.set()
+            import time as _time
+            t0 = _time.monotonic()
             try:
                 for key, k_dev, v_dev, progress in work:
                     try:
@@ -317,6 +337,12 @@ class KVConnector:
                         logger.warning("KV save failed: %s", e)
             finally:
                 self._inflight.clear()
+                if self.phase_recorder is not None:
+                    # publish latency per write-through batch: D2H sync
+                    # + serialization + tier puts, on the writer thread
+                    # — the cost a slow tier charges the publish path
+                    self.phase_recorder.observe(
+                        "kv_publish", _time.monotonic() - t0)
 
     # -- serialization ---------------------------------------------------
 
@@ -418,6 +444,7 @@ class KVConnector:
             "rejected_chunks": self.rejected_chunks,
             "dropped_saves": self.dropped_saves,
             "prefetch_deadline_hits": self.prefetch_deadline_hits,
+            "tier_hits": dict(self.tier_hits),
             "remote_breaker_open": self.remote_breaker_open(),
             # remote occupancy lives on the cache server's own surface;
             # its local entry carries only breaker state (no bytes)

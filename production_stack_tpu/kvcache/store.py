@@ -36,6 +36,12 @@ class KVStore(ABC):
     @abstractmethod
     def get(self, key: bytes) -> Optional[bytes]: ...
 
+    def get_with_tier(self, key: bytes):
+        """``(value, tier_name_that_served_it)`` — single stores serve
+        from themselves; TieredStore reports the tier that actually hit
+        (the connector's per-tier hit attribution, tracing.py)."""
+        return self.get(key), self.tier_name
+
     @abstractmethod
     def put(self, key: bytes, val: bytes) -> bool: ...
 
@@ -490,13 +496,16 @@ class TieredStore(KVStore):
         self.tiers = tiers
 
     def get(self, key: bytes) -> Optional[bytes]:
+        return self.get_with_tier(key)[0]
+
+    def get_with_tier(self, key: bytes):
         for i, tier in enumerate(self.tiers):
             val = tier.get(key)
             if val is not None:
                 for faster in self.tiers[:i]:  # promote
                     faster.put(key, val)
-                return val
-        return None
+                return val, tier.tier_name
+        return None, None
 
     def put(self, key: bytes, val: bytes) -> bool:
         ok = False

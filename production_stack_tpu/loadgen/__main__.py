@@ -36,6 +36,13 @@ disagg   — launch the P/D split (cache server + prefill pool + decode
            short-decode storm at both (SIGKILLing a prefill pod
            mid-run) and exit 1 unless chat ITL p99 improves with zero
            client-visible errors (DISAGG_*.json)
+trace    — launch router + engines (optionally the disagg split),
+           storm them, and join client x-trace-ids against the
+           router's and engines' /debug/traces rings; exit 1 unless
+           >=95%% of sampled requests have a complete span chain,
+           unattributed time is <10%% at p50, and nothing errored
+           (TRACE_*.json; --overhead-guard re-runs the r7 A/B with
+           tracing on)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -61,6 +68,7 @@ from production_stack_tpu.loadgen.overload import (overload_violations,
                                                    run_overload)
 from production_stack_tpu.loadgen.runner import run_workload
 from production_stack_tpu.loadgen.spec import WorkloadSpec, preset
+from production_stack_tpu.loadgen.trace import run_trace, trace_violations
 
 
 def parse_duration(text: str) -> float:
@@ -393,6 +401,55 @@ def cmd_disagg(args) -> int:
               f"count ({d['prefill_engines']}P+{d['decode_engines']}D), "
               f"{chaos.get('kills', 0)} prefill-pod kill(s) with zero "
               f"client-visible errors")
+    return 1 if violations else 0
+
+
+def cmd_trace(args) -> int:
+    record = asyncio.run(run_trace(
+        engines=args.engines, engine=args.engine, disagg=args.disagg,
+        prefill_engines=args.prefill_engines,
+        decode_engines=args.decode_engines,
+        chat_users=args.chat_users, rag_users=args.rag_users,
+        duration_s=args.duration,
+        chat_prompt_chars=args.chat_prompt_chars,
+        chat_tokens=args.chat_tokens,
+        rag_prompt_chars=args.rag_prompt_chars,
+        rag_tokens=args.rag_tokens,
+        tokens_per_s=args.fake_tokens_per_s,
+        prefill_ms_per_char=args.prefill_ms_per_char,
+        interference=args.interference,
+        kv_chunk_chars=args.kv_chunk_chars,
+        headstart_s=args.headstart,
+        min_prompt_chars=args.min_prompt_chars,
+        routing=args.routing, seed=args.seed,
+        ring_entries=args.ring_entries,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout,
+        overhead_guard=args.overhead_guard,
+        overhead_users=args.overhead_users,
+        overhead_duration_s=args.overhead_duration))
+    print(json.dumps(record, indent=2))
+    output = args.output or f"TRACE_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = trace_violations(
+        record, min_chain_fraction=args.min_chain_fraction,
+        max_unattributed_pct=args.max_unattributed,
+        max_overhead_ratio=(args.max_overhead_ratio
+                            if args.overhead_guard else None))
+    for v in violations:
+        print(f"TRACE VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        j = d["join"]
+        msg = (f"trace PASSED: {record['value']}% complete span chains "
+               f"({j['complete_chains']}/{j['sampled']} sampled, "
+               f"{d['topology']}), unattributed time p50 "
+               f"{j['unattributed_p50_pct']}%")
+        guard = d.get("overhead_guard")
+        if guard:
+            msg += (f"; tracing-on overhead "
+                    f"{guard['overhead_ratio']:.2f}x vs direct")
+        print(msg)
     return 1 if violations else 0
 
 
@@ -771,6 +828,69 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write DISAGG_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_disagg)
+
+    sp = sub.add_parser("trace",
+                        help="router + engines (optionally the disagg "
+                             "split); storm, then join client "
+                             "x-trace-ids against the /debug/traces "
+                             "rings — span chains must be complete "
+                             "and phases must cover the time")
+    sp.add_argument("--engines", type=int, default=2,
+                    help="engine count (aggregated topology)")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (deterministic pacing — measures the "
+                         "tracing substrate) or a real engine model "
+                         "name")
+    sp.add_argument("--disagg", action="store_true",
+                    help="launch the P/D split (cache server + "
+                         "producer pool + consumer pool + "
+                         "--prefill-backends) so the chain gate "
+                         "covers router->prefill->decode")
+    sp.add_argument("--prefill-engines", type=int, default=2)
+    sp.add_argument("--decode-engines", type=int, default=2)
+    sp.add_argument("--chat-users", type=int, default=6)
+    sp.add_argument("--rag-users", type=int, default=3)
+    sp.add_argument("--duration", type=parse_duration, default=20.0)
+    sp.add_argument("--chat-prompt-chars", type=int, default=96)
+    sp.add_argument("--chat-tokens", type=int, default=24)
+    sp.add_argument("--rag-prompt-chars", type=int, default=2400)
+    sp.add_argument("--rag-tokens", type=int, default=4)
+    sp.add_argument("--fake-tokens-per-s", type=float, default=40.0)
+    sp.add_argument("--prefill-ms-per-char", type=float, default=0.4)
+    sp.add_argument("--interference", type=float, default=1.5)
+    sp.add_argument("--kv-chunk-chars", type=int, default=64)
+    sp.add_argument("--headstart", type=float, default=3.0)
+    sp.add_argument("--min-prompt-chars", type=int, default=512)
+    sp.add_argument("--routing", default="least_loaded",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--ring-entries", type=int, default=16384,
+                    help="router/engine --trace-ring-entries (must "
+                         "hold the storm, or old traces churn out "
+                         "before the join reads them)")
+    sp.add_argument("--min-chain-fraction", type=float, default=0.95,
+                    help="sampled requests that must show a complete "
+                         "router->engine span chain")
+    sp.add_argument("--max-unattributed", type=float, default=10.0,
+                    help="percent of a trace's duration the phase "
+                         "spans may leave uncovered at the p50")
+    sp.add_argument("--overhead-guard", action="store_true",
+                    help="also re-run the r7 router-overhead A/B "
+                         "(tracing on, zero-think fake) and embed it")
+    sp.add_argument("--overhead-users", type=int, default=48)
+    sp.add_argument("--overhead-duration", type=parse_duration,
+                    default=10.0)
+    sp.add_argument("--max-overhead-ratio", type=float, default=2.5,
+                    help="exit 1 if the tracing-on overhead ratio "
+                         "exceeds this band (the r7 contract)")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write TRACE_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_trace)
 
     return p
 

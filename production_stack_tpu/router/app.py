@@ -35,6 +35,7 @@ from production_stack_tpu.router.service_discovery import (
     K8sServiceDiscovery, StaticServiceDiscovery, engine_auth_headers)
 from production_stack_tpu.router.stats import (EngineStatsScraper,
                                                RequestStatsMonitor)
+from production_stack_tpu.tracing import TraceRecorder, debug_traces_handler
 from production_stack_tpu.utils import (init_logger, parse_comma_separated,
                                         parse_static_aliases,
                                         parse_static_urls, set_ulimit)
@@ -144,6 +145,9 @@ async def metrics(request: web.Request) -> web.Response:
     # windows/breaker state over a scrape
     configured = state["discovery"].all_endpoints()
     state["request_stats"].evict_except(ep.url for ep in configured)
+    # per-endpoint phase-histogram series leave with the endpoint, like
+    # every other per-endpoint family (r8 refresh_resilience precedent)
+    state["metrics"].evict_phase_servers(ep.url for ep in configured)
     tracker = state.get("health")
     if tracker is not None:
         tracker.evict_except(ep.url for ep in configured)
@@ -224,6 +228,13 @@ def build_app(args: argparse.Namespace) -> web.Application:
         "endpoint_cap": args.endpoint_inflight_cap,
         "proxied_inflight": 0,
         "shed_counts": {"admission": 0, "endpoint_cap": 0},
+        # request tracing (tracing.py): span ring + traceparent
+        # propagation + x-trace-id stamping, consumed by
+        # proxy.route_general_request; completed traces on
+        # GET /debug/traces, phase histograms on /metrics
+        "tracer": TraceRecorder("router",
+                                ring_entries=args.trace_ring_entries,
+                                sample_rate=args.trace_sample_rate),
     }
     app["state"] = state
 
@@ -318,6 +329,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
     app.router.add_get("/health", health)
     app.router.add_get("/version", version)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/debug/traces",
+                       debug_traces_handler(lambda: state["tracer"]))
     app.router.add_post("/admin/drain", admin_drain)
 
     if args.enable_files_api or args.enable_batch_api:
@@ -518,6 +531,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="disable transfer-cost decode selection: the "
                         "configured routing policy picks the decode "
                         "engine unassisted")
+    p.add_argument("--trace-ring-entries", type=int, default=2048,
+                   help="completed request traces kept per process "
+                        "(bounded ring served on GET /debug/traces)")
+    p.add_argument("--trace-sample-rate", type=float, default=1.0,
+                   help="fraction of requests whose span timeline "
+                        "enters the trace ring (phase histograms always "
+                        "record; an inbound sampled traceparent flag "
+                        "wins either way)")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

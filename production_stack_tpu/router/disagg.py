@@ -223,10 +223,14 @@ class DecodeSelector:
 
     def select(self, body: dict, urls: Sequence[str],
                request_stats: Dict, engine_stats: Dict,
-               digests: Optional[List[bytes]] = None) -> Optional[str]:
+               digests: Optional[List[bytes]] = None,
+               explain: Optional[dict] = None) -> Optional[str]:
         """Pick a decode URL, or None to abstain (cold prefix — let the
         routing policy decide). ``digests`` lets the caller hash the
-        prompt once per request instead of once per hook."""
+        prompt once per request instead of once per hook. ``explain``
+        (a caller-owned dict) is filled with the per-candidate
+        transfer-cost inputs so the decision is reconstructable from a
+        trace (tracing.py ``decode_select`` event span)."""
         if len(urls) <= 1:
             return None
         if digests is None:
@@ -234,6 +238,10 @@ class DecodeSelector:
         if not digests:
             return None
         costs = {u: self.transfer_cost(digests, u) for u in urls}
+        if explain is not None:
+            explain["transfer_cost"] = {u: round(c, 1)
+                                        for u, c in costs.items()}
+            explain["chunks"] = len(digests)
         if max(costs.values()) - min(costs.values()) < 1e-9:
             # no locality signal separates the candidates: abstain so
             # the policy's own affinity (hash ring) keeps repeated cold
@@ -273,7 +281,10 @@ class DecodeSelector:
                     + self.load_weight * load_norm(u), u)
 
         self.cost_routes += 1
-        return min(urls, key=score)
+        picked = min(urls, key=score)
+        if explain is not None:
+            explain["selected"] = picked
+        return picked
 
 
 class DisaggPrefillOrchestrator:
@@ -441,15 +452,35 @@ class DisaggPrefillOrchestrator:
     async def run_prefill(self, session: aiohttp.ClientSession,
                           endpoint_path: str, model: str, body: dict,
                           headers: Optional[Dict[str, str]] = None,
-                          digests: Optional[List[bytes]] = None) -> bool:
+                          digests: Optional[List[bytes]] = None,
+                          trace=None) -> bool:
         """Fire the prefill pass; True when the pool accepted it. Every
-        failure path increments exactly one fallback reason."""
+        failure path increments exactly one fallback reason. ``trace``
+        (tracing.RequestTrace) gets a ``prefill`` EVENT span AT
+        DISPATCH (so a pass that outlasts the sealed trace — long
+        prompt, short decode — still leaves its evidence in the chain)
+        and a ``prefill_result`` event when the pass settles, when the
+        trace is still open. Events, not phases: the pass overlaps the
+        decode-side phases past the head-start."""
         if endpoint_path not in PREFILL_PATHS:
             return False
         url = self.pick(model)
         if url is None:
+            if trace is not None:
+                trace.add_event("prefill", None, 0.0, status="fallback",
+                                attrs={"reason": "no_pool_or_breaker"})
             return False            # pick counted no_pool/breaker_open
         self.prefills += 1
+        t_pf = time.monotonic()
+        if trace is not None:
+            trace.add_event("prefill", t_pf, 0.0, status="dispatched",
+                            attrs={"server": url})
+
+        def _span(status: str) -> None:
+            if trace is not None:
+                trace.add_event("prefill_result", t_pf,
+                                time.monotonic() - t_pf, status=status,
+                                attrs={"server": url})
         if self.selector is not None:
             # mark at dispatch: the producer publishes progressively,
             # so by the time a post-head-start decode walks the tier
@@ -467,6 +498,7 @@ class DisaggPrefillOrchestrator:
                 await resp.read()
                 if resp.status == 200:
                     self._record(url, True)
+                    _span("ok")
                     return True
                 if resp.status in (429, 503) and \
                         "Retry-After" in resp.headers:
@@ -478,6 +510,7 @@ class DisaggPrefillOrchestrator:
                                  "decode recomputes", url, resp.status)
                     self.prefill_errors += 1
                     self._fallback("shed")
+                    _span("shed")
                     return False
                 logger.warning("disagg prefill on %s returned %d", url,
                                resp.status)
@@ -499,6 +532,7 @@ class DisaggPrefillOrchestrator:
             self._fallback("http_error")
         self.prefill_errors += 1
         self._record(url, False)
+        _span("error")
         return False
 
     async def run_with_headstart(self, session: aiohttp.ClientSession,
@@ -506,7 +540,7 @@ class DisaggPrefillOrchestrator:
                                  body: dict,
                                  headers: Optional[Dict[str, str]] = None,
                                  digests: Optional[List[bytes]] = None,
-                                 ) -> None:
+                                 trace=None) -> None:
         """Overlap: give prefill at most ``headstart_s`` before decode
         routing proceeds. The prefill task keeps running (and its engine
         keeps publishing KV chunks progressively) in the background; a
@@ -514,7 +548,7 @@ class DisaggPrefillOrchestrator:
         — never a wrong result."""
         task = asyncio.ensure_future(self.run_prefill(
             session, endpoint_path, model, body, headers,
-            digests=digests))
+            digests=digests, trace=trace))
         done, _ = await asyncio.wait({task}, timeout=self.headstart_s)
         if not done:
             self.headstart_elapsed += 1
@@ -528,7 +562,8 @@ class DisaggPrefillOrchestrator:
 
     def select_decode(self, body: dict, candidates, request_stats,
                       engine_stats,
-                      digests: Optional[List[bytes]] = None
+                      digests: Optional[List[bytes]] = None,
+                      explain: Optional[dict] = None
                       ) -> Optional[str]:
         """Transfer-cost-aware decode pick; None = let the routing
         policy decide (selector disabled or cold prefix)."""
@@ -536,7 +571,7 @@ class DisaggPrefillOrchestrator:
             return None
         return self.selector.select(
             body, [ep.url for ep in candidates], request_stats,
-            engine_stats or {}, digests=digests)
+            engine_stats or {}, digests=digests, explain=explain)
 
     def on_decode_routed(self, body: dict, url: str,
                          digests: Optional[List[bytes]] = None) -> None:

@@ -9,10 +9,27 @@ vllm:healthy_pods_total) so existing Grafana dashboards keep working.
 
 from prometheus_client import CollectorRegistry, Gauge, generate_latest
 
+from production_stack_tpu.tracing import (PhaseHistogramCollector,
+                                          PhaseHistograms)
+
 
 class RouterMetrics:
     def __init__(self):
         self.registry = CollectorRegistry()
+        # phase-latency attribution (tracing.py): one histogram series
+        # per (phase, server). Router-local phases (admission, routing,
+        # backoff, prefill_dispatch) carry server=""; backend-attributed
+        # phases (backend_ttfb, relay) carry the endpoint URL — and are
+        # EVICTED with the endpoint (evict_phase_servers) so a dynamic-
+        # config swap never leaves frozen per-endpoint series behind
+        # (the r8 refresh_resilience precedent). Fed at trace seal time
+        # (proxy.py), rendered at scrape by the custom collector —
+        # never a prometheus object on the relay hot loop.
+        self.request_phases = PhaseHistograms(("phase", "server"))
+        self.registry.register(PhaseHistogramCollector(
+            "tpu:request_phase_seconds",
+            "Router-side request phase durations (docs/observability.md "
+            "'Tracing' phase glossary)", self.request_phases))
 
         def gauge(name, doc):
             return Gauge(name, doc, ["server"], registry=self.registry)
@@ -257,6 +274,14 @@ class RouterMetrics:
             bump("cost_routes", sel.cost_routes,
                  self.disagg_decode_cost_routes)
             bump("abstains", sel.abstains, self.disagg_decode_abstains)
+
+    def evict_phase_servers(self, live_urls) -> int:
+        """Drop per-endpoint phase-histogram series for endpoints no
+        longer configured (called from the /metrics handler next to the
+        stats/breaker evictions). Router-local series (server="") are
+        untouched."""
+        return self.request_phases.evict_except(live_urls,
+                                                label_index=1)
 
     def reset_disagg_baseline(self) -> None:
         """Called after a final refresh_disagg fold when the

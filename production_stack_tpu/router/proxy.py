@@ -182,6 +182,28 @@ def _shed_response(status: int, message: str,
     return resp
 
 
+# response header carrying the request's trace id (stamped on EVERY
+# response, sheds and errors included) so a client-side harness can
+# join client-observed latency to the server-side span chain
+# (docs/observability.md "Tracing"; the closed loop is
+# ``python -m production_stack_tpu.loadgen trace``)
+TRACE_ID_HEADER = "x-trace-id"
+
+
+def _finish_trace(state, trace, status: str) -> None:
+    """Seal the request trace into the ring and fold its phase spans
+    into the tpu:request_phase_seconds histograms — ONE pass at request
+    end, so the relay hot loop never touches histogram state. Event
+    spans (abandoned failover attempts, decode-selection detail) ride
+    in the trace only: phases must sum to at most the request's wall
+    time or unattributed-time accounting goes negative."""
+    state["tracer"].finish(trace, status)
+    phases = state["metrics"].request_phases
+    for name, kind, _start, dur, _status, attrs in trace.spans:
+        if kind == "phase":
+            phases.observe(name, (attrs or {}).get("server", ""), dur)
+
+
 async def route_general_request(request: web.Request,
                                 endpoint_path: str) -> web.StreamResponse:
     """Proxy `request` to an engine chosen by the app's routing policy.
@@ -191,22 +213,41 @@ async def route_general_request(request: web.Request,
     the router's own event loop is the last line of defense when every
     engine-side bound has already been blown through."""
     state = request.app["state"]
+    trace = state["tracer"].begin(request.headers.get("traceparent"),
+                                  name=endpoint_path)
     max_inflight = state.get("max_inflight") or 0
     if max_inflight and state["proxied_inflight"] >= max_inflight:
         state["shed_counts"]["admission"] += 1
-        return _shed_response(
+        resp = _shed_response(
             429, f"router overloaded: {state['proxied_inflight']} "
                  f"requests already in flight (--max-inflight "
                  f"{max_inflight}); retry later")
+        resp.headers[TRACE_ID_HEADER] = trace.trace_id
+        _finish_trace(state, trace, "shed")
+        return resp
     state["proxied_inflight"] += 1
     try:
-        return await _proxy_request(request, endpoint_path)
+        resp = await _proxy_request(request, endpoint_path, trace)
+    except BaseException:
+        _finish_trace(state, trace, "exception")
+        raise
     finally:
         state["proxied_inflight"] -= 1
+    if resp is not None and not resp.prepared:
+        # prepared (streaming / relayed) responses were stamped before
+        # resp.prepare inside the relay; everything else — error JSON,
+        # sheds, cache hits — is stamped here
+        resp.headers[TRACE_ID_HEADER] = trace.trace_id
+    status = trace.attrs.get("final_status", "ok")
+    if status == "ok" and resp is not None and resp.status >= 400:
+        status = f"http_{resp.status}"
+    _finish_trace(state, trace, status)
+    return resp
 
 
 async def _proxy_request(request: web.Request,
-                         endpoint_path: str) -> web.StreamResponse:
+                         endpoint_path: str,
+                         trace) -> web.StreamResponse:
     app = request.app
     state = app["state"]
     t_route0 = time.monotonic()
@@ -250,6 +291,8 @@ async def _proxy_request(request: web.Request,
             logger.warning("semantic cache check failed: %s", e)
             cached = None
         if cached is not None:
+            trace.add_phase("admission", t_route0, time.monotonic(),
+                            attrs={"semantic_cache": "hit"})
             return web.json_response(cached)
 
     # router-level cache knobs are not OpenAI fields: strip them from the
@@ -272,6 +315,11 @@ async def _proxy_request(request: web.Request,
     health = state.get("health")
     if health is not None:
         candidates = health.healthy_endpoints(candidates)
+
+    # admission phase: body parse, rewrite, cache check, candidate
+    # discovery + health filtering — everything before the disagg
+    # overlap / routing decision starts
+    trace.add_phase("admission", t_route0, time.monotonic())
 
     # disaggregated prefill: the prefill pool computes the prompt KV into
     # the shared tier (publishing chunk-by-chunk as it goes) while decode
@@ -299,7 +347,10 @@ async def _proxy_request(request: web.Request,
     if disagg_active:
         request_id = request.headers.get("x-request-id") or \
             uuid.uuid4().hex
-        prefill_headers = {"x-request-id": request_id}
+        # the producer's engine-side spans must join the same trace as
+        # the decode engine's (router->prefill->decode chain)
+        prefill_headers = {"x-request-id": request_id,
+                           "traceparent": trace.child_traceparent()}
         if "Authorization" in request.headers:
             prefill_headers["Authorization"] = \
                 request.headers["Authorization"]
@@ -308,15 +359,26 @@ async def _proxy_request(request: web.Request,
         # hash the prompt once; the same digest list feeds the prefill
         # dispatch, decode selection, and the locality-ring record
         disagg_digests = disagg.digests(body)
+        t_pf0 = time.monotonic()
         await disagg.run_with_headstart(state["client"], endpoint_path,
                                         model, body,
                                         headers=prefill_headers,
-                                        digests=disagg_digests)
+                                        digests=disagg_digests,
+                                        trace=trace)
+        # the serialization the CLIENT pays before decode routing: the
+        # bounded head-start wait (the prefill pass itself, which may
+        # keep running in the background, is the "prefill" event span
+        # the orchestrator records)
+        trace.add_phase("prefill_dispatch", t_pf0, time.monotonic())
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
     fwd_headers = _forward_headers(request, state["auth_overlay"],
                                    state.get("deadline_overlay"))
+    # the engine parents its spans onto the ROUTER's span (a client-
+    # supplied traceparent became this trace's parent in begin(), so
+    # the client's own context is replaced, not forwarded verbatim)
+    fwd_headers["traceparent"] = trace.child_traceparent()
     budget = state.get("retry_budget")
     if budget is not None:
         budget.on_request()
@@ -341,7 +403,10 @@ async def _proxy_request(request: web.Request,
             break
         if attempt > 0:
             # de-synchronize concurrent failovers off a dying endpoint
+            t_bo = time.monotonic()
             await asyncio.sleep(backoff_s(attempt))
+            trace.add_phase("backoff", t_bo, time.monotonic())
+        t_route = time.monotonic()
         # routing reads the TTL-cached snapshot: window aggregates at
         # most snapshot_ttl_s stale, in-flight counters live
         request_stats = state["request_stats"].snapshot()
@@ -379,9 +444,19 @@ async def _proxy_request(request: web.Request,
                 # two-stage decode selection: expected KV transfer
                 # bytes vs scraped load; None (cold prefix / selection
                 # disabled) falls through to the routing policy
+                explain: dict = {}
                 url = disagg.select_decode(body, pool, request_stats,
                                            scraper_stats,
-                                           digests=disagg_digests)
+                                           digests=disagg_digests,
+                                           explain=explain)
+                if explain:
+                    # per-candidate transfer-cost inputs, in the trace
+                    # only (event): the "why this decode engine" record
+                    trace.add_event("decode_select", t_route,
+                                    time.monotonic() - t_route,
+                                    status=("cost" if url is not None
+                                            else "abstain"),
+                                    attrs=explain)
             if url is None:
                 url = state["router"].route(pool, request_stats,
                                             request.headers, body)
@@ -396,6 +471,12 @@ async def _proxy_request(request: web.Request,
             # the KV stays credited
             disagg.on_decode_routed(body, url, digests=disagg_digests)
         attempt += 1
+        t_attempt = time.monotonic()
+        # routing phase: snapshot read + cap filter + policy/cost pick
+        # (one span per attempt; histogram counts therefore tally
+        # routing PASSES, not requests, under failover)
+        trace.add_phase("routing", t_route, t_attempt,
+                        attrs={"server": ""})
         if attempt == 1:
             logger.debug("routed %s %s -> %s (%.2fms)", endpoint_path,
                          model, url,
@@ -403,6 +484,7 @@ async def _proxy_request(request: web.Request,
         rec = monitor.on_new_request(url)
         resp: Optional[web.StreamResponse] = None
         retry_cause: Optional[str] = None
+        t_hdrs: Optional[float] = None   # backend headers received at
         decode_failed = False   # pre-stream failure: un-credit locality
         try:
             async with session.post(
@@ -410,6 +492,7 @@ async def _proxy_request(request: web.Request,
                     headers=fwd_headers,
                     timeout=state["client_timeout"],
             ) as backend:
+                t_hdrs = time.monotonic()
                 shed = (backend.status in (429, 503)
                         and "Retry-After" in backend.headers)
                 if shed:
@@ -477,6 +560,11 @@ async def _proxy_request(request: web.Request,
                     resp = web.Response(status=backend.status,
                                         body=payload)
                     _copy_backend_headers(resp, backend)
+                    resp.headers[TRACE_ID_HEADER] = trace.trace_id
+                    trace.add_phase("backend_ttfb", t_attempt, t_hdrs,
+                                    attrs={"server": url})
+                    trace.add_phase("relay", t_hdrs, time.monotonic(),
+                                    attrs={"server": url})
                     if capture:
                         _store_cached_response(semantic_cache, body,
                                                payload)
@@ -484,6 +572,9 @@ async def _proxy_request(request: web.Request,
 
                 resp = web.StreamResponse(status=backend.status)
                 _copy_backend_headers(resp, backend)
+                resp.headers[TRACE_ID_HEADER] = trace.trace_id
+                trace.add_phase("backend_ttfb", t_attempt, t_hdrs,
+                                attrs={"server": url})
                 try:
                     await resp.prepare(request)
                 except _CLIENT_LEG_ERRORS as e:
@@ -505,6 +596,8 @@ async def _proxy_request(request: web.Request,
                     await resp.write_eof()
                 except _CLIENT_LEG_ERRORS as e:
                     raise _ClientDisconnect() from e
+                trace.add_phase("relay", t_hdrs, time.monotonic(),
+                                attrs={"server": url})
                 if captured is not None:
                     _store_cached_response(semantic_cache, body,
                                            bytes(captured))
@@ -515,6 +608,11 @@ async def _proxy_request(request: web.Request,
             # engine's breaker)
             logger.debug("client disconnected during relay from %s",
                          url)
+            if t_hdrs is not None:
+                trace.add_phase("relay", t_hdrs, time.monotonic(),
+                                status="client_disconnect",
+                                attrs={"server": url})
+            trace.attrs["final_status"] = "client_disconnect"
             if resp is not None and resp.prepared:
                 resp.force_close()
             return resp
@@ -527,6 +625,11 @@ async def _proxy_request(request: web.Request,
             if resp is not None and resp.prepared:
                 if health is not None:
                     health.record_failure(url, "mid_stream")
+                if t_hdrs is not None:
+                    trace.add_phase("relay", t_hdrs, time.monotonic(),
+                                    status="truncated",
+                                    attrs={"server": url})
+                trace.attrs["final_status"] = "truncated"
                 resp.force_close()
                 return resp
             if health is not None:
@@ -549,6 +652,11 @@ async def _proxy_request(request: web.Request,
                 # exchange
                 if health is not None:
                     health.record_failure(url, "mid_stream")
+                if t_hdrs is not None:
+                    trace.add_phase("relay", t_hdrs, time.monotonic(),
+                                    status="truncated",
+                                    attrs={"server": url})
+                trace.attrs["final_status"] = "truncated"
                 resp.force_close()
                 return resp
             if health is not None:
@@ -571,6 +679,15 @@ async def _proxy_request(request: web.Request,
                 disagg.on_decode_failed(body, url,
                                         digests=disagg_digests)
             if retry_cause is not None:
+                # abandoned attempt: an EVENT span, never a phase — its
+                # wall time must not double-count against the winning
+                # attempt's backend_ttfb in the histograms (the trace
+                # still shows exactly where the failover time went)
+                trace.add_event("backend_attempt", t_attempt,
+                                time.monotonic() - t_attempt,
+                                status="abandoned",
+                                attrs={"server": url,
+                                       "cause": retry_cause})
                 tried.add(url)
                 if health is not None:
                     health.note_retry(url)

@@ -1,0 +1,402 @@
+"""In-process request tracing: spans, W3C traceparent, phase histograms.
+
+Every aggregate loop this stack has closed (overhead, chaos, overload,
+autoscale, kvshare, disagg) reports *end-to-end* percentiles; when a
+percentile moves, nothing says which phase moved it. This module is the
+attribution substrate: a dependency-free span recorder each process
+(router, engine, fake engine) threads through its request path, plus
+W3C ``traceparent`` propagation so one request's spans join up across
+processes.
+
+Design constraints, in order:
+
+- **Hot-path cost ~zero.** A span is one tuple append; sealing a trace
+  is a handful of bisects into plain-int bucket arrays. Nothing here
+  touches prometheus objects, locks the event loop, or renders JSON
+  per request — rendering happens at ``GET /debug/traces`` read time.
+- **Bounded.** Completed traces live in a ring (``ring_entries``,
+  ``collections.deque(maxlen=...)``); an unread ring costs a fixed
+  amount of memory forever.
+- **Cross-process correlation.** The router parses an inbound
+  ``traceparent`` (or mints one), forwards a child context to the
+  engine, and stamps ``x-trace-id`` on every response so a client-side
+  harness can join client-observed latency to server-side spans. The
+  sampled flag (``-01``) propagates: the engine records whatever the
+  router sampled, so chains are never half-recorded by disagreeing
+  sampling decisions.
+- **Phases vs events.** Spans carry a ``kind``: ``"phase"`` spans are
+  mutually non-overlapping slices of the request's wall time and feed
+  the ``tpu:*_phase_seconds`` histograms at seal time (so an abandoned
+  failover attempt — an ``"event"`` span — shows up in the trace but
+  never double-counts a phase); unattributed time is the trace's
+  duration minus the phase sum, the honesty metric ``loadgen trace``
+  gates on.
+
+The wire format follows https://www.w3.org/TR/trace-context/ level 1:
+``traceparent: 00-<32 hex trace-id>-<16 hex parent-id>-<2 hex flags>``.
+
+Operator surface: docs/observability.md "Tracing".
+"""
+
+import collections
+import os
+import random
+import threading
+import time
+from bisect import bisect_right
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# phase-duration histogram bucket bounds (seconds). Identical for the
+# router and engine families so stacked dashboards line up.
+PHASE_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0)
+
+_FLAG_SAMPLED = 0x01
+
+
+# ---------------------------------------------------------------- context
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def parse_traceparent(header: Optional[str]
+                      ) -> Optional[Tuple[str, str, bool]]:
+    """``(trace_id, parent_span_id, sampled)`` or None when absent or
+    malformed (a bad header starts a fresh trace, never an error)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16 \
+            or len(flags) != 2:
+        return None
+    try:
+        int(version, 16)
+        int(trace_id, 16)
+        int(span_id, 16)
+        flag_bits = int(flags, 16)
+    except ValueError:
+        return None
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None                      # spec: invalid sentinels
+    return trace_id, span_id, bool(flag_bits & _FLAG_SAMPLED)
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+# ---------------------------------------------------------------- spans
+
+class RequestTrace:
+    """One request's spans inside one process.
+
+    Spans append as ``(name, kind, start_mono, dur_s, status, attrs)``
+    tuples — no objects on the hot path. ``start_mono`` may be None for
+    duration-only spans (work measured elsewhere, e.g. the KV prefetch
+    that ran on another thread). A sealed trace ignores late appends
+    (a head-started prefill finishing after the response is gone is
+    counted in the orchestrator's counters, not in a trace that has
+    already been read)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled", "name",
+                 "started_at", "t0", "spans", "status", "attrs",
+                 "_sealed", "duration_s")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], sampled: bool, name: str,
+                 attrs: Optional[dict] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = sampled
+        self.name = name
+        self.started_at = time.time()
+        self.t0 = time.monotonic()
+        self.spans: List[tuple] = []
+        self.status = "ok"
+        self.attrs = attrs or {}
+        self._sealed = False
+        self.duration_s = 0.0
+
+    # -- recording -------------------------------------------------------
+
+    def add_span(self, name: str, start: Optional[float],
+                 dur_s: float, kind: str = "phase", status: str = "ok",
+                 attrs: Optional[dict] = None) -> None:
+        if self._sealed:
+            return
+        self.spans.append((name, kind, start, dur_s, status, attrs))
+
+    def add_phase(self, name: str, start: float, end: float,
+                  status: str = "ok",
+                  attrs: Optional[dict] = None) -> None:
+        self.add_span(name, start, end - start, "phase", status, attrs)
+
+    def add_event(self, name: str, start: Optional[float], dur_s: float,
+                  status: str = "ok",
+                  attrs: Optional[dict] = None) -> None:
+        self.add_span(name, start, dur_s, "event", status, attrs)
+
+    def child_traceparent(self) -> str:
+        """Context the NEXT hop parents onto (this process's span)."""
+        return format_traceparent(self.trace_id, self.span_id,
+                                  self.sampled)
+
+    def seal(self, status: str = "ok",
+             end: Optional[float] = None) -> None:
+        if self._sealed:
+            return
+        self.status = status
+        self.duration_s = (end if end is not None
+                           else time.monotonic()) - self.t0
+        self._sealed = True
+
+    # -- reads (off the hot path) ---------------------------------------
+
+    def phase_totals(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name, kind, _start, dur, _status, _attrs in self.spans:
+            if kind == "phase":
+                out[name] = out.get(name, 0.0) + dur
+        return out
+
+    def unattributed_s(self) -> float:
+        return max(0.0, self.duration_s
+                   - sum(self.phase_totals().values()))
+
+    def render(self) -> dict:
+        """JSON-ready dict (the /debug/traces row)."""
+        spans = []
+        for name, kind, start, dur, status, attrs in self.spans:
+            row = {
+                "name": name,
+                "kind": kind,
+                "start_ms": (None if start is None
+                             else round(1e3 * (start - self.t0), 3)),
+                "duration_ms": round(1e3 * dur, 3),
+                "status": status,
+            }
+            if attrs:
+                row["attrs"] = attrs
+            spans.append(row)
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "status": self.status,
+            "started_at": round(self.started_at, 3),
+            "duration_ms": round(1e3 * self.duration_s, 3),
+            "unattributed_ms": round(1e3 * self.unattributed_s(), 3),
+            "attrs": self.attrs,
+            "spans": spans,
+        }
+
+
+# ---------------------------------------------------------------- histograms
+
+class PhaseHistograms:
+    """Plain-int phase-duration histograms, one series per label tuple.
+
+    The hot path does one bisect + two adds per observation; the
+    prometheus exposition reads the arrays at scrape time through
+    ``PhaseHistogramCollector`` (a custom collector — recorder totals
+    are rendered at scrape, the delta-sync idiom every other family in
+    this stack uses, with zero prometheus objects near the hot loop).
+
+    ``labelnames`` is usually ``("phase",)`` (engine) or
+    ``("phase", "server")`` (router — per-endpoint series must be
+    evictable when an endpoint leaves the fleet, see
+    ``evict_except``)."""
+
+    def __init__(self, labelnames: Sequence[str] = ("phase",),
+                 buckets: Sequence[float] = PHASE_BUCKETS):
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets)
+        # labels tuple -> [counts per bucket + overflow], sum, count
+        self._series: Dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, *args: object) -> None:
+        """``observe(label1, ..., dur_s)``. The lock is uncontended in
+        practice (router: event loop only; engine: loop + writer +
+        server threads at request granularity) — cheaper to hold for
+        three increments than to defend lock-free float accumulation."""
+        labels, dur = tuple(args[:-1]), float(args[-1])  # type: ignore
+        idx = bisect_right(self.buckets, dur)
+        with self._lock:
+            series = self._series.get(labels)
+            if series is None:
+                series = self._series.setdefault(
+                    labels, [[0] * (len(self.buckets) + 1), 0.0, 0])
+            series[0][idx] += 1
+            series[1] += dur
+            series[2] += 1
+
+    def snapshot(self) -> Dict[tuple, tuple]:
+        """{labels: (cumulative bucket counts, sum, count)}."""
+        out = {}
+        with self._lock:
+            items = list(self._series.items())
+        for labels, (counts, total, n) in items:
+            acc, cum = 0, []
+            for c in counts:
+                acc += c
+                cum.append(acc)
+            out[labels] = (tuple(cum), total, n)
+        return out
+
+    def evict_except(self, live: Iterable[str],
+                     label_index: int = 1) -> int:
+        """Drop series whose ``label_index`` label (the ``server``
+        label) is not in ``live`` — per-endpoint phase series must not
+        outlive the endpoint across dynamic-config swaps (the r8
+        ``refresh_resilience`` precedent). Series with an empty label
+        (router-local phases) are never evicted. Returns how many
+        series were dropped."""
+        live = set(live)
+        with self._lock:
+            dead = [labels for labels in self._series
+                    if len(labels) > label_index
+                    and labels[label_index]
+                    and labels[label_index] not in live]
+            for labels in dead:
+                del self._series[labels]
+        return len(dead)
+
+
+class PhaseHistogramCollector:
+    """prometheus_client custom collector over a ``PhaseHistograms``."""
+
+    def __init__(self, name: str, documentation: str,
+                 phases: PhaseHistograms):
+        self.name = name
+        self.documentation = documentation
+        self.phases = phases
+
+    def _family(self):
+        from prometheus_client.core import HistogramMetricFamily
+        return HistogramMetricFamily(self.name, self.documentation,
+                                     labels=self.phases.labelnames)
+
+    def describe(self):
+        # registration must not trigger a collect; also feeds
+        # registry._collector_to_names so the exposition-name checks in
+        # tests/test_observability.py see the family
+        return [self._family()]
+
+    def collect(self):
+        fam = self._family()
+        for labels, (cum, total, _n) in self.phases.snapshot().items():
+            buckets = [(str(b), c) for b, c in
+                       zip(self.phases.buckets, cum)]
+            buckets.append(("+Inf", cum[-1]))
+            fam.add_metric(list(labels), buckets, sum_value=total)
+        yield fam
+
+
+# ---------------------------------------------------------------- recorder
+
+class TraceRecorder:
+    """Per-process recorder: mints/continues trace contexts, keeps the
+    bounded ring of completed traces.
+
+    ``sample_rate`` gates which traces enter the ring (phase histograms
+    always record — they are aggregates, not exemplars). An inbound
+    sampled flag wins in both directions so cross-process chains are
+    complete-or-absent, never half-recorded."""
+
+    def __init__(self, service: str, ring_entries: int = 2048,
+                 sample_rate: float = 1.0):
+        self.service = service
+        self.sample_rate = max(0.0, min(1.0, sample_rate))
+        self.ring: "collections.deque[RequestTrace]" = \
+            collections.deque(maxlen=max(1, ring_entries))
+        self.traces_started = 0
+        self.traces_recorded = 0
+        self._rng = random.Random(os.urandom(8))
+
+    def begin(self, traceparent: Optional[str] = None,
+              name: str = "request",
+              attrs: Optional[dict] = None) -> RequestTrace:
+        self.traces_started += 1
+        parsed = parse_traceparent(traceparent)
+        if parsed is not None:
+            trace_id, parent_id, sampled = parsed
+        else:
+            trace_id, parent_id = new_trace_id(), None
+            sampled = (self.sample_rate >= 1.0
+                       or self._rng.random() < self.sample_rate)
+        return RequestTrace(trace_id, new_span_id(), parent_id, sampled,
+                            name, attrs)
+
+    def finish(self, trace: RequestTrace, status: str = "ok") -> None:
+        if trace._sealed:
+            return                    # double-finish must not re-ring
+        trace.seal(status)
+        if trace.sampled:
+            self.ring.append(trace)
+            self.traces_recorded += 1
+
+    # -- reads ----------------------------------------------------------
+
+    def snapshot(self, trace_id: Optional[str] = None,
+                 slowest: Optional[int] = None,
+                 limit: int = 100) -> List[dict]:
+        traces = list(self.ring)
+        if trace_id:
+            traces = [t for t in traces if t.trace_id == trace_id]
+        if slowest:
+            traces = sorted(traces, key=lambda t: t.duration_s,
+                            reverse=True)[:slowest]
+        else:
+            traces = traces[-limit:]
+        return [t.render() for t in traces]
+
+
+def debug_traces_handler(get_recorder):
+    """aiohttp handler factory for ``GET /debug/traces``.
+
+    Query params: ``trace_id=<32 hex>`` (exact match), ``slowest=N``
+    (N slowest in the ring), ``limit=N`` (most recent N, default 100).
+    ``get_recorder`` is a zero-arg callable so app wiring can
+    late-bind."""
+    from aiohttp import web
+
+    async def handler(request: web.Request) -> web.Response:
+        rec: TraceRecorder = get_recorder()
+
+        def intq(key, default=None):
+            raw = request.query.get(key)
+            if raw is None:
+                return default
+            try:
+                return max(1, int(raw))
+            except ValueError:
+                return default
+
+        traces = rec.snapshot(
+            trace_id=request.query.get("trace_id"),
+            slowest=intq("slowest"),
+            limit=intq("limit", 100) or 100)
+        return web.json_response({
+            "service": rec.service,
+            "ring_entries": rec.ring.maxlen,
+            "traces_started": rec.traces_started,
+            "traces_recorded": rec.traces_recorded,
+            "sample_rate": rec.sample_rate,
+            "returned": len(traces),
+            "traces": traces,
+        })
+
+    return handler

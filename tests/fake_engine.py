@@ -68,6 +68,14 @@ decode ticks by ``(1 + B × concurrently-prefilling requests)`` — the
 head-of-line contention a real engine shows when long prompts
 chunk-prefill between decode steps, and exactly the term the P/D split
 removes from the decode pool.
+
+Tracing (the trace rig's lever): the fake continues an inbound W3C
+``traceparent`` (or mints a context), stamps ``x-trace-id`` on its
+responses, and records a minimal engine-side span set — ``prefill``
+(ttft/kv pacing) and ``decode`` (tick pacing) — into a bounded ring
+served on ``GET /debug/traces``, so cross-process span-chain tests and
+``loadgen trace`` run without a real engine
+(production_stack_tpu/tracing.py; docs/observability.md "Tracing").
 """
 
 import asyncio
@@ -77,6 +85,8 @@ import uuid
 from typing import Optional
 
 from aiohttp import web
+
+from production_stack_tpu.tracing import TraceRecorder
 
 
 FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft",
@@ -102,7 +112,8 @@ class FakeEngine:
                  kv_chunk_chars: int = 64,
                  prefill_s_per_char: float = 0.0,
                  kv_role: str = "kv_both",
-                 prefill_decode_interference: float = 0.0):
+                 prefill_decode_interference: float = 0.0,
+                 trace_ring_entries: int = 4096):
         self.model = model
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
@@ -158,6 +169,14 @@ class FakeEngine:
         # capacity and reported queue delay, None = not overridden
         self.capacity_override: Optional[float] = None
         self.queue_delay_override: Optional[float] = None
+        # engine-side tracing (production_stack_tpu/tracing.py): the
+        # fake continues an inbound traceparent (echoing the router's
+        # trace id on x-trace-id) and records a minimal span set —
+        # prefill (ttft/kv pacing) + decode (tick pacing) — on
+        # /debug/traces, so tier-1 propagation/attribution tests run
+        # with no real engine
+        self.tracer = TraceRecorder("fake-engine",
+                                    ring_entries=trace_ring_entries)
         # {"mode": ..., "count": int (-1 = persistent), "arg": float,
         #  "scope": "inference" | "all"}
         self.fault: Optional[dict] = dict(fault) if fault else None
@@ -177,6 +196,9 @@ class FakeEngine:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/fault", self.set_fault)
         app.router.add_get("/fault", self.get_fault)
+        from production_stack_tpu.tracing import debug_traces_handler
+        app.router.add_get("/debug/traces",
+                           debug_traces_handler(lambda: self.tracer))
         return app
 
     async def _tick(self):
@@ -487,10 +509,18 @@ class FakeEngine:
 
     async def chat(self, request: web.Request) -> web.StreamResponse:
         self.last_headers = dict(request.headers)
+        # continue the router's trace context (or mint one): the fake's
+        # minimal engine-side span set is what tier-1 propagation tests
+        # join against
+        trace = self.tracer.begin(request.headers.get("traceparent"),
+                                  name="/v1/chat/completions")
         fault = self._take_fault("/v1/chat/completions")
         if fault is not None:
             faulted = await self._apply_fault(request, fault)
             if faulted is not None:
+                if not faulted.prepared:
+                    faulted.headers["x-trace-id"] = trace.trace_id
+                self.tracer.finish(trace, f"fault:{fault['mode']}")
                 return faulted
         # keep the exact wire bytes: the router's passthrough fast path
         # promises byte identity (tests/test_router_fastpath.py)
@@ -505,6 +535,7 @@ class FakeEngine:
         try:
             n = min(body.get("max_tokens") or self.num_tokens,
                     self.num_tokens)
+            t_pf = time.monotonic()
             if self.ttft_s:
                 await asyncio.sleep(self.ttft_s)
             prompt_text = ""
@@ -519,11 +550,14 @@ class FakeEngine:
                 # (paced, so it interferes with concurrent decode)
                 await self._paced_sleep(self.prefill_s_per_char *
                                         len(self._kv_prompt_text(body)))
+            t_dec = time.monotonic()
+            trace.add_phase("prefill", t_pf, t_dec)
             rid = f"chatcmpl-{uuid.uuid4().hex[:12]}"
             reply = " ".join(f"tok{i}" for i in range(n))
             if body.get("stream"):
                 resp = web.StreamResponse(
-                    headers={"Content-Type": "text/event-stream"})
+                    headers={"Content-Type": "text/event-stream",
+                             "x-trace-id": trace.trace_id})
                 await resp.prepare(request)
                 for i in range(n):
                     await self._tick()
@@ -536,10 +570,14 @@ class FakeEngine:
                                      .encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
+                trace.add_phase("decode", t_dec, time.monotonic())
+                self.tracer.finish(trace, "ok")
                 self._kv_publish(prompt_text, reply)
                 return resp
             self._kv_publish(prompt_text, reply)
-            return web.json_response({
+            trace.add_phase("decode", t_dec, time.monotonic())
+            self.tracer.finish(trace, "ok")
+            resp = web.json_response({
                 "id": rid, "object": "chat.completion", "model": self.model,
                 "choices": [{"index": 0,
                              "message": {"role": "assistant",
@@ -547,6 +585,8 @@ class FakeEngine:
                              "finish_reason": "length"}],
                 "usage": {"prompt_tokens": 3, "completion_tokens": n,
                           "total_tokens": 3 + n}})
+            resp.headers["x-trace-id"] = trace.trace_id
+            return resp
         finally:
             self._in_flight -= 1
             self.gauges["vllm:num_requests_running"] = float(self._in_flight)
@@ -558,13 +598,18 @@ class FakeEngine:
             faulted = await self._apply_fault(request, fault)
             if faulted is not None:
                 return faulted
+        trace = self.tracer.begin(request.headers.get("traceparent"),
+                                  name="/v1/completions")
+        t_pf = time.monotonic()
         self.last_raw = await request.read()
         body = json.loads(self.last_raw)
         self.requests_seen.append(
             ("/v1/completions", request.headers.get("x-user-id"),
              body.get("model")))
         n = min(body.get("max_tokens") or self.num_tokens, self.num_tokens)
-        return web.json_response({
+        trace.add_phase("prefill", t_pf, time.monotonic())
+        self.tracer.finish(trace, "ok")
+        resp = web.json_response({
             "id": f"cmpl-{uuid.uuid4().hex[:12]}",
             "object": "text_completion", "model": self.model,
             "choices": [{"index": 0,
@@ -572,6 +617,8 @@ class FakeEngine:
                          "finish_reason": "length"}],
             "usage": {"prompt_tokens": 3, "completion_tokens": n,
                       "total_tokens": 3 + n}})
+        resp.headers["x-trace-id"] = trace.trace_id
+        return resp
 
     async def models(self, request: web.Request) -> web.Response:
         fault = self._take_fault("/v1/models")
@@ -689,6 +736,9 @@ def main(argv=None) -> None:
                         "concurrently-prefilling requests) — the "
                         "head-of-line contention the disagg split "
                         "removes from the decode pool")
+    p.add_argument("--trace-ring-entries", type=int, default=4096,
+                   help="completed traces kept for /debug/traces "
+                        "(mirror of the real engine's flag)")
     args = p.parse_args(argv)
     fault = None
     if args.fault:
@@ -702,7 +752,8 @@ def main(argv=None) -> None:
                      prefill_s_per_char=args.prefill_ms_per_char / 1e3,
                      kv_role=args.kv_role,
                      prefill_decode_interference=args.
-                     prefill_decode_interference)
+                     prefill_decode_interference,
+                     trace_ring_entries=args.trace_ring_entries)
     web.run_app(eng.build_app(), host=args.host, port=args.port,
                 print=None)
 

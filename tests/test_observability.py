@@ -83,3 +83,16 @@ def test_kube_prom_stack_values_parse():
     tdir = os.path.join(os.path.dirname(OBS), "helm", "templates")
     for svc in ("service-engine.yaml", "service-router.yaml"):
         assert marker in open(os.path.join(tdir, svc)).read(), svc
+
+
+def test_every_registered_metric_is_documented():
+    """tools/check_metrics_documented.py: each tpu:/vllm: family the
+    code registers must have its line in docs/observability.md — a new
+    metric cannot land undocumented (also wired into ci.yml)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(OBS), "tools",
+                        "check_metrics_documented.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

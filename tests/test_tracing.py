@@ -1,0 +1,471 @@
+"""Tracing substrate tests (production_stack_tpu/tracing.py + the
+router threading in proxy.py): W3C traceparent handling, bounded rings,
+phase histograms with per-endpoint eviction, cross-process propagation
+over fake engines, and span lifecycle edge cases — client disconnect
+mid-stream, pre-stream failover (abandoned attempts marked, never
+double-counted as phases), and shed paths."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu import tracing
+from production_stack_tpu.router.app import build_app, parse_args
+from tests.fake_engine import FakeEngine
+
+
+# ------------------------------------------------------------------ units
+
+def test_traceparent_roundtrip():
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    hdr = tracing.format_traceparent(tid, sid, sampled=True)
+    assert tracing.parse_traceparent(hdr) == (tid, sid, True)
+    hdr = tracing.format_traceparent(tid, sid, sampled=False)
+    assert tracing.parse_traceparent(hdr) == (tid, sid, False)
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-xyz-abc-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "ff-" + "a" * 32 + "-" + "1" * 16 + "-01",     # forbidden version
+    "00-" + "a" * 31 + "-" + "1" * 16 + "-01",     # short trace id
+])
+def test_traceparent_malformed(bad):
+    assert tracing.parse_traceparent(bad) is None
+
+
+def test_recorder_continues_inbound_context():
+    rec = tracing.TraceRecorder("t")
+    tid, sid = tracing.new_trace_id(), tracing.new_span_id()
+    tr = rec.begin(tracing.format_traceparent(tid, sid))
+    assert tr.trace_id == tid
+    assert tr.parent_id == sid
+    assert tr.sampled
+    # the child context carries THIS process's span id, same trace
+    got = tracing.parse_traceparent(tr.child_traceparent())
+    assert got == (tid, tr.span_id, True)
+
+
+def test_inbound_unsampled_flag_wins():
+    rec = tracing.TraceRecorder("t", sample_rate=1.0)
+    tr = rec.begin(tracing.format_traceparent(
+        tracing.new_trace_id(), tracing.new_span_id(), sampled=False))
+    rec.finish(tr)
+    assert len(rec.ring) == 0          # upstream said no
+
+
+def test_ring_bounded_under_churn():
+    rec = tracing.TraceRecorder("t", ring_entries=8)
+    for i in range(100):
+        tr = rec.begin(name=f"req-{i}")
+        tr.add_phase("p", tr.t0, tr.t0 + 0.001)
+        rec.finish(tr)
+    assert len(rec.ring) == 8
+    assert rec.traces_recorded == 100
+    # the ring holds the newest
+    assert [t.name for t in rec.ring] == [f"req-{i}"
+                                          for i in range(92, 100)]
+
+
+def test_sealed_trace_drops_late_spans():
+    rec = tracing.TraceRecorder("t")
+    tr = rec.begin()
+    tr.add_phase("a", tr.t0, tr.t0 + 0.5)
+    rec.finish(tr)
+    n = len(tr.spans)
+    tr.add_event("late-prefill", None, 1.0)    # head-started prefill
+    assert len(tr.spans) == n
+    rec.finish(tr)                             # double-seal is a no-op
+    assert len(rec.ring) == 1
+
+
+def test_unattributed_accounting():
+    rec = tracing.TraceRecorder("t")
+    tr = rec.begin()
+    tr.add_phase("a", tr.t0, tr.t0 + 0.25)
+    tr.add_event("overlapping", tr.t0, 5.0)    # events never count
+    tr.seal("ok", end=tr.t0 + 1.0)
+    assert tr.duration_s == pytest.approx(1.0)
+    assert tr.phase_totals() == {"a": pytest.approx(0.25)}
+    assert tr.unattributed_s() == pytest.approx(0.75)
+
+
+def test_phase_histograms_observe_and_evict():
+    ph = tracing.PhaseHistograms(("phase", "server"))
+    ph.observe("relay", "http://a:1", 0.02)
+    ph.observe("relay", "http://b:2", 0.02)
+    ph.observe("admission", "", 0.0005)
+    snap = ph.snapshot()
+    assert snap[("relay", "http://a:1")][2] == 1
+    # bucket placement: 0.02 lands at le=0.025
+    cum = snap[("relay", "http://a:1")][0]
+    idx = ph.buckets.index(0.025)
+    assert cum[idx] == 1 and cum[idx - 1] == 0
+    # eviction drops the departed endpoint, keeps "" and the live one
+    assert ph.evict_except(["http://b:2"]) == 1
+    snap = ph.snapshot()
+    assert ("relay", "http://a:1") not in snap
+    assert ("relay", "http://b:2") in snap
+    assert ("admission", "") in snap
+
+
+def test_collector_exposition():
+    from prometheus_client import CollectorRegistry, generate_latest
+    reg = CollectorRegistry()
+    ph = tracing.PhaseHistograms(("phase",))
+    reg.register(tracing.PhaseHistogramCollector(
+        "tpu:engine_phase_seconds", "doc", ph))
+    ph.observe("prefill", 0.3)
+    text = generate_latest(reg).decode()
+    assert 'tpu:engine_phase_seconds_bucket{le="0.5",phase="prefill"} 1.0' \
+        in text
+    assert "tpu:engine_phase_seconds_sum" in text
+
+
+# ------------------------------------------------------------- router e2e
+
+def _router_args(backends, models, extra=None):
+    argv = ["--service-discovery", "static",
+            "--static-backends", ",".join(backends),
+            "--static-models", ",".join(models),
+            "--engine-stats-interval", "0.2"]
+    return parse_args(argv + (extra or []))
+
+
+async def _start_fakes(*fakes):
+    servers = []
+    for fake in fakes:
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        servers.append(server)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+async def _router_traces(client, **params):
+    r = await client.get("/debug/traces", params=params)
+    assert r.status == 200
+    return (await r.json())["traces"]
+
+
+def test_propagation_router_to_engine():
+    """A client traceparent survives the whole chain: the router
+    continues it, stamps x-trace-id, and forwards a CHILD context whose
+    parent is the router's span — which the fake engine's own trace
+    records."""
+    async def body():
+        fake = FakeEngine(model="m")
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        client_tid = tracing.new_trace_id()
+        client_sid = tracing.new_span_id()
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "m",
+                      "messages": [{"role": "user", "content": "hi"}]},
+                headers={"traceparent": tracing.format_traceparent(
+                    client_tid, client_sid)})
+            assert r.status == 200
+            assert r.headers["x-trace-id"] == client_tid
+
+            # the engine received the ROUTER's child context, not the
+            # client's own
+            fwd = tracing.parse_traceparent(
+                fake.last_headers.get("Traceparent")
+                or fake.last_headers.get("traceparent"))
+            assert fwd is not None and fwd[0] == client_tid
+            assert fwd[1] != client_sid
+
+            rtraces = await _router_traces(client, trace_id=client_tid)
+            assert len(rtraces) == 1
+            rt = rtraces[0]
+            assert rt["parent_id"] == client_sid
+            assert rt["span_id"] == fwd[1]
+            phases = {s["name"] for s in rt["spans"]
+                      if s["kind"] == "phase"}
+            assert {"admission", "routing", "backend_ttfb",
+                    "relay"} <= phases
+            # unattributed time is bounded even on a fast request
+            assert rt["unattributed_ms"] < rt["duration_ms"]
+
+        # the fake's own ring joins on the same trace id, parented on
+        # the router's span
+        etrace = [t for t in fake.tracer.snapshot()
+                  if t["trace_id"] == client_tid]
+        assert len(etrace) == 1
+        assert etrace[0]["parent_id"] == rt["span_id"]
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_failover_attempt_marked_not_double_counted():
+    """Pre-stream failover: the abandoned attempt is an EVENT span
+    (status abandoned); exactly one backend_ttfb/relay PHASE pair is
+    recorded — the winning attempt's — so histograms never count the
+    dead engine's time as served latency."""
+    async def body():
+        f1, f2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(f1, f2)
+        # roundrobin orders candidates BY URL and ports are random:
+        # fault whichever fake sorts first so attempt 1 always fails
+        faulty = f1 if urls[0] == min(urls) else f2
+        faulty.fault = {"mode": "error", "count": 1}
+        app = build_app(_router_args(urls, ["m", "m"],
+                                     ["--routing-logic", "roundrobin"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 200
+            tid = r.headers["x-trace-id"]
+            rt = (await _router_traces(client, trace_id=tid))[0]
+            abandoned = [s for s in rt["spans"]
+                         if s["name"] == "backend_attempt"]
+            assert len(abandoned) == 1
+            assert abandoned[0]["kind"] == "event"
+            assert abandoned[0]["status"] == "abandoned"
+            ttfb = [s for s in rt["spans"]
+                    if s["name"] == "backend_ttfb"]
+            relay = [s for s in rt["spans"] if s["name"] == "relay"]
+            assert len(ttfb) == 1 and len(relay) == 1
+            # the winning phase names the engine that actually served,
+            # not the one that was abandoned
+            assert ttfb[0]["attrs"]["server"] != \
+                abandoned[0]["attrs"]["server"]
+            # histograms saw exactly one backend_ttfb observation
+            phases = app["state"]["metrics"].request_phases.snapshot()
+            ttfb_counts = sum(n for (phase, _srv), (_c, _s, n)
+                              in phases.items()
+                              if phase == "backend_ttfb")
+            assert ttfb_counts == 1
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_shed_responses_carry_trace_id():
+    async def body():
+        fake = FakeEngine(model="m",
+                          fault={"mode": "overload", "arg": 0})
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 503
+            tid = r.headers["x-trace-id"]
+            assert tid
+            rt = (await _router_traces(client, trace_id=tid))[0]
+            assert rt["status"] == "http_503"
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_router_admission_shed_traced():
+    """--max-inflight 0-budget shed: even the cheapest refusal path
+    stamps x-trace-id and lands in the ring as status shed."""
+    async def body():
+        fake = FakeEngine(model="m", tokens_per_s=5, num_tokens=50)
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"],
+                                     ["--max-inflight", "1"]))
+        async with TestClient(TestServer(app)) as client:
+            slow = asyncio.ensure_future(client.post(
+                "/v1/chat/completions",
+                json={"model": "m", "stream": True,
+                      "messages": [{"role": "user", "content": "x"}]}))
+            await asyncio.sleep(0.3)       # occupy the only slot
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "y"}]})
+            assert r.status == 429
+            tid = r.headers["x-trace-id"]
+            rt = (await _router_traces(client, trace_id=tid))[0]
+            assert rt["status"] == "shed"
+            slow.cancel()
+            await asyncio.gather(slow, return_exceptions=True)
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_client_disconnect_mid_stream_sealed():
+    """A client dropping mid-stream still produces a sealed trace (the
+    ring must not leak half-open traces) with a non-ok status."""
+    async def body():
+        fake = FakeEngine(model="m", tokens_per_s=10, num_tokens=100)
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        server = TestServer(app)
+        await server.start_server()
+        url = f"http://127.0.0.1:{server.port}"
+        tid = None
+        async with aiohttp.ClientSession() as session:
+            resp = await session.post(
+                f"{url}/v1/chat/completions",
+                json={"model": "m", "stream": True,
+                      "messages": [{"role": "user", "content": "x"}]})
+            tid = resp.headers["x-trace-id"]
+            await resp.content.read(10)        # first bytes arrived
+            resp.close()                       # hang up mid-stream
+        deadline = asyncio.get_event_loop().time() + 5.0
+        rt = None
+        while asyncio.get_event_loop().time() < deadline:
+            traces = app["state"]["tracer"].snapshot(trace_id=tid)
+            if traces:
+                rt = traces[0]
+                break
+            await asyncio.sleep(0.1)
+        assert rt is not None, "disconnected request never sealed"
+        assert rt["status"] in ("client_disconnect", "exception")
+        await server.close()
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_metrics_scrape_evicts_departed_endpoint_phase_series():
+    """Regression (the r8 label-leak class): per-endpoint phase series
+    must leave with the endpoint on the next /metrics scrape after a
+    fleet change — frozen relay histograms for dead pods would skew
+    every dashboard quantile."""
+    async def body():
+        fake = FakeEngine(model="m")
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 200
+            # a departed endpoint's leftover series (as if the config
+            # had swapped it out after serving traffic)
+            phases = app["state"]["metrics"].request_phases
+            phases.observe("relay", "http://dead:9", 0.5)
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert f'server="{urls[0]}"' in text
+            assert 'server="http://dead:9"' not in text
+            snap = phases.snapshot()
+            assert ("relay", "http://dead:9") not in snap
+            assert ("relay", urls[0]) in snap
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_debug_traces_ring_bound_and_filters():
+    async def body():
+        fake = FakeEngine(model="m")
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"],
+                                     ["--trace-ring-entries", "4"]))
+        async with TestClient(TestServer(app)) as client:
+            tids = []
+            for i in range(10):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": f"q{i}"}]})
+                assert r.status == 200
+                tids.append(r.headers["x-trace-id"])
+            r = await client.get("/debug/traces")
+            data = await r.json()
+            assert data["ring_entries"] == 4
+            assert data["returned"] == 4
+            got = [t["trace_id"] for t in data["traces"]]
+            assert got == tids[-4:]        # newest survive the churn
+            # slowest=N returns N, sorted by duration
+            r = await client.get("/debug/traces", params={"slowest": "2"})
+            rows = (await r.json())["traces"]
+            assert len(rows) == 2
+            assert rows[0]["duration_ms"] >= rows[1]["duration_ms"]
+            # filter by a churned-out id: empty, not an error
+            r = await client.get("/debug/traces",
+                                 params={"trace_id": tids[0]})
+            assert (await r.json())["returned"] == 0
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_disagg_prefill_span_and_decode_select_event():
+    """Split-topology spans: the prefill stage shows up as a
+    prefill_dispatch PHASE (the head-start wait the client paid) plus a
+    prefill EVENT naming the producer, and decode selection records its
+    per-candidate transfer-cost inputs."""
+    async def body():
+        prod = FakeEngine(model="m")
+        d1, d2 = FakeEngine(model="m"), FakeEngine(model="m")
+        servers, urls = await _start_fakes(prod, d1, d2)
+        app = build_app(_router_args(
+            urls[1:], ["m", "m"],
+            ["--prefill-backends", urls[0],
+             "--prefill-models", "m",
+             "--routing-logic", "least_loaded"]))
+        async with TestClient(TestServer(app)) as client:
+            body_json = {"model": "m", "messages": [
+                {"role": "user", "content": "z" * 600}]}
+            tids = []
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions",
+                                      json=body_json)
+                assert r.status == 200
+                tids.append(r.headers["x-trace-id"])
+            rt = (await _router_traces(client, trace_id=tids[-1]))[0]
+            names = {s["name"] for s in rt["spans"]}
+            assert "prefill_dispatch" in names
+            prefill = [s for s in rt["spans"] if s["name"] == "prefill"]
+            assert prefill and prefill[0]["kind"] == "event"
+            assert prefill[0]["attrs"]["server"] == urls[0]
+            sel = [s for s in rt["spans"]
+                   if s["name"] == "decode_select"]
+            # warmed locality ring by request 3: the selector scored
+            assert sel and "transfer_cost" in sel[0]["attrs"]
+            assert set(sel[0]["attrs"]["transfer_cost"]) == set(urls[1:])
+            # producer's own ring saw the router-issued trace ids
+            prod_ids = {t["trace_id"] for t in prod.tracer.snapshot()}
+            assert prod_ids & set(tids)
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
+
+
+def test_preempted_sequence_queue_wait_not_double_counted():
+    """Phase-accounting regression: queue_wait accumulates per
+    enqueue->admit interval, so a preempted-and-requeued sequence
+    counts each wait once — never the first run's prefill/decode —
+    and a first token emitted before the LAST admission clamps prefill
+    to zero (the re-prefill folds into decode, keeping the phase sum
+    within wall time)."""
+    import time as _t
+
+    from production_stack_tpu.engine.scheduler import (SamplingOptions,
+                                                       Scheduler,
+                                                       Sequence)
+    sched = Scheduler(max_num_seqs=1, max_model_len=100,
+                      prefill_chunk=10)
+    seq = Sequence(seq_id="s", prompt_tokens=[1, 2, 3],
+                   options=SamplingOptions())
+    sched.add(seq)
+    _t.sleep(0.02)
+    sched.schedule()                       # first admission
+    w1 = seq.queue_wait_s
+    assert 0.015 <= w1 < 0.5
+    seq.first_token_time = _t.monotonic()  # first run emitted a token
+    seq.output_tokens.append(7)
+    _t.sleep(0.01)                         # decode runs a while...
+    sched.preempt(seq)                     # ...then KV pressure
+    _t.sleep(0.02)
+    sched.schedule()                       # re-admission
+    # both waits counted, the in-slot interval NOT
+    assert w1 + 0.015 <= seq.queue_wait_s < w1 + 0.5
+    # preemption after first token: prefill clamps to zero under the
+    # engine's max() math (first_token < admit)
+    assert seq.first_token_time < seq.admit_time

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Lint: every metric family the code registers must be documented.
+
+Scans ``production_stack_tpu/`` (and ``tests/fake_engine.py``, whose
+exposition mirrors the real engine's) for ``tpu:`` / ``vllm:`` metric
+name literals and checks each appears in ``docs/observability.md`` —
+the operator-facing metrics reference. A family is documented when the
+docs contain:
+
+- the exact name (``tpu:est_queue_delay_ms``),
+- the name with the prometheus ``_total`` suffix Counters gain at
+  exposition time (code registers ``tpu:kvcache_chunk_hits``, docs list
+  ``tpu:kvcache_chunk_hits_total``), or
+- a wildcard family entry (``vllm:semantic_cache_*`` documents every
+  ``vllm:semantic_cache_`` name).
+
+Exit 1 lists every undocumented family. Wired into ci.yml next to the
+tier-1 run and into tests/test_observability.py, so a new metric family
+cannot land without its one line of documentation.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs" / "observability.md"
+
+# string literals that look like metric names but are not registered
+# families: label-value sentinels, protocol prefixes, examples
+IGNORE = {
+    "tpukv:",                    # the cache-server URL scheme
+}
+
+NAME_RE = re.compile(r"""["']((?:tpu|vllm):[a-z][a-z0-9_]+)["']""")
+
+
+def registered_metrics() -> set:
+    names = set()
+    scan = list((REPO / "production_stack_tpu").rglob("*.py"))
+    scan.append(REPO / "tests" / "fake_engine.py")
+    for path in scan:
+        text = path.read_text(encoding="utf-8")
+        for m in NAME_RE.finditer(text):
+            name = m.group(1)
+            if name not in IGNORE:
+                names.add(name)
+    return names
+
+
+def documented(name: str, docs: str, wildcards) -> bool:
+    if name in docs or f"{name}_total" in docs:
+        return True
+    return any(name.startswith(prefix) for prefix in wildcards)
+
+
+def main() -> int:
+    docs = DOCS.read_text(encoding="utf-8")
+    wildcards = {m.group(1) for m in
+                 re.finditer(r"((?:tpu|vllm):[a-z0-9_]+_)\*", docs)}
+    missing = sorted(n for n in registered_metrics()
+                     if not documented(n, docs, wildcards))
+    if missing:
+        print(f"{len(missing)} metric families are registered in code "
+              f"but absent from docs/observability.md:",
+              file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+        print("\nAdd each to the metric tables in "
+              "docs/observability.md (or a `family_*` wildcard row).",
+              file=sys.stderr)
+        return 1
+    print(f"ok: {len(registered_metrics())} metric families all "
+          f"documented in docs/observability.md")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
