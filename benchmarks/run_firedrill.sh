@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# SLO fire-drill closed loop: the committed FIREDRILL_r14.json recipe —
+# real router + fake engines with the canonical 5m/1h + 30m/6h
+# burn-rate windows scaled to seconds, a clean baseline phase (zero
+# alerts may fire), then every fault scenario (partial 500s, engine
+# SIGKILL, TTFT inflation, overload storm, queue-delay override), each
+# required to fire its expected alert within the detection bound and
+# resolve after the fault clears; plus the r7 router-overhead A/B
+# re-run with SLO accounting enabled (on by default) against the
+# <=2.5x band.
+#
+#   ./benchmarks/run_firedrill.sh                       # full drill (fakes)
+#   SCENARIOS=error_rate,slow_ttft ./benchmarks/run_firedrill.sh
+#   ENGINE=debug-tiny ./benchmarks/run_firedrill.sh     # engine_down only
+#
+# Exit 1 on any missed detection, false fire, non-resolution, baseline
+# 5xx, control-plane error, or overhead-band breach.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+OUT="${OUT:-FIREDRILL_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ -n "${SCENARIOS:-}" ]; then
+  EXTRA+=(--scenarios "$SCENARIOS")
+fi
+if [ "${GUARD:-1}" = "1" ]; then
+  EXTRA+=(--overhead-guard)
+fi
+
+python -m production_stack_tpu.loadgen firedrill \
+  --engine "$ENGINE" \
+  --engines "${ENGINES:-2}" --users "${USERS:-8}" \
+  --baseline "${BASELINE:-10s}" \
+  --window-scale "${WINDOW_SCALE:-0.01}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "firedrill record: $OUT"

@@ -98,6 +98,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--engines", required=True,
                    help="comma-separated engine URLs to poll /load on")
     p.add_argument("--router-url", default=None)
+    p.add_argument("--alerts-url", default=None,
+                   help="router base URL whose GET /alerts firing set "
+                        "annotates every decision record (defaults to "
+                        "--router-url when that is set; 'off' "
+                        "disables)")
     p.add_argument("--interval", type=float, default=5.0,
                    help="seconds between control ticks")
     p.add_argument("--decision-log", default=None,
@@ -112,6 +117,36 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "dry-run — log the patch, touch nothing)")
     add_policy_args(p)
     return p.parse_args(argv)
+
+
+def make_alerts_fetch(router_url: str):
+    """Async fetcher of the router's firing burn-rate alert names
+    (GET /alerts "firing" list) for decision-log annotation. Failures
+    raise — the controller catches and skips the annotation. Holds ONE
+    lazily-created session across ticks (the collector/actuator
+    convention — no per-tick connection setup); callers close it via
+    ``fetch.aclose()``."""
+    import aiohttp
+
+    holder = {"session": None}
+
+    async def fetch():
+        if holder["session"] is None or holder["session"].closed:
+            holder["session"] = aiohttp.ClientSession()
+        timeout = aiohttp.ClientTimeout(total=2.0)
+        async with holder["session"].get(f"{router_url}/alerts",
+                                         timeout=timeout) as r:
+            if r.status != 200:
+                raise RuntimeError(f"/alerts HTTP {r.status}")
+            body = await r.json()
+            return list(body.get("firing") or [])
+
+    async def aclose():
+        if holder["session"] is not None and not holder["session"].closed:
+            await holder["session"].close()
+
+    fetch.aclose = aclose
+    return fetch
 
 
 async def serve_metrics(metrics: AutoscalerMetrics,
@@ -146,9 +181,14 @@ async def amain(args: argparse.Namespace) -> None:
     collector = SignalCollector(lambda: urls,
                                 router_url=args.router_url,
                                 poll_interval_s=args.interval)
+    alerts_fetch = None
+    alerts_url = args.alerts_url or args.router_url
+    if alerts_url and alerts_url != "off":
+        alerts_fetch = make_alerts_fetch(alerts_url.rstrip("/"))
     scaler = Autoscaler(AutoscalerPolicy(policy_config(args)), actuator,
                         collector, interval_s=args.interval,
-                        decision_log_path=args.decision_log)
+                        decision_log_path=args.decision_log,
+                        alerts_fetch=alerts_fetch)
     runner = await serve_metrics(scaler.metrics, args.metrics_port)
     await scaler.start()
     try:
@@ -156,6 +196,8 @@ async def amain(args: argparse.Namespace) -> None:
             await asyncio.sleep(3600)
     finally:
         await scaler.close()
+        if alerts_fetch is not None:
+            await alerts_fetch.aclose()
         if runner is not None:
             await runner.cleanup()
 

@@ -80,7 +80,8 @@ class Autoscaler:
                  interval_s: float = 2.0,
                  decision_log_path: Optional[str] = None,
                  metrics: Optional[AutoscalerMetrics] = None,
-                 max_decisions: int = 4096):
+                 max_decisions: int = 4096,
+                 alerts_fetch=None):
         self.policy = policy
         self.actuator = actuator
         self.collector = collector
@@ -90,6 +91,13 @@ class Autoscaler:
         self.decisions: collections.deque = collections.deque(
             maxlen=max_decisions)
         self.scale_events: List[dict] = []
+        # optional async callable returning the router's firing
+        # burn-rate alert names (slo.py; the standalone CLI wires it to
+        # GET {router}/alerts) — each tick's decision record is
+        # annotated with whatever is firing, so "the fleet scaled while
+        # chat_availability_page was burning" is readable straight off
+        # the decision log
+        self._alerts_fetch = alerts_fetch
         self._task: Optional[asyncio.Task] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -130,6 +138,15 @@ class Autoscaler:
             replicas=self.actuator.replicas)
         decision = self.policy.decide(sig, now)
         record = {"ts": round(time.time(), 3), **decision.to_json()}
+        if self._alerts_fetch is not None:
+            # annotation only: a dead router must never stall scaling
+            try:
+                firing = await self._alerts_fetch()
+            except Exception as e:
+                logger.debug("alerts fetch failed: %s", e)
+                firing = None
+            if firing:
+                record["alerts_firing"] = sorted(firing)
 
         if decision.direction != HOLD:
             victims = None

@@ -36,6 +36,14 @@ disagg   — launch the P/D split (cache server + prefill pool + decode
            short-decode storm at both (SIGKILLing a prefill pod
            mid-run) and exit 1 unless chat ITL p99 improves with zero
            client-visible errors (DISAGG_*.json)
+firedrill — launch router + N engines with SLO windows scaled to
+           seconds, storm a clean baseline (zero alerts may fire),
+           then inject fault scenarios (partial 500s, engine SIGKILL,
+           TTFT inflation, overload storm, queue-delay override); each
+           must fire its expected burn-rate alert within the detection
+           bound and resolve after the fault clears; exit 1 on any
+           miss, false fire, or non-resolution (FIREDRILL_*.json;
+           --overhead-guard re-runs the r7 A/B with SLO accounting on)
 trace    — launch router + engines (optionally the disagg split),
            storm them, and join client x-trace-ids against the
            router's and engines' /debug/traces rings; exit 1 unless
@@ -60,6 +68,9 @@ from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.disagg import (disagg_violations,
                                                  run_disagg)
+from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
+                                                    firedrill_violations,
+                                                    run_firedrill)
 from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
@@ -401,6 +412,62 @@ def cmd_disagg(args) -> int:
               f"count ({d['prefill_engines']}P+{d['decode_engines']}D), "
               f"{chaos.get('kills', 0)} prefill-pod kill(s) with zero "
               f"client-visible errors")
+    return 1 if violations else 0
+
+
+def cmd_firedrill(args) -> int:
+    scenarios = None
+    if args.scenarios:
+        scenarios = [s.strip() for s in args.scenarios.split(",")
+                     if s.strip()]
+    record = asyncio.run(run_firedrill(
+        engines=args.engines, engine=args.engine, users=args.users,
+        baseline_s=args.baseline, window_scale=args.window_scale,
+        scenarios=scenarios,
+        detect_timeout_s=args.detect_timeout,
+        resolve_timeout_s=args.resolve_timeout,
+        num_tokens=args.num_tokens,
+        fake_tokens_per_s=args.fake_tokens_per_s,
+        error_rate=args.error_rate,
+        slow_ttft_arg_s=args.slow_ttft_arg,
+        ttft_threshold_s=args.ttft_threshold,
+        overload_capacity=args.overload_capacity,
+        queue_delay_ms=args.queue_delay_ms,
+        min_events=args.min_events, routing=args.routing,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout,
+        overhead_guard=args.overhead_guard,
+        overhead_users=args.overhead_users,
+        overhead_duration_s=args.overhead_duration))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"FIREDRILL_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = firedrill_violations(
+        record, max_overhead_ratio=(args.max_overhead_ratio
+                                    if args.overhead_guard else None))
+    for v in violations:
+        print(f"FIREDRILL VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        # a real-engine drill may have dropped every /fault-driven
+        # scenario: the baseline false-positive gate alone still passes
+        detect = [s["detected_in_s"] for s in d["scenarios"]
+                  if s["detected_in_s"] is not None]
+        scen_msg = (f"{d['detected']}/{len(d['scenarios'])} scenarios "
+                    f"detected (worst {max(detect):.1f}s vs "
+                    f"{d['detect_timeout_s']:.0f}s bound) and "
+                    f"resolved, zero false fires"
+                    if detect else "no scenarios run (baseline "
+                                   "false-positive gate only)")
+        msg = (f"firedrill PASSED: baseline clean "
+               f"({d['baseline']['storm']['ok']} ok, 0 alerts), "
+               + scen_msg)
+        guard = d.get("overhead_guard")
+        if guard:
+            msg += (f"; SLO-on overhead {guard['overhead_ratio']:.2f}x "
+                    f"vs direct")
+        print(msg)
     return 1 if violations else 0
 
 
@@ -828,6 +895,86 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write DISAGG_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_disagg)
+
+    sp = sub.add_parser("firedrill",
+                        help="router + N engines with seconds-scale "
+                             "SLO windows; clean baseline must fire "
+                             "zero alerts, injected faults must each "
+                             "fire their expected burn-rate alert and "
+                             "resolve after clearing")
+    sp.add_argument("--engines", type=int, default=2,
+                    help="engine replica count behind the router")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (the /fault control endpoint drives "
+                         "most scenarios) or a real engine model name "
+                         "(engine_down only)")
+    sp.add_argument("--users", type=int, default=8,
+                    help="closed-loop storm concurrency (80%% chat, "
+                         "20%% x-slo-class: rag)")
+    sp.add_argument("--baseline", type=parse_duration, default=10.0,
+                    help="clean-phase duration (the false-positive "
+                         "gate)")
+    sp.add_argument("--window-scale", type=float, default=0.01,
+                    help="router --slo-window-scale: multiplies the "
+                         "canonical 5m/30m/1h/6h windows (0.01 -> "
+                         "3s/18s/36s/216s)")
+    sp.add_argument("--scenarios", default=None,
+                    help=f"comma-separated subset of "
+                         f"{','.join(SCENARIO_NAMES)} "
+                         f"(default: all)")
+    sp.add_argument("--detect-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds an expected alert has to reach "
+                         "firing (default: sized to the scaled 1h "
+                         "window)")
+    sp.add_argument("--resolve-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds alerts have to resolve after the "
+                         "fault clears (default: sized to the scaled "
+                         "30m window)")
+    sp.add_argument("--num-tokens", type=int, default=4)
+    sp.add_argument("--fake-tokens-per-s", type=float, default=400.0)
+    sp.add_argument("--error-rate", type=float, default=0.5,
+                    help="partial 500 fraction for the error_rate "
+                         "scenario")
+    sp.add_argument("--slow-ttft-arg", type=float, default=0.4,
+                    help="seconds of TTFT inflation for slow_ttft")
+    sp.add_argument("--ttft-threshold", type=float, default=0.25,
+                    help="drill chat_ttft SLO threshold (seconds; "
+                         "clean TTFT must sit well under, slow_ttft "
+                         "well over)")
+    sp.add_argument("--overload-capacity", type=int, default=1,
+                    help="per-engine bounded-queue capacity for the "
+                         "overload scenario")
+    sp.add_argument("--queue-delay-ms", type=float, default=60000.0,
+                    help="injected /load queue-delay override for "
+                         "queue_delay")
+    sp.add_argument("--min-events", type=int, default=4,
+                    help="drill SLO volume floor (router "
+                         "--slo-min-events equivalent, inside the "
+                         "drill config)")
+    sp.add_argument("--routing", default="roundrobin",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--overhead-guard", action="store_true",
+                    help="also re-run the r7 router-overhead A/B "
+                         "(SLO accounting is on by default) and embed "
+                         "it")
+    sp.add_argument("--overhead-users", type=int, default=48)
+    sp.add_argument("--overhead-duration", type=parse_duration,
+                    default=10.0)
+    sp.add_argument("--max-overhead-ratio", type=float, default=2.5,
+                    help="exit 1 if the SLO-on overhead ratio exceeds "
+                         "this band AND the same-host --no-slo "
+                         "baseline by >10%% (the r7 contract, "
+                         "host-normalized)")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write FIREDRILL_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_firedrill)
 
     sp = sub.add_parser("trace",
                         help="router + engines (optionally the disagg "

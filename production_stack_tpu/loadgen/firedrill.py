@@ -1,0 +1,690 @@
+"""Fire-drill mode: prove the in-process SLO engine detects real faults.
+
+The SLO engine (production_stack_tpu/slo.py) is only worth shipping if
+it (a) stays silent on a healthy stack and (b) fires the RIGHT alert,
+fast, when a real fault is injected — and resolves once the fault
+clears. This rig closes that loop with the r8/r9 injection machinery:
+
+1. **Baseline phase** (false-positive gate): a clean closed-loop storm
+   against router + N healthy engines; *zero* alerts may fire, nothing
+   may be pending, and the storm itself must see zero 5xx.
+2. **Scenarios**, each: inject a fault -> keep the storm running ->
+   the expected alert must reach ``firing`` within the detection bound
+   -> clear the fault -> every alert must resolve within the
+   resolution bound. Alerts firing for an SLO the scenario does not
+   plausibly affect are *false fires*.
+
+   - ``error_rate``   — partial 500s on every engine (the fake's
+     ``error_rate`` override: gradual availability breach, no breaker
+     trip) -> ``chat_availability_page``
+   - ``engine_down``  — SIGKILL one engine, no goodbye (failover is
+     disabled for the drill so the fault is client-visible)
+     -> ``chat_availability_page``
+   - ``slow_ttft``    — TTFT inflation past the chat TTFT threshold
+     -> ``chat_ttft_page`` (rag traffic keeps its own e2e SLO green:
+     the per-class separation assertion)
+   - ``overload``     — bounded-queue engines + the same storm ->
+     relayed/endpoint-cap sheds -> ``shed_rate_page`` (and
+     availability must NOT fire: sheds are backpressure, not outage)
+   - ``queue_delay``  — /load queue-delay override -> the signal-fed
+     ``engine_queue_delay_page``
+
+The drill runs the REAL router with ``--slo-window-scale`` shrinking
+the canonical 5m/1h + 30m/6h windows to seconds, and neutralizes the
+resilience machinery that exists to HIDE faults from clients
+(``--failover-attempts 1``, breaker thresholds out of reach) — the
+drill measures detection, not masking. ``--overhead-guard`` runs the
+r7 router A/B paired — SLO accounting on (the default) vs ``--no-slo``
+on the same host — failing only when the SLO-on ratio breaks the 2.5x
+band AND exceeds the same-host baseline by >10% (the absolute ratio is
+host-relative; the accounting's marginal cost is not).
+
+Committed record: ``FIREDRILL_r14.json`` via
+``benchmarks/run_firedrill.sh``; exit 1 on any missed detection, false
+fire, non-resolution, baseline 5xx, or control-plane error.
+"""
+
+import asyncio
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from production_stack_tpu.loadgen.orchestrator import (Proc, _stop,
+                                                       free_port,
+                                                       launch_engine,
+                                                       launch_router,
+                                                       wait_healthy)
+from production_stack_tpu.slo import WINDOWS, default_slos
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+CHAT_PATH = "/v1/chat/completions"
+
+# the drill measures the SLO engine, so the layers built to MASK
+# faults from clients are turned down: no failover, breaker thresholds
+# out of reach (rate trip is `>=`, so 1.01 can never trip), fast
+# scrape/eval so the signal SLOs see injected /load overrides quickly
+ROUTER_FIREDRILL_ARGS = ["--failover-attempts", "1",
+                         "--breaker-threshold", "1000000",
+                         "--breaker-failure-rate", "1.01",
+                         "--engine-stats-interval", "0.5",
+                         "--request-timeout", "20",
+                         "--slo-eval-interval", "0.25"]
+
+SCENARIO_NAMES = ("error_rate", "engine_down", "slow_ttft", "overload",
+                  "queue_delay")
+# scenarios that drive the fake engine's /fault control endpoint; a
+# real-engine drill is limited to the process-level one
+_FAKE_ONLY = ("error_rate", "slow_ttft", "overload", "queue_delay")
+
+
+def drill_slo_config(window_scale: float, *, min_events: int = 4,
+                     ttft_threshold_s: float = 0.25,
+                     rag_e2e_threshold_s: float = 10.0,
+                     queue_delay_bound_ms: float = 5000.0) -> dict:
+    """The default SLO set with drill-sized latency thresholds (the
+    objectives and alert shape stay canonical — only windows scale)."""
+    slos = []
+    for slo in default_slos():
+        row = slo.to_json()
+        if slo.name == "chat_ttft":
+            row["threshold_s"] = ttft_threshold_s
+        elif slo.name == "rag_e2e":
+            row["threshold_s"] = rag_e2e_threshold_s
+        elif slo.name == "engine_queue_delay":
+            row["bound"] = queue_delay_bound_ms
+        slos.append(row)
+    return {"window_scale": window_scale, "min_events": min_events,
+            "slos": slos}
+
+
+class _StormCounters:
+    __slots__ = ("launched", "ok", "http_5xx", "http_4xx", "shed",
+                 "transport_errors", "samples")
+
+    def __init__(self):
+        self.launched = 0
+        self.ok = 0
+        self.http_5xx = 0
+        self.http_4xx = 0
+        self.shed = 0
+        self.transport_errors = 0
+        self.samples: List[str] = []
+
+    def to_json(self) -> dict:
+        return {"launched": self.launched, "ok": self.ok,
+                "http_5xx": self.http_5xx, "http_4xx": self.http_4xx,
+                "shed": self.shed,
+                "transport_errors": self.transport_errors,
+                "samples": self.samples}
+
+
+class _Storm:
+    """Continuous closed-loop storm with phase-tagged outcome counters.
+
+    80% of requests are plain chat; 20% carry ``x-slo-class: rag`` so
+    the per-class SLO split has two live classes to separate. Sheds
+    (429/503 + Retry-After) are counted apart from 5xx — the overload
+    scenario's whole point is that sheds burn shed_rate, not
+    availability."""
+
+    def __init__(self, url: str, model: str, *, users: int,
+                 num_tokens: int, request_timeout_s: float = 20.0):
+        self.url = url
+        self.model = model
+        self.users = users
+        self.num_tokens = num_tokens
+        self.timeout = aiohttp.ClientTimeout(total=request_timeout_s)
+        self.phase = "baseline"
+        self.counters: Dict[str, _StormCounters] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+    def _c(self) -> _StormCounters:
+        c = self.counters.get(self.phase)
+        if c is None:
+            c = self.counters[self.phase] = _StormCounters()
+        return c
+
+    async def _one(self, session: aiohttp.ClientSession,
+                   i: int, n: int) -> None:
+        rag = (n % 5) == 0
+        headers = {"Content-Type": "application/json"}
+        if rag:
+            headers["x-slo-class"] = "rag"
+        body = json.dumps({
+            "model": self.model,
+            "messages": [{"role": "user",
+                          "content": f"drill u{i} r{n}"
+                                     + (" ctx " * 40 if rag else "")}],
+            "max_tokens": self.num_tokens, "stream": False}).encode()
+        c = self._c()
+        c.launched += 1
+        try:
+            async with session.post(f"{self.url}{CHAT_PATH}", data=body,
+                                    headers=headers,
+                                    timeout=self.timeout) as resp:
+                await resp.read()
+                if resp.status < 400:
+                    c.ok += 1
+                elif resp.status in (429, 503) and \
+                        "Retry-After" in resp.headers:
+                    c.shed += 1
+                elif resp.status >= 500:
+                    c.http_5xx += 1
+                    if len(c.samples) < 5:
+                        c.samples.append(f"HTTP {resp.status}")
+                else:
+                    c.http_4xx += 1
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            c.transport_errors += 1
+            if len(c.samples) < 5:
+                c.samples.append(f"{type(e).__name__}: {e}")
+
+    async def _worker(self, i: int) -> None:
+        n = i          # stagger the rag fraction across workers
+        async with aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=0)) as session:
+            while not self._stopping:
+                await self._one(session, i, n)
+                n += self.users
+                await asyncio.sleep(0.02)
+
+    def start(self) -> None:
+        self._tasks = [asyncio.create_task(self._worker(i))
+                       for i in range(self.users)]
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+
+    def totals(self) -> dict:
+        return {phase: c.to_json()
+                for phase, c in self.counters.items()}
+
+
+class _Control:
+    """The rig's own control plane (fault POSTs, /alerts polls) with
+    its error count — 'zero raw 5xx from the rig itself' is a gate."""
+
+    def __init__(self, session: aiohttp.ClientSession):
+        self.session = session
+        self.errors: List[str] = []
+
+    async def post_fault(self, engine_url: str, body: dict) -> bool:
+        try:
+            async with self.session.post(
+                    f"{engine_url}/fault", json=body,
+                    timeout=aiohttp.ClientTimeout(total=3)) as r:
+                if r.status == 200:
+                    return True
+                self.errors.append(
+                    f"POST {engine_url}/fault -> HTTP {r.status}")
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            self.errors.append(
+                f"POST {engine_url}/fault -> {type(e).__name__}: {e}")
+        return False
+
+    async def alerts(self, router_url: str) -> Optional[dict]:
+        try:
+            async with self.session.get(
+                    f"{router_url}/alerts",
+                    timeout=aiohttp.ClientTimeout(total=3)) as r:
+                if r.status == 200:
+                    return await r.json()
+                self.errors.append(f"GET /alerts -> HTTP {r.status}")
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            self.errors.append(f"GET /alerts -> {type(e).__name__}: {e}")
+        return None
+
+
+async def _wait_alerts(control: _Control, router_url: str, predicate,
+                       timeout_s: float,
+                       poll_s: float = 0.3) -> Optional[float]:
+    """Poll /alerts until ``predicate(payload)``; seconds it took, or
+    None on timeout."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        payload = await control.alerts(router_url)
+        if payload is not None and predicate(payload):
+            return round(time.monotonic() - t0, 2)
+        await asyncio.sleep(poll_s)
+    return None
+
+
+def _fired_totals(payload: dict) -> Dict[str, int]:
+    return {a["name"]: a["fired_total"] for a in payload["alerts"]}
+
+
+def _slo_of(alert_name: str, payload: dict) -> str:
+    for a in payload["alerts"]:
+        if a["name"] == alert_name:
+            return a["slo"]
+    return alert_name
+
+
+async def run_firedrill(*, engines: int = 2,
+                        engine: str = "fake",
+                        users: int = 8,
+                        baseline_s: float = 10.0,
+                        window_scale: float = 0.01,
+                        scenarios: Optional[List[str]] = None,
+                        detect_timeout_s: Optional[float] = None,
+                        resolve_timeout_s: Optional[float] = None,
+                        num_tokens: int = 4,
+                        fake_tokens_per_s: float = 400.0,
+                        error_rate: float = 0.5,
+                        slow_ttft_arg_s: float = 0.4,
+                        ttft_threshold_s: float = 0.25,
+                        overload_capacity: int = 1,
+                        queue_delay_ms: float = 60000.0,
+                        min_events: int = 4,
+                        routing: str = "roundrobin",
+                        platform: str = "cpu",
+                        log_dir: str = "loadgen-logs",
+                        startup_timeout_s: float = 420.0,
+                        overhead_guard: bool = False,
+                        overhead_users: int = 48,
+                        overhead_duration_s: float = 10.0) -> Dict:
+    """Launch router + N engines with scaled SLO windows, storm, run
+    the fault scenarios; return the FIREDRILL record."""
+    if scenarios is None:
+        scenarios = list(SCENARIO_NAMES)
+    if engine != "fake":
+        dropped = [s for s in scenarios if s in _FAKE_ONLY]
+        if dropped:
+            logger.warning("real-engine drill: dropping fake-only "
+                           "scenarios %s", dropped)
+        scenarios = [s for s in scenarios if s not in _FAKE_ONLY]
+    unknown = [s for s in scenarios if s not in SCENARIO_NAMES]
+    if unknown:
+        raise ValueError(f"unknown scenarios {unknown}; "
+                         f"options: {list(SCENARIO_NAMES)}")
+
+    # detection must cover filling the page pair's LONG window past the
+    # 14.4x burn (~0.144 bad fraction) plus the scaled for_s hold;
+    # resolution covers flushing the SHORT window plus resolve_s
+    long_w = WINDOWS["1h"] * window_scale
+    short_w = WINDOWS["5m"] * window_scale
+    # the slow (ticket) pair's short window is the longest residue a
+    # cleared fault leaves behind: resolution and inter-scenario
+    # settling are sized to IT, not to the page pair's 5m window
+    ticket_short_w = WINDOWS["30m"] * window_scale
+    # worst case is a latency fault: the inflation itself collapses
+    # the storm's throughput, so bad events fill the long window at a
+    # fraction of the clean rate — budget most of the window plus slack
+    if detect_timeout_s is None:
+        detect_timeout_s = max(15.0, 0.85 * long_w + 10.0)
+    if resolve_timeout_s is None:
+        resolve_timeout_s = max(10.0, ticket_short_w + 10.0)
+    settle_s = ticket_short_w + 1.0
+
+    os.makedirs(log_dir, exist_ok=True)
+    slo_cfg = drill_slo_config(window_scale, min_events=min_events,
+                               ttft_threshold_s=ttft_threshold_s)
+    slo_cfg_path = os.path.join(log_dir, "firedrill_slo_config.json")
+    with open(slo_cfg_path, "w") as f:
+        json.dump(slo_cfg, f, indent=2)
+
+    procs: List[Proc] = []
+    engine_procs: List[Proc] = []
+    fake_args = ["--tokens-per-s", str(fake_tokens_per_s),
+                 "--num-tokens", str(num_tokens)] \
+        if engine == "fake" else None
+    record_scenarios: List[dict] = []
+    storm = None
+    try:
+        for _ in range(engines):
+            engine_procs.append(launch_engine(
+                engine, free_port(), log_dir=log_dir, platform=platform,
+                extra_args=fake_args))
+        procs.extend(engine_procs)
+        await asyncio.gather(*[wait_healthy(e.url, startup_timeout_s)
+                               for e in engine_procs])
+        model = "fake-model" if engine == "fake" else engine
+        router = launch_router(
+            [e.url for e in engine_procs], model, free_port(),
+            routing=routing, log_dir=log_dir,
+            extra_args=ROUTER_FIREDRILL_ARGS
+            + ["--slo-config", slo_cfg_path])
+        procs.append(router)
+        await wait_healthy(router.url, 60.0, require_endpoints=engines)
+
+        logger.info("firedrill: %d users vs router + %d %s engines, "
+                    "window_scale %g (5m->%.1fs, 1h->%.1fs), "
+                    "scenarios %s", users, engines, engine,
+                    window_scale, short_w, long_w, scenarios)
+        async with aiohttp.ClientSession() as control_session:
+            control = _Control(control_session)
+            storm = _Storm(router.url, model, users=users,
+                           num_tokens=num_tokens)
+            storm.start()
+            t0 = time.monotonic()
+
+            # ---------------------------------------------- baseline
+            await asyncio.sleep(baseline_s)
+            baseline_payload = await control.alerts(router.url)
+            baseline_fired = (_fired_totals(baseline_payload)
+                              if baseline_payload else {})
+            baseline_states = {
+                a["name"]: a["state"]
+                for a in (baseline_payload or {}).get("alerts", [])}
+            fired_so_far = dict(baseline_fired)
+
+            # ---------------------------------------------- scenarios
+            async def all_engines_fault(body: dict) -> bool:
+                oks = await asyncio.gather(*[
+                    control.post_fault(e.url, body)
+                    for e in engine_procs])
+                return all(oks)
+
+            killed: Dict[str, int] = {}     # name -> engine index
+
+            async def inject(name: str) -> bool:
+                if name == "error_rate":
+                    return await all_engines_fault(
+                        {"error_rate": error_rate})
+                if name == "slow_ttft":
+                    return await all_engines_fault(
+                        {"mode": "slow_ttft", "arg": slow_ttft_arg_s,
+                         "count": -1})
+                if name == "overload":
+                    return await all_engines_fault(
+                        {"mode": "overload", "arg": overload_capacity})
+                if name == "queue_delay":
+                    return await all_engines_fault(
+                        {"queue_delay_ms": queue_delay_ms})
+                if name == "engine_down":
+                    victim = engine_procs[0]
+                    victim.popen.kill()
+                    victim.popen.wait()
+                    killed[name] = 0
+                    logger.info("firedrill: killed %s", victim.url)
+                    return True
+                raise AssertionError(name)
+
+            async def clear(name: str) -> bool:
+                if name == "engine_down":
+                    idx = killed.pop(name)
+                    port = int(engine_procs[idx].url.rsplit(":", 1)[1])
+                    engine_procs[idx] = launch_engine(
+                        engine, port, log_dir=log_dir,
+                        platform=platform, extra_args=fake_args)
+                    try:
+                        await wait_healthy(engine_procs[idx].url, 60.0)
+                    except TimeoutError:
+                        control.errors.append(
+                            f"{engine_procs[idx].url} not healthy "
+                            f"after restart")
+                        return False
+                    return True
+                if name == "queue_delay":
+                    return await all_engines_fault(
+                        {"queue_delay_ms": None})
+                # mode-clearing POST also resets error_rate
+                return await all_engines_fault({"mode": None})
+
+            expected_slo = {
+                "error_rate": "chat_availability",
+                "engine_down": "chat_availability",
+                "slow_ttft": "chat_ttft",
+                "overload": "shed_rate",
+                "queue_delay": "engine_queue_delay",
+            }
+            # SLOs a scenario's fault plausibly burns: alerts firing
+            # outside this set are false fires. The rag fraction of
+            # the storm means availability faults burn BOTH
+            # availability SLOs; latency inflation burns only chat's
+            # TTFT (rag's 10s e2e bar stays green — the per-class
+            # separation the drill asserts).
+            affected_slos = {
+                "error_rate": {"chat_availability", "rag_availability"},
+                "engine_down": {"chat_availability",
+                                "rag_availability"},
+                "slow_ttft": {"chat_ttft"},
+                "overload": {"shed_rate"},
+                "queue_delay": {"engine_queue_delay"},
+            }
+
+            for name in scenarios:
+                expected_alert = f"{expected_slo[name]}_page"
+                storm.phase = name
+                # outcomes are attributed to the phase a request
+                # LAUNCHED in; let requests launched under the previous
+                # phase finish before the fault exists, or a tail-end
+                # baseline request served through the fault reads as a
+                # 5xx on a healthy stack
+                await asyncio.sleep(0.5)
+                injected_ok = await inject(name)
+                injected_at = time.monotonic()
+
+                detected_in = await _wait_alerts(
+                    control, router.url,
+                    lambda p: expected_alert in p["firing"],
+                    detect_timeout_s)
+                payload = await control.alerts(router.url) or {}
+                firing_at_detect = list(payload.get("firing", []))
+
+                cleared_ok = await clear(name)
+                resolved_in = await _wait_alerts(
+                    control, router.url,
+                    lambda p: not p["firing"],
+                    resolve_timeout_s) if detected_in is not None \
+                    else None
+
+                # drain the scenario's residue from the slow pair's
+                # short window, then require quiet again — a ticket
+                # alert whose pending period completes DURING this
+                # settle still belongs to THIS scenario's fault, so
+                # the fired-totals snapshot for attribution is taken
+                # only after the post-settle quiet gate
+                storm.phase = "settle"
+                await asyncio.sleep(settle_s)
+                post_settle_quiet = await _wait_alerts(
+                    control, router.url,
+                    lambda p: not p["firing"],
+                    resolve_timeout_s)
+
+                payload = await control.alerts(router.url) or {}
+                totals = _fired_totals(payload) if payload else {}
+                fired_delta = {
+                    a: totals.get(a, 0) - fired_so_far.get(a, 0)
+                    for a in totals
+                    if totals.get(a, 0) > fired_so_far.get(a, 0)}
+                fired_so_far = totals or fired_so_far
+                false_fires = sorted(
+                    a for a in fired_delta
+                    if _slo_of(a, payload) not in affected_slos[name])
+
+                record_scenarios.append({
+                    "name": name,
+                    "expected_alert": expected_alert,
+                    "injected_ok": injected_ok,
+                    "cleared_ok": cleared_ok,
+                    "t_inject_s": round(injected_at - t0, 2),
+                    "detected_in_s": detected_in,
+                    "firing_at_detect": firing_at_detect,
+                    "resolved_in_s": resolved_in,
+                    "post_settle_quiet": post_settle_quiet is not None,
+                    "fired_during": fired_delta,
+                    "false_fires": false_fires,
+                })
+                logger.info(
+                    "firedrill %s: detected=%s resolved=%s fired=%s",
+                    name, detected_in, resolved_in, fired_delta)
+
+            storm.phase = "final"
+            await asyncio.sleep(1.0)
+            final_payload = await control.alerts(router.url) or {}
+            await storm.stop()
+            storm_totals = storm.totals()
+            control_errors = list(control.errors)
+            elapsed = time.monotonic() - t0
+    finally:
+        if storm is not None and not storm._stopping:
+            await storm.stop()
+        _stop(list(engine_procs) + [p for p in procs
+                                    if p not in engine_procs])
+
+    overhead = None
+    if overhead_guard:
+        from production_stack_tpu.loadgen.overhead import run_overhead
+        logger.info("firedrill: re-running the r7 overhead A/B — "
+                    "SLO accounting on (default) vs --no-slo on the "
+                    "same host...")
+
+        async def _side(extra):
+            guard = await run_overhead(
+                engine="fake", users=overhead_users,
+                duration_s=overhead_duration_s, num_tokens=num_tokens,
+                platform=platform, log_dir=log_dir,
+                startup_timeout_s=startup_timeout_s,
+                router_extra_args=extra)
+            return {
+                "router_req_per_s":
+                    guard["detail"]["router"]["req_per_s"],
+                "direct_req_per_s":
+                    guard["detail"]["direct"]["req_per_s"],
+                "overhead_ratio": guard["detail"]["overhead_ratio"],
+                "errors": (guard["detail"]["router"]["errors"]
+                           + guard["detail"]["direct"]["errors"]),
+            }
+
+        # paired same-host A/B: the absolute ratio swings with the
+        # host (core count, contention — r7 measured 2.34x, r13 2.47x
+        # on their hosts), so the guard also pins the --no-slo
+        # baseline from THIS host and bounds the accounting's marginal
+        # cost even where the absolute band is out of reach
+        slo_on = await _side(None)
+        no_slo = await _side(["--no-slo"])
+        overhead = {
+            **slo_on,
+            "no_slo_baseline": no_slo,
+            "errors": slo_on["errors"] + no_slo["errors"],
+        }
+
+    detected = [s for s in record_scenarios
+                if s["detected_in_s"] is not None]
+    resolved = [s for s in record_scenarios
+                if s["resolved_in_s"] is not None]
+    baseline = storm_totals.get("baseline", _StormCounters().to_json())
+    return {
+        "metric": "SLO fire-drill: injected faults detected by the "
+                  "in-process burn-rate alerts and resolved after "
+                  "clearing (baseline fires nothing)",
+        "value": round(100.0 * len(resolved)
+                       / max(1, len(record_scenarios)), 1),
+        "unit": "% scenarios detected+resolved",
+        "platform": platform,
+        "detail": {
+            "engine": engine, "engines": engines, "users": users,
+            "routing": routing,
+            "duration_s": round(elapsed, 1),
+            "window_scale": window_scale,
+            "windows_s": {lbl: round(w * window_scale, 2)
+                          for lbl, w in WINDOWS.items()},
+            "min_events": min_events,
+            "baseline_s": baseline_s,
+            "detect_timeout_s": round(detect_timeout_s, 1),
+            "resolve_timeout_s": round(resolve_timeout_s, 1),
+            "settle_s": round(settle_s, 1),
+            "slo_config": slo_cfg,
+            "baseline": {
+                "storm": baseline,
+                "alerts_fired": {k: v for k, v in baseline_fired.items()
+                                 if v},
+                "non_inactive": {k: v for k, v in
+                                 baseline_states.items()
+                                 if v not in ("inactive",)},
+            },
+            "scenarios": record_scenarios,
+            "detected": len(detected),
+            "resolved": len(resolved),
+            "final_firing": list(final_payload.get("firing", [])),
+            "storm": storm_totals,
+            "control_errors": control_errors,
+            "overhead_guard": overhead,
+        },
+    }
+
+
+def firedrill_violations(record: Dict,
+                         max_overhead_ratio: Optional[float] = None
+                         ) -> List[str]:
+    """The drill's pass/fail contract (CLI exits 1 on any)."""
+    d = record["detail"]
+    out = []
+    if d["control_errors"]:
+        out.append(f"{len(d['control_errors'])} control-plane errors "
+                   f"from the rig itself (first: "
+                   f"{d['control_errors'][0]})")
+    b = d["baseline"]
+    if b["storm"]["http_5xx"] or b["storm"]["transport_errors"]:
+        out.append(f"baseline storm saw {b['storm']['http_5xx']} 5xx / "
+                   f"{b['storm']['transport_errors']} transport errors "
+                   f"on a healthy stack")
+    if b["storm"]["ok"] == 0:
+        out.append("baseline storm finished zero requests — the drill "
+                   "measured nothing")
+    if b["alerts_fired"]:
+        out.append(f"alerts fired during the clean baseline "
+                   f"(false positives): {b['alerts_fired']}")
+    if any(s in ("pending", "firing")
+           for s in b["non_inactive"].values()):
+        out.append(f"alerts pending/firing at the end of the clean "
+                   f"baseline: {b['non_inactive']}")
+    for s in d["scenarios"]:
+        if not s["injected_ok"]:
+            out.append(f"{s['name']}: fault injection failed")
+        if s["detected_in_s"] is None:
+            out.append(f"{s['name']}: {s['expected_alert']} did not "
+                       f"fire within {d['detect_timeout_s']}s "
+                       f"(missed detection)")
+        elif s["resolved_in_s"] is None:
+            out.append(f"{s['name']}: alerts did not resolve within "
+                       f"{d['resolve_timeout_s']}s of clearing the "
+                       f"fault")
+        elif not s.get("post_settle_quiet", True):
+            out.append(f"{s['name']}: alerts re-fired and stayed "
+                       f"firing through the settle window")
+        if not s["cleared_ok"]:
+            out.append(f"{s['name']}: fault clear failed")
+        if s["false_fires"]:
+            out.append(f"{s['name']}: false fires on unrelated SLOs: "
+                       f"{s['false_fires']}")
+    if d["final_firing"]:
+        out.append(f"alerts still firing at drill end: "
+                   f"{d['final_firing']}")
+    guard = d.get("overhead_guard")
+    if guard is not None:
+        if guard["errors"]:
+            out.append(f"overhead guard saw {guard['errors']} errors — "
+                       f"the A/B is suspect")
+        ratio = guard["overhead_ratio"]
+        baseline = (guard.get("no_slo_baseline") or {}).get(
+            "overhead_ratio")
+        # the band is the contract where the host can reach it; where
+        # even --no-slo measures above the band (slower host than the
+        # r7/r13 runs), the guard still bounds SLO accounting's
+        # marginal cost to <=10% over the same-host baseline
+        if max_overhead_ratio and ratio:
+            bound = max_overhead_ratio
+            if baseline:
+                bound = max(bound, baseline * 1.10)
+            if ratio > bound:
+                out.append(
+                    f"overhead ratio {ratio:.2f}x with SLO accounting "
+                    f"enabled exceeds the {max_overhead_ratio:g}x band "
+                    f"and the same-host --no-slo baseline "
+                    f"({baseline if baseline else '?'}x) by more "
+                    f"than 10%")
+    return out

@@ -184,7 +184,9 @@ async def run_overhead(*, engine: str = "fake",
                        snapshot_ttl: Optional[float] = None,
                        warmup_requests: int = 32,
                        unique_prompts: bool = False,
-                       prompt_chars: int = 768) -> Dict:
+                       prompt_chars: int = 768,
+                       router_extra_args: Optional[List[str]] = None
+                       ) -> Dict:
     """Launch engine + router, measure both sides, return the A/B
     record (BENCH schema; headline value = router-side req/s)."""
     procs = []
@@ -201,7 +203,8 @@ async def run_overhead(*, engine: str = "fake",
         model = "fake-model" if engine == "fake" else engine
         router = launch_router([eng.url], model, free_port(),
                                routing=routing, log_dir=log_dir,
-                               snapshot_ttl=snapshot_ttl)
+                               snapshot_ttl=snapshot_ttl,
+                               extra_args=router_extra_args)
         procs.append(router)
         await wait_healthy(router.url, 60.0, require_endpoints=1)
 

@@ -35,6 +35,8 @@ from production_stack_tpu.router.service_discovery import (
     K8sServiceDiscovery, StaticServiceDiscovery, engine_auth_headers)
 from production_stack_tpu.router.stats import (EngineStatsScraper,
                                                RequestStatsMonitor)
+from production_stack_tpu.slo import (SLOConfig, SLOEngine, SLOTask,
+                                      default_config)
 from production_stack_tpu.tracing import TraceRecorder, debug_traces_handler
 from production_stack_tpu.utils import (init_logger, parse_comma_separated,
                                         parse_static_aliases,
@@ -81,6 +83,9 @@ async def health(request: web.Request) -> web.Response:
     tracker = state.get("health")
     if tracker and not tracker.healthy():
         problems.append("health re-probe task dead")
+    slo_task = state.get("slo_task")
+    if slo_task and not slo_task.healthy():
+        problems.append("SLO evaluation task dead")
     endpoints = state["discovery"].get_endpoints()
     body = {
         "status": "ok" if not problems else "unhealthy",
@@ -98,7 +103,29 @@ async def health(request: web.Request) -> web.Response:
     disagg = state.get("disagg")
     if disagg is not None:
         body["prefill_pool"] = disagg.pool_snapshot()
+    # firing burn-rate alerts ride on /health so a probe (or a human
+    # with curl) sees SLO burn without knowing about /alerts — but
+    # they do NOT flip status: a burning SLO is the fleet's problem
+    # to diagnose (docs/runbooks.md), not this process being sick
+    slo = state.get("slo")
+    if slo is not None:
+        # probes arrive faster than alert states can change; serve the
+        # eval task's result when it is under half an interval old
+        slo.evaluate(max_age_s=0.5)
+        body["firing_alerts"] = slo.firing()
     return web.json_response(body, status=200 if not problems else 503)
+
+
+async def alerts(request: web.Request) -> web.Response:
+    """GET /alerts: the SLO engine's full state — per-SLO good/bad
+    counts and burn rates for every window, plus the alert state
+    machine (pending/firing/resolved, fire counts, runbook anchors).
+    The read evaluates first, so a poll always sees current states."""
+    slo = request.app["state"].get("slo")
+    if slo is None:
+        return web.json_response(
+            {"enabled": False, "slos": [], "alerts": [], "firing": []})
+    return web.json_response({"enabled": True, **slo.snapshot()})
 
 
 async def admin_drain(request: web.Request) -> web.Response:
@@ -181,6 +208,8 @@ async def metrics(request: web.Request) -> web.Response:
     state["metrics"].refresh_routing(state["router"])
     if disagg is not None:
         state["metrics"].refresh_disagg(disagg)
+    if state.get("slo") is not None:
+        state["metrics"].refresh_slo(state["slo"])
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
 
@@ -309,6 +338,22 @@ def build_app(args: argparse.Namespace) -> web.Application:
                     "decode selection %s", len(disagg.endpoints),
                     "on" if disagg.selector is not None else "off")
 
+    # SLO engine (slo.py): good/bad accounting fed by the proxy's
+    # completion path + the /load scraper, burn-rate alert evaluation
+    # on a short interval task, surfaced on GET /alerts, /health, and
+    # /metrics. On by default — the firedrill overhead guard holds the
+    # r7 band with accounting enabled — and declarative: --slo-config
+    # swaps the objective set, --slo-window-scale shrinks every window
+    # for drills
+    if not args.no_slo:
+        if args.slo_config:
+            slo_cfg = SLOConfig.from_file(args.slo_config)
+        else:
+            slo_cfg = default_config(
+                window_scale=args.slo_window_scale,
+                min_events=args.slo_min_events)
+        state["slo"] = SLOEngine(slo_cfg)
+
     # indirect through state so dynamic-config discovery swaps are followed
     state["scraper"] = EngineStatsScraper(
         lambda: state["discovery"].get_endpoints(),
@@ -331,6 +376,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/debug/traces",
                        debug_traces_handler(lambda: state["tracer"]))
+    app.router.add_get("/alerts", alerts)
     app.router.add_post("/admin/drain", admin_drain)
 
     if args.enable_files_api or args.enable_batch_api:
@@ -349,6 +395,11 @@ def build_app(args: argparse.Namespace) -> web.Application:
             interval_s=args.log_stats_interval,
             health_tracker=state["health"])
 
+    if "slo" in state:
+        state["slo_task"] = SLOTask(
+            state["slo"], scraper_get=lambda: state["scraper"].get(),
+            interval_s=args.slo_eval_interval)
+
     async def on_startup(app):
         state["client"] = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=0))
@@ -359,8 +410,12 @@ def build_app(args: argparse.Namespace) -> web.Application:
             await state["stat_logger"].start()
         if "config_watcher" in state:
             await state["config_watcher"].start()
+        if "slo_task" in state:
+            await state["slo_task"].start()
 
     async def on_cleanup(app):
+        if "slo_task" in state:
+            await state["slo_task"].close()
         if "stat_logger" in state:
             await state["stat_logger"].close()
         if "config_watcher" in state:
@@ -539,6 +594,27 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "enters the trace ring (phase histograms always "
                         "record; an inbound sampled traceparent flag "
                         "wins either way)")
+    p.add_argument("--no-slo", action="store_true",
+                   help="disable the in-process SLO engine (burn-rate "
+                        "accounting, /alerts, tpu:slo_* families)")
+    p.add_argument("--slo-config", default=None,
+                   help="SLO definition JSON file (slo.SLOConfig "
+                        "shape: objectives, window_scale, min_events); "
+                        "default: the built-in objective set")
+    p.add_argument("--slo-window-scale", type=float, default=1.0,
+                   help="multiply every burn-rate window and alert "
+                        "hold duration (labels stay canonical; the "
+                        "firedrill rig's lever — ignored when "
+                        "--slo-config provides its own scale)")
+    p.add_argument("--slo-min-events", type=int, default=12,
+                   help="volume floor both windows of an alert must "
+                        "hold before its condition can be true (one "
+                        "bad request against an empty window must "
+                        "never page)")
+    p.add_argument("--slo-eval-interval", type=float, default=1.0,
+                   help="seconds between alert-state evaluation ticks "
+                        "(also pulls fresh /load samples into the "
+                        "signal SLOs)")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

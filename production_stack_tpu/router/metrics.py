@@ -149,6 +149,35 @@ class RouterMetrics:
             "Decode selections deferred to the routing policy "
             "(cold prefix)", registry=self.registry)
         self._disagg_last: dict = {}
+        # SLO surface (production_stack_tpu/slo.py): burn rates per
+        # (slo, window) — the series the generated Prometheus rules in
+        # observability/alert-rules.yaml alert over, so in-process and
+        # cluster alerting read the same accounting — plus the alert
+        # state machine and firing transitions (delta-synced real
+        # counter). Refreshed at scrape (refresh_slo), like every
+        # other family.
+        self.slo_burn = Gauge(
+            "tpu:slo_burn_rate",
+            "Error-budget burn rate per SLO and window (bad fraction "
+            "over the window / error budget; docs/observability.md "
+            "'SLOs and alerting')",
+            ["slo", "window"], registry=self.registry)
+        self.slo_events = Gauge(
+            "tpu:slo_window_events",
+            "Good+bad events per SLO window — the volume floor the "
+            "generated alert rules gate on, mirroring the in-process "
+            "min_events gate",
+            ["slo", "window"], registry=self.registry)
+        self.alert_state = Gauge(
+            "tpu:alert_state",
+            "Burn-rate alert state (0 inactive/resolved, 1 pending, "
+            "2 firing; diagnosis steps in docs/runbooks.md)",
+            ["alert"], registry=self.registry)
+        self.alerts_fired = Counter(
+            "tpu:alerts_fired",
+            "Alert firing transitions (pending -> firing)",
+            ["alert"], registry=self.registry)
+        self._alerts_fired_last: dict = {}
         # PII surface (reference: pii/middleware.py:20-39 counters)
         self.pii_scanned = plain("vllm:pii_requests_scanned",
                                  "Requests scanned for PII")
@@ -290,6 +319,32 @@ class RouterMetrics:
         would swallow its first increments whenever they happen to
         pass the old totals between scrapes."""
         self._disagg_last = {}
+
+    def refresh_slo(self, slo_engine) -> None:
+        """Export the SLO engine's burn rates and alert states (a
+        scrape re-evaluates unless the eval task's last pass is under
+        half a second old — states cannot move faster). Fired counts are
+        delta-synced real counters; the (slo, window) and (alert)
+        label sets are fixed by the SLO config, so there is nothing to
+        evict."""
+        from production_stack_tpu.slo import STATE_CODE
+        slo_engine.evaluate(max_age_s=0.5)
+        for slo_name, windows in slo_engine.burns.items():
+            for window, value in windows.items():
+                self.slo_burn.labels(slo=slo_name, window=window).set(
+                    value)
+        for slo_name, windows in slo_engine.volumes.items():
+            for window, value in windows.items():
+                self.slo_events.labels(slo=slo_name, window=window).set(
+                    value)
+        for name, alert in slo_engine.alerts.items():
+            self.alert_state.labels(alert=name).set(
+                STATE_CODE[alert.state])
+            delta = alert.fired_total - \
+                self._alerts_fired_last.get(name, 0)
+            if delta > 0:
+                self.alerts_fired.labels(alert=name).inc(delta)
+            self._alerts_fired_last[name] = alert.fired_total
 
     def refresh_semantic_cache(self, cache) -> None:
         self.semantic_hits.set(cache.hits)

@@ -190,6 +190,30 @@ def _shed_response(status: int, message: str,
 TRACE_ID_HEADER = "x-trace-id"
 
 
+def _slo_observe(state, endpoint_path: str, request: web.Request,
+                 resp: Optional[web.StreamResponse], trace,
+                 final_status: str = "ok") -> None:
+    """Feed the SLO engine one finished request — a handful of bucket
+    increments, taken from state already at hand (the response and the
+    trace's phase spans). Client disconnects are skipped entirely: the
+    caller vanished, so neither availability nor latency was observed
+    by anyone."""
+    slo = state.get("slo")
+    if slo is None or resp is None or final_status == "client_disconnect":
+        return
+    t0 = trace.t0
+    ttft = None
+    for name, kind, start, dur, _status, _attrs in trace.spans:
+        if kind == "phase" and name == "backend_ttfb" \
+                and start is not None:
+            ttft = start + dur - t0
+            break
+    slo.observe_response(endpoint_path, request.headers, resp.status,
+                         resp.headers, ttft_s=ttft,
+                         e2e_s=time.monotonic() - t0,
+                         truncated=(final_status == "truncated"))
+
+
 def _finish_trace(state, trace, status: str) -> None:
     """Seal the request trace into the ring and fold its phase spans
     into the tpu:request_phase_seconds histograms — ONE pass at request
@@ -223,12 +247,24 @@ async def route_general_request(request: web.Request,
                  f"requests already in flight (--max-inflight "
                  f"{max_inflight}); retry later")
         resp.headers[TRACE_ID_HEADER] = trace.trace_id
+        _slo_observe(state, endpoint_path, request, resp, trace)
         _finish_trace(state, trace, "shed")
         return resp
     state["proxied_inflight"] += 1
     try:
         resp = await _proxy_request(request, endpoint_path, trace)
-    except BaseException:
+    except BaseException as e:
+        if not isinstance(e, asyncio.CancelledError):
+            # an escaped handler exception becomes aiohttp's own 500 —
+            # client-visible, so it must burn availability like any
+            # other 5xx (a router-side bug 500ing every request is
+            # exactly the outage class the SLO engine exists to catch);
+            # cancellation is the client disconnecting, observed by
+            # nobody
+            slo = state.get("slo")
+            if slo is not None:
+                slo.observe_response(endpoint_path, request.headers,
+                                     500, None)
         _finish_trace(state, trace, "exception")
         raise
     finally:
@@ -241,6 +277,7 @@ async def route_general_request(request: web.Request,
     status = trace.attrs.get("final_status", "ok")
     if status == "ok" and resp is not None and resp.status >= 400:
         status = f"http_{resp.status}"
+    _slo_observe(state, endpoint_path, request, resp, trace, status)
     _finish_trace(state, trace, status)
     return resp
 

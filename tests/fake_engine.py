@@ -40,6 +40,15 @@ health probes fail along with inference (a fully-dead engine); the
 default ``"inference"`` scope keeps probes answering (a sick engine
 that still looks alive to discovery).
 
+Partial error injection (the SLO firedrill's lever): ``POST /fault``
+accepts an ``error_rate`` key — a fraction [0, 1] of inference
+requests answered HTTP 500, drawn from a seeded RNG per request. Unlike
+the all-or-nothing ``error`` mode, a partial rate breaches an
+availability SLO *gradually* without tripping the router's r8 breaker
+(no long consecutive-failure runs, windowed rate below the trip
+fraction at moderate settings). Runtime-adjustable, independent of the
+active fault mode; ``null`` (or a mode-clearing POST) resets it.
+
 Load-signal overrides (the autoscaler's lever): ``POST /fault`` also
 accepts ``capacity`` and ``queue_delay_ms`` keys — runtime-settable
 advertised capacity (``tpu:engine_capacity_seqs`` + /load
@@ -169,6 +178,13 @@ class FakeEngine:
         # capacity and reported queue delay, None = not overridden
         self.capacity_override: Optional[float] = None
         self.queue_delay_override: Optional[float] = None
+        # partial error injection (POST /fault {"error_rate": 0.3}):
+        # that fraction of inference requests answers 500, seeded RNG
+        # so runs are reproducible; independent of the fault mode
+        self.error_rate: float = 0.0
+        self.errors_injected = 0
+        import random as _random
+        self._error_rng = _random.Random(0xE44)
         # engine-side tracing (production_stack_tpu/tracing.py): the
         # fake continues an inbound traceparent (echoing the router's
         # trace id on x-trace-id) and records a minimal span set —
@@ -461,6 +477,10 @@ class FakeEngine:
             # must not clobber a gauge a test set directly
             self.gauges["tpu:est_queue_delay_ms"] = \
                 self.queue_delay_override or 0.0
+        if "error_rate" in body:
+            v = body["error_rate"]
+            self.error_rate = 0.0 if v is None else \
+                min(1.0, max(0.0, float(v)))
         if self.capacity_override is not None:
             self.gauges["tpu:engine_capacity_seqs"] = \
                 self.capacity_override
@@ -468,22 +488,30 @@ class FakeEngine:
     async def set_fault(self, request: web.Request) -> web.Response:
         """POST /fault {"mode": "error", "count": 5, "arg": 1.0,
         "scope": "all"} — mode null/absent clears. ``capacity`` /
-        ``queue_delay_ms`` keys set load-signal overrides; a body with
-        ONLY those keys leaves the fault mode alone."""
+        ``queue_delay_ms`` / ``error_rate`` keys set runtime overrides;
+        a body with ONLY those keys leaves the fault mode alone."""
         body = await request.json()
         signal_only = bool(body) and set(body) <= {"capacity",
-                                                   "queue_delay_ms"}
+                                                   "queue_delay_ms",
+                                                   "error_rate"}
         if signal_only:
             self._apply_signal_overrides(body)
             return web.json_response(
                 {"fault": self.fault,
                  "capacity": self.capacity_override,
-                 "queue_delay_ms": self.queue_delay_override})
+                 "queue_delay_ms": self.queue_delay_override,
+                 "error_rate": self.error_rate})
         mode = body.get("mode")
         if mode is None:
+            # a mode-clearing POST also resets the partial error rate
+            # unless the body re-asserts one — "clear the fault" means
+            # the engine behaves again
             self.fault = None
+            if "error_rate" not in body:
+                self.error_rate = 0.0
             self._apply_signal_overrides(body)
-            return web.json_response({"fault": None})
+            return web.json_response({"fault": None,
+                                      "error_rate": self.error_rate})
         if mode not in FAULT_MODES:
             return web.json_response(
                 {"error": f"unknown fault mode {mode!r}; "
@@ -505,7 +533,20 @@ class FakeEngine:
 
     async def get_fault(self, request: web.Request) -> web.Response:
         return web.json_response({"fault": self.fault,
-                                  "faults_served": self.faults_served})
+                                  "faults_served": self.faults_served,
+                                  "error_rate": self.error_rate,
+                                  "errors_injected": self.errors_injected})
+
+    def _draw_partial_error(self) -> Optional[web.Response]:
+        """One RNG draw against the partial error_rate override."""
+        if self.error_rate <= 0 or \
+                self._error_rng.random() >= self.error_rate:
+            return None
+        self.errors_injected += 1
+        return web.json_response(
+            {"error": {"message": "injected partial error "
+                                  f"(rate {self.error_rate:g})",
+                       "type": "server_error"}}, status=500)
 
     async def chat(self, request: web.Request) -> web.StreamResponse:
         self.last_headers = dict(request.headers)
@@ -522,6 +563,11 @@ class FakeEngine:
                     faulted.headers["x-trace-id"] = trace.trace_id
                 self.tracer.finish(trace, f"fault:{fault['mode']}")
                 return faulted
+        injected = self._draw_partial_error()
+        if injected is not None:
+            injected.headers["x-trace-id"] = trace.trace_id
+            self.tracer.finish(trace, "fault:error_rate")
+            return injected
         # keep the exact wire bytes: the router's passthrough fast path
         # promises byte identity (tests/test_router_fastpath.py)
         self.last_raw = await request.read()
@@ -598,6 +644,9 @@ class FakeEngine:
             faulted = await self._apply_fault(request, fault)
             if faulted is not None:
                 return faulted
+        injected = self._draw_partial_error()
+        if injected is not None:
+            return injected
         trace = self.tracer.begin(request.headers.get("traceparent"),
                                   name="/v1/completions")
         t_pf = time.monotonic()
@@ -714,6 +763,10 @@ def main(argv=None) -> None:
                    choices=["inference", "all"],
                    help="'all' makes reset/error/stall hit /v1/models "
                         "(health probes) too")
+    p.add_argument("--error-rate", type=float, default=0.0,
+                   help="fraction of inference requests answered 500 "
+                        "(partial, seeded; also settable at runtime "
+                        "via POST /fault {\"error_rate\": f})")
     p.add_argument("--kv-remote-url", default=None,
                    help="tpukv://host:port — enable the shared-KV "
                         "simulation against a real cache server")
@@ -754,6 +807,8 @@ def main(argv=None) -> None:
                      prefill_decode_interference=args.
                      prefill_decode_interference,
                      trace_ring_entries=args.trace_ring_entries)
+    if args.error_rate:
+        eng.error_rate = min(1.0, max(0.0, args.error_rate))
     web.run_app(eng.build_app(), host=args.host, port=args.port,
                 print=None)
 

@@ -1,0 +1,226 @@
+"""SLO fire-drill rig (loadgen firedrill): contract units, the fake
+engine's partial error_rate lever, and the end-to-end smoke.
+
+Tiers:
+- contract units — drill_slo_config shape and firedrill_violations
+  over synthetic records (miss, false fire, non-resolution, baseline
+  5xx, control errors);
+- error_rate lever — POST /fault {"error_rate": f} injects partial
+  500s without touching the fault mode, clears with the mode;
+- rig — ONE-scenario subprocess smoke (real router + fake engines,
+  seconds-scale windows: clean baseline fires nothing, injected
+  partial 500s fire chat_availability_page and resolve). The full
+  five-scenario drill and the real-engine mode stay behind ``slow``
+  (tier-1 is a time-bounded budget; the committed FIREDRILL_r14.json
+  is produced by benchmarks/run_firedrill.sh).
+"""
+
+import asyncio
+import copy
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
+                                                    drill_slo_config,
+                                                    firedrill_violations,
+                                                    run_firedrill)
+from tests.fake_engine import FakeEngine
+
+
+# ------------------------------------------------------------ units
+
+def test_drill_slo_config_shape():
+    cfg = drill_slo_config(0.01, min_events=4, ttft_threshold_s=0.25)
+    assert cfg["window_scale"] == 0.01
+    assert cfg["min_events"] == 4
+    by_name = {s["name"]: s for s in cfg["slos"]}
+    assert by_name["chat_ttft"]["threshold_s"] == 0.25
+    assert by_name["rag_e2e"]["threshold_s"] == 10.0
+    # it must parse back through the router's config loader
+    from production_stack_tpu.slo import SLOConfig
+    parsed = SLOConfig.from_json(cfg)
+    assert parsed.window_scale == 0.01
+
+
+def _clean_record():
+    return {
+        "detail": {
+            "control_errors": [],
+            "baseline": {
+                "storm": {"launched": 100, "ok": 100, "http_5xx": 0,
+                          "http_4xx": 0, "shed": 0,
+                          "transport_errors": 0, "samples": []},
+                "alerts_fired": {}, "non_inactive": {},
+            },
+            "scenarios": [{
+                "name": "error_rate",
+                "expected_alert": "chat_availability_page",
+                "injected_ok": True, "cleared_ok": True,
+                "t_inject_s": 10.0, "detected_in_s": 3.0,
+                "firing_at_detect": ["chat_availability_page"],
+                "resolved_in_s": 5.0, "post_settle_quiet": True,
+                "fired_during": {"chat_availability_page": 1},
+                "false_fires": [],
+            }],
+            "detect_timeout_s": 20.0, "resolve_timeout_s": 20.0,
+            "final_firing": [],
+            "overhead_guard": None,
+        },
+    }
+
+
+def test_violations_clean_record_passes():
+    assert firedrill_violations(_clean_record()) == []
+
+
+def test_violations_catch_each_contract():
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["detected_in_s"] = None
+    assert any("missed detection" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["resolved_in_s"] = None
+    assert any("did not resolve" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["false_fires"] = ["shed_rate_page"]
+    assert any("false fires" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["baseline"]["storm"]["http_5xx"] = 2
+    assert any("baseline storm" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["baseline"]["alerts_fired"] = {"chat_ttft_page": 1}
+    assert any("false positives" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["control_errors"] = ["GET /alerts -> HTTP 500"]
+    assert any("control-plane" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["final_firing"] = ["chat_availability_ticket"]
+    assert any("still firing" in v for v in firedrill_violations(r))
+
+    r = _clean_record()
+    r["detail"]["overhead_guard"] = {"overhead_ratio": 3.0, "errors": 0,
+                                     "router_req_per_s": 1,
+                                     "direct_req_per_s": 3}
+    assert any("band" in v
+               for v in firedrill_violations(r, max_overhead_ratio=2.5))
+    assert firedrill_violations(r, max_overhead_ratio=None) == \
+        firedrill_violations(copy.deepcopy(r), max_overhead_ratio=None)
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError):
+        asyncio.run(run_firedrill(scenarios=["nope"]))
+
+
+# ------------------------------------------------------------ error_rate
+
+def test_fake_engine_partial_error_rate_lever():
+    async def body():
+        fake = FakeEngine(model="m")
+        server = TestServer(fake.build_app())
+        async with TestClient(server) as client:
+            # signal-only POST: sets the rate, leaves fault mode alone
+            r = await client.post("/fault", json={"error_rate": 1.0})
+            assert (await r.json())["error_rate"] == 1.0
+            assert fake.fault is None
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 500
+            r = await client.get("/fault")
+            info = await r.json()
+            assert info["errors_injected"] == 1
+            # a mode-clearing POST resets the rate too
+            r = await client.post("/fault", json={"mode": None})
+            assert (await r.json())["error_rate"] == 0.0
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "x"}]})
+            assert r.status == 200
+            # out-of-range rates clamp
+            await client.post("/fault", json={"error_rate": 7})
+            assert fake.error_rate == 1.0
+            await client.post("/fault", json={"error_rate": None})
+            assert fake.error_rate == 0.0
+    asyncio.run(body())
+
+
+def test_fake_engine_partial_rate_is_partial_and_seeded():
+    async def body():
+        fake = FakeEngine(model="m")
+        fake.error_rate = 0.5
+        server = TestServer(fake.build_app())
+        async with TestClient(server) as client:
+            statuses = []
+            for _ in range(40):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "x"}]})
+                statuses.append(r.status)
+        # partial: both outcomes present, roughly half errored
+        assert 8 <= statuses.count(500) <= 32
+        assert statuses.count(200) == 40 - statuses.count(500)
+        assert fake.errors_injected == statuses.count(500)
+    asyncio.run(body())
+
+
+# ------------------------------------------------------------ rig
+
+def _assert_drill_clean(record):
+    violations = firedrill_violations(record)
+    assert not violations, violations
+    d = record["detail"]
+    assert d["baseline"]["storm"]["ok"] > 0
+    for s in d["scenarios"]:
+        assert s["detected_in_s"] is not None
+        assert s["detected_in_s"] <= d["detect_timeout_s"]
+        assert s["resolved_in_s"] is not None
+        assert s["expected_alert"] in s["fired_during"]
+
+
+def test_firedrill_smoke_fake_engines(tmp_path):
+    """Tier-1 one-scenario smoke: clean baseline fires nothing, a
+    partial-500 burst fires chat_availability_page within the bound
+    and resolves after the fault clears (seconds-scale windows)."""
+    record = asyncio.run(run_firedrill(
+        engines=2, engine="fake", users=6,
+        baseline_s=4.0, window_scale=0.004,
+        scenarios=["error_rate"],
+        log_dir=str(tmp_path / "logs")))
+    _assert_drill_clean(record)
+    assert record["detail"]["scenarios"][0]["expected_alert"] == \
+        "chat_availability_page"
+
+
+@pytest.mark.slow
+def test_firedrill_full_fake_engines(tmp_path):
+    """All five scenarios, including the SIGKILL, overload-shed, and
+    signal-fed queue-delay paths (the committed-record shape)."""
+    record = asyncio.run(run_firedrill(
+        engines=2, engine="fake", users=8,
+        baseline_s=8.0, window_scale=0.01,
+        scenarios=list(SCENARIO_NAMES),
+        log_dir=str(tmp_path / "logs")))
+    _assert_drill_clean(record)
+    assert len(record["detail"]["scenarios"]) == len(SCENARIO_NAMES)
+
+
+@pytest.mark.slow
+def test_firedrill_real_engine_down(tmp_path):
+    """Real-engine mode: only the process-level scenario applies (the
+    rest drive the fake's /fault); a SIGKILLed debug-tiny must still
+    fire availability and resolve after the restart."""
+    record = asyncio.run(run_firedrill(
+        engines=2, engine="debug-tiny", users=4,
+        baseline_s=10.0, window_scale=0.02,
+        scenarios=["engine_down", "error_rate"],   # error_rate dropped
+        num_tokens=4, log_dir=str(tmp_path / "logs")))
+    d = record["detail"]
+    assert [s["name"] for s in d["scenarios"]] == ["engine_down"]
+    _assert_drill_clean(record)

@@ -85,6 +85,21 @@ def test_kube_prom_stack_values_parse():
         assert marker in open(os.path.join(tdir, svc)).read(), svc
 
 
+def test_alert_rules_in_sync_and_resolved():
+    """tools/check_alert_rules.py: observability/alert-rules.yaml must
+    byte-match a fresh compilation of the SLO definitions (one source
+    for in-process and cluster alerting), every metric an alert
+    references must be a registered family, and every alert's runbook
+    anchor must exist in docs/runbooks.md (also wired into ci.yml)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(OBS), "tools",
+                        "check_alert_rules.py")
+    spec = importlib.util.spec_from_file_location("check_alerts", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
 def test_every_registered_metric_is_documented():
     """tools/check_metrics_documented.py: each tpu:/vllm: family the
     code registers must have its line in docs/observability.md — a new

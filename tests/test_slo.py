@@ -1,0 +1,490 @@
+"""In-process SLO engine (slo.py): burn-rate math, the alert state
+machine, the router surface, and the decision-log annotation.
+
+Tiers:
+- window/burn units — RollingCounts edge semantics, empty windows,
+  injected clocks;
+- state machine — pending flap, for_s hold, resolve hysteresis,
+  refire-from-resolved;
+- classification — shed vs availability vs latency, per-class
+  filtering, the min_events volume floor, /load signal dedup;
+- router surface — /alerts payload, /health annotation, and the
+  tpu:slo_* exposition against a real router app + FakeEngine;
+- autoscaler — firing alerts annotate the decision record;
+- rules — compile_prometheus_rules shape and the committed
+  alert-rules.yaml sync (tools/check_alert_rules.py runs in
+  tests/test_observability.py next to the metrics-doc check).
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu import slo as slo_mod
+from production_stack_tpu.slo import (ALERT_PAIRS, FIRING, INACTIVE,
+                                      PENDING, RESOLVED, WINDOWS,
+                                      AlertRule, AlertState,
+                                      RollingCounts, SLOConfig, SLODef,
+                                      SLOEngine, burn_rate,
+                                      classify_request,
+                                      compile_prometheus_rules,
+                                      default_config)
+
+
+# ------------------------------------------------------------ windows
+
+def test_rolling_counts_window_edges():
+    rc = RollingCounts(horizon_s=100.0, bucket_s=1.0)
+    rc.add(1, 0, now=10.0)
+    rc.add(0, 1, now=20.0)
+    rc.add(1, 0, now=30.0)
+    # read at t=30: a 10s window covers (20, 30] — the t=20 bucket
+    # overlaps the edge (one-bucket resolution), t=10 is out
+    assert rc.counts(10.0, now=30.0) == (1, 1)
+    assert rc.counts(5.0, now=30.0) == (1, 0)
+    assert rc.counts(100.0, now=30.0) == (2, 1)
+    # far future: everything expired
+    assert rc.counts(10.0, now=500.0) == (0, 0)
+
+
+def test_rolling_counts_empty_and_bucket_merge():
+    rc = RollingCounts(horizon_s=50.0, bucket_s=1.0)
+    assert rc.counts(10.0, now=0.0) == (0, 0)
+    # same-bucket adds merge instead of appending
+    rc.add(1, 0, now=5.1)
+    rc.add(2, 3, now=5.9)
+    assert len(rc._buckets) == 1
+    assert rc.counts(10.0, now=6.0) == (3, 3)
+
+
+def test_rolling_counts_trims_to_horizon():
+    rc = RollingCounts(horizon_s=10.0, bucket_s=1.0)
+    for t in range(100):
+        rc.add(1, 0, now=float(t))
+    assert len(rc._buckets) <= 12
+    good, bad = rc.counts(10.0, now=99.0)
+    assert good <= 12
+
+
+def test_burn_rate_math():
+    assert burn_rate(0, 0, 0.01) == 0.0          # empty window
+    assert burn_rate(100, 0, 0.01) == 0.0
+    assert burn_rate(0, 100, 0.01) == pytest.approx(100.0)
+    assert burn_rate(99, 1, 0.01) == pytest.approx(1.0)   # on budget
+    assert burn_rate(50, 50, 0.01) == pytest.approx(50.0)
+
+
+# ------------------------------------------------------------ state machine
+
+def _rule(for_s=10.0, resolve_s=5.0):
+    return AlertRule(name="x_page", slo="x", severity="page",
+                     short_window="5m", long_window="1h",
+                     burn_threshold=14.4, for_s=for_s,
+                     resolve_s=resolve_s)
+
+
+def test_alert_pending_flap_never_fires():
+    a = AlertState(_rule(for_s=10.0))
+    assert a.evaluate(True, 0.0) == PENDING
+    assert a.evaluate(True, 5.0) == PENDING
+    assert a.evaluate(False, 6.0) == INACTIVE      # flap
+    assert a.fired_total == 0
+    assert a.pending_since is None
+
+
+def test_alert_fires_after_hold_and_resolves_with_hysteresis():
+    a = AlertState(_rule(for_s=10.0, resolve_s=5.0))
+    a.evaluate(True, 0.0)
+    assert a.evaluate(True, 10.0) == FIRING
+    assert a.fired_total == 1
+    # a brief clear shorter than resolve_s must NOT resolve
+    assert a.evaluate(False, 12.0) == FIRING
+    assert a.evaluate(True, 14.0) == FIRING        # clear_since resets
+    assert a.evaluate(False, 20.0) == FIRING
+    assert a.evaluate(False, 24.0) == FIRING       # 4s clear < 5s
+    assert a.evaluate(False, 25.5) == RESOLVED
+    assert a.resolved_at == 25.5
+    # refire from resolved goes through pending again
+    assert a.evaluate(True, 30.0) == PENDING
+    assert a.evaluate(True, 40.0) == FIRING
+    assert a.fired_total == 2
+
+
+def test_alert_for_s_zero_fires_immediately():
+    a = AlertState(_rule(for_s=0.0))
+    assert a.evaluate(True, 1.0) == FIRING
+
+
+# ------------------------------------------------------------ classification
+
+class _H(dict):
+    """Case-literal header stand-in (real aiohttp headers are
+    CIMultiDict; the engine only .get()s)."""
+
+
+def _engine(scale=0.001, min_events=2, **cfg_kw):
+    return SLOEngine(default_config(window_scale=scale,
+                                    min_events=min_events, **cfg_kw))
+
+
+def test_classify_header_wins_over_path():
+    assert classify_request("/v1/chat/completions", _H()) == "chat"
+    assert classify_request("/v1/embeddings", _H()) == "embeddings"
+    assert classify_request("/v1/chat/completions",
+                            _H({"x-slo-class": "rag"})) == "rag"
+    assert classify_request("/weird", _H()) == "other"
+
+
+def test_observe_availability_and_shed_separation():
+    e = _engine()
+    now = 100.0
+    e.observe_response("/v1/chat/completions", _H(), 200, {}, now=now)
+    e.observe_response("/v1/chat/completions", _H(), 502, {}, now=now)
+    # shed shapes: never availability-bad, always shed-bad
+    e.observe_response("/v1/chat/completions", _H(), 503,
+                       {"Retry-After": "1"}, now=now)
+    e.observe_response("/v1/chat/completions", _H(), 429,
+                       {"Retry-After": "1"}, now=now)
+    e.observe_response("/v1/chat/completions", _H(), 504,
+                       {"x-deadline-expired": "1"}, now=now)
+    assert e.window_counts("chat_availability", "5m", now) == (1, 1)
+    assert e.window_counts("shed_rate", "5m", now) == (2, 3)
+    # a non-shed 504 (router timeout) IS an availability failure
+    e.observe_response("/v1/chat/completions", _H(), 504, {}, now=now)
+    assert e.window_counts("chat_availability", "5m", now) == (1, 2)
+
+
+def test_observe_latency_threshold_and_class_filter():
+    e = _engine()
+    now = 50.0
+    e.observe_response("/v1/chat/completions", _H(), 200, {},
+                       ttft_s=0.5, e2e_s=1.0, now=now)
+    e.observe_response("/v1/chat/completions", _H(), 200, {},
+                       ttft_s=3.0, e2e_s=4.0, now=now)
+    assert e.window_counts("chat_ttft", "5m", now) == (1, 1)
+    # rag-class events land on rag SLOs only
+    e.observe_response("/v1/chat/completions",
+                       _H({"x-slo-class": "rag"}), 200, {},
+                       ttft_s=3.0, e2e_s=40.0, now=now)
+    assert e.window_counts("chat_ttft", "5m", now) == (1, 1)
+    assert e.window_counts("rag_e2e", "5m", now) == (0, 1)
+    # truncated stream: availability-bad, no latency sample
+    e.observe_response("/v1/chat/completions", _H(), 200, {},
+                       ttft_s=0.1, e2e_s=0.2, truncated=True, now=now)
+    assert e.window_counts("chat_availability", "5m", now) == (2, 1)
+    assert e.window_counts("chat_ttft", "5m", now) == (1, 1)
+    # 4xx: availability-good, never a latency sample
+    e.observe_response("/v1/chat/completions", _H(), 400, {},
+                       ttft_s=9.0, e2e_s=9.0, now=now)
+    assert e.window_counts("chat_ttft", "5m", now) == (1, 1)
+
+
+def test_min_events_floor_blocks_thin_traffic():
+    e = _engine(min_events=10)
+    now = 10.0
+    for _ in range(5):
+        e.observe_response("/v1/chat/completions", _H(), 500, {},
+                           now=now)
+    e.evaluate(now + 0.01)
+    # 100% bad, but 5 < 10 events: condition must stay false
+    assert e.alerts["chat_availability_page"].state == INACTIVE
+    for _ in range(5):
+        e.observe_response("/v1/chat/completions", _H(), 500, {},
+                           now=now)
+    e.evaluate(now + 0.02)
+    assert e.alerts["chat_availability_page"].state == PENDING
+
+
+def test_engine_fires_and_resolves_with_injected_clock():
+    e = _engine(scale=0.01, min_events=2)   # for_s page = 1.2s
+    now = 1000.0
+    for i in range(40):
+        e.observe_response("/v1/chat/completions", _H(), 500, {},
+                           now=now + i * 0.01)
+    assert e.evaluate(now + 0.5) == []      # pending, inside for_s
+    assert e.alerts["chat_availability_page"].state == PENDING
+    firing = e.evaluate(now + 2.0)
+    assert "chat_availability_page" in firing
+    assert e.fired_totals()["chat_availability_page"] == 1
+    # good traffic flushes the short (3 s) window; resolve_s = 0.6 s
+    for i in range(40):
+        e.observe_response("/v1/chat/completions", _H(), 200, {},
+                           now=now + 4.0 + i * 0.01)
+    e.evaluate(now + 8.0)
+    e.evaluate(now + 9.0)
+    assert e.alerts["chat_availability_page"].state == RESOLVED
+    assert "chat_availability_page" not in e.firing()
+    # the ticket pair's 30m short window (18 s scaled) still holds the
+    # burst — it resolves later through the same machinery (one tick
+    # starts the clear clock, a second past resolve_s resolves)
+    e.evaluate(now + 30.0)
+    e.evaluate(now + 32.0)
+    assert e.firing() == []
+
+
+def test_ingest_engine_loads_dedup_and_eviction():
+    class _Rec:
+        def __init__(self, delay, at):
+            self.est_queue_delay_ms = delay
+            self.scraped_at = at
+
+    e = _engine(scale=1.0)
+    now = 10.0
+    stats = {"http://e1": _Rec(100.0, 1.0), "http://e2": _Rec(9999.0, 1.0)}
+    assert e.ingest_engine_loads(stats, now=now) == 2
+    # same scrape read again: no new samples
+    assert e.ingest_engine_loads(stats, now=now + 1) == 0
+    assert e.window_counts("engine_queue_delay", "5m", now + 1) == (1, 1)
+    # fresh scrape timestamp: counted once more
+    stats["http://e1"] = _Rec(100.0, 2.0)
+    assert e.ingest_engine_loads(stats, now=now + 2) == 1
+    # a departed engine drops its dedup entry
+    del stats["http://e2"]
+    e.ingest_engine_loads(stats, now=now + 3)
+    assert "http://e2" not in e._last_scrape
+
+
+# ------------------------------------------------------------ config
+
+def test_config_validation_errors():
+    with pytest.raises(ValueError):
+        SLODef("x", "nope", 0.99).validate()
+    with pytest.raises(ValueError):
+        SLODef("x", "availability", 1.0).validate()
+    with pytest.raises(ValueError):
+        SLODef("x", "latency", 0.99, metric="ttft").validate()
+    with pytest.raises(ValueError):
+        SLODef("x", "signal", 0.99, metric="est_queue_delay_ms"
+               ).validate()
+    with pytest.raises(ValueError):
+        SLOConfig(slos=[SLODef("a", "availability", 0.9),
+                        SLODef("a", "availability", 0.9)]).validate()
+    with pytest.raises(ValueError):
+        SLOConfig(window_scale=0.0).validate()
+
+
+def test_config_roundtrip_and_window_scale():
+    cfg = default_config(window_scale=0.5)
+    again = SLOConfig.from_json(
+        {"window_scale": 0.5, "min_events": 12,
+         "slos": [s.to_json() for s in cfg.slos]})
+    assert [s.name for s in again.slos] == [s.name for s in cfg.slos]
+    assert again.window_s("5m") == 150.0
+    assert again.horizon_s == WINDOWS["6h"] * 0.5
+
+
+# ------------------------------------------------------------ rules
+
+def test_compile_prometheus_rules_shape():
+    doc = compile_prometheus_rules()
+    rules = doc["groups"][0]["rules"]
+    cfg = default_config()
+    assert len(rules) == len(cfg.slos) * len(ALERT_PAIRS)
+    by_name = {r["alert"]: r for r in rules}
+    page = by_name["chat_availability_page"]
+    assert 'window="5m"' in page["expr"] and 'window="1h"' in page["expr"]
+    assert "tpu:slo_burn_rate" in page["expr"]
+    assert page["for"] == "120s"           # canonical, never scaled
+    assert page["labels"] == {"severity": "page",
+                              "slo": "chat_availability"}
+    assert page["annotations"]["runbook"] == \
+        "docs/runbooks.md#chat_availability_page"
+    ticket = by_name["shed_rate_ticket"]
+    assert 'window="30m"' in ticket["expr"] \
+        and 'window="6h"' in ticket["expr"]
+    assert ticket["labels"]["severity"] == "ticket"
+
+
+# ------------------------------------------------------------ router surface
+
+def test_router_alerts_endpoint_metrics_and_health():
+    from production_stack_tpu.router.app import build_app, parse_args
+    from tests.fake_engine import FakeEngine
+
+    async def body():
+        fake = FakeEngine(model="m")
+        fs = TestServer(fake.build_app())
+        await fs.start_server()
+        url = f"http://127.0.0.1:{fs.port}"
+        args = parse_args(
+            ["--service-discovery", "static",
+             "--static-backends", url, "--static-models", "m",
+             "--slo-window-scale", "0.01", "--slo-min-events", "2",
+             "--slo-eval-interval", "0.1",
+             # the drill posture: injected 5xx must reach the client,
+             # not the breaker
+             "--failover-attempts", "1",
+             "--breaker-threshold", "1000000",
+             "--breaker-failure-rate", "1.01",
+             "--engine-stats-interval", "0.2"])
+        app = build_app(args)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/alerts")
+            payload = await r.json()
+            assert payload["enabled"] is True
+            assert payload["window_scale"] == 0.01
+            assert {s["name"] for s in payload["slos"]} >= \
+                {"chat_availability", "shed_rate", "engine_queue_delay"}
+            assert payload["firing"] == []
+
+            # clean request, then a 100%-error burst
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "hi"}]})
+            assert r.status == 200
+            fake.error_rate = 1.0
+            for _ in range(20):
+                await client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": "hi"}]})
+            # for_s at scale 0.01 is 1.2 s; the 0.1 s eval task steps
+            # pending -> firing
+            await asyncio.sleep(1.6)
+            r = await client.get("/alerts")
+            payload = await r.json()
+            assert "chat_availability_page" in payload["firing"]
+            row = {a["name"]: a for a in payload["alerts"]}[
+                "chat_availability_page"]
+            assert row["state"] == "firing"
+            assert row["fired_total"] == 1
+            assert row["runbook"] == \
+                "docs/runbooks.md#chat_availability_page"
+
+            r = await client.get("/health")
+            health = await r.json()
+            # the ticket pair may join on a slow machine (its 3 s
+            # scaled for_s): assert membership, not the exact set
+            assert "chat_availability_page" in health["firing_alerts"]
+            assert health["status"] == "ok"    # burn is not sickness
+
+            r = await client.get("/metrics")
+            text = await r.text()
+            assert 'tpu:slo_burn_rate{slo="chat_availability",' \
+                   'window="5m"}' in text
+            assert 'tpu:alert_state{alert="chat_availability_page"}' \
+                   ' 2.0' in text
+            assert 'tpu:alerts_fired_total{' \
+                   'alert="chat_availability_page"} 1.0' in text
+        await fs.close()
+    asyncio.run(body())
+
+
+def test_router_no_slo_flag_disables_surface():
+    from production_stack_tpu.router.app import build_app, parse_args
+    from tests.fake_engine import FakeEngine
+
+    async def body():
+        fake = FakeEngine(model="m")
+        fs = TestServer(fake.build_app())
+        await fs.start_server()
+        args = parse_args(
+            ["--service-discovery", "static",
+             "--static-backends", f"http://127.0.0.1:{fs.port}",
+             "--static-models", "m", "--no-slo"])
+        app = build_app(args)
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/alerts")
+            assert (await r.json())["enabled"] is False
+            r = await client.get("/health")
+            assert "firing_alerts" not in await r.json()
+            r = await client.get("/metrics")
+            assert "tpu:slo_burn_rate{" not in await r.text()
+        await fs.close()
+    asyncio.run(body())
+
+
+# ------------------------------------------------------------ autoscaler
+
+def test_autoscaler_decision_log_annotated_with_firing_alerts(tmp_path):
+    from production_stack_tpu.autoscaler.controller import Autoscaler
+    from production_stack_tpu.autoscaler.policy import (AutoscalerPolicy,
+                                                        PolicyConfig)
+
+    class _Collector:
+        async def start(self):
+            pass
+
+        async def close(self):
+            pass
+
+        async def collect(self, replicas):
+            from production_stack_tpu.autoscaler.policy import \
+                FleetSignal
+            return FleetSignal(replicas=replicas, ready=replicas,
+                               in_flight=0.0, capacity=10.0,
+                               queue_delay_ms=0.0)
+
+        def per_engine(self):
+            return {}
+
+    class _Actuator:
+        replicas = 1
+
+        async def apply(self, target, victims=None):
+            pass
+
+        def endpoint_urls(self):
+            return []
+
+        def draining_urls(self):
+            return []
+
+    firing: list = []
+
+    async def fetch_alerts():
+        if firing is None:
+            raise RuntimeError("router down")
+        return list(firing)
+
+    async def body():
+        import json as _json
+        log = str(tmp_path / "decisions.jsonl")
+        scaler = Autoscaler(
+            AutoscalerPolicy(PolicyConfig(min_replicas=1,
+                                          max_replicas=2)),
+            _Actuator(), _Collector(), decision_log_path=log,
+            alerts_fetch=fetch_alerts)
+        r1 = await scaler.tick(now=0.0)
+        assert "alerts_firing" not in r1       # nothing firing: no key
+        firing.append("shed_rate_page")
+        r2 = await scaler.tick(now=1.0)
+        assert r2["alerts_firing"] == ["shed_rate_page"]
+        lines = [_json.loads(ln)
+                 for ln in open(log).read().splitlines()]
+        assert "alerts_firing" not in lines[0]
+        assert lines[1]["alerts_firing"] == ["shed_rate_page"]
+
+        # a failing fetch skips annotation, never breaks the tick
+        scaler._alerts_fetch = None
+        scaler2 = Autoscaler(
+            AutoscalerPolicy(PolicyConfig(min_replicas=1,
+                                          max_replicas=2)),
+            _Actuator(), _Collector(),
+            alerts_fetch=lambda: (_ for _ in ()).throw(
+                RuntimeError("boom")))
+        r3 = await scaler2.tick(now=0.0)
+        assert "alerts_firing" not in r3
+    asyncio.run(body())
+
+
+# ------------------------------------------------------------ slo task
+
+def test_slo_task_ticks_and_ingests():
+    class _Rec:
+        est_queue_delay_ms = 9999.0
+        scraped_at = 1.0
+
+    eng = _engine(min_events=1)
+    task = slo_mod.SLOTask(eng, scraper_get=lambda: {"u": _Rec()},
+                           interval_s=0.01)
+
+    async def body():
+        await task.start()
+        assert task.healthy()
+        await asyncio.sleep(0.1)
+        await task.close()
+        assert not task.healthy()
+    asyncio.run(body())
+    good, bad = eng.window_counts("engine_queue_delay", "5m")
+    assert (good, bad) == (0, 1)       # one scrape, deduped across ticks

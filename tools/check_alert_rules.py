@@ -1,0 +1,114 @@
+#!/usr/bin/env python3
+"""Lint: the generated alert rules must resolve and stay in sync.
+
+Three contracts over ``observability/alert-rules.yaml`` (wired into
+the ci.yml lint job next to check_metrics_documented.py, and into
+tier-1 via tests/test_observability.py):
+
+1. **No drift** — the committed file must byte-match a fresh
+   ``tools/gen_alert_rules.py`` compilation of the SLO definitions in
+   ``production_stack_tpu/slo.py`` (the in-process engine and the
+   cluster rules share one source).
+2. **Metrics resolve** — every ``tpu:``/``vllm:`` family an alert
+   expression references must be a family the code actually registers
+   (same literal scan as check_metrics_documented.py): a renamed
+   gauge cannot leave a rule silently matching nothing.
+3. **Runbooks exist** — every alert must carry a ``runbook``
+   annotation pointing at a ``docs/runbooks.md`` anchor whose heading
+   exists: an alert that fires at 3am must come with its diagnosis
+   steps.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RULES = REPO / "observability" / "alert-rules.yaml"
+RUNBOOKS = REPO / "docs" / "runbooks.md"
+
+METRIC_RE = re.compile(r"((?:tpu|vllm):[a-z][a-z0-9_]*)")
+
+
+def _registered_metrics() -> set:
+    import importlib.util
+    path = REPO / "tools" / "check_metrics_documented.py"
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.registered_metrics()
+
+
+def _runbook_anchors(text: str) -> set:
+    """GitHub-style anchors of every heading in docs/runbooks.md."""
+    anchors = set()
+    for m in re.finditer(r"^#+\s+(.+?)\s*$", text, re.M):
+        title = m.group(1).strip().lower()
+        anchors.add(re.sub(r"[^a-z0-9_\- ]", "", title)
+                    .replace(" ", "-"))
+    return anchors
+
+
+def main() -> int:
+    problems = []
+
+    sys.path.insert(0, str(REPO / "tools"))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "gen_alert_rules", REPO / "tools" / "gen_alert_rules.py")
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+    expected = gen.render()
+    if not RULES.exists():
+        problems.append(f"{RULES} is missing — run "
+                        f"python tools/gen_alert_rules.py")
+    elif RULES.read_text() != expected:
+        problems.append(f"{RULES} drifted from slo.py — run "
+                        f"python tools/gen_alert_rules.py")
+
+    import yaml
+    doc = yaml.safe_load(RULES.read_text()) if RULES.exists() else None
+    registered = _registered_metrics()
+    runbook_text = RUNBOOKS.read_text() if RUNBOOKS.exists() else ""
+    anchors = _runbook_anchors(runbook_text)
+    if not RUNBOOKS.exists():
+        problems.append(f"{RUNBOOKS} is missing")
+
+    n_rules = 0
+    for group in (doc or {}).get("groups", []):
+        for rule in group.get("rules", []):
+            n_rules += 1
+            name = rule.get("alert", "?")
+            for metric in METRIC_RE.findall(rule.get("expr", "")):
+                base = re.sub(r"_(bucket|sum|count|total)$", "", metric)
+                if not {metric, base, metric + "_total",
+                        base + "_total"} & registered:
+                    problems.append(
+                        f"alert {name}: expr references unregistered "
+                        f"metric {metric}")
+            runbook = (rule.get("annotations") or {}).get("runbook", "")
+            m = re.fullmatch(r"docs/runbooks\.md#([a-z0-9_\-]+)",
+                             runbook)
+            if not m:
+                problems.append(
+                    f"alert {name}: runbook annotation {runbook!r} is "
+                    f"not a docs/runbooks.md#anchor link")
+            elif m.group(1) not in anchors:
+                problems.append(
+                    f"alert {name}: runbook anchor #{m.group(1)} has "
+                    f"no matching heading in docs/runbooks.md")
+    if doc is not None and n_rules == 0:
+        problems.append("alert-rules.yaml contains zero rules")
+
+    if problems:
+        print(f"{len(problems)} alert-rule problems:", file=sys.stderr)
+        for pr in problems:
+            print(f"  - {pr}", file=sys.stderr)
+        return 1
+    print(f"ok: {n_rules} alert rules in sync, all metrics registered, "
+          f"all runbook anchors present")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
