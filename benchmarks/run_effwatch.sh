@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Engine-efficiency accounting audit (docs/benchmarks.md "Engine
+# efficiency: effwatch"). Storms one real debug-tiny engine, scrapes
+# the /load perf block around the steady window, and exits 1 unless
+# token-step fractions sum to 1, accounted decode tokens/s reconciles
+# with client-measured throughput within 10%, and zero XLA compiles
+# land in the steady window. Pass --anti-vacuity to prove the gates
+# can fail. Extra args are forwarded.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m production_stack_tpu.loadgen effwatch \
+  --engine debug-tiny --users 6 --duration 20 --warmup 8 \
+  --num-tokens 32 "$@"

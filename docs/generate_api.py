@@ -33,6 +33,7 @@ MODULES = {
         "production_stack_tpu.engine.runner",
         "production_stack_tpu.engine.sampler",
         "production_stack_tpu.engine.block_manager",
+        "production_stack_tpu.engine.efficiency",
         "production_stack_tpu.engine.guided",
         "production_stack_tpu.engine.metrics",
         "production_stack_tpu.engine.tokenizer",

@@ -55,6 +55,25 @@ class BlockManager:
             collections.OrderedDict()
         self.hits = 0
         self.misses = 0
+        # fragmentation telemetry (plain ints — read at scrape time and
+        # on /debug/perf; docs/observability.md "Engine efficiency"):
+        # allocation failures split by WHY the pool refused. A request
+        # arriving at a pool with zero allocatable blocks hit true
+        # exhaustion; one refused while allocatable blocks remain
+        # (just fewer than it needs) hit the fragmentation regime —
+        # free capacity exists but is insufficient for this request,
+        # the admission-failure class fleet-level migration/defrag
+        # (ROADMAP item 3) exists to erase.
+        self.allocs = 0
+        self.blocks_allocated = 0
+        self.alloc_failures_exhausted = 0
+        self.alloc_failures_fragmented = 0
+        self.cache_evictions = 0
+        # optional occupancy observer (the engine wires this to the
+        # metrics layer's plain-int histogram): called with the pool
+        # usage fraction at every allocation attempt, so the histogram
+        # shows which occupancy regime allocations actually run in
+        self.on_alloc_occupancy = None
 
     # -- capacity --------------------------------------------------------
 
@@ -73,9 +92,37 @@ class BlockManager:
         return self.active_blocks / float(self.num_blocks - 1)
 
     @property
+    def free_blocks(self) -> int:
+        """Blocks on the free list (never-written or fully released)."""
+        return len(self._free)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 registered blocks (evictable prefix cache)."""
+        return len(self._evictable)
+
+    @property
     def hit_rate(self) -> float:
         total = self.hits + self.misses
         return self.hits / total if total else 0.0
+
+    def frag_report(self) -> dict:
+        """Point-in-time fragmentation view (plain-int reads, safe from
+        any thread): block-state census + allocation-failure
+        classification. The scrape-time sync (EngineMetrics.sync_kvpool)
+        and ``GET /debug/perf`` both serve exactly this dict."""
+        return {
+            "num_blocks": self.num_blocks - 1,   # allocatable, no trash
+            "free": self.free_blocks,
+            "active": self.active_blocks,
+            "cached": self.cached_blocks,
+            "usage": round(self.usage, 4),
+            "allocs": self.allocs,
+            "blocks_allocated": self.blocks_allocated,
+            "alloc_failures_exhausted": self.alloc_failures_exhausted,
+            "alloc_failures_fragmented": self.alloc_failures_fragmented,
+            "cache_evictions": self.cache_evictions,
+        }
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)
@@ -89,19 +136,32 @@ class BlockManager:
             blk, _ = self._evictable.popitem(last=False)   # LRU out
             key = self._key_of.pop(blk)
             del self._by_key[key]
+            self.cache_evictions += 1
             return blk
         return None
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh exclusive blocks (refcount 1), or None — all-or-
         nothing, so a failed admission/extension never leaks blocks."""
-        if n < 0 or self.available < n:
+        if n <= 0:
+            # n == 0 requests (fully prefix-shared prompts) are not
+            # allocation attempts; keep them out of the telemetry
+            return None if n < 0 else []
+        self.allocs += 1
+        if self.on_alloc_occupancy is not None:
+            self.on_alloc_occupancy(self.usage)
+        if self.available < n:
+            if self.available == 0:
+                self.alloc_failures_exhausted += 1
+            else:
+                self.alloc_failures_fragmented += 1
             return None
         out = []
         for _ in range(n):
             blk = self._take_one()
             self._ref[blk] = 1
             out.append(blk)
+        self.blocks_allocated += n
         return out
 
     def free(self, blocks: Sequence[int]) -> None:

@@ -113,6 +113,13 @@ class EngineConfig:
     # (finish_reason "queue_delay" -> 503 + Retry-After at the server)
     # rather than serviced long after its useful-by time. None = never.
     max_queue_delay_ms: Optional[float] = None
+    # Efficiency telemetry (engine/efficiency.py; docs/engine.md
+    # "Efficiency telemetry"): the HBM peak bandwidth the MBU gauge
+    # normalizes against (GB/s; v5e-class default — the 819 GB/s the
+    # measured steady state is quoted against in BASELINE.md), and the
+    # bounded ring of per-window breakdowns served on GET /debug/perf.
+    hbm_peak_gbps: float = 819.0
+    perf_ring_entries: int = 256
 
     def __post_init__(self):
         if self.dtype not in ("bfloat16", "float32"):
@@ -161,6 +168,10 @@ class EngineConfig:
         if self.max_queue_delay_ms is not None \
                 and self.max_queue_delay_ms <= 0:
             raise ValueError("max_queue_delay_ms must be positive")
+        if self.hbm_peak_gbps <= 0:
+            raise ValueError("hbm_peak_gbps must be positive")
+        if self.perf_ring_entries < 1:
+            raise ValueError("perf_ring_entries must be >= 1")
         # chunks never exceed prefill_chunk (or the cache), so larger
         # buckets would only waste warmup compiles and executable HBM
         self.prefill_chunk = min(self.prefill_chunk, self.max_model_len)

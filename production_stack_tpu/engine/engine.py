@@ -168,6 +168,40 @@ class LLMEngine:
                                  engine_cfg.max_blocks_per_seq), np.int32)
         self.scheduler.can_admit = self._try_admit
         self.scheduler.on_admit = self._on_admit
+        # pool-occupancy-at-allocation histogram: the block manager
+        # stays metrics-free, the metrics layer owns the plain-int
+        # buckets (one bisect per allocation attempt, not per token)
+        self.block_mgr.on_alloc_occupancy = \
+            self.metrics.kvpool_occ_hist.observe
+        # engine efficiency accounting (engine/efficiency.py;
+        # docs/engine.md "Efficiency telemetry"): classifies every
+        # fused window's token-steps, models HBM traffic for the
+        # effective-bandwidth/MBU gauges, and stamps XLA compiles.
+        # Byte model inputs are host-side metadata only (no device
+        # sync): the full parameter footprint and the per-position KV
+        # read cost (K+V across layers/heads, plus the int8 cache's
+        # f32 scales).
+        from production_stack_tpu.engine.efficiency import (
+            EngineEffAccounting)
+        mc = self.model_cfg
+        kv_itemsize = {"bfloat16": 2, "float32": 4,
+                       "int8": 1}[engine_cfg.kv_dtype]
+        kv_pos_bytes = (2 * mc.num_layers * mc.num_kv_heads
+                        * mc.head_dim_ * kv_itemsize)
+        if engine_cfg.kv_dtype == "int8":
+            # per-(token, head) f32 scales stream alongside the blocks
+            kv_pos_bytes += 2 * mc.num_layers * mc.num_kv_heads * 4
+        from jax import tree_util as _tree_util
+        weight_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in _tree_util.tree_leaves(self.runner.params))
+        self.eff = EngineEffAccounting(
+            weight_bytes=weight_bytes,
+            kv_position_bytes=kv_pos_bytes,
+            hbm_peak_bytes_per_s=engine_cfg.hbm_peak_gbps * 1e9,
+            ring_entries=engine_cfg.perf_ring_entries,
+            compile_hist=self.metrics.compile_hist)
+        self.runner.compile_observer = self.eff
         # advertised once: the router's per-endpoint concurrency cap
         # reads this gauge (0 = unbounded admission, nothing to cap on)
         self.metrics.capacity.set(
@@ -584,6 +618,11 @@ class LLMEngine:
                 tokens, starts, lengths, self._dev_sampling, kv_len,
                 guide_table=gtable, guide_ids=gids,
                 guide_states=gstates, penalized=penalized, topk=topk)
+            # bucket-padding accounting: the dispatch computed B*bucket
+            # positions; only the scheduled chunks' tokens were real
+            self.eff.note_prefill(
+                bucket=bucket, batch=B,
+                real_tokens=sum(len(w.chunk) for w in group))
             ids = lps = tops = None
             for w in group:
                 self.scheduler.on_prefill_done(w)
@@ -832,7 +871,7 @@ class LLMEngine:
             spec_ok=spec_ok, plain=plain, penalized=penalized, topk=topk)
         self._inflight.append((ids_dev, lps_dev, counts_dev, tops_dev,
                                W, list(decode_seqs), time.monotonic(),
-                               spec_ok))
+                               spec_ok, kv_len))
         return True
 
     def _drain_decode(self) -> List[StepOutput]:
@@ -853,7 +892,7 @@ class LLMEngine:
         if not self._inflight:
             return None
         (ids_dev, lps_dev, counts_dev, tops_dev, W, seqs,
-         t0, spec_ok) = self._inflight.pop(0)
+         t0, spec_ok, kv_len) = self._inflight.pop(0)
         t0 = max(t0, getattr(self, "_last_sync_t", 0.0))
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
@@ -861,12 +900,12 @@ class LLMEngine:
         tops = (None if tops_dev is None else
                 (np.asarray(tops_dev[0]), np.asarray(tops_dev[1])))
         self._last_sync_t = time.monotonic()
-        return ids, lps, counts, tops, W, seqs, t0, spec_ok
+        return ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len
 
     def _process_window(self, synced) -> List[StepOutput]:
         if synced is None:
             return []
-        ids, lps, counts, tops, W, seqs, t0, spec_ok = synced
+        ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len = synced
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
@@ -877,6 +916,15 @@ class LLMEngine:
         else:
             emitted = int(sum(counts[s.slot].sum() for s in alive))
             per_tok_dt = dt / max(1, emitted)
+        # window efficiency accounting: every row computes W steps of P
+        # positions each (P = spec+1 under speculation). real counts
+        # tokens the client keeps (one per _accept_token); parked rows
+        # are pure padding; everything else a live row computed but did
+        # not emit — finished-row tails, rows finished/aborted between
+        # dispatch and drain, rejected draft positions — is dead.
+        B = self.cfg.max_num_seqs
+        P = ids.shape[2] if counts is not None and ids.ndim == 3 else 1
+        accepted = 0
         for j in range(W):
             still = []
             for seq in alive:
@@ -911,6 +959,7 @@ class LLMEngine:
                 finished = False
                 for token, lp in row:
                     self.metrics.per_token.observe(per_tok_dt)
+                    accepted += 1
                     outs = self._accept_token(seq, token, lp, alts)
                     outputs.extend(outs)
                     if outs[-1].finished:
@@ -921,6 +970,12 @@ class LLMEngine:
             alive = still
             if not alive:
                 break
+        pad = (B - len(seqs)) * W * P
+        dead = B * W * P - pad - accepted
+        self.eff.note_window(steps=W, positions=P, batch=B,
+                             live_rows=len(seqs), kv_len=kv_len,
+                             real=accepted, pad=pad, dead=dead,
+                             window_s=dt)
         return outputs
 
     @staticmethod
@@ -1272,6 +1327,10 @@ class LLMEngine:
                 # totals -> counter deltas + tier occupancy gauges, at
                 # scrape frequency (never on the step loop)
                 self.metrics.sync_kv(self.connector.stats_report())
+            # efficiency + fragmentation totals -> counter deltas and
+            # rate gauges, same scrape-time idiom
+            self.metrics.sync_eff(self.eff.report(), self.eff.rates())
+            self.metrics.sync_kvpool(self.block_mgr.frag_report())
         return self.metrics.render()
 
     # ------------------------------------------------- overload surface
@@ -1324,6 +1383,12 @@ class LLMEngine:
             "kv_usage": round(self.block_mgr.usage, 4),
             "est_queue_delay_ms": round(
                 1e3 * self.estimated_queue_delay_s(), 1),
+            # engine-efficiency accounting (engine/efficiency.py):
+            # token-step totals, recent effective-bandwidth/MBU rates,
+            # and compile counters — including compile_in_flight, which
+            # this lock-free path reports WHILE the engine lock is held
+            # across the compile itself. Parsed by signals.EngineLoad.
+            "perf": self.eff.perf_block(),
         }
         if self.connector is not None:
             # tier hit/miss/bytes counters (all in-memory totals — no

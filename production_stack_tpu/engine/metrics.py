@@ -11,6 +11,8 @@ exported under `tpu:` (HBM KV usage) for the Grafana dashboard.
 from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
                                generate_latest)
 
+from production_stack_tpu.engine.efficiency import (COMPILE_BUCKETS,
+                                                    OCCUPANCY_BUCKETS)
 from production_stack_tpu.tracing import (PhaseHistogramCollector,
                                           PhaseHistograms)
 
@@ -172,8 +174,75 @@ class EngineMetrics:
             "tpu:engine_phase_seconds",
             "Engine-side request phase durations (docs/observability.md "
             "'Tracing' phase glossary)", self.engine_phases))
+        # engine efficiency accounting (engine/efficiency.py;
+        # docs/engine.md "Efficiency telemetry"): every family here is
+        # fed plain-int on the step loop and delta-synced at scrape
+        # time via sync_eff/sync_kvpool — zero prometheus objects near
+        # the loop, the same idiom as sync_kv above.
+        self._token_steps = Counter(
+            "tpu:engine_token_steps",
+            "Device token-step computations by usefulness: real "
+            "(emitted tokens), pad (parked rows), dead (finished-row "
+            "tails, discarded rows, rejected draft positions, prefill "
+            "bucket padding)",
+            list(labels) + ["kind", "phase"], registry=self.registry)
+        self.effective_bytes_per_s = gauge(
+            "tpu:engine_effective_bytes_per_s",
+            "Modeled useful HBM traffic per wall-clock second over the "
+            "recent window (weights + live-row KV reads, scaled by the "
+            "live fraction)")
+        self.mbu_perc = gauge(
+            "tpu:engine_mbu_perc",
+            "Model-bandwidth utilization: effective bytes/s over the "
+            "configured --hbm-peak-gbps (0-100)")
+        self.decode_live_fraction = gauge(
+            "tpu:decode_window_live_fraction",
+            "Recent fraction of decode token-steps that emitted a "
+            "kept token (real / (real+pad+dead))")
+        self._compiles = Counter(
+            "tpu:engine_compiles",
+            "XLA executable compilations by (kind, window, kv bucket)",
+            list(labels) + ["kind", "window", "kv_bucket"],
+            registry=self.registry)
+        self.compile_in_flight = gauge(
+            "tpu:engine_compile_in_flight",
+            "XLA compilations currently blocking the engine loop "
+            "(also on /load perf.compile_in_flight, which answers "
+            "mid-compile)")
+        # compile-duration histogram, fed at compile completion by the
+        # accounting layer (seconds-scale buckets)
+        self.compile_hist = PhaseHistograms(
+            ("kind", "window", "kv_bucket"), buckets=COMPILE_BUCKETS)
+        self.registry.register(PhaseHistogramCollector(
+            "tpu:engine_compile_seconds",
+            "XLA compile durations by (kind, window, kv bucket)",
+            self.compile_hist))
+        # KV block-pool fragmentation (engine/block_manager.py)
+        self._kvpool_blocks = Gauge(
+            "tpu:kvpool_blocks",
+            "Paged-KV pool blocks by state (free list / held by live "
+            "sequences / refcount-0 prefix-cached)",
+            list(labels) + ["state"], registry=self.registry)
+        self._kvpool_alloc_failures = Counter(
+            "tpu:kvpool_alloc_failures",
+            "Block allocations refused, by reason: exhausted (zero "
+            "allocatable blocks) vs fragmented (free blocks remain "
+            "but fewer than the request needs)",
+            list(labels) + ["reason"], registry=self.registry)
+        self.kvpool_cache_evictions = counter(
+            "tpu:kvpool_cache_evictions_total",
+            "Prefix-cached blocks reclaimed (LRU) to satisfy "
+            "allocations")
+        self.kvpool_occ_hist = PhaseHistograms(
+            (), buckets=OCCUPANCY_BUCKETS)
+        self.registry.register(PhaseHistogramCollector(
+            "tpu:kvpool_alloc_occupancy",
+            "Pool occupancy fraction observed at each allocation "
+            "attempt", self.kvpool_occ_hist))
         self._labels = labels
         self._kv_last: dict = {}
+        self._eff_last: dict = {}
+        self._kvpool_last: dict = {}
 
     _KV_COUNTER_KEYS = (
         ("query_tokens", "kv_query_tokens"),
@@ -216,6 +285,55 @@ class EngineMetrics:
                 st.get("bytes", 0))
             self._kv_tier_items.labels(tier=tier, **self._labels).set(
                 st.get("count", 0))
+
+    def _delta_inc(self, metric, last: dict, key: str, total) -> None:
+        delta = total - last.get(key, 0)
+        if delta > 0:
+            metric.inc(delta)
+        last[key] = total
+
+    def sync_eff(self, report: dict, rates: dict) -> None:
+        """Fold an ``EngineEffAccounting.report()/rates()`` pair into
+        the exposition: token-step/compile counters advance by deltas,
+        rate gauges are set absolutely."""
+        dec = report.get("decode") or {}
+        for kind in ("real", "pad", "dead"):
+            self._delta_inc(
+                self._token_steps.labels(kind=kind, phase="decode",
+                                         **self._labels),
+                self._eff_last, f"decode:{kind}", dec.get(kind, 0))
+        pre = report.get("prefill") or {}
+        for kind in ("real", "pad"):
+            self._delta_inc(
+                self._token_steps.labels(kind=kind, phase="prefill",
+                                         **self._labels),
+                self._eff_last, f"prefill:{kind}", pre.get(kind, 0))
+        for key, entry in (report.get("compiles") or {}).items():
+            kind, window, kv = key.split("|")
+            self._delta_inc(
+                self._compiles.labels(kind=kind, window=window,
+                                      kv_bucket=kv, **self._labels),
+                self._eff_last, f"compile:{key}", entry["count"])
+        self.compile_in_flight.set(report.get("compile_in_flight", 0))
+        self.effective_bytes_per_s.set(
+            rates.get("effective_bytes_per_s", 0.0))
+        self.mbu_perc.set(rates.get("mbu_perc", 0.0))
+        self.decode_live_fraction.set(rates.get("live_fraction", 0.0))
+
+    def sync_kvpool(self, report: dict) -> None:
+        """Fold a ``BlockManager.frag_report()`` into the exposition."""
+        for state in ("free", "active", "cached"):
+            self._kvpool_blocks.labels(state=state, **self._labels).set(
+                report.get(state, 0))
+        for reason in ("exhausted", "fragmented"):
+            self._delta_inc(
+                self._kvpool_alloc_failures.labels(reason=reason,
+                                                   **self._labels),
+                self._kvpool_last, reason,
+                report.get(f"alloc_failures_{reason}", 0))
+        self._delta_inc(self.kvpool_cache_evictions, self._kvpool_last,
+                        "cache_evictions",
+                        report.get("cache_evictions", 0))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

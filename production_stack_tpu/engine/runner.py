@@ -200,6 +200,13 @@ class ModelRunner:
         # tokenizer after construction (static per executable)
         self._eos_id = 0
 
+        # compile observer (engine/efficiency.py): an object with
+        # compile_started/compile_finished hooks, stamped around every
+        # serving-executable build in _compile_with_fallback so compile
+        # stalls are attributable (counters, histogram, trace events)
+        # instead of bare log lines. None = no accounting (bare runner
+        # in tests).
+        self.compile_observer = None
         # executable caches: decode keyed (steps, kv_len, greedy, seeded),
         # prefill keyed (chunk bucket, kv bucket)
         self._decode_fns = {}
@@ -627,7 +634,9 @@ class ModelRunner:
                     donate_argnums=(1,))
 
             fn = self._compile_with_fallback(self._decode_fns, key,
-                                             make_spec, args)
+                                             make_spec, args,
+                                             kind="decode_spec",
+                                             window=steps, kv_len=kv_len)
             (ids, lps, tis, tls, cnt, self._dec_tokens, self._dec_pos,
              self._dec_hist, self._dec_gstate, counts_out,
              self.cache) = fn(*args)
@@ -656,14 +665,18 @@ class ModelRunner:
                 donate_argnums=(1,))
 
         fn = self._compile_with_fallback(self._decode_fns, cache_key,
-                                         make_decode, args)
+                                         make_decode, args,
+                                         kind="decode", window=steps,
+                                         kv_len=kv_len)
         (ids, lps, tis, tls, self._dec_tokens, self._dec_pos,
          self._dec_gstate, counts_out, self.cache) = fn(*args)
         if penalized:
             self._dec_counts = counts_out
         return ids, lps, None, (tis, tls) if topk else None
 
-    def _compile_with_fallback(self, cache: dict, key, make_fn, args):
+    def _compile_with_fallback(self, cache: dict, key, make_fn, args,
+                               kind: str = "", window: int = 0,
+                               kv_len: int = 0):
         """Fetch-or-compile an executable; if the pallas paged kernel
         fails to BUILD for this combination (backend or VMEM limits
         beyond paged_viable's estimate), recompile THIS key on the jnp
@@ -673,24 +686,39 @@ class ModelRunner:
         that already compiled — or will — keep the kernel. Compilation
         is an explicit lower+compile BEFORE any buffers are donated, so
         a runtime failure of a working executable propagates unchanged
-        (retrying it would re-pass a donated, deleted cache buffer)."""
+        (retrying it would re-pass a donated, deleted cache buffer).
+
+        Every cache miss is stamped through ``compile_observer``
+        (kind, window, kv bucket, wall duration — the fallback recompile
+        is part of the same stall and folds into one event): compiles
+        block the engine loop for seconds, so they must be countable
+        and visible in /debug/traces, not just log lines."""
         fn = cache.get(key)
         if fn is not None:
             return fn
         from production_stack_tpu.ops import pallas_attention
+        obs = self.compile_observer
+        t0 = time.monotonic()
+        if obs is not None:
+            obs.compile_started(kind, window, kv_len)
         try:
-            fn = make_fn()
-            fn.lower(*args).compile()   # donation applies at execution
-        except Exception:
-            if not pallas_attention.flash_enabled():
-                raise
-            logger.exception(
-                "pallas paged attention failed to compile for %r; "
-                "recompiling this executable on the jnp attention path",
-                key)
-            with pallas_attention.force_jnp():
+            try:
                 fn = make_fn()
-                fn.lower(*args).compile()
+                fn.lower(*args).compile()   # donation applies at execution
+            except Exception:
+                if not pallas_attention.flash_enabled():
+                    raise
+                logger.exception(
+                    "pallas paged attention failed to compile for %r; "
+                    "recompiling this executable on the jnp attention "
+                    "path", key)
+                with pallas_attention.force_jnp():
+                    fn = make_fn()
+                    fn.lower(*args).compile()
+        finally:
+            if obs is not None:
+                obs.compile_finished(kind, window, kv_len, t0,
+                                     time.monotonic() - t0)
         cache[key] = fn
         return fn
 
@@ -745,7 +773,8 @@ class ModelRunner:
         fn = self._compile_with_fallback(
             self._prefill_fns,
             (Tb, kv_len, guided, gshape, penalized, topk),
-            make_prefill, args)
+            make_prefill, args, kind="prefill", window=Tb,
+            kv_len=kv_len)
         ids, lps, tis, tls, self.cache = fn(*args)
         return ids, lps, (tis, tls) if topk else None
 

@@ -75,8 +75,21 @@ def _seal_engine_trace(tracer: TraceRecorder, trace, request: web.Request,
 
     Requests that never produced a sequence (400s, sheds, deadline
     504s) get a single ``preprocess`` phase covering their whole life.
+
+    XLA compiles that overlapped this request's life are attached as
+    ``xla_compile`` EVENT spans (engine/efficiency.py keeps the bounded
+    compile-event ring): a compile stalls every in-flight request, so a
+    request whose tail latency was a compile must say so in
+    ``/debug/traces`` instead of showing unattributed decode time.
     """
     now = time.monotonic()
+    engine = request.app.get(ENGINE_KEY)
+    if engine is not None:
+        for (start, dur, kind, window, kv) in \
+                engine.engine.eff.compile_events_between(trace.t0, now):
+            trace.add_event("xla_compile", start, dur,
+                            attrs={"kind": kind, "window": window,
+                                   "kv_bucket": kv})
     timing = request.get("seq_timing")
     tok_s = request.get("trace_tokenize_s")
     if timing is not None:
@@ -1117,6 +1130,29 @@ async def version(request: web.Request) -> web.Response:
     return web.json_response({"version": __version__})
 
 
+async def debug_perf(request: web.Request) -> web.Response:
+    """``GET /debug/perf``: the engine-efficiency ring — recent
+    window-level real/pad/dead breakdowns, recent XLA compile events,
+    cumulative totals + rates, and the KV block pool's fragmentation
+    census. Aggregate-only data, but served under the same auth
+    posture as /debug/traces (the /debug namespace is operator
+    surface, not probe surface). Query param ``limit=N`` bounds the
+    rings returned (default 50)."""
+    engine = request.app[ENGINE_KEY]
+    eng = engine.engine
+    try:
+        limit = max(1, int(request.query.get("limit", "50")))
+    except ValueError:
+        limit = 50
+    return web.json_response({
+        "totals": eng.eff.report(),
+        "rates": eng.eff.rates(),
+        "windows": eng.eff.recent_windows(limit),
+        "compiles": eng.eff.recent_compiles(limit),
+        "kv_pool": eng.block_mgr.frag_report(),
+    })
+
+
 async def metrics(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
     return web.Response(body=engine.engine.render_metrics(),
@@ -1146,9 +1182,11 @@ async def detokenize(request: web.Request) -> web.Response:
 # helm/templates/deployment-vllm-multi.yaml:143-150 + probe blocks)
 AUTH_EXEMPT_PATHS = frozenset({"/health", "/metrics", "/version",
                                "/load"})
-# NOTE: /debug/traces is deliberately NOT exempt — unlike the probe
-# endpoints it carries per-request data (trace ids, timings, token
-# counts); readers on a secured deployment present the engine key
+# NOTE: the /debug namespace (/debug/traces, /debug/perf) is
+# deliberately NOT exempt — /debug/traces carries per-request data
+# (trace ids, timings, token counts) and /debug/perf shares the
+# operator-surface posture; readers on a secured deployment present
+# the engine key
 
 
 def _auth_middleware(api_key: str):
@@ -1210,6 +1248,7 @@ def build_app(engine: AsyncLLMEngine,
     app[TRACER_KEY] = tracer
     app.router.add_get("/debug/traces",
                        debug_traces_handler(lambda: tracer))
+    app.router.add_get("/debug/perf", debug_perf)
     app.router.add_post("/v1/chat/completions", chat_completions)
     app.router.add_post("/v1/completions", completions)
     app.router.add_post("/v1/embeddings", embeddings)
@@ -1341,6 +1380,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--lora-targets", default="q,v",
                    help="comma-separated projections to adapt "
                         "(q,k,v,o,gate,up,down)")
+    p.add_argument("--hbm-peak-gbps", type=float, default=819.0,
+                   help="HBM peak bandwidth the tpu:engine_mbu_perc "
+                        "gauge normalizes effective bytes/s against "
+                        "(GB/s; set to the serving chip's datasheet "
+                        "number)")
+    p.add_argument("--perf-ring-entries", type=int, default=256,
+                   help="window-level efficiency breakdowns kept in "
+                        "memory (bounded ring on GET /debug/perf)")
     p.add_argument("--trace-ring-entries", type=int, default=2048,
                    help="completed request traces kept in memory "
                         "(bounded ring served on GET /debug/traces)")
@@ -1371,6 +1418,8 @@ def main(argv=None) -> None:
         max_num_seqs=args.max_num_seqs, prefill_chunk=args.prefill_chunk,
         max_waiting_seqs=args.max_waiting_seqs,
         max_queue_delay_ms=args.max_queue_delay_ms,
+        hbm_peak_gbps=args.hbm_peak_gbps,
+        perf_ring_entries=args.perf_ring_entries,
         decode_window=args.decode_window,
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),

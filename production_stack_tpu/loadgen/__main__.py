@@ -44,6 +44,13 @@ firedrill — launch router + N engines with SLO windows scaled to
            bound and resolve after the fault clears; exit 1 on any
            miss, false fire, or non-resolution (FIREDRILL_*.json;
            --overhead-guard re-runs the r7 A/B with SLO accounting on)
+effwatch — launch ONE engine and audit its efficiency accounting
+           around a steady storm: real+pad+dead token-step deltas must
+           sum to the independent total within tolerance, accounted
+           decode tokens/s must reconcile with client-measured
+           throughput within 10%, and zero XLA compile events may land
+           in the post-warmup steady window; --anti-vacuity mis-sizes
+           the accounting window and must fail (EFF_*.json)
 trace    — launch router + engines (optionally the disagg split),
            storm them, and join client x-trace-ids against the
            router's and engines' /debug/traces rings; exit 1 unless
@@ -68,6 +75,8 @@ from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.disagg import (disagg_violations,
                                                  run_disagg)
+from production_stack_tpu.loadgen.effwatch import (effwatch_violations,
+                                                   run_effwatch)
 from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
                                                     firedrill_violations,
                                                     run_firedrill)
@@ -276,6 +285,48 @@ def cmd_overload(args) -> int:
               f"plateau held at {top['offered_qps']} qps offered "
               f"({top['goodput_qps']} qps goodput, "
               f"{top['shed']} shed, 0 late, 0 errors)")
+    return 1 if violations else 0
+
+
+def cmd_effwatch(args) -> int:
+    record = asyncio.run(run_effwatch(
+        engine=args.engine, users=args.users, duration_s=args.duration,
+        warmup_s=args.warmup, num_tokens=args.num_tokens,
+        sum_tolerance=args.sum_tolerance,
+        rate_tolerance=args.rate_tolerance,
+        anti_vacuity=args.anti_vacuity,
+        fake_pad_fraction=args.fake_pad_fraction,
+        fake_dead_fraction=args.fake_dead_fraction,
+        fake_skew=args.fake_skew,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"EFF_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = effwatch_violations(
+        record, sum_tolerance=args.sum_tolerance,
+        rate_tolerance=args.rate_tolerance)
+    if args.anti_vacuity:
+        # the mis-sized window EXISTS to prove the gates can fail
+        if any("diverge" in v for v in violations):
+            print("effwatch anti-vacuity PASSED: the mis-sized window "
+                  "failed the reconciliation gate as it must",
+                  file=sys.stderr)
+            return 0
+        print("effwatch anti-vacuity FAILED: the reconciliation gate "
+              "did not trip on a deliberately mis-sized window",
+              file=sys.stderr)
+        return 1
+    for v in violations:
+        print(f"EFFWATCH VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        print(f"effwatch PASSED: accounted {record['value']} decode "
+              f"tok/s vs client {d['client_decode_tokens_per_s']} "
+              f"(fraction sum {d['fraction_sum']}, live fraction "
+              f"{d['live_fraction_steady']}, mbu "
+              f"{d['mbu_perc_steady']}%, 0 steady compiles, 0 errors)")
     return 1 if violations else 0
 
 
@@ -720,6 +771,51 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write OVERLOAD_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_overload)
+
+    sp = sub.add_parser("effwatch",
+                        help="one engine; audit the efficiency "
+                             "accounting (token-step fractions, "
+                             "accounted-vs-client decode tokens/s, "
+                             "steady-window compile silence) around "
+                             "a real storm")
+    sp.add_argument("--engine", default="debug-tiny",
+                    help="engine model name (real process) or 'fake' "
+                         "(synthetic perf block — the engine-free "
+                         "smoke)")
+    sp.add_argument("--users", type=int, default=6,
+                    help="closed-loop concurrent streaming clients")
+    sp.add_argument("--duration", type=parse_duration, default=20.0,
+                    help="steady measured window")
+    sp.add_argument("--warmup", type=parse_duration, default=8.0,
+                    help="warmup storm ahead of the measured window "
+                         "(same shape, so every executable is "
+                         "compiled before the steady scrape)")
+    sp.add_argument("--num-tokens", type=int, default=32)
+    sp.add_argument("--sum-tolerance", type=float, default=0.02,
+                    help="allowed |1 - (real+pad+dead)/total|")
+    sp.add_argument("--rate-tolerance", type=float, default=0.10,
+                    help="allowed relative gap between accounted and "
+                         "client-measured decode tokens")
+    sp.add_argument("--anti-vacuity", action="store_true",
+                    help="mis-size the accounting window (scrape "
+                         "before the warmup storm): the "
+                         "reconciliation gate MUST fail; exit 0 iff "
+                         "it does")
+    sp.add_argument("--fake-pad-fraction", type=float, default=0.3,
+                    help="fake engine: synthetic padding fraction")
+    sp.add_argument("--fake-dead-fraction", type=float, default=0.1,
+                    help="fake engine: synthetic dead fraction")
+    sp.add_argument("--fake-skew", type=float, default=0.0,
+                    help="fake engine: inflate the independent "
+                         "token_steps_total by this fraction (breaks "
+                         "the sum-to-1 gate on purpose)")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write EFF_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_effwatch)
 
     sp = sub.add_parser("autoscale",
                         help="router + autoscaler-owned engines; drive "

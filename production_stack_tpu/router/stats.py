@@ -252,6 +252,16 @@ class EngineStats:
     # "" = no KV tiering): surfaced in the stat log so a mis-wired
     # pool (a producer in the decode set) is visible at a glance
     kv_role: str = ""
+    # engine-efficiency signals (/load "perf" block; zeros for
+    # foreign/legacy engines): the hardware-level view next to the
+    # load view — a replica at high utilization but low MBU/live
+    # fraction is busy doing dead work, and compile_in_flight > 0
+    # explains a latency spike without a /debug round trip
+    mbu_perc: float = 0.0
+    live_fraction: float = 0.0
+    decode_tokens_per_s: float = 0.0
+    compiles_total: float = 0.0
+    compile_in_flight: float = 0.0
     scraped_at: float = field(default_factory=time.time)
 
 
@@ -315,6 +325,11 @@ class EngineStatsScraper(LoadPoller):
             kv_hit_tokens=load.kv_hit_tokens,
             kv_foreign_hit_tokens=load.kv_foreign_hit_tokens,
             kv_role=load.kv_role,
+            mbu_perc=load.mbu_perc,
+            live_fraction=load.live_fraction,
+            decode_tokens_per_s=load.decode_tokens_per_s,
+            compiles_total=load.compiles_total,
+            compile_in_flight=load.compile_in_flight,
         )
 
     async def _fetch_fallback(self, url: str) -> Optional[EngineStats]:
@@ -400,6 +415,15 @@ class StatLogger:
                     f"running={es.num_running:.0f} "
                     f"waiting={es.num_waiting:.0f} "
                     f"kv_usage={es.kv_usage:.1%}")
+                # compile_in_flight gates too: a cold engine stalled
+                # on its FIRST build has zero mbu/live fraction — the
+                # one moment this line exists to explain
+                if es.mbu_perc or es.live_fraction \
+                        or es.compile_in_flight or es.compiles_total:
+                    parts.append(
+                        f"mbu={es.mbu_perc:.2f}% "
+                        f"live={es.live_fraction:.2f} "
+                        f"compiling={es.compile_in_flight:.0f}")
             logger.info("stats: %s", " | ".join(parts))
         if self.metrics is not None:
             eps = list(self.get_endpoints())

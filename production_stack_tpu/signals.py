@@ -61,6 +61,22 @@ class EngineLoad:
     # disagg role advertised in the kv_cache block ("kv_producer",
     # "kv_consumer", "kv_both"; "" = no KV tiering / unknown)
     kv_role: str = ""
+    # engine-efficiency signals (/load "perf" block, engine/
+    # efficiency.py; zeros for engines without the accounting layer):
+    # recent effective-bandwidth/MBU rates, the decode live fraction,
+    # cumulative real/pad/dead token-step totals, and compile
+    # counters — compile_in_flight > 0 means the engine loop is
+    # blocked on an XLA build RIGHT NOW (the /load path answers
+    # through it)
+    mbu_perc: float = 0.0
+    effective_bytes_per_s: float = 0.0
+    live_fraction: float = 0.0
+    decode_tokens_per_s: float = 0.0
+    token_steps_real: float = 0.0
+    token_steps_pad: float = 0.0
+    token_steps_dead: float = 0.0
+    compiles_total: float = 0.0
+    compile_in_flight: float = 0.0
     scraped_at: float = field(default_factory=time.time)
 
     @property
@@ -79,30 +95,37 @@ class EngineLoad:
 
 
 def parse_load_report(data: dict) -> EngineLoad:
-    def num(key, default=0.0):
-        v = data.get(key)
-        return default if v is None else float(v)
+    def pnum(src: dict, key: str) -> float:
+        v = src.get(key)
+        return 0.0 if v is None else float(v)
 
     cap = data.get("capacity")
     kv = data.get("kv_cache") or {}
-
-    def knum(key):
-        v = kv.get(key)
-        return 0.0 if v is None else float(v)
+    perf = data.get("perf") or {}
+    steps = perf.get("token_steps") or {}
 
     return EngineLoad(
-        queue_depth=num("queue_depth"),
-        running=num("running"),
+        queue_depth=pnum(data, "queue_depth"),
+        running=pnum(data, "running"),
         capacity=None if cap is None else float(cap),
-        max_num_seqs=num("max_num_seqs"),
-        est_queue_delay_ms=num("est_queue_delay_ms"),
-        kv_usage=num("kv_usage"),
-        free_kv_blocks=num("free_kv_blocks"),
-        kv_hit_rate=knum("hit_rate"),
-        kv_query_tokens=knum("query_tokens"),
-        kv_hit_tokens=knum("hit_tokens"),
-        kv_foreign_hit_tokens=knum("foreign_hit_tokens"),
+        max_num_seqs=pnum(data, "max_num_seqs"),
+        est_queue_delay_ms=pnum(data, "est_queue_delay_ms"),
+        kv_usage=pnum(data, "kv_usage"),
+        free_kv_blocks=pnum(data, "free_kv_blocks"),
+        kv_hit_rate=pnum(kv, "hit_rate"),
+        kv_query_tokens=pnum(kv, "query_tokens"),
+        kv_hit_tokens=pnum(kv, "hit_tokens"),
+        kv_foreign_hit_tokens=pnum(kv, "foreign_hit_tokens"),
         kv_role=str(kv.get("role") or ""),
+        mbu_perc=pnum(perf, "mbu_perc"),
+        effective_bytes_per_s=pnum(perf, "effective_bytes_per_s"),
+        live_fraction=pnum(perf, "live_fraction"),
+        decode_tokens_per_s=pnum(perf, "decode_tokens_per_s"),
+        token_steps_real=pnum(steps, "real"),
+        token_steps_pad=pnum(steps, "pad"),
+        token_steps_dead=pnum(steps, "dead"),
+        compiles_total=pnum(perf, "compiles_total"),
+        compile_in_flight=pnum(perf, "compile_in_flight"),
     )
 
 

@@ -183,6 +183,28 @@ class FakeEngine:
         # so runs are reproducible; independent of the fault mode
         self.error_rate: float = 0.0
         self.errors_injected = 0
+        # synthetic engine-efficiency telemetry (the effwatch rig's
+        # lever; mirrors the real engine's /load "perf" block +
+        # tpu:engine_* exposition, engine/efficiency.py). Real decode
+        # token-steps are tokens actually served minus one per request
+        # (the real engine's first token comes from the prefill
+        # dispatch, so its decode accounting excludes it — the fake
+        # keeps the same reconciliation semantics); pad/dead are
+        # derived from configurable fractions, and "skew" inflates the
+        # independent token_steps_total so the effwatch sum-to-1 gate
+        # can be made to FAIL on purpose. All settable at runtime via
+        # POST /fault {"perf": {...}} — keys: pad_fraction,
+        # dead_fraction, skew, compiles_total, compile_in_flight,
+        # mbu_perc, effective_bytes_per_s.
+        self.perf = {
+            "pad_fraction": 0.0, "dead_fraction": 0.0, "skew": 0.0,
+            "compiles_total": 0, "compile_in_flight": 0,
+            "mbu_perc": None, "effective_bytes_per_s": None,
+        }
+        self.perf_real = 0               # decode real token-steps
+        self.perf_prefill_real = 0
+        import collections as _collections
+        self._perf_events = _collections.deque(maxlen=4096)
         import random as _random
         self._error_rng = _random.Random(0xE44)
         # engine-side tracing (production_stack_tpu/tracing.py): the
@@ -366,6 +388,71 @@ class FakeEngine:
         prompt = body.get("prompt", "")
         return prompt if isinstance(prompt, str) else json.dumps(prompt)
 
+    # -- synthetic efficiency telemetry ---------------------------------
+
+    def _note_served(self, n_tokens: int) -> None:
+        """One finished inference request that served ``n_tokens``:
+        n-1 decode real token-steps (first token = prefill, like the
+        real engine) + the fake's canonical 3 prompt tokens."""
+        real = max(0, n_tokens - 1)
+        self.perf_real += real
+        self.perf_prefill_real += 3
+        self._perf_events.append((time.monotonic(), real))
+
+    def _perf_block(self) -> dict:
+        """Mirror of the real engine's /load ``perf`` block, derived
+        from served tokens + the configured pad/dead fractions."""
+        p = self.perf
+        real = self.perf_real
+        denom = max(1e-9, 1.0 - p["pad_fraction"] - p["dead_fraction"])
+        pad = int(round(real * p["pad_fraction"] / denom))
+        dead = int(round(real * p["dead_fraction"] / denom))
+        total = int(round((real + pad + dead) * (1.0 + p["skew"])))
+        now = time.monotonic()
+        horizon = 10.0
+        recent = sum(n for t, n in self._perf_events
+                     if t >= now - horizon)
+        tokens_per_s = recent / horizon
+        steps = real + pad + dead
+        eff = p["effective_bytes_per_s"]
+        if eff is None:
+            eff = round(tokens_per_s * 1e6, 1)   # synthetic byte model
+        mbu = p["mbu_perc"]
+        if mbu is None:
+            mbu = round(100.0 * eff / 819e9, 6)
+        return {
+            "token_steps": {"real": real, "pad": pad, "dead": dead,
+                            "token_steps_total": total,
+                            "windows": 0, "busy_s": 0.0},
+            "prefill_tokens": {"real": self.perf_prefill_real,
+                               "pad": 0, "dispatches": 0},
+            "compiles_total": int(p["compiles_total"]),
+            "compile_s_total": 0.0,
+            "compile_in_flight": int(p["compile_in_flight"]),
+            "weight_bytes": 0,
+            "horizon_s": horizon,
+            "effective_bytes_per_s": eff,
+            "total_bytes_per_s": eff,
+            "mbu_perc": mbu,
+            "live_fraction": round(real / steps, 6) if steps else 0.0,
+            "decode_tokens_per_s": round(tokens_per_s, 3),
+        }
+
+    def _apply_perf_overrides(self, body: dict) -> None:
+        cfg = body.get("perf")
+        if not isinstance(cfg, dict):
+            return
+        for key in ("pad_fraction", "dead_fraction", "skew"):
+            if key in cfg:
+                self.perf[key] = float(cfg[key] or 0.0)
+        for key in ("compiles_total", "compile_in_flight"):
+            if key in cfg:
+                self.perf[key] = int(cfg[key] or 0)
+        for key in ("mbu_perc", "effective_bytes_per_s"):
+            if key in cfg:
+                v = cfg[key]
+                self.perf[key] = None if v is None else float(v)
+
     # -- fault machinery ------------------------------------------------
 
     def _take_fault(self, path: str) -> Optional[dict]:
@@ -491,16 +578,19 @@ class FakeEngine:
         ``queue_delay_ms`` / ``error_rate`` keys set runtime overrides;
         a body with ONLY those keys leaves the fault mode alone."""
         body = await request.json()
+        self._apply_perf_overrides(body)
         signal_only = bool(body) and set(body) <= {"capacity",
                                                    "queue_delay_ms",
-                                                   "error_rate"}
+                                                   "error_rate",
+                                                   "perf"}
         if signal_only:
             self._apply_signal_overrides(body)
             return web.json_response(
                 {"fault": self.fault,
                  "capacity": self.capacity_override,
                  "queue_delay_ms": self.queue_delay_override,
-                 "error_rate": self.error_rate})
+                 "error_rate": self.error_rate,
+                 "perf": self.perf})
         mode = body.get("mode")
         if mode is None:
             # a mode-clearing POST also resets the partial error rate
@@ -619,8 +709,10 @@ class FakeEngine:
                 trace.add_phase("decode", t_dec, time.monotonic())
                 self.tracer.finish(trace, "ok")
                 self._kv_publish(prompt_text, reply)
+                self._note_served(n)
                 return resp
             self._kv_publish(prompt_text, reply)
+            self._note_served(n)
             trace.add_phase("decode", t_dec, time.monotonic())
             self.tracer.finish(trace, "ok")
             resp = web.json_response({
@@ -656,6 +748,7 @@ class FakeEngine:
             ("/v1/completions", request.headers.get("x-user-id"),
              body.get("model")))
         n = min(body.get("max_tokens") or self.num_tokens, self.num_tokens)
+        self._note_served(n)
         trace.add_phase("prefill", t_pf, time.monotonic())
         self.tracer.finish(trace, "ok")
         resp = web.json_response({
@@ -706,6 +799,7 @@ class FakeEngine:
             # report exactly that value here for surface agreement
             "kv_usage": self.gauges["vllm:gpu_cache_usage_perc"],
             "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
+            "perf": self._perf_block(),
         }
         if self._kv_store is not None:
             c = self.kv_counters
@@ -723,6 +817,35 @@ class FakeEngine:
         for name, value in self.gauges.items():
             lines.append(f"# TYPE {name.replace(':', '_')} gauge")
             lines.append(f'{name}{{model_name="{self.model}"}} {value}')
+        # surface parity with the real engine's efficiency exposition
+        # (engine/metrics.py sync_eff): /load perf and /metrics must
+        # tell the same story, like the kv_cache families below
+        perf = self._perf_block()
+        steps = perf["token_steps"]
+        lines.append("# TYPE tpu_engine_token_steps counter")
+        for kind in ("real", "pad", "dead"):
+            lines.append(
+                f'tpu:engine_token_steps_total{{model_name='
+                f'"{self.model}",kind="{kind}",phase="decode"}} '
+                f'{steps[kind]}')
+        lines.append(
+            f'tpu:engine_token_steps_total{{model_name="{self.model}",'
+            f'kind="real",phase="prefill"}} '
+            f'{perf["prefill_tokens"]["real"]}')
+        for name, key in (("tpu:engine_effective_bytes_per_s",
+                           "effective_bytes_per_s"),
+                          ("tpu:engine_mbu_perc", "mbu_perc"),
+                          ("tpu:decode_window_live_fraction",
+                           "live_fraction"),
+                          ("tpu:engine_compile_in_flight",
+                           "compile_in_flight")):
+            lines.append(f"# TYPE {name.replace(':', '_')} gauge")
+            lines.append(f'{name}{{model_name="{self.model}"}} '
+                         f'{perf[key]}')
+        lines.append("# TYPE tpu_engine_compiles counter")
+        lines.append(f'tpu:engine_compiles_total{{model_name='
+                     f'"{self.model}",kind="decode",window="8",'
+                     f'kv_bucket="512"}} {perf["compiles_total"]}')
         if self._kv_store is not None:
             # surface parity with the real engine's tpu:kvcache_* family
             for key in ("query_tokens", "hit_tokens",

@@ -1,0 +1,369 @@
+"""Engine efficiency telemetry (ISSUE 11): window accounting units
+with an injected clock, BlockManager fragmentation accounting, the
+scrape-time delta sync, and the real-engine perf surfaces
+(/load perf block, /debug/perf, xla_compile trace events).
+
+Tiers:
+- unit — EngineEffAccounting with ``now_fn`` injection (reconciliation
+  math, ring-derived rates, compile event overlap) and BlockManager
+  fragmentation counters (alloc-failure classification, occupancy
+  observer, state census) — no engine, no device;
+- metrics — EngineMetrics.sync_eff/sync_kvpool delta semantics and
+  exposition names;
+- engine — a real debug-tiny AsyncLLMEngine behind the aiohttp server
+  launched WITHOUT warmup, so the first request's XLA compiles happen
+  mid-request and must surface as counters, /debug/perf events, AND
+  xla_compile spans on that request's trace.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.engine.efficiency import (EngineEffAccounting,
+                                                    OCCUPANCY_BUCKETS)
+from production_stack_tpu.engine.metrics import EngineMetrics
+from production_stack_tpu.tracing import PhaseHistograms
+
+
+# ------------------------------------------------------------ unit tier
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_window_accounting_reconciles_with_injected_clock():
+    """A steady synthetic stream of windows: kind totals must equal the
+    independent token_steps_total, and the ring-derived rates must
+    match hand-computed values at the injected timestamps."""
+    clock = _Clock()
+    acct = EngineEffAccounting(weight_bytes=1000, kv_position_bytes=10,
+                               hbm_peak_bytes_per_s=1e6, now_fn=clock)
+    # 10 windows, 1s apart: batch 4, 8 steps, 1 position; 2 live rows
+    # emitting fully (16 real), 2 parked (16 pad), 0 dead
+    for i in range(10):
+        clock.t = float(i + 1)
+        acct.note_window(steps=8, positions=1, batch=4, live_rows=2,
+                         kv_len=100, real=16, pad=16, dead=0,
+                         window_s=0.5)
+    r = acct.report()
+    dec = r["decode"]
+    assert dec["real"] == 160 and dec["pad"] == 160
+    assert dec["dead"] == 0
+    assert dec["token_steps_total"] == 10 * 4 * 8
+    assert dec["real"] + dec["pad"] + dec["dead"] == \
+        dec["token_steps_total"]
+    # per-window bytes: 8 * (1000 + 4*10*100) = 40000; half effective
+    assert r["bytes_total"] == 10 * 8 * (1000 + 4000)
+    assert r["bytes_effective"] == r["bytes_total"] // 2
+    rates = acct.rates(horizon_s=10.0, now=10.0)
+    # all 10 windows inside the horizon; 40000 bytes each, half live
+    assert rates["total_bytes_per_s"] == pytest.approx(40000.0)
+    assert rates["effective_bytes_per_s"] == pytest.approx(20000.0)
+    assert rates["mbu_perc"] == pytest.approx(2.0)
+    assert rates["live_fraction"] == pytest.approx(0.5)
+    assert rates["decode_tokens_per_s"] == pytest.approx(16.0)
+    # a narrower horizon sees only the last windows (cutoff is
+    # inclusive: t in {5..10} = 6 windows over 5 seconds)
+    rates5 = acct.rates(horizon_s=5.0, now=10.0)
+    assert rates5["decode_tokens_per_s"] == pytest.approx(6 * 16 / 5.0)
+    assert rates5["horizon_s"] == pytest.approx(5.0)
+
+
+def test_window_accounting_speculative_positions_and_dead():
+    """Speculative windows: positions = spec+1 per macro-step; rejected
+    draft positions and finished tails land in dead, and the kinds
+    still sum to the independent total."""
+    acct = EngineEffAccounting(now_fn=_Clock(1.0))
+    # batch 2, 4 macro-steps, 3 positions each; one live row emitted 7
+    # tokens across its macro-steps, one row parked
+    total = 2 * 4 * 3
+    pad = 1 * 4 * 3
+    real = 7
+    dead = total - pad - real
+    acct.note_window(steps=4, positions=3, batch=2, live_rows=1,
+                     kv_len=64, real=real, pad=pad, dead=dead,
+                     window_s=0.1)
+    d = acct.report()["decode"]
+    assert d["token_steps_total"] == total
+    assert d["real"] + d["pad"] + d["dead"] == total
+    assert d["dead"] == 5
+
+
+def test_prefill_padding_accounting():
+    acct = EngineEffAccounting(now_fn=_Clock(1.0))
+    # bucket 64 over batch 8 = 512 positions; 100 real chunk tokens
+    acct.note_prefill(bucket=64, batch=8, real_tokens=100)
+    p = acct.report()["prefill"]
+    assert p["real"] == 100 and p["pad"] == 412
+    assert p["dispatches"] == 1
+
+
+def test_compile_tracking_and_event_overlap():
+    clock = _Clock(0.0)
+    hist = PhaseHistograms(("kind", "window", "kv_bucket"),
+                           buckets=(1.0, 10.0))
+    acct = EngineEffAccounting(now_fn=clock, compile_hist=hist)
+    acct.compile_started("decode", 8, 512)
+    assert acct.report()["compile_in_flight"] == 1
+    acct.compile_finished("decode", 8, 512, started_at=5.0, dur_s=2.5)
+    acct.compile_started("prefill", 64, 256)
+    acct.compile_finished("prefill", 64, 256, started_at=20.0,
+                          dur_s=0.5)
+    r = acct.report()
+    assert r["compile_in_flight"] == 0
+    assert r["compiles_total"] == 2
+    assert r["compiles"]["decode|8|512"]["count"] == 1
+    assert r["compiles"]["decode|8|512"]["seconds"] == pytest.approx(2.5)
+    # duration histogram got both observations under their labels
+    # (snapshot values are (cumulative buckets, sum, count))
+    snap = hist.snapshot()
+    assert snap[("decode", "8", "512")][1] == pytest.approx(2.5)
+    assert snap[("decode", "8", "512")][2] == 1
+    # overlap filter: [6.0, 7.0] overlaps the decode compile (5.0-7.5)
+    # but not the prefill one (20.0-20.5)
+    events = acct.compile_events_between(6.0, 7.0)
+    assert [e[2] for e in events] == ["decode"]
+    # an interval strictly between the two catches neither
+    assert acct.compile_events_between(10.0, 19.0) == []
+    # recent_compiles renders both
+    assert len(acct.recent_compiles()) == 2
+
+
+def test_window_ring_is_bounded():
+    acct = EngineEffAccounting(ring_entries=8, now_fn=_Clock(1.0))
+    for _ in range(50):
+        acct.note_window(steps=1, positions=1, batch=1, live_rows=1,
+                         kv_len=1, real=1, pad=0, dead=0,
+                         window_s=0.01)
+    assert len(acct.recent_windows(100)) == 8
+    assert acct.report()["decode"]["windows"] == 50   # totals keep all
+
+
+def test_rates_clamp_to_ring_coverage():
+    """Regression: a busy engine whose ring evicts entries faster than
+    the horizon drains must divide by the span the ring actually
+    witnessed, not the full horizon — otherwise every rate understates
+    by the eviction ratio."""
+    clock = _Clock(0.0)
+    acct = EngineEffAccounting(weight_bytes=0, kv_position_bytes=1,
+                               ring_entries=4, now_fn=clock)
+    # 20 windows, 0.1s apart: ring keeps only the last 4 (t=1.7..2.0)
+    for i in range(20):
+        clock.t = 0.1 * (i + 1)
+        acct.note_window(steps=1, positions=1, batch=1, live_rows=1,
+                         kv_len=1, real=10, pad=0, dead=0,
+                         window_s=0.05)
+    rates = acct.rates(horizon_s=10.0, now=2.0)
+    # oldest resident entry is at t=1.7 -> 0.3s coverage holding 3
+    # entries within (1.7, 2.0]... the t=1.7 entry itself is included
+    # (cutoff inclusive): 4 entries * 10 real / 0.3s
+    assert rates["decode_tokens_per_s"] == pytest.approx(40 / 0.3,
+                                                         rel=1e-3)
+    # an un-evicted ring still divides by uptime
+    acct2 = EngineEffAccounting(ring_entries=100, now_fn=_Clock(0.0))
+    acct2._started_at = 0.0
+    acct2.note_window(steps=1, positions=1, batch=1, live_rows=1,
+                      kv_len=1, real=10, pad=0, dead=0, window_s=0.05)
+    assert acct2.rates(horizon_s=10.0,
+                       now=2.0)["decode_tokens_per_s"] == \
+        pytest.approx(5.0)
+
+
+# --------------------------------------------------- block manager tier
+
+def test_block_manager_alloc_failure_classification():
+    bm = BlockManager(num_blocks=5, block_size=4)   # 4 allocatable
+    got = bm.alloc(3)
+    assert got is not None and len(got) == 3
+    # 1 free remains: asking for 2 is the fragmentation regime
+    assert bm.alloc(2) is None
+    assert bm.alloc_failures_fragmented == 1
+    assert bm.alloc_failures_exhausted == 0
+    # drain the pool: now a failure is true exhaustion
+    assert bm.alloc(1) is not None
+    assert bm.alloc(1) is None
+    assert bm.alloc_failures_exhausted == 1
+    # zero-block requests (fully prefix-shared prompts) are not
+    # allocation attempts
+    allocs_before = bm.allocs
+    assert bm.alloc(0) == []
+    assert bm.allocs == allocs_before
+    assert bm.alloc(-1) is None
+    report = bm.frag_report()
+    assert report["alloc_failures_fragmented"] == 1
+    assert report["alloc_failures_exhausted"] == 1
+    assert report["blocks_allocated"] == 4
+
+
+def test_block_manager_state_census_and_evictions():
+    bm = BlockManager(num_blocks=5, block_size=2,
+                      enable_prefix_caching=True)
+    blocks = bm.alloc(2)
+    assert bm.frag_report()["active"] == 2
+    assert bm.frag_report()["free"] == 2
+    # register + free: the blocks become evictable cache, not free
+    tokens = [1, 2, 3, 4]
+    assert bm.register(tokens, blocks) == 2
+    bm.free(blocks)
+    rep = bm.frag_report()
+    assert rep["active"] == 0 and rep["cached"] == 2 and rep["free"] == 2
+    # allocating past the free list reclaims cached blocks (LRU) and
+    # counts the evictions
+    got = bm.alloc(4)
+    assert got is not None and len(got) == 4
+    assert bm.cache_evictions == 2
+    assert bm.frag_report()["cached"] == 0
+
+
+def test_block_manager_occupancy_observer():
+    seen = []
+    bm = BlockManager(num_blocks=5, block_size=4)
+    bm.on_alloc_occupancy = seen.append
+    bm.alloc(2)          # observed at usage 0.0
+    bm.alloc(2)          # observed at usage 0.5
+    bm.alloc(1)          # observed at usage 1.0 (fails, still observed)
+    assert seen == [0.0, 0.5, 1.0]
+    # the metrics layer's histogram shape accepts these observations
+    hist = PhaseHistograms((), buckets=OCCUPANCY_BUCKETS)
+    for v in seen:
+        hist.observe(v)
+    (cum, total, n), = hist.snapshot().values()
+    assert n == 3 and total == pytest.approx(1.5)
+
+
+# -------------------------------------------------------- metrics tier
+
+def test_metrics_delta_sync_eff_and_kvpool():
+    m = EngineMetrics(model="t")
+    acct = EngineEffAccounting(hbm_peak_bytes_per_s=1e9,
+                               weight_bytes=100,
+                               kv_position_bytes=1,
+                               now_fn=_Clock(1.0))
+    acct.note_window(steps=4, positions=1, batch=2, live_rows=1,
+                     kv_len=8, real=4, pad=4, dead=0, window_s=0.1)
+    m.sync_eff(acct.report(), acct.rates(now=1.0))
+    m.sync_eff(acct.report(), acct.rates(now=1.0))   # idempotent resync
+    text = m.render().decode()
+    assert 'tpu:engine_token_steps_total{kind="real",model_name="t",' \
+           'phase="decode"} 4.0' in text
+    assert 'tpu:engine_token_steps_total{kind="pad",model_name="t",' \
+           'phase="decode"} 4.0' in text
+    # a second window advances counters by the delta only
+    acct.note_window(steps=4, positions=1, batch=2, live_rows=1,
+                     kv_len=8, real=3, pad=4, dead=1, window_s=0.1)
+    m.sync_eff(acct.report(), acct.rates(now=1.0))
+    text = m.render().decode()
+    assert 'kind="real",model_name="t",phase="decode"} 7.0' in text
+    assert 'kind="dead",model_name="t",phase="decode"} 1.0' in text
+    bm = BlockManager(num_blocks=5, block_size=4)
+    bm.alloc(4)
+    bm.alloc(1)
+    m.sync_kvpool(bm.frag_report())
+    m.sync_kvpool(bm.frag_report())
+    text = m.render().decode()
+    assert 'tpu:kvpool_blocks{model_name="t",state="active"} 4.0' in text
+    assert 'tpu:kvpool_alloc_failures_total{model_name="t",' \
+           'reason="exhausted"} 1.0' in text
+    assert "tpu:engine_mbu_perc" in text
+    assert "tpu:decode_window_live_fraction" in text
+    assert "tpu:engine_compile_seconds" in text
+    assert "tpu:kvpool_alloc_occupancy" in text
+
+
+# --------------------------------------------------------- engine tier
+
+@pytest.fixture(scope="module")
+def cold_engine():
+    """A real debug-tiny engine with NO warmup: the first request's
+    XLA compiles happen mid-request, which is exactly what the compile
+    observability must make visible."""
+    from production_stack_tpu.engine.async_engine import AsyncLLMEngine
+    from production_stack_tpu.engine.config import EngineConfig
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=2, prefill_chunk=32,
+                       prefill_buckets=(16, 32))
+    return AsyncLLMEngine(cfg)
+
+
+def _with_client(engine, coro, **build_kw):
+    from production_stack_tpu.engine.server import build_app
+
+    async def runner():
+        app = build_app(engine, **build_kw)
+        async with TestClient(TestServer(app)) as client:
+            return await coro(client)
+    return asyncio.run(runner())
+
+
+def test_engine_perf_surfaces_and_compile_trace(cold_engine):
+    async def body(client):
+        body = {"model": "debug-tiny",
+                "messages": [{"role": "user", "content": "measure me"}],
+                "max_tokens": 6, "temperature": 0.0,
+                "ignore_eos": True}
+        r = await client.post("/v1/chat/completions", json=body)
+        assert r.status == 200
+        trace_id = r.headers["x-trace-id"]
+        # /load perf block: the request's decode steps are accounted
+        r = await client.get("/load")
+        perf = (await r.json())["perf"]
+        steps = perf["token_steps"]
+        assert steps["real"] == 5          # 6 tokens, first = prefill
+        assert steps["token_steps_total"] == \
+            steps["real"] + steps["pad"] + steps["dead"]
+        assert perf["compiles_total"] >= 2   # cold start compiled
+        assert perf["compile_in_flight"] == 0
+        assert perf["weight_bytes"] > 0
+        # /debug/perf: window ring + compile events + pool census
+        r = await client.get("/debug/perf?limit=5")
+        assert r.status == 200
+        dp = await r.json()
+        assert dp["windows"], "no window breakdowns recorded"
+        w = dp["windows"][-1]
+        assert w["batch"] == 2 and w["steps"] == 8
+        assert {"real", "pad", "dead", "kv_len",
+                "window_s"} <= set(w)
+        kinds = [e["kind"] for e in dp["compiles"]]
+        assert "decode" in kinds and "prefill" in kinds
+        assert dp["kv_pool"]["active"] == 0   # request finished
+        assert dp["totals"]["compiles_total"] == len(dp["compiles"])
+        # the compile-stalled request's trace carries xla_compile
+        # events (the compiles overlapped its life)
+        r = await client.get(f"/debug/traces?trace_id={trace_id}")
+        traces = (await r.json())["traces"]
+        assert traces
+        compile_spans = [s for s in traces[0]["spans"]
+                         if s["name"] == "xla_compile"]
+        assert compile_spans, "cold-start compiles missing from trace"
+        assert compile_spans[0]["kind"] == "event"
+        assert "kind" in compile_spans[0]["attrs"]
+        # /metrics exposition carries the new families with live values
+        r = await client.get("/metrics")
+        text = (await r.read()).decode()
+        assert 'tpu:engine_token_steps_total{kind="real"' in text
+        assert "tpu:engine_compiles_total{" in text
+        assert "tpu:engine_compile_seconds_bucket" in text
+        assert "tpu:kvpool_blocks{" in text
+    _with_client(cold_engine, body)
+
+
+def test_debug_perf_behind_api_key(cold_engine):
+    """/debug/perf follows /debug/traces' auth posture: enforced when
+    an API key is configured (probe endpoints stay open)."""
+    async def body(client):
+        r = await client.get("/debug/perf")
+        assert r.status == 401
+        r = await client.get("/debug/perf",
+                             headers={"Authorization": "Bearer sk"})
+        assert r.status == 200
+        r = await client.get("/load")   # probe surface stays open
+        assert r.status == 200
+        assert "perf" in await r.json()
+    _with_client(cold_engine, body, api_key="sk")

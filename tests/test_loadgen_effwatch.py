@@ -1,0 +1,232 @@
+"""Effwatch rig (loadgen effwatch): contract units, the fake engine's
+synthetic perf block, router-side perf parsing, and the engine-free
+smokes.
+
+Tiers:
+- units — effwatch_violations over synthetic records (each gate trips
+  independently), CLI defaults;
+- fake perf lever — POST /fault {"perf": {...}} drives the synthetic
+  pad/dead fractions, compile counters, and the sum-skew knob; /load
+  and /metrics tell the same story;
+- router parsing — a real EngineStatsScraper scrape of a fake's /load
+  lands the perf signals in router EngineStats;
+- rig — fake-engine effwatch smoke (reconciliation holds), the
+  anti-vacuity mis-sized window MUST fail reconciliation, and the
+  sum-skew knob MUST fail the sum-to-1 gate. The real-engine audit
+  stays behind ``slow`` (the committed EFF_r15.json is produced by
+  benchmarks/run_effwatch.sh).
+"""
+
+import asyncio
+import copy
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.loadgen.effwatch import (effwatch_violations,
+                                                   run_effwatch)
+from tests.fake_engine import FakeEngine
+
+
+# ------------------------------------------------------------ units
+
+def _clean_record():
+    return {
+        "value": 100.0,
+        "detail": {
+            "errors": 0,
+            "error_samples": [],
+            "deltas": {"real": 1000, "pad": 500, "dead": 100,
+                       "token_steps_total": 1600, "windows": 10,
+                       "compiles_total": 0},
+            "accounted_decode_tokens": 1000,
+            "client_decode_tokens": 1020,
+        },
+    }
+
+
+def test_violations_clean_record_passes():
+    assert effwatch_violations(_clean_record()) == []
+
+
+def test_violations_catch_each_gate():
+    # sum-to-1: kinds drift from the independent total
+    rec = _clean_record()
+    rec["detail"]["deltas"]["token_steps_total"] = 2000
+    assert any("sum to the independent total" in v
+               for v in effwatch_violations(rec))
+    # reconciliation: accounted diverges from client-measured
+    rec = _clean_record()
+    rec["detail"]["accounted_decode_tokens"] = 1500
+    assert any("diverge" in v for v in effwatch_violations(rec))
+    # steady-window compile silence
+    rec = _clean_record()
+    rec["detail"]["deltas"]["compiles_total"] = 2
+    assert any("compile events landed" in v
+               for v in effwatch_violations(rec))
+    # errors
+    rec = _clean_record()
+    rec["detail"]["errors"] = 3
+    assert any("client-visible errors" in v
+               for v in effwatch_violations(rec))
+    # empty window
+    rec = _clean_record()
+    rec["detail"]["deltas"].update(real=0, pad=0, dead=0,
+                                   token_steps_total=0)
+    rec["detail"]["accounted_decode_tokens"] = 0
+    assert any("no decode token-steps" in v
+               for v in effwatch_violations(rec))
+    # tolerance is honored
+    rec = _clean_record()
+    rec["detail"]["accounted_decode_tokens"] = 960   # 5.9% off
+    assert effwatch_violations(rec, rate_tolerance=0.10) == []
+    assert any("diverge" in v
+               for v in effwatch_violations(rec, rate_tolerance=0.02))
+
+
+def test_cli_defaults():
+    from production_stack_tpu.loadgen.__main__ import build_parser
+    args = build_parser().parse_args(["effwatch"])
+    assert args.engine == "debug-tiny"
+    assert args.duration == 20.0 and args.warmup == 8.0
+    assert args.sum_tolerance == 0.02
+    assert args.rate_tolerance == 0.10
+    assert not args.anti_vacuity
+
+
+# ----------------------------------------------- fake perf block tier
+
+def test_fake_engine_perf_block_and_fault_lever():
+    async def body():
+        fake = FakeEngine(model="m", num_tokens=8)
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        try:
+            async with TestClient(server) as client:
+                # perf controls ride POST /fault without touching the
+                # fault mode
+                r = await client.post("/fault", json={
+                    "perf": {"pad_fraction": 0.25,
+                             "dead_fraction": 0.25,
+                             "compiles_total": 3,
+                             "compile_in_flight": 1}})
+                assert (await r.json())["fault"] is None
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m", "max_tokens": 8,
+                    "messages": [{"role": "user", "content": "hi"}]})
+                assert r.status == 200
+                r = await client.get("/load")
+                perf = (await r.json())["perf"]
+                steps = perf["token_steps"]
+                # 8 served tokens -> 7 decode real (first = prefill)
+                assert steps["real"] == 7
+                assert steps["pad"] == 4 and steps["dead"] == 4
+                assert steps["token_steps_total"] == 15
+                assert perf["compiles_total"] == 3
+                assert perf["compile_in_flight"] == 1
+                assert perf["live_fraction"] == pytest.approx(7 / 15)
+                # /metrics agrees with /load
+                r = await client.get("/metrics")
+                text = (await r.read()).decode()
+                assert 'tpu:engine_token_steps_total{model_name="m",' \
+                       'kind="real",phase="decode"} 7' in text
+                assert "tpu:engine_mbu_perc" in text
+                assert "tpu:engine_compiles_total" in text
+                # the skew knob inflates the independent total
+                await client.post("/fault", json={"perf": {"skew": 1.0}})
+                r = await client.get("/load")
+                steps = (await r.json())["perf"]["token_steps"]
+                assert steps["token_steps_total"] == 30
+        finally:
+            await server.close()
+    asyncio.run(body())
+
+
+def test_router_scraper_parses_perf_block():
+    """Router-side parsing satellite: one real EngineStatsScraper
+    scrape of the fake's /load lands mbu/live-fraction/compile signals
+    in EngineStats."""
+    from production_stack_tpu.router.stats import EngineStatsScraper
+
+    async def body():
+        fake = FakeEngine(model="m", num_tokens=8)
+        fake._apply_perf_overrides({"perf": {
+            "pad_fraction": 0.5, "compiles_total": 7,
+            "compile_in_flight": 2, "mbu_perc": 41.5,
+            "effective_bytes_per_s": 3.4e11}})
+        fake._note_served(9)           # 8 decode-real token-steps
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+
+            class _Ep:
+                pass
+            ep = _Ep()
+            ep.url = url
+            scraper = EngineStatsScraper(lambda: [ep])
+            import aiohttp
+            async with aiohttp.ClientSession() as session:
+                scraper.attach(session)
+                await scraper.poll_now()
+            stats = scraper.get()[url]
+            assert stats.mbu_perc == pytest.approx(41.5)
+            assert stats.live_fraction == pytest.approx(8 / 16)
+            assert stats.compiles_total == 7
+            assert stats.compile_in_flight == 2
+            assert stats.decode_tokens_per_s > 0
+        finally:
+            await server.close()
+    asyncio.run(body())
+
+
+# -------------------------------------------------------------- rig
+
+def test_effwatch_smoke_fake_engine(tmp_path):
+    """Engine-free effwatch: synthetic pad/dead fractions, exact
+    client reconciliation, zero compiles — all gates green."""
+    record = asyncio.run(run_effwatch(
+        engine="fake", users=3, duration_s=4.0, warmup_s=1.5,
+        num_tokens=8, fake_pad_fraction=0.3, fake_dead_fraction=0.1,
+        log_dir=str(tmp_path / "logs")))
+    violations = effwatch_violations(record)
+    assert not violations, violations
+    d = record["detail"]
+    assert d["requests"] > 0
+    assert d["deltas"]["real"] == d["client_decode_tokens"]
+    assert d["fraction_sum"] == pytest.approx(1.0, abs=0.02)
+    assert d["live_fraction_steady"] == pytest.approx(0.6, abs=0.05)
+
+
+def test_effwatch_anti_vacuity_fails_reconciliation(tmp_path):
+    """The mis-sized accounting window (scrape taken before the warmup
+    storm) must trip the reconciliation gate — the audit can fail."""
+    record = asyncio.run(run_effwatch(
+        engine="fake", users=3, duration_s=3.0, warmup_s=3.0,
+        num_tokens=8, anti_vacuity=True,
+        log_dir=str(tmp_path / "logs")))
+    violations = effwatch_violations(record)
+    assert any("diverge" in v for v in violations), violations
+
+
+def test_effwatch_skew_fails_sum_gate(tmp_path):
+    """A fake whose independent total is inflated must trip the
+    sum-to-1 gate (and only that gate needs to trip)."""
+    record = asyncio.run(run_effwatch(
+        engine="fake", users=2, duration_s=3.0, warmup_s=1.0,
+        num_tokens=8, fake_skew=0.25,
+        log_dir=str(tmp_path / "logs")))
+    violations = effwatch_violations(record)
+    assert any("sum to the independent total" in v
+               for v in violations), violations
+
+
+@pytest.mark.slow
+def test_effwatch_real_engine(tmp_path):
+    """The committed acceptance shape: a real debug-tiny process,
+    10% reconciliation tolerance, zero steady compiles."""
+    record = asyncio.run(run_effwatch(
+        engine="debug-tiny", users=6, duration_s=20.0, warmup_s=8.0,
+        num_tokens=32, log_dir=str(tmp_path / "logs")))
+    violations = effwatch_violations(record)
+    assert not violations, violations

@@ -100,6 +100,21 @@ def test_alert_rules_in_sync_and_resolved():
     assert mod.main() == 0
 
 
+def test_dashboard_expressions_reference_registered_families():
+    """tools/check_dashboard_metrics.py: every PromQL expression in the
+    dashboard must reference a tpu:/vllm: family the code registers —
+    a renamed metric cannot leave a silently flatlined panel (also
+    wired into ci.yml). Complements the in-process registry check
+    above with the literal-scan view (the two walks must agree)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(OBS), "tools",
+                        "check_dashboard_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_dash", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0
+
+
 def test_every_registered_metric_is_documented():
     """tools/check_metrics_documented.py: each tpu:/vllm: family the
     code registers must have its line in docs/observability.md — a new

@@ -120,9 +120,19 @@ def test_sheds_never_feed_the_breaker():
 
 @pytest.fixture(scope="module")
 def engine():
-    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+    # max_model_len sizes the HOLD: the deadline/queue-delay tests park
+    # a stream on the single slot and need it to still be decoding
+    # seconds later when the queued victim's 300ms budget elapses. At
+    # 128 context a fast host finishes the length-capped hold in
+    # ~200ms and the victim gets admitted (and a 200) instead of
+    # dropped — the r14-noted flaky trio. 2048 gives the hold ~1900
+    # tokens of runway (holds are close()d long before they finish).
+    # One kv bucket so no decode executable compiles mid-test (a
+    # compile holds the engine lock and would stall the expiry sweep).
+    cfg = EngineConfig(model="debug-tiny", max_model_len=2048,
                        max_num_seqs=1, prefill_chunk=32,
-                       prefill_buckets=(16, 32), max_waiting_seqs=2)
+                       prefill_buckets=(16, 32),
+                       kv_len_buckets=(2048,), max_waiting_seqs=2)
     eng = AsyncLLMEngine(cfg)
     eng.engine.runner.warmup()
     return eng
@@ -147,7 +157,7 @@ async def _occupy_slot(client):
     response (close() releases it). post() returns once the first
     payload is out, i.e. the sequence is admitted and RUNNING."""
     resp = await client.post("/v1/chat/completions", json=_chat_body(
-        "hold", max_tokens=500, stream=True, ignore_eos=True))
+        "hold", max_tokens=1900, stream=True, ignore_eos=True))
     assert resp.status == 200
     await resp.content.readany()
     return resp

@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Lint: every Prometheus expression in the Grafana dashboard must
+reference a registered metric family.
+
+Walks every panel target's ``expr`` in
+``observability/tpu-stack-dashboard.json``, extracts the ``tpu:`` /
+``vllm:`` metric names it references, and checks each against the
+family names registered anywhere in ``production_stack_tpu/`` (and
+``tests/fake_engine.py`` — the same registry walk as
+``tools/check_metrics_documented.py``). A panel referencing a renamed
+or deleted family is a dashboard that silently flatlines; this makes
+it a CI failure instead.
+
+A dashboard name matches a registered family when it equals the
+registered literal, the literal plus the ``_total`` suffix Counters
+gain at exposition, or a histogram-derived series
+(``_bucket``/``_sum``/``_count`` over a registered base). Colon-named
+metrics exported by cluster infrastructure rather than this repo
+(``kubernetes_io:...``) are allowlisted.
+
+Exit 1 lists every unknown reference. Wired into the ci.yml lint job
+next to check_metrics_documented.py and into
+tests/test_observability.py.
+"""
+
+import json
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DASHBOARD = REPO / "observability" / "tpu-stack-dashboard.json"
+
+# colon-named series the dashboard may reference that other exporters
+# own (not this repo's registries)
+INFRA = {
+    "kubernetes_io:node_accelerator_duty_cycle",
+}
+
+# the registry walk shared with check_metrics_documented.py
+sys.path.insert(0, str(REPO / "tools"))
+from check_metrics_documented import registered_metrics  # noqa: E402
+
+EXPR_NAME_RE = re.compile(r"[a-z_]+:[a-z0-9_]+")
+
+
+def dashboard_exprs() -> list:
+    with open(DASHBOARD, encoding="utf-8") as f:
+        dash = json.load(f)
+    return [(panel.get("title", "?"), target["expr"])
+            for panel in dash.get("panels", [])
+            for target in panel.get("targets", [])
+            if target.get("expr")]
+
+
+def is_registered(name: str, registered: set) -> bool:
+    base = re.sub(r"_(bucket|sum|count|total)$", "", name)
+    candidates = {name, base, name + "_total", base + "_total"}
+    return bool(candidates & registered)
+
+
+def main() -> int:
+    registered = registered_metrics()
+    exprs = dashboard_exprs()
+    if not exprs:
+        print("no expressions found in the dashboard — parse failure?",
+              file=sys.stderr)
+        return 1
+    missing = []
+    for title, expr in exprs:
+        for name in EXPR_NAME_RE.findall(expr):
+            if name in INFRA:
+                continue
+            if not is_registered(name, registered):
+                missing.append((title, name, expr))
+    if missing:
+        print(f"{len(missing)} dashboard expressions reference metric "
+              f"families no code registers:", file=sys.stderr)
+        for title, name, expr in missing:
+            print(f"  - panel {title!r}: {name}  (expr: {expr})",
+                  file=sys.stderr)
+        print("\nRename the expression to a registered tpu:/vllm: "
+              "family or register the metric.", file=sys.stderr)
+        return 1
+    print(f"ok: {len(exprs)} dashboard expressions all reference "
+          f"registered metric families")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
