@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Multi-router closed loop: N real peered routers behind an L4 split
+# (affinity vs single-router control, breaker convergence, router
+# SIGKILL blip containment, QoS tier degradation). Committed record:
+# MULTIROUTER_r16.json. See docs/benchmarks.md "Multi-router".
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+OUT="${OUT:-MULTIROUTER_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ "${GUARD:-1}" = "1" ]; then
+  EXTRA+=(--overhead-guard)
+fi
+if [ "${NO_SHARED_STATE:-0}" = "1" ]; then
+  # anti-vacuity: this run MUST fail the affinity gate (exit 1)
+  EXTRA+=(--no-shared-state)
+fi
+
+python -m production_stack_tpu.loadgen multirouter \
+  --engine "$ENGINE" \
+  --engines "${ENGINES:-3}" --routers "${ROUTERS:-2}" \
+  --sessions "${SESSIONS:-12}" \
+  --phase-duration "${PHASE_DURATION:-20s}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "multirouter record: $OUT"

@@ -46,6 +46,8 @@ MODULES = {
         "production_stack_tpu.router.proxy",
         "production_stack_tpu.router.stats",
         "production_stack_tpu.router.dynamic_config",
+        "production_stack_tpu.router.shared_state",
+        "production_stack_tpu.router.qos",
         "production_stack_tpu.router.semantic_cache",
         "production_stack_tpu.router.pii",
         "production_stack_tpu.router.disagg",

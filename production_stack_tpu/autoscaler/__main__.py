@@ -97,7 +97,11 @@ def parse_args(argv=None) -> argparse.Namespace:
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--engines", required=True,
                    help="comma-separated engine URLs to poll /load on")
-    p.add_argument("--router-url", default=None)
+    p.add_argument("--router-url", default=None,
+                   help="router base URL(s) for the healthy-endpoint "
+                        "cross-check; comma-separated with N router "
+                        "replicas (the collector asks every one and "
+                        "takes the max)")
     p.add_argument("--alerts-url", default=None,
                    help="router base URL whose GET /alerts firing set "
                         "annotates every decision record (defaults to "
@@ -182,7 +186,11 @@ async def amain(args: argparse.Namespace) -> None:
                                 router_url=args.router_url,
                                 poll_interval_s=args.interval)
     alerts_fetch = None
-    alerts_url = args.alerts_url or args.router_url
+    # with N router replicas, alerts come from the first listed one
+    # (every replica computes its own burn off its own traffic; any
+    # live replica's firing set is a valid annotation source)
+    first_router = (args.router_url or "").split(",")[0].strip() or None
+    alerts_url = args.alerts_url or first_router
     if alerts_url and alerts_url != "off":
         alerts_fetch = make_alerts_fetch(alerts_url.rstrip("/"))
     scaler = Autoscaler(AutoscalerPolicy(policy_config(args)), actuator,

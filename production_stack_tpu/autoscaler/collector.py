@@ -35,12 +35,22 @@ logger = init_logger(__name__)
 
 class SignalCollector:
     def __init__(self, get_urls: Callable[[], Iterable[str]], *,
-                 router_url: Optional[str] = None,
+                 router_url=None,
                  poller: Optional[LoadPoller] = None,
                  poll_interval_s: float = 5.0,
                  freshness_s: float = 10.0):
         self._get_urls = get_urls
-        self.router_url = router_url
+        # one router URL, a comma-separated string, or a list: with N
+        # router replicas behind an L4 split the cross-check asks every
+        # one and takes the max healthy-endpoint count — any single
+        # replica being mid-restart must not read as "config never
+        # landed" while its peers see the full fleet
+        if isinstance(router_url, str):
+            router_url = [u.strip() for u in router_url.split(",")
+                          if u.strip()]
+        self.router_urls = list(router_url or [])
+        self.router_url = self.router_urls[0] if self.router_urls \
+            else None          # kept for existing callers/logs
         self._owns_poller = poller is None
         self.poller = poller if poller is not None else \
             LoadPoller(get_urls, interval_s=poll_interval_s)
@@ -108,11 +118,17 @@ class SignalCollector:
         )
 
     async def _router_healthy(self) -> Optional[int]:
-        if self.router_url is None or self._session is None:
+        if not self.router_urls or self._session is None:
             return None
+        counts = await asyncio.gather(
+            *(self._one_router_healthy(u) for u in self.router_urls))
+        live = [c for c in counts if c is not None]
+        return max(live) if live else None
+
+    async def _one_router_healthy(self, url: str) -> Optional[int]:
         try:
             async with self._session.get(
-                    f"{self.router_url}/health",
+                    f"{url}/health",
                     timeout=aiohttp.ClientTimeout(total=3)) as r:
                 body = await r.json()
                 return body.get("healthy_endpoints")

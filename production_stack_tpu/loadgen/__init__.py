@@ -28,9 +28,18 @@ Pieces (each importable on its own):
 - ``autoscale`` — offered-QPS ramp against the closed-loop autoscaler
                  (replicas track the ramp, drain-safe scale-down,
                  fixed-N comparison)
+- ``kvshare`` / ``disagg`` / ``trace`` / ``firedrill`` / ``effwatch``
+                 — the r11–r15 closed loops (cross-replica KV sharing,
+                 P/D split A/B, span-chain joins, SLO fire drill,
+                 efficiency-accounting audit)
+- ``multirouter`` — N peered router replicas behind an in-process L4
+                 splitter (affinity vs single-router control, breaker
+                 convergence, router-SIGKILL blip containment, QoS
+                 tier degradation)
 
 CLI: ``python -m production_stack_tpu.loadgen
-{run,soak,scaleout,overhead,chaos,overload,autoscale} ...``
+{run,soak,scaleout,overhead,chaos,overload,autoscale,kvshare,disagg,
+trace,firedrill,effwatch,multirouter} ...``
 (docs/benchmarks.md has the cookbook).
 
 Talks to the stack only through its public HTTP surfaces; no imports

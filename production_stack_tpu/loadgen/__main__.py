@@ -51,6 +51,15 @@ effwatch — launch ONE engine and audit its efficiency accounting
            throughput within 10%, and zero XLA compile events may land
            in the post-warmup steady window; --anti-vacuity mis-sizes
            the accounting window and must fail (EFF_*.json)
+multirouter — launch N peered router replicas (breaker/drain gossip,
+           QoS tiers, apportioned caps) behind an in-process L4
+           splitter; exit 1 unless pair affinity matches the
+           single-router control within tolerance, breaker state
+           converges across replicas within one probe interval, a
+           router SIGKILL costs only the counted in-flight blip, and
+           a saturation sweep holds tier-0 goodput while tier-2
+           sheds (MULTIROUTER_*.json; --no-shared-state must fail
+           the affinity gate)
 trace    — launch router + engines (optionally the disagg split),
            storm them, and join client x-trace-ids against the
            router's and engines' /debug/traces rings; exit 1 unless
@@ -82,6 +91,8 @@ from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
                                                     run_firedrill)
 from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
+from production_stack_tpu.loadgen.multirouter import (
+    multirouter_violations, run_multirouter)
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
 from production_stack_tpu.loadgen.overload import (overload_violations,
@@ -236,7 +247,12 @@ def cmd_chaos(args) -> int:
         startup_timeout_s=args.startup_timeout,
         cache_server_kill=args.cache_server_kill,
         cache_kill_interval_s=args.cache_kill_interval,
-        cache_downtime_s=args.cache_downtime))
+        cache_downtime_s=args.cache_downtime,
+        router_kill=args.router_kill,
+        router_replicas=args.router_replicas,
+        router_kill_interval_s=args.router_kill_interval,
+        router_downtime_s=args.router_downtime,
+        router_blip_window_s=args.router_blip_window))
     print(json.dumps(record, indent=2))
     output = args.output or f"CHAOS_{time.strftime('%Y%m%d_%H%M%S')}.json"
     report_mod.write_json(output, record)
@@ -571,6 +587,67 @@ def cmd_trace(args) -> int:
     return 1 if violations else 0
 
 
+def cmd_multirouter(args) -> int:
+    record = asyncio.run(run_multirouter(
+        engines=args.engines, routers=args.routers, engine=args.engine,
+        sessions=args.sessions, phase_duration_s=args.phase_duration,
+        num_tokens=args.num_tokens,
+        tokens_per_s=args.fake_tokens_per_s,
+        gossip_interval_s=args.gossip_interval,
+        settle_s=args.settle, blip_window_s=args.blip_window,
+        max_inflight=args.max_inflight,
+        tier0_users=args.tier0_users, tier1_users=args.tier1_users,
+        tier2_users=args.tier2_users,
+        saturation_presat_s=args.presat_duration,
+        routing=args.routing,
+        shared_state=not args.no_shared_state, seed=args.seed,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout,
+        skip_saturation=args.skip_saturation,
+        skip_kill=args.skip_kill,
+        overhead_guard=args.overhead_guard,
+        overhead_users=args.overhead_users,
+        overhead_duration_s=args.overhead_duration))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"MULTIROUTER_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = multirouter_violations(
+        record, affinity_tolerance=args.affinity_tolerance,
+        convergence_bound_s=args.convergence_bound or None,
+        min_tier0_hold=args.min_tier0_hold,
+        min_tier2_shed=args.min_tier2_shed,
+        max_overhead_ratio=(args.max_overhead_ratio
+                            if args.overhead_guard else None))
+    for v in violations:
+        print(f"MULTIROUTER VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        conv = d.get("breaker_convergence") or {}
+        kill = d.get("router_kill") or {}
+        sat = d.get("saturation") or {}
+        sat0 = (sat.get("saturated") or {}).get("tier0") or {}
+        sat2 = (sat.get("saturated") or {}).get("tier2") or {}
+        msg = (f"multirouter PASSED: pair affinity {record['value']}% "
+               f"vs control "
+               f"{100 * d['control']['affinity_hit_rate']:.1f}%, "
+               f"breaker open spread {conv.get('open_spread_s')}s")
+        if kill:
+            msg += (f", router kill blip {kill.get('blip_errors')} "
+                    f"errors / 0 outside, "
+                    f"{kill.get('post_restart_ok')} ok post-restart")
+        if sat:
+            msg += (f", tier0 {sat0.get('goodput_qps')} qps held while "
+                    f"tier2 shed {sat2.get('shed_fraction', 0):.0%}")
+        guard = d.get("overhead_guard")
+        if guard:
+            msg += (f"; shared-state overhead "
+                    f"{guard['overhead_ratio']:.2f}x vs baseline "
+                    f"{guard['baseline_ratio']:.2f}x")
+        print(msg)
+    return 1 if violations else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         "python -m production_stack_tpu.loadgen",
@@ -729,6 +806,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds between cache-server SIGKILLs")
     sp.add_argument("--cache-downtime", type=parse_duration, default=2.0,
                     help="seconds the cache server stays down")
+    sp.add_argument("--router-kill", action="store_true",
+                    help="launch --router-replicas peered routers "
+                         "behind an in-process L4 splitter and "
+                         "SIGKILL/restart router replicas on their "
+                         "own schedule — client errors are then "
+                         "allowed only inside each kill's blip window")
+    sp.add_argument("--router-replicas", type=int, default=2,
+                    help="router replica count with --router-kill")
+    sp.add_argument("--router-kill-interval", type=parse_duration,
+                    default=15.0,
+                    help="seconds between router SIGKILLs")
+    sp.add_argument("--router-downtime", type=parse_duration,
+                    default=2.0,
+                    help="seconds a killed router stays down")
+    sp.add_argument("--router-blip-window", type=parse_duration,
+                    default=4.0,
+                    help="seconds after each router kill during which "
+                         "in-flight client errors are tolerated "
+                         "(counted, reported)")
     sp.add_argument("--output", default=None,
                     help="write CHAOS_*.json here (default: "
                          "timestamped)")
@@ -1071,6 +1167,93 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write FIREDRILL_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_firedrill)
+
+    sp = sub.add_parser("multirouter",
+                        help="N real routers (peer gossip + QoS "
+                             "tiers) behind an in-process L4 "
+                             "splitter: pair affinity must match the "
+                             "single-router control, a router "
+                             "SIGKILL must cost only the in-flight "
+                             "blip, breakers must converge across "
+                             "replicas, and saturation must shed "
+                             "low-tier-first")
+    sp.add_argument("--engines", type=int, default=3,
+                    help="engine replica count behind the routers")
+    sp.add_argument("--routers", type=int, default=2,
+                    help="router replica count (>= 2)")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (the rig measures the control "
+                         "plane, not the model) or a real engine "
+                         "model name")
+    sp.add_argument("--sessions", type=int, default=12,
+                    help="sticky sessions in the affinity storms")
+    sp.add_argument("--phase-duration", type=parse_duration,
+                    default=20.0, help="seconds per phase")
+    sp.add_argument("--num-tokens", type=int, default=8)
+    sp.add_argument("--fake-tokens-per-s", type=float, default=60.0,
+                    help="fake engines: decode pacing (slow enough "
+                         "that router admission is the scarce "
+                         "resource in the saturation sweep)")
+    sp.add_argument("--gossip-interval", type=float, default=0.25,
+                    help="router --peer-gossip-interval")
+    sp.add_argument("--settle", type=parse_duration, default=3.0,
+                    help="seconds after the one-sided drain before "
+                         "the steady affinity window starts")
+    sp.add_argument("--blip-window", type=parse_duration, default=3.0,
+                    help="seconds after the router kill during which "
+                         "in-flight client errors are tolerated")
+    sp.add_argument("--max-inflight", type=int, default=8,
+                    help="per-router --max-inflight (the saturation "
+                         "sweep's scarce resource)")
+    sp.add_argument("--tier0-users", type=int, default=4)
+    sp.add_argument("--tier1-users", type=int, default=8)
+    sp.add_argument("--tier2-users", type=int, default=16,
+                    help="background users added for the saturation "
+                         "phase")
+    sp.add_argument("--presat-duration", type=parse_duration,
+                    default=8.0,
+                    help="pre-saturation tier0 goodput baseline "
+                         "window")
+    sp.add_argument("--routing", default="session",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--no-shared-state", action="store_true",
+                    help="launch the routers WITHOUT the gossip "
+                         "plane: the affinity gate must then fail "
+                         "(exit 1) — the anti-vacuity check")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--skip-saturation", action="store_true",
+                    help="skip the QoS saturation phase")
+    sp.add_argument("--skip-kill", action="store_true",
+                    help="skip the router-SIGKILL phase")
+    sp.add_argument("--affinity-tolerance", type=float, default=0.05,
+                    help="pair affinity may trail the control by "
+                         "this much")
+    sp.add_argument("--convergence-bound", type=float, default=0.0,
+                    help="seconds the per-router breaker open reports "
+                         "may spread (0 = one probe interval)")
+    sp.add_argument("--min-tier0-hold", type=float, default=0.95,
+                    help="tier0 saturated goodput as a fraction of "
+                         "pre-saturation")
+    sp.add_argument("--min-tier2-shed", type=float, default=0.5,
+                    help="tier2 shed fraction the sweep must reach")
+    sp.add_argument("--overhead-guard", action="store_true",
+                    help="also re-run the r7 A/B through a shared-"
+                         "state router vs a same-host plain baseline")
+    sp.add_argument("--overhead-users", type=int, default=48)
+    sp.add_argument("--overhead-duration", type=parse_duration,
+                    default=10.0)
+    sp.add_argument("--max-overhead-ratio", type=float, default=2.5,
+                    help="exit 1 if the shared-state ratio exceeds "
+                         "this band AND the same-host baseline by "
+                         ">10%% (the r14 convention)")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write MULTIROUTER_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_multirouter)
 
     sp = sub.add_parser("trace",
                         help="router + engines (optionally the disagg "

@@ -75,6 +75,10 @@ class _Counters:
         self.latencies: List[float] = []
         self.ttfts: List[float] = []
         self.samples: List[str] = []
+        # absolute monotonic stamps of every transport error: with
+        # --router-kill the contract becomes "errors only inside the
+        # kill blip windows", which needs to know WHEN each happened
+        self.transport_error_times: List[float] = []
 
     def sample(self, text: str) -> None:
         if len(self.samples) < 8:
@@ -149,11 +153,13 @@ async def chaos_storm(url: str, model: str, *, users: int,
                     c.stale_conn_retries += 1
                     continue
                 c.transport_errors += 1
+                c.transport_error_times.append(time.monotonic())
                 c.sample(f"{type(e).__name__}: {e}")
                 return
             except (aiohttp.ClientError, ConnectionError, OSError,
                     asyncio.TimeoutError) as e:
                 c.transport_errors += 1
+                c.transport_error_times.append(time.monotonic())
                 c.sample(f"{type(e).__name__}: {e}")
                 return
 
@@ -240,6 +246,64 @@ async def _cache_churn_loop(holder: Dict[str, Proc], *,
                            "restart", holder["proc"].url)
 
 
+async def _router_churn_loop(router_procs: List[Proc],
+                             router_ports: List[int],
+                             engine_urls: List[str], model: str, *,
+                             routing: str, kill_interval_s: float,
+                             downtime_s: float, deadline: float,
+                             log_dir: str, t0: float,
+                             events: List[Dict],
+                             router_extra_args: Optional[List[str]],
+                             engines: int) -> None:
+    """SIGKILL/restart ROUTER replicas round-robin (mirroring the
+    engine churn scheduler): sequential kill -> downtime -> restart ->
+    wait-healthy, so at least one replica is always up and the L4
+    splitter's connect-failover carries the traffic."""
+    i = 0
+    while True:
+        await asyncio.sleep(kill_interval_s)
+        if time.monotonic() + downtime_s + 5.0 >= deadline:
+            return
+        victim_idx = i % len(router_procs)
+        i += 1
+        victim = router_procs[victim_idx]
+        victim.popen.kill()
+        victim.popen.wait()
+        events.append({"t_s": round(time.monotonic() - t0, 2),
+                       "event": "router_kill", "url": victim.url})
+        logger.info("chaos: killed router %s", victim.url)
+        await asyncio.sleep(downtime_s)
+        router_procs[victim_idx] = _launch_chaos_router(
+            victim_idx, router_ports, engine_urls, model,
+            routing=routing, log_dir=log_dir,
+            router_extra_args=router_extra_args)
+        try:
+            await wait_healthy(router_procs[victim_idx].url, 30.0,
+                               require_endpoints=engines)
+            events.append({"t_s": round(time.monotonic() - t0, 2),
+                           "event": "router_restart",
+                           "url": router_procs[victim_idx].url})
+        except TimeoutError:
+            logger.warning("chaos: router %s not healthy after restart",
+                           router_procs[victim_idx].url)
+
+
+def _launch_chaos_router(idx: int, router_ports: List[int],
+                         engine_urls: List[str], model: str, *,
+                         routing: str, log_dir: str,
+                         router_extra_args: Optional[List[str]]) -> Proc:
+    port = router_ports[idx]
+    peers = ",".join(f"http://127.0.0.1:{p}" for p in router_ports
+                     if p != port)
+    extra = ROUTER_CHAOS_ARGS + [
+        "--router-id", f"chaos-router-{idx}",
+        "--peer-routers", peers,
+        "--peer-gossip-interval", "0.25",
+    ] + (router_extra_args or [])
+    return launch_router(engine_urls, model, port, routing=routing,
+                         log_dir=log_dir, extra_args=extra)
+
+
 async def _error_burst_loop(engine_urls: List[str], *,
                             interval_s: float, burst: int,
                             deadline: float, seed: int, t0: float,
@@ -315,7 +379,12 @@ async def run_chaos(*, engines: int = 3,
                     cache_server_kill: bool = False,
                     cache_kill_interval_s: float = 7.0,
                     cache_downtime_s: float = 2.0,
-                    prefill_ms_per_char: float = 0.2
+                    prefill_ms_per_char: float = 0.2,
+                    router_kill: bool = False,
+                    router_replicas: int = 2,
+                    router_kill_interval_s: float = 15.0,
+                    router_downtime_s: float = 2.0,
+                    router_blip_window_s: float = 4.0
                     ) -> Dict:
     """Launch router + N engines, storm the router while killing and
     restarting engines on a schedule; return the CHAOS record.
@@ -324,12 +393,21 @@ async def run_chaos(*, engines: int = 3,
     server wired into (fake) engines as their remote KV tier and
     SIGKILLs/restarts IT on its own schedule — the r11 extension: a
     dying cache server mid-transfer must cost TTFT (recompute), never a
-    client-visible error."""
+    client-visible error.
+
+    ``router_kill`` (the r16 extension) launches ``router_replicas``
+    peered routers behind an in-process L4 splitter instead of one
+    router, and SIGKILLs/restarts router replicas round-robin on their
+    own schedule: client errors are then allowed ONLY inside each
+    kill's ``router_blip_window_s`` (the dead replica's in-flight
+    requests), never in steady state."""
     procs: List[Proc] = []
     engine_procs: List[Proc] = []
+    router_procs: List[Proc] = []
     events: List[Dict] = []
     engine_extra_args: Optional[List[str]] = None
     cache_holder: Dict[str, Proc] = {}
+    splitter = None
     try:
         if cache_server_kill:
             if engine != "fake":
@@ -350,12 +428,34 @@ async def run_chaos(*, engines: int = 3,
         await asyncio.gather(*[wait_healthy(e.url, startup_timeout_s)
                                for e in engine_procs])
         model = "fake-model" if engine == "fake" else engine
-        router = launch_router(
-            [e.url for e in engine_procs], model, free_port(),
-            routing=routing, log_dir=log_dir,
-            extra_args=ROUTER_CHAOS_ARGS + (router_extra_args or []))
-        procs.append(router)
-        await wait_healthy(router.url, 60.0, require_endpoints=engines)
+        if router_kill:
+            from production_stack_tpu.loadgen.multirouter import (
+                L4Splitter)
+            router_ports = [free_port() for _ in range(router_replicas)]
+            for idx in range(router_replicas):
+                router_procs.append(_launch_chaos_router(
+                    idx, router_ports, [e.url for e in engine_procs],
+                    model, routing=routing, log_dir=log_dir,
+                    router_extra_args=router_extra_args))
+            procs.extend(router_procs)
+            await asyncio.gather(*[
+                wait_healthy(r.url, 60.0, require_endpoints=engines)
+                for r in router_procs])
+            splitter = L4Splitter([("127.0.0.1", p)
+                                   for p in router_ports])
+            await splitter.start()
+            storm_url = splitter.url
+            scrape_url = router_procs[0].url
+        else:
+            router = launch_router(
+                [e.url for e in engine_procs], model, free_port(),
+                routing=routing, log_dir=log_dir,
+                extra_args=ROUTER_CHAOS_ARGS + (router_extra_args or []))
+            procs.append(router)
+            await wait_healthy(router.url, 60.0,
+                               require_endpoints=engines)
+            storm_url = router.url
+            scrape_url = router.url
 
         logger.info("chaos: %d users vs router + %d %s engines for "
                     "%.0fs (kill every %.0fs, %.0fs downtime)",
@@ -378,8 +478,16 @@ async def run_chaos(*, engines: int = 3,
                 [e.url for e in engine_procs],
                 interval_s=error_burst_interval_s, burst=error_burst,
                 deadline=deadline, seed=seed, t0=t0, events=events)))
+        if router_kill:
+            tasks.append(asyncio.create_task(_router_churn_loop(
+                router_procs, router_ports,
+                [e.url for e in engine_procs], model, routing=routing,
+                kill_interval_s=router_kill_interval_s,
+                downtime_s=router_downtime_s, deadline=deadline,
+                log_dir=log_dir, t0=t0, events=events,
+                router_extra_args=router_extra_args, engines=engines)))
         try:
-            c = await chaos_storm(router.url, model, users=users,
+            c = await chaos_storm(storm_url, model, users=users,
                                   deadline=deadline,
                                   stream_fraction=stream_fraction,
                                   num_tokens=num_tokens, seed=seed)
@@ -388,17 +496,18 @@ async def run_chaos(*, engines: int = 3,
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
         elapsed = time.monotonic() - t0
-        router_counters = await _scrape_router_resilience(router.url)
+        router_counters = await _scrape_router_resilience(scrape_url)
         engine_kv = None
         if cache_server_kill:
             from production_stack_tpu.loadgen.kvshare import _scrape_kv
             engine_kv = await _scrape_kv([e.url for e in engine_procs])
     finally:
-        # the churn loops swap engine/cache Procs in place; stop the
-        # CURRENT processes plus anything from the launch-time snapshot
-        # (the router, and already-dead originals — _stop skips exited
-        # pids)
-        current = list(engine_procs)
+        # the churn loops swap engine/cache/router Procs in place; stop
+        # the CURRENT processes plus anything from the launch-time
+        # snapshot (already-dead originals — _stop skips exited pids)
+        if splitter is not None:
+            await splitter.close()
+        current = list(engine_procs) + list(router_procs)
         if cache_holder.get("proc") is not None:
             current.append(cache_holder["proc"])
         current.extend(p for p in procs if p not in current)
@@ -407,6 +516,26 @@ async def run_chaos(*, engines: int = 3,
     kills = len([e for e in events if e["event"] == "kill"])
     restarts = len([e for e in events if e["event"] == "restart"])
     cache_kills = len([e for e in events if e["event"] == "cache_kill"])
+    router_kills = len([e for e in events
+                        if e["event"] == "router_kill"])
+    # classify each transport error against the router-kill blip
+    # windows (kill .. restart-healthy + blip slack)
+    transport_rel = sorted(round(ts - t0, 2)
+                           for ts in c.transport_error_times)
+    errors_outside_blip = []
+    if router_kill:
+        windows = []
+        for e in events:
+            if e["event"] == "router_kill":
+                # the kill stamp lands after popen.wait(); connections
+                # reset the instant the signal delivers, so each
+                # window opens 0.5s early
+                windows.append([e["t_s"] - 0.5,
+                                e["t_s"] + router_downtime_s
+                                + router_blip_window_s])
+        for rel in transport_rel:
+            if not any(lo <= rel <= hi for lo, hi in windows):
+                errors_outside_blip.append(rel)
     done = c.ok + c.http_5xx + c.http_4xx + c.truncated_streams + \
         c.transport_errors
     availability = 100.0 * c.ok / done if done else 0.0
@@ -434,6 +563,16 @@ async def run_chaos(*, engines: int = 3,
             "kills": kills, "restarts": restarts,
             "cache_server_kill": cache_server_kill,
             "cache_kills": cache_kills,
+            "router_kill": router_kill,
+            "router_replicas": router_replicas if router_kill else 1,
+            "router_kills": router_kills,
+            "router_blip_window_s": router_blip_window_s
+            if router_kill else None,
+            "transport_error_times_s": transport_rel,
+            "errors_outside_blip": errors_outside_blip
+            if router_kill else None,
+            "splitter_connect_failovers": splitter.connect_failovers
+            if splitter is not None else None,
             "engine_kv": engine_kv,
             "requests": {
                 "launched": c.launched, "ok": c.ok,
@@ -462,7 +601,19 @@ def chaos_violations(record: Dict) -> List[str]:
     if r["http_5xx"]:
         out.append(f"{r['http_5xx']} client-visible 5xx (pre-stream "
                    f"failures must fail over, not surface)")
-    if r["transport_errors"]:
+    if d.get("router_kill"):
+        # router replicas DO die on schedule here: each kill may cost
+        # its in-flight blip (counted), but nothing outside a window
+        outside = d.get("errors_outside_blip") or []
+        if outside:
+            out.append(f"{len(outside)} transport errors OUTSIDE the "
+                       f"router-kill blip windows (at {outside[:5]}s) "
+                       f"— only the dead replica's in-flight requests "
+                       f"may surface")
+        if not d.get("router_kills"):
+            out.append("router churn never killed a router (window "
+                       "too short for router_kill_interval?)")
+    elif r["transport_errors"]:
         out.append(f"{r['transport_errors']} transport errors talking "
                    f"to the router (the router must not die)")
     if r["ok"] == 0:

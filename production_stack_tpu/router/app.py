@@ -29,8 +29,12 @@ from production_stack_tpu.router.resilience import (CLOSED,
                                                     HealthTracker,
                                                     RetryBudget,
                                                     wait_for_drain)
+from production_stack_tpu.router.qos import QosPolicy
 from production_stack_tpu.router.rewriter import make_rewriter
 from production_stack_tpu.router.routing import make_router
+from production_stack_tpu.router.shared_state import (RouterPeers,
+                                                      derive_router_id,
+                                                      peers_payload)
 from production_stack_tpu.router.service_discovery import (
     K8sServiceDiscovery, StaticServiceDiscovery, engine_auth_headers)
 from production_stack_tpu.router.stats import (EngineStatsScraper,
@@ -86,10 +90,14 @@ async def health(request: web.Request) -> web.Response:
     slo_task = state.get("slo_task")
     if slo_task and not slo_task.healthy():
         problems.append("SLO evaluation task dead")
+    peers = state.get("peers")
+    if peers is not None and not peers.healthy():
+        problems.append("peer gossip task dead")
     endpoints = state["discovery"].get_endpoints()
     body = {
         "status": "ok" if not problems else "unhealthy",
         "problems": problems,
+        "router_id": state["router_id"],
         "endpoints": len(endpoints),
         "healthy_endpoints": len([ep for ep in endpoints
                                   if tracker is None
@@ -103,6 +111,10 @@ async def health(request: web.Request) -> web.Response:
     disagg = state.get("disagg")
     if disagg is not None:
         body["prefill_pool"] = disagg.pool_snapshot()
+    if peers is not None:
+        body["peers"] = peers.snapshot()
+    if state.get("qos") is not None:
+        body["qos"] = state["qos"].snapshot()
     # firing burn-rate alerts ride on /health so a probe (or a human
     # with curl) sees SLO burn without knowing about /alerts — but
     # they do NOT flip status: a burning SLO is the fleet's problem
@@ -210,8 +222,22 @@ async def metrics(request: web.Request) -> web.Response:
         state["metrics"].refresh_disagg(disagg)
     if state.get("slo") is not None:
         state["metrics"].refresh_slo(state["slo"])
+    if state.get("peers") is not None:
+        state["metrics"].refresh_peers(state["peers"])
+    if state.get("qos") is not None:
+        state["metrics"].refresh_qos(state["qos"])
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
+
+
+async def peers_endpoint(request: web.Request) -> web.Response:
+    """GET /peers: this router's shareable control-plane facts — the
+    gossip wire format peer replicas poll (shared_state.RouterPeers).
+    Cheap by construction: a dict walk over breaker/drain state, no
+    window math."""
+    state = request.app["state"]
+    return web.json_response(
+        peers_payload(state["router_id"], state["health"]))
 
 
 # ---------------------------------------------------------------- wiring
@@ -219,6 +245,11 @@ async def metrics(request: web.Request) -> web.Response:
 def build_app(args: argparse.Namespace) -> web.Application:
     app = web.Application(client_max_size=64 * 1024 * 1024)
     state: dict = {
+        # replica identity: reported on /health, stamped as
+        # x-router-id on EVERY response (loadgen trace three-way joins
+        # attribute chains per replica), and exchanged in peer gossip
+        "router_id": args.router_id or derive_router_id(args.host,
+                                                        args.port),
         "request_timeout": args.request_timeout,
         # hot-path statics, built once: the client timeout object and
         # the engine-auth header overlay (proxy._forward_headers) are
@@ -266,6 +297,23 @@ def build_app(args: argparse.Namespace) -> web.Application:
                                 sample_rate=args.trace_sample_rate),
     }
     app["state"] = state
+
+    # QoS priority tiers (router/qos.py): graduated low-tier-first
+    # admission on the r9 gates + per-tier deadline budgets + optional
+    # background preemption; off unless --qos-tiers names a tier set
+    if args.qos_tiers:
+        state["qos"] = QosPolicy(
+            args.qos_tiers, tier_rates=args.qos_tier_rates,
+            preempt_from=args.qos_preempt_from)
+        state["qos_deadline_overlays"] = [
+            {"x-request-deadline-ms":
+             str(max(1000, int(args.request_timeout * 1000
+                               * state["qos"].deadline_factor(t))))}
+            for t in state["qos"].tiers]
+
+    async def stamp_router_id(request, response):
+        response.headers["x-router-id"] = state["router_id"]
+    app.on_response_prepare.append(stamp_router_id)
 
     @web.middleware
     async def track_inflight(request, handler):
@@ -368,6 +416,21 @@ def build_app(args: argparse.Namespace) -> web.Application:
             state, args.dynamic_config_json,
             interval_s=args.dynamic_config_interval)
 
+    # multi-router shared state (router/shared_state.py): gossip
+    # breaker/drain transitions with the named peer replicas and split
+    # the fleet-wide per-endpoint caps across live routers.
+    # --no-shared-state keeps the flags parsed but the plane dark —
+    # the multirouter rig's anti-vacuity lever.
+    if args.peer_routers and not args.no_shared_state:
+        state["peers"] = RouterPeers(
+            state["router_id"],
+            parse_comma_separated(args.peer_routers),
+            state["health"],
+            known_urls=lambda: [ep.url for ep in
+                                state["discovery"].all_endpoints()],
+            interval_s=args.peer_gossip_interval,
+            stale_after_s=args.peer_stale_after)
+
     for path in PROXIED_PATHS:
         app.router.add_post(path, _make_proxy_handler(path))
     app.router.add_get("/v1/models", list_models)
@@ -377,6 +440,9 @@ def build_app(args: argparse.Namespace) -> web.Application:
     app.router.add_get("/debug/traces",
                        debug_traces_handler(lambda: state["tracer"]))
     app.router.add_get("/alerts", alerts)
+    # always served (even with zero peers configured): a replica
+    # joining later can start polling before this one learns about it
+    app.router.add_get("/peers", peers_endpoint)
     app.router.add_post("/admin/drain", admin_drain)
 
     if args.enable_files_api or args.enable_batch_api:
@@ -396,9 +462,15 @@ def build_app(args: argparse.Namespace) -> web.Application:
             health_tracker=state["health"])
 
     if "slo" in state:
+        peers_get = None
+        if "peers" in state:
+            # peer gossip freshness feeds the router_peer_lost signal
+            # SLO through the same ingest path as engine /load samples
+            peers_get = lambda: state["peers"].signal_records()  # noqa: E731
         state["slo_task"] = SLOTask(
             state["slo"], scraper_get=lambda: state["scraper"].get(),
-            interval_s=args.slo_eval_interval)
+            interval_s=args.slo_eval_interval,
+            peers_get=peers_get)
 
     async def on_startup(app):
         state["client"] = aiohttp.ClientSession(
@@ -406,6 +478,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
         await state["discovery"].start()
         await state["scraper"].start()
         await state["health"].start(state["client"])
+        if "peers" in state:
+            await state["peers"].start(state["client"])
         if "stat_logger" in state:
             await state["stat_logger"].start()
         if "config_watcher" in state:
@@ -420,6 +494,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
             await state["stat_logger"].close()
         if "config_watcher" in state:
             await state["config_watcher"].close()
+        if "peers" in state:
+            await state["peers"].close()
         await state["health"].close()
         await state["scraper"].close()
         await state["discovery"].close()
@@ -615,6 +691,49 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="seconds between alert-state evaluation ticks "
                         "(also pulls fresh /load samples into the "
                         "signal SLOs)")
+    p.add_argument("--router-id", default=None,
+                   help="replica identity reported on /health, "
+                        "stamped as x-router-id on every response, "
+                        "and exchanged in peer gossip (default: "
+                        "derived from host:port)")
+    p.add_argument("--peer-routers", default="",
+                   help="comma-separated peer router base URLs: "
+                        "enables the multi-router shared-state plane "
+                        "(breaker/drain gossip via GET /peers, "
+                        "apportioned per-endpoint caps)")
+    p.add_argument("--peer-gossip-interval", type=float, default=1.0,
+                   help="seconds between peer gossip rounds")
+    p.add_argument("--peer-stale-after", type=float, default=None,
+                   help="seconds of gossip silence before a peer "
+                        "stops counting toward the live-router cap "
+                        "split (default: 3x the gossip interval)")
+    p.add_argument("--no-shared-state", action="store_true",
+                   help="parse --peer-routers but keep the gossip "
+                        "plane dark (no breaker/drain exchange, no "
+                        "cap splitting) — the multirouter rig's "
+                        "anti-vacuity lever")
+    p.add_argument("--qos-tiers", default="",
+                   help="enable QoS priority tiers: ordered "
+                        "name=admit_fraction pairs, highest priority "
+                        "first (canonical: "
+                        "'tier0=1.0,tier1=0.85,tier2=0.7'). Requests "
+                        "pick a tier via the x-priority-class header "
+                        "(name or index; untagged traffic = tier 0); "
+                        "tier k admits only while proxied in-flight "
+                        "is under fraction*--max-inflight, so "
+                        "saturation sheds low tiers first")
+    p.add_argument("--qos-tier-rates", default="",
+                   help="optional per-tier token buckets: "
+                        "name=requests_per_second pairs (absent = "
+                        "uncapped rate)")
+    p.add_argument("--qos-preempt-from", type=int, default=None,
+                   help="tiers at or past this index register as "
+                        "preemptable while their backend dispatch is "
+                        "pre-first-byte; a higher-priority arrival at "
+                        "the full gate takes the newest such slot "
+                        "(victim gets a structured 503 + Retry-After). "
+                        "Default: only the lowest tier; pass the tier "
+                        "count to disable preemption")
     p.add_argument("--enable-files-api", action="store_true")
     p.add_argument("--enable-batch-api", action="store_true")
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")

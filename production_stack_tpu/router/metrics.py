@@ -113,6 +113,40 @@ class RouterMetrics:
             "Routing decisions that fell back to hash affinity "
             "(cold prefix)", registry=self.registry)
         self._prefix_last = {"warm": 0, "cold": 0}
+        # multi-router control plane (router/shared_state.py + qos.py):
+        # peer liveness, per-tier QoS sheds/preemptions, and affinity
+        # moves. Counters are delta-synced (the r12 disagg convention)
+        # so a dynamic-config router swap — which resets the policy
+        # object's affinity totals — never reads as a counter reset.
+        self.router_peers = Gauge(
+            "tpu:router_peers",
+            "Peer router replicas by gossip liveness state "
+            "(live, stale, unreachable)",
+            ["state"], registry=self.registry)
+        self.qos_sheds = Counter(
+            "tpu:router_qos_sheds",
+            "Requests shed by the QoS admission layer per priority "
+            "tier (graduated pressure gate, token bucket, preemption)",
+            ["tier"], registry=self.registry)
+        self.qos_preemptions = Counter(
+            "tpu:router_qos_preemptions",
+            "In-flight background dispatches preempted by higher-"
+            "priority arrivals, per victim tier",
+            ["tier"], registry=self.registry)
+        self.qos_inflight = Gauge(
+            "tpu:router_qos_inflight",
+            "Currently proxied requests per priority tier",
+            ["tier"], registry=self.registry)
+        self.affinity_moves = Counter(
+            "tpu:router_affinity_moves",
+            "Session/prefix keys routed away from their previous home "
+            "endpoint, by reason (endpoint_lost = home unroutable/"
+            "removed; rebalance = policy drift — across N routers, "
+            "the split-brain signal)",
+            ["reason"], registry=self.registry)
+        self._qos_shed_last: dict = {}
+        self._qos_preempt_last: dict = {}
+        self._affinity_last: dict = {}
         # disaggregated prefill surface (router/disagg.py): prefill
         # dispatches/failures, per-reason fallbacks to aggregated
         # serving, breaker opens, and decode-selection outcomes. Real
@@ -258,10 +292,19 @@ class RouterMetrics:
             self.router_sheds.labels(scope=scope).set(count)
 
     def refresh_routing(self, router) -> None:
-        """Export cache-aware routing counters when the active policy
-        carries them (PrefixAwareRouter). Delta-synced: a dynamic-config
+        """Export cache-aware routing + affinity-move counters when
+        the active policy carries them. Delta-synced: a dynamic-config
         swap resets the router object's totals, so fresh totals below
         the last sync are treated as new increments."""
+        moves = getattr(router, "affinity_moves", None)
+        if moves is not None:
+            for reason, total in moves.items():
+                delta = total - self._affinity_last.get(reason, 0)
+                if delta < 0:     # router swapped: totals restarted
+                    delta = total
+                if delta > 0:
+                    self.affinity_moves.labels(reason=reason).inc(delta)
+                self._affinity_last[reason] = total
         warm = getattr(router, "warm_routes", None)
         if warm is None:
             return
@@ -275,6 +318,30 @@ class RouterMetrics:
             if delta > 0:
                 counter.inc(delta)
             self._prefix_last[key] = total
+
+    def refresh_peers(self, peers) -> None:
+        """Export peer-router liveness (shared_state.RouterPeers).
+        The state label set is fixed, so nothing to evict."""
+        for state, count in peers.state_counts().items():
+            self.router_peers.labels(state=state).set(count)
+
+    def refresh_qos(self, qos) -> None:
+        """Export per-tier QoS accounting (qos.QosPolicy). The tier
+        label set is fixed by the CLI spec for the process lifetime;
+        sheds/preemptions are delta-synced real counters."""
+        for tier, total in qos.shed_totals().items():
+            delta = total - self._qos_shed_last.get(tier, 0)
+            if delta > 0:
+                self.qos_sheds.labels(tier=tier).inc(delta)
+            self._qos_shed_last[tier] = total
+        for t in qos.tiers:
+            total = qos.preemptions[t.index]
+            delta = total - self._qos_preempt_last.get(t.name, 0)
+            if delta > 0:
+                self.qos_preemptions.labels(tier=t.name).inc(delta)
+            self._qos_preempt_last[t.name] = total
+            self.qos_inflight.labels(tier=t.name).set(
+                qos.inflight[t.index])
 
     def refresh_disagg(self, orch) -> None:
         """Export the disagg orchestrator's counters. Delta-synced like

@@ -107,6 +107,58 @@ class _ClientDisconnect(Exception):
     signal against the engine and no retry — nobody is listening."""
 
 
+class _Preempted(Exception):
+    """A higher-priority request took this one's admission slot
+    (router/qos.py). Raised only while the backend dispatch is in
+    flight and no byte has reached the client; the handler answers a
+    structured 503 + Retry-After."""
+
+
+class _PreemptableRequest:
+    """Races a backend dispatch against a preemption event. Wraps the
+    aiohttp request context manager ONLY for preemptable-tier requests
+    — the untiered/tier-0 hot path never allocates any of this."""
+
+    __slots__ = ("_ctx", "_event")
+
+    def __init__(self, ctx, event: asyncio.Event):
+        self._ctx = ctx
+        self._event = event
+
+    async def __aenter__(self):
+        req_task = asyncio.ensure_future(self._ctx.__aenter__())
+        waiter = asyncio.ensure_future(self._event.wait())
+        try:
+            await asyncio.wait({req_task, waiter},
+                               return_when=asyncio.FIRST_COMPLETED)
+        except asyncio.CancelledError:
+            # the HANDLER was cancelled (client disconnect): reap the
+            # in-flight dispatch too — asyncio.wait never cancels its
+            # pending futures, and a detached request task would pin
+            # its pooled connection until GC
+            req_task.cancel()
+            try:
+                await req_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            raise
+        finally:
+            waiter.cancel()
+        if req_task.done():
+            return req_task.result()
+        # preempted mid-dispatch: cancelling the request coroutine
+        # closes the backend connection, so the engine sees the abort
+        req_task.cancel()
+        try:
+            await req_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        raise _Preempted()
+
+    async def __aexit__(self, *exc):
+        return await self._ctx.__aexit__(*exc)
+
+
 # client-leg transport failures (raised by resp.prepare/write/write_eof)
 _CLIENT_LEG_ERRORS = (OSError, RuntimeError, aiohttp.ClientError)
 
@@ -148,10 +200,19 @@ def _endpoint_cap(state, url: str, scraper_stats=None) -> float:
     advertises on /metrics (tpu:engine_capacity_seqs, scraped by
     EngineStatsScraper; 0 = unbounded admission -> no cap).
     ``scraper_stats`` lets the failover loop snapshot the scraper once
-    per routing pass instead of once per candidate."""
+    per routing pass instead of once per candidate.
+
+    Both caps are FLEET-wide bounds: with peer routers configured
+    (shared_state.RouterPeers) each router enforces only its share —
+    cap / live-router-count, floored at 1 — so N routers together
+    still respect the engine's advertised capacity instead of
+    N-times it, and a dead peer's share flows back to the survivors
+    once gossip marks it stale."""
+    peers = state.get("peers")
+    share = peers.cap_share() if peers is not None else 1.0
     static = state.get("endpoint_cap") or 0
     if static > 0:
-        return float(static)
+        return max(1.0, float(static) * share)
     if scraper_stats is None:
         scraper = state.get("scraper")
         if scraper is None:
@@ -160,7 +221,7 @@ def _endpoint_cap(state, url: str, scraper_stats=None) -> float:
     es = scraper_stats.get(url)
     if es is None or es.capacity <= 0:
         return float("inf")
-    return es.capacity
+    return max(1.0, es.capacity * share)
 
 
 def _under_cap(state, ep, request_stats, scraper_stats) -> bool:
@@ -182,6 +243,16 @@ def _shed_response(status: int, message: str,
     return resp
 
 
+def _preempted_response(tier) -> web.Response:
+    """Structured answer for a preempted background request: the same
+    shed wire shape (503 + Retry-After), so clients back off and the
+    SLO engine classifies it as intentional backpressure, never an
+    availability burn."""
+    return _shed_response(
+        503, f"preempted: tier {tier.name} admission slot taken by "
+             f"higher-priority traffic; retry later")
+
+
 # response header carrying the request's trace id (stamped on EVERY
 # response, sheds and errors included) so a client-side harness can
 # join client-observed latency to the server-side span chain
@@ -192,15 +263,22 @@ TRACE_ID_HEADER = "x-trace-id"
 
 def _slo_observe(state, endpoint_path: str, request: web.Request,
                  resp: Optional[web.StreamResponse], trace,
-                 final_status: str = "ok") -> None:
+                 final_status: str = "ok", tier=None) -> None:
     """Feed the SLO engine one finished request — a handful of bucket
     increments, taken from state already at hand (the response and the
     trace's phase spans). Client disconnects are skipped entirely: the
     caller vanished, so neither availability nor latency was observed
-    by anyone."""
+    by anyone. A QoS tier becomes the request's SLO class (unless the
+    client named one explicitly) so per-tier objectives — e.g. the
+    default tier0_shed_rate — see per-tier traffic."""
     slo = state.get("slo")
     if slo is None or resp is None or final_status == "client_disconnect":
         return
+    cls = None
+    if tier is not None:
+        from production_stack_tpu.slo import CLASS_HEADER
+        if CLASS_HEADER not in request.headers:
+            cls = tier.name
     t0 = trace.t0
     ttft = None
     for name, kind, start, dur, _status, _attrs in trace.spans:
@@ -211,7 +289,8 @@ def _slo_observe(state, endpoint_path: str, request: web.Request,
     slo.observe_response(endpoint_path, request.headers, resp.status,
                          resp.headers, ttft_s=ttft,
                          e2e_s=time.monotonic() - t0,
-                         truncated=(final_status == "truncated"))
+                         truncated=(final_status == "truncated"),
+                         cls=cls)
 
 
 def _finish_trace(state, trace, status: str) -> None:
@@ -240,7 +319,30 @@ async def route_general_request(request: web.Request,
     trace = state["tracer"].begin(request.headers.get("traceparent"),
                                   name=endpoint_path)
     max_inflight = state.get("max_inflight") or 0
-    if max_inflight and state["proxied_inflight"] >= max_inflight:
+    qos = state.get("qos")
+    tier = None
+    if qos is not None:
+        # graduated, low-tier-first admission (router/qos.py): each
+        # tier hits its own fraction of the --max-inflight gate, its
+        # optional token bucket applies pressure or not, and a top-tier
+        # arrival at the full gate may preempt a background dispatch
+        # instead of shedding
+        tier = qos.resolve(request.headers)
+        verdict, _victim = qos.admit(tier, state["proxied_inflight"],
+                                     max_inflight)
+        if verdict == "shed":
+            state["shed_counts"]["admission"] += 1
+            resp = _shed_response(
+                429, f"router overloaded: priority tier {tier.name} "
+                     f"is past its admission bound "
+                     f"({state['proxied_inflight']} in flight, "
+                     f"--max-inflight {max_inflight}); retry later")
+            resp.headers[TRACE_ID_HEADER] = trace.trace_id
+            _slo_observe(state, endpoint_path, request, resp, trace,
+                         tier=tier)
+            _finish_trace(state, trace, "shed")
+            return resp
+    elif max_inflight and state["proxied_inflight"] >= max_inflight:
         state["shed_counts"]["admission"] += 1
         resp = _shed_response(
             429, f"router overloaded: {state['proxied_inflight']} "
@@ -251,8 +353,10 @@ async def route_general_request(request: web.Request,
         _finish_trace(state, trace, "shed")
         return resp
     state["proxied_inflight"] += 1
+    if qos is not None:
+        qos.on_start(tier)
     try:
-        resp = await _proxy_request(request, endpoint_path, trace)
+        resp = await _proxy_request(request, endpoint_path, trace, tier)
     except BaseException as e:
         if not isinstance(e, asyncio.CancelledError):
             # an escaped handler exception becomes aiohttp's own 500 —
@@ -269,6 +373,8 @@ async def route_general_request(request: web.Request,
         raise
     finally:
         state["proxied_inflight"] -= 1
+        if qos is not None:
+            qos.on_complete(tier)
     if resp is not None and not resp.prepared:
         # prepared (streaming / relayed) responses were stamped before
         # resp.prepare inside the relay; everything else — error JSON,
@@ -277,14 +383,15 @@ async def route_general_request(request: web.Request,
     status = trace.attrs.get("final_status", "ok")
     if status == "ok" and resp is not None and resp.status >= 400:
         status = f"http_{resp.status}"
-    _slo_observe(state, endpoint_path, request, resp, trace, status)
+    _slo_observe(state, endpoint_path, request, resp, trace, status,
+                 tier=tier)
     _finish_trace(state, trace, status)
     return resp
 
 
 async def _proxy_request(request: web.Request,
                          endpoint_path: str,
-                         trace) -> web.StreamResponse:
+                         trace, tier=None) -> web.StreamResponse:
     app = request.app
     state = app["state"]
     t_route0 = time.monotonic()
@@ -410,8 +517,17 @@ async def _proxy_request(request: web.Request,
 
     monitor = state["request_stats"]
     session: aiohttp.ClientSession = state["client"]
+    # tiered deadline budgets: the overlay injected when the client
+    # sent no deadline shrinks with the tier's admit fraction, so under
+    # queue buildup the engine's expiry sweep drops background work
+    # first (router/qos.py "deadline budgets, low-tier-first")
+    deadline_overlay = state.get("deadline_overlay")
+    if tier is not None:
+        overlays = state.get("qos_deadline_overlays")
+        if overlays is not None:
+            deadline_overlay = overlays[tier.index]
     fwd_headers = _forward_headers(request, state["auth_overlay"],
-                                   state.get("deadline_overlay"))
+                                   deadline_overlay)
     # the engine parents its spans onto the ROUTER's span (a client-
     # supplied traceparent became this trace's parent in begin(), so
     # the client's own context is replaced, not forwarded verbatim)
@@ -428,14 +544,37 @@ async def _proxy_request(request: web.Request,
     prefer_least_loaded = False
     last_was_shed = False  # exhaustion after a shed relays 503, not 502
 
+    # preemption surface (router/qos.py): background-tier requests
+    # register while their backend dispatch is in flight; a top-tier
+    # arrival at the full admission gate may take the slot
+    qos = state.get("qos")
+    preempt_event: Optional[asyncio.Event] = None
+    preempt_slot = None
+    if qos is not None and tier is not None \
+            and tier.index >= qos.preempt_from:
+        preempt_event = asyncio.Event()
+        preempt_slot = qos.register_preemptable(tier, preempt_event)
+
     # bounded pre-stream failover loop: a connect error, refusal,
     # timeout, or backend 5xx *before any byte reached the client* marks
     # the endpoint in the health tracker and re-routes among the
     # remaining candidates (jittered backoff, global retry budget).
     # Once bytes have been relayed the stream can only truncate — bytes
     # cannot be replayed.
-    while True:
-        pool = [ep for ep in candidates if ep.url not in tried]
+    try:
+      while True:
+        if preempt_event is not None and preempt_event.is_set():
+            # preempted between attempts: the slot is already gone
+            trace.attrs["final_status"] = "preempted"
+            return _preempted_response(tier)
+        # re-read the CONFIGURED fleet each attempt: a dynamic-config
+        # apply that removed an endpoint mid-failover must not see it
+        # resurrected from this loop's captured candidate list
+        # (pinned by tests/test_router_resilience.py)
+        live = {ep.url
+                for ep in state["discovery"].all_endpoints()}
+        pool = [ep for ep in candidates
+                if ep.url not in tried and ep.url in live]
         if not pool:
             break
         if attempt > 0:
@@ -524,12 +663,23 @@ async def _proxy_request(request: web.Request,
         t_hdrs: Optional[float] = None   # backend headers received at
         decode_failed = False   # pre-stream failure: un-credit locality
         try:
-            async with session.post(
-                    f"{url}{endpoint_path}", data=raw,
-                    headers=fwd_headers,
-                    timeout=state["client_timeout"],
-            ) as backend:
+            post_cm = session.post(
+                f"{url}{endpoint_path}", data=raw,
+                headers=fwd_headers,
+                timeout=state["client_timeout"])
+            if preempt_event is not None:
+                # background tier: the dispatch races the preemption
+                # event (the hot path takes the bare context manager)
+                post_cm = _PreemptableRequest(post_cm, preempt_event)
+            async with post_cm as backend:
                 t_hdrs = time.monotonic()
+                if preempt_slot is not None:
+                    # the engine answered: preempting past this point
+                    # saves almost nothing, so leave the registry now
+                    # and close the picked-but-already-streaming race
+                    qos.unregister_preemptable(preempt_slot)
+                    preempt_slot = None
+                    preempt_event = None
                 shed = (backend.status in (429, 503)
                         and "Retry-After" in backend.headers)
                 if shed:
@@ -639,6 +789,13 @@ async def _proxy_request(request: web.Request,
                     _store_cached_response(semantic_cache, body,
                                            bytes(captured))
                 return resp
+        except _Preempted:
+            # a higher-priority request took this slot mid-dispatch:
+            # structured 503 + Retry-After (the engine saw the abort;
+            # no health signal — nothing is wrong with it)
+            decode_failed = True
+            trace.attrs["final_status"] = "preempted"
+            return _preempted_response(tier)
         except _ClientDisconnect:
             # the client vanished mid-relay; the backend did nothing
             # wrong (a few users hitting stop must not trip a healthy
@@ -732,6 +889,9 @@ async def _proxy_request(request: web.Request,
                             "(attempt %d/%d)", url, retry_cause,
                             attempt, max_attempts)
         break
+    finally:
+        if qos is not None:
+            qos.unregister_preemptable(preempt_slot)
 
     # all attempts exhausted before a byte reached the client
     if timed_out:

@@ -50,10 +50,14 @@ HALF_OPEN = "half_open"
 FAILURE_KINDS = ("connect", "timeout", "http_5xx", "mid_stream", "probe")
 INFORMATIONAL_KINDS = ("shed", "deadline")
 
+# "no transition ever" sentinel age for the peer-gossip payloads
+# (float('inf') is not valid JSON)
+NEVER_AGE = 1e9
+
 
 class _EndpointHealth:
     __slots__ = ("state", "consecutive", "outcomes", "open_until",
-                 "opened_at", "probing", "opens")
+                 "opened_at", "probing", "opens", "transition_at")
 
     def __init__(self):
         self.state = CLOSED
@@ -64,6 +68,11 @@ class _EndpointHealth:
         self.opened_at = 0.0
         self.probing = False
         self.opens = 0
+        # when this endpoint last crossed open<->closed — the peer
+        # gossip layer (shared_state.py) compares transition AGES so
+        # two routers agree on which of them saw the newer event
+        # without sharing a clock
+        self.transition_at: Optional[float] = None
 
 
 class HealthTracker:
@@ -90,6 +99,13 @@ class HealthTracker:
         self._now = now_fn
         self._eps: Dict[str, _EndpointHealth] = {}
         self._draining: set = set()
+        # url -> (draining bool, stamped at): drain TRANSITIONS carry
+        # ages through peer gossip the same way breaker transitions do
+        # (an /admin/drain lands on ONE router; its peers must learn)
+        self._drain_events: Dict[str, Tuple[bool, float]] = {}
+        # peer-adoption telemetry (shared_state.py feeds these)
+        self.peer_adopted_opens = 0
+        self.peer_adopted_closes = 0
         # counters exported by RouterMetrics.refresh_resilience
         self.failures: Dict[Tuple[str, str], int] = \
             collections.defaultdict(int)
@@ -127,6 +143,7 @@ class HealthTracker:
         h.open_until = now + self.cooldown_s
         h.opens += 1
         h.probing = False
+        h.transition_at = now
         self.breaker_opens += 1
         logger.warning("breaker OPEN for %s (%s; cooldown %.1fs)",
                        url, why, self.cooldown_s)
@@ -134,6 +151,7 @@ class HealthTracker:
     def _close(self, url: str, h: _EndpointHealth, why: str) -> None:
         if h.state != CLOSED:
             self.recoveries += 1
+            h.transition_at = self._now()
             logger.info("breaker CLOSED for %s (%s)", url, why)
         h.state = CLOSED
         h.consecutive = 0
@@ -251,17 +269,124 @@ class HealthTracker:
         for key in [k for k in self.failures if k[0] not in live]:
             del self.failures[key]
 
+    # -- peer gossip (shared_state.py) -----------------------------------
+
+    def peer_view(self) -> Dict[str, Dict]:
+        """This router's shareable health facts, ages instead of
+        timestamps (two processes share no clock; an age survives the
+        hop with only gossip-interval skew). Endpoints with no
+        transition yet are omitted — there is nothing to converge on."""
+        now = self._now()
+        out: Dict[str, Dict] = {}
+        for url, h in self._eps.items():
+            if h.transition_at is None:
+                continue
+            entry = {"state": h.state,
+                     "age_s": max(0.0, now - h.transition_at)}
+            if h.state != CLOSED:
+                entry["cooldown_remaining_s"] = max(
+                    0.0, h.open_until - now)
+            out[url] = entry
+        for url, (draining, at) in self._drain_events.items():
+            # NEVER_AGE keeps the payload JSON-clean (inf is not JSON)
+            entry = out.setdefault(url, {"state": self.state_of(url),
+                                         "age_s": NEVER_AGE})
+            entry["draining"] = draining
+            entry["drain_age_s"] = max(0.0, now - at)
+        return out
+
+    def _transition_age(self, h: Optional[_EndpointHealth]) -> float:
+        if h is None or h.transition_at is None:
+            return float("inf")
+        return max(0.0, self._now() - h.transition_at)
+
+    def adopt_peer_view(self, view: Dict[str, Dict],
+                        known_urls=None) -> None:
+        """Merge one peer's ``peer_view()``: last-writer-wins by
+        transition age. A peer that observed a NEWER open/close than we
+        did wins — its age is smaller than ours — so when an engine
+        dies under traffic only one router carries, every replica
+        converges on OPEN within a gossip interval instead of a full
+        organic trip; when the probe closes it, the close propagates
+        the same way. ``known_urls`` (the configured fleet) bounds what
+        a peer can make us track — a peer with a stale config must not
+        plant state for endpoints we no longer serve."""
+        known = set(known_urls) if known_urls is not None else None
+        for url, entry in view.items():
+            if known is not None and url not in known:
+                continue
+            self._adopt_breaker(url, entry)
+            self._adopt_drain(url, entry)
+
+    def _adopt_breaker(self, url: str, entry: Dict) -> None:
+        peer_state = entry.get("state")
+        peer_age = float(entry.get("age_s", NEVER_AGE))
+        if peer_state not in (OPEN, HALF_OPEN, CLOSED) or \
+                peer_age >= NEVER_AGE:
+            return
+        h = self._eps.get(url)
+        if self._transition_age(h) <= peer_age:
+            return            # our own observation is at least as new
+        now = self._now()
+        if peer_state in (OPEN, HALF_OPEN):
+            if self.state_of(url) == CLOSED:
+                h = self._h(url)
+                h.state = OPEN
+                h.opened_at = now - peer_age
+                # inherit the peer's remaining cooldown so our OWN
+                # re-probe takes over roughly when theirs would —
+                # adopted opens still close only through a probe
+                h.open_until = now + max(
+                    0.0, float(entry.get("cooldown_remaining_s",
+                                         self.cooldown_s)))
+                h.opens += 1
+                h.probing = False
+                h.transition_at = now - peer_age
+                self.breaker_opens += 1
+                self.peer_adopted_opens += 1
+                logger.warning("breaker OPEN for %s (adopted from peer, "
+                               "%.1fs old)", url, peer_age)
+        else:
+            if h is not None and h.state != CLOSED:
+                self._close(url, h, "peer observed recovery")
+                h.transition_at = now - peer_age
+                self.peer_adopted_closes += 1
+
+    def _adopt_drain(self, url: str, entry: Dict) -> None:
+        if "draining" not in entry:
+            return
+        peer_draining = bool(entry["draining"])
+        peer_age = float(entry.get("drain_age_s", float("inf")))
+        ours = self._drain_events.get(url)
+        our_age = float("inf") if ours is None \
+            else max(0.0, self._now() - ours[1])
+        if our_age <= peer_age:
+            return
+        if peer_draining == (url in self._draining):
+            # already agree; just remember the (older) stamp so a
+            # third router's even-staler contradiction cannot win later
+            self._drain_events[url] = (peer_draining,
+                                       self._now() - peer_age)
+            return
+        if peer_draining:
+            self.start_drain(url)
+        else:
+            self.end_drain(url)
+        self._drain_events[url] = (peer_draining, self._now() - peer_age)
+
     # -- drain ----------------------------------------------------------
 
     def start_drain(self, url: str) -> None:
         if url not in self._draining:
             logger.info("draining %s: no new admissions; in-flight "
                         "requests continue", url)
+            self._drain_events[url] = (True, self._now())
         self._draining.add(url)
 
     def end_drain(self, url: str) -> None:
         if url in self._draining:
             logger.info("drain ended for %s: routable again", url)
+            self._drain_events[url] = (False, self._now())
         self._draining.discard(url)
 
     def draining(self) -> List[str]:

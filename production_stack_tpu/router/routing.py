@@ -78,6 +78,61 @@ def record_chunk_holders(ring, digests, url: str, *,
         ring.popitem(last=False)
 
 
+class AffinityTracker:
+    """Counts affinity *moves*: routing decisions where a key
+    (session id / prompt prefix) lands on a different endpoint than
+    its previous home. Reasons:
+
+    - ``endpoint_lost`` — the previous home is absent from this
+      decision's candidate set (breaker open, draining, removed):
+      the expected, bounded churn consistent hashing promises.
+    - ``endpoint_recovered`` — the key moved BACK to the home it held
+      before its last ``endpoint_lost`` displacement: the second half
+      of the same expected churn (breaker closed / drain ended), not
+      drift — counting it as rebalance would spike the split-brain
+      signal after every ordinary recovery.
+    - ``rebalance`` — the previous home was still a candidate but
+      the policy picked elsewhere: in a single router this is warm-
+      scoring drift; across N routers it is the split-brain signal
+      the multi-router control plane exists to keep near zero
+      (``tpu:router_affinity_moves_total{reason}``).
+
+    Bounded LRU over keys; one dict get + set per routing decision.
+    """
+
+    __slots__ = ("_homes", "max_entries", "moves")
+
+    def __init__(self, max_entries: int = 4096):
+        import collections
+        # key -> [current_home, displaced_home-or-None]
+        self._homes: "collections.OrderedDict[str, List[Optional[str]]]" \
+            = collections.OrderedDict()
+        self.max_entries = max_entries
+        self.moves = {"endpoint_lost": 0, "endpoint_recovered": 0,
+                      "rebalance": 0}
+
+    def note(self, key: str, url: str, candidate_urls) -> None:
+        entry = self._homes.get(key)
+        if entry is None:
+            entry = self._homes[key] = [url, None]
+        else:
+            prev, displaced = entry
+            if prev != url:
+                if prev not in candidate_urls:
+                    self.moves["endpoint_lost"] += 1
+                    entry[1] = prev       # remember the real home
+                elif url == displaced:
+                    self.moves["endpoint_recovered"] += 1
+                    entry[1] = None
+                else:
+                    self.moves["rebalance"] += 1
+                    entry[1] = None
+                entry[0] = url
+        self._homes.move_to_end(key)
+        while len(self._homes) > self.max_entries:
+            self._homes.popitem(last=False)
+
+
 class Router(ABC):
     name = "abstract"
 
@@ -220,6 +275,11 @@ class SessionRouter(Router):
         self.session_key = session_key
         self._ring = HashRing(vnodes)
         self._fallback = LeastLoadedRouter()
+        self.affinity = AffinityTracker()
+
+    @property
+    def affinity_moves(self) -> Dict[str, int]:
+        return self.affinity.moves
 
     def route(self, endpoints, request_stats, headers, body) -> str:
         self._ring.rebuild([e.url for e in endpoints])
@@ -227,7 +287,9 @@ class SessionRouter(Router):
         if not session_id:
             return self._fallback.route(endpoints, request_stats, headers,
                                         body)
-        return self._ring.lookup(session_id)
+        url = self._ring.lookup(session_id)
+        self.affinity.note(session_id, url, {e.url for e in endpoints})
+        return url
 
 
 class PrefixAwareRouter(Router):
@@ -287,6 +349,11 @@ class PrefixAwareRouter(Router):
         self._get_engine_stats = None    # attach_scraper
         self.warm_routes = 0
         self.cold_routes = 0
+        self.affinity = AffinityTracker()
+
+    @property
+    def affinity_moves(self) -> Dict[str, int]:
+        return self.affinity.moves
 
     def attach_scraper(self, get_stats) -> None:
         """``get_stats() -> {url: EngineStats}`` (the router app passes
@@ -351,6 +418,8 @@ class PrefixAwareRouter(Router):
             self.cold_routes += 1
             url = self._ring.lookup(text[:self.prefix_chars])
         self._record(digests, url)
+        self.affinity.note(text[:self.prefix_chars], url,
+                           {e.url for e in endpoints})
         return url
 
     def _tiebreak(self, urls: List[str], request_stats) -> str:

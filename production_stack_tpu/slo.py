@@ -230,10 +230,23 @@ def default_slos() -> List[SLODef]:
                description="requests admitted rather than shed "
                            "(429/503 + Retry-After, expired "
                            "deadlines) across every class"),
+        SLODef("tier0_shed_rate", "shed_rate", 0.99,
+               request_class="tier0",
+               description="top-priority (tier0) requests admitted "
+                           "rather than shed — with QoS tiers on "
+                           "(router/qos.py), the one tier the "
+                           "low-tier-first contract says must hold "
+                           "under saturation"),
         SLODef("engine_queue_delay", "signal", 0.99,
                metric="est_queue_delay_ms", bound=5000.0,
                description="scraped engine /load queue-delay estimate "
                            "under 5 s"),
+        SLODef("router_peer_lost", "signal", 0.99,
+               metric="peer_age_s", bound=10.0,
+               description="peer router replicas answering gossip "
+                           "within 10 s (router/shared_state.py; "
+                           "fed only once a peer has been seen, so "
+                           "single-router deployments stay silent)"),
     ]
 
 
@@ -476,17 +489,21 @@ class SLOEngine:
                          ttft_s: Optional[float] = None,
                          e2e_s: Optional[float] = None,
                          truncated: bool = False,
-                         now: Optional[float] = None) -> None:
+                         now: Optional[float] = None,
+                         cls: Optional[str] = None) -> None:
         """One finished (or shed) proxied request.
 
         Shed detection reads the response itself — 429/503 with
         ``Retry-After`` (the router's and the relayed engine's shed
         shape) or the 504 deadline marker — so the caller does not
-        thread shed flags through every return path.
+        thread shed flags through every return path. ``cls`` overrides
+        classification (the proxy passes the QoS tier name for tiered
+        traffic so per-tier objectives like tier0_shed_rate see it).
         """
         if now is None:
             now = time.time()       # one clock read for every bucket add
-        cls = classify_request(path, req_headers)
+        if cls is None:
+            cls = classify_request(path, req_headers)
         shed = ((status in (429, 503)
                  and resp_headers is not None
                  and "Retry-After" in resp_headers)
@@ -529,7 +546,16 @@ class SLOEngine:
             self._last_scrape[url] = at
             fresh += 1
             for slo in self._signal_slos:
-                value = float(getattr(rec, slo.metric, 0.0) or 0.0)
+                # a record only feeds the signal SLOs whose metric it
+                # actually carries: engine /load records have
+                # est_queue_delay_ms but no peer_age_s, peer gossip
+                # records (shared_state.signal_records) the reverse —
+                # defaulting the absent one to 0.0 would pad the other
+                # family's volume with vacuous good samples
+                raw = getattr(rec, slo.metric, None)
+                if raw is None:
+                    continue
+                value = float(raw)
                 good = value <= slo.bound
                 self._counts[slo.name].add(1 if good else 0,
                                            0 if good else 1, now)
@@ -632,9 +658,13 @@ class SLOTask:
 
     def __init__(self, engine: SLOEngine,
                  scraper_get: Optional[Callable[[], Dict]] = None,
-                 interval_s: float = 1.0):
+                 interval_s: float = 1.0,
+                 peers_get: Optional[Callable[[], Dict]] = None):
         self.engine = engine
         self.scraper_get = scraper_get
+        # peer-router gossip freshness (shared_state.signal_records)
+        # rides the same signal path as engine /load samples
+        self.peers_get = peers_get
         self.interval_s = interval_s
         self._task = None
 
@@ -667,8 +697,18 @@ class SLOTask:
             await asyncio.sleep(self.interval_s)
 
     def tick(self) -> List[str]:
+        stats: Dict = {}
         if self.scraper_get is not None:
-            self.engine.ingest_engine_loads(self.scraper_get())
+            stats.update(self.scraper_get())
+        if self.peers_get is not None:
+            # one merged ingest: the per-(url, scrape) dedup evicts
+            # urls absent from the snapshot, so feeding engine and
+            # peer records in separate calls would evict each other's
+            # dedup stamps every tick
+            stats.update(self.peers_get())
+        if stats or self.scraper_get is not None \
+                or self.peers_get is not None:
+            self.engine.ingest_engine_loads(stats)
         return self.engine.evaluate()
 
 

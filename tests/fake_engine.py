@@ -693,7 +693,8 @@ class FakeEngine:
             if body.get("stream"):
                 resp = web.StreamResponse(
                     headers={"Content-Type": "text/event-stream",
-                             "x-trace-id": trace.trace_id})
+                             "x-trace-id": trace.trace_id,
+                             "x-engine-id": self._engine_id(request)})
                 await resp.prepare(request)
                 for i in range(n):
                     await self._tick()
@@ -724,6 +725,7 @@ class FakeEngine:
                 "usage": {"prompt_tokens": 3, "completion_tokens": n,
                           "total_tokens": 3 + n}})
             resp.headers["x-trace-id"] = trace.trace_id
+            resp.headers["x-engine-id"] = self._engine_id(request)
             return resp
         finally:
             self._in_flight -= 1
@@ -760,7 +762,16 @@ class FakeEngine:
             "usage": {"prompt_tokens": 3, "completion_tokens": n,
                       "total_tokens": 3 + n}})
         resp.headers["x-trace-id"] = trace.trace_id
+        resp.headers["x-engine-id"] = self._engine_id(request)
         return resp
+
+    def _engine_id(self, request: web.Request) -> str:
+        """Replica identity stamped as x-engine-id on every inference
+        response: the address the caller dialed (the Host header the
+        router's client leg sets from the endpoint URL) — so a
+        multi-router rig can check that two routers sent one session
+        to the SAME engine without scraping trace rings."""
+        return request.headers.get("Host", "") or "fake-engine"
 
     async def models(self, request: web.Request) -> web.Response:
         fault = self._take_fault("/v1/models")

@@ -126,3 +126,16 @@ def test_every_registered_metric_is_documented():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main() == 0
+
+
+def test_every_cli_flag_is_documented():
+    """tools/check_flags_documented.py: every router/engine/autoscaler
+    argparse flag must appear in the docs flag tables — an operator
+    knob cannot land without its one row (also wired into ci.yml)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(OBS), "tools",
+                        "check_flags_documented.py")
+    spec = importlib.util.spec_from_file_location("check_flags", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main() == 0

@@ -599,3 +599,66 @@ def test_chaos_real_engine(tmp_path):
         error_burst_interval_s=None, num_tokens=8, seed=1,
         log_dir=str(tmp_path / "logs")))
     _assert_chaos_clean(record)
+
+
+# ------------------------------------- dynamic-config vs failover race
+
+def test_config_swap_mid_failover_does_not_resurrect_removed_endpoint():
+    """A dynamic-config apply that removes an endpoint while another
+    endpoint is mid-retry must not see the removed one resurrected
+    from the in-flight failover loop's captured candidate list.
+
+    Shape: session s homes on W (stalling); the consistent-hash
+    successor once W is excluded is X. Mid-stall, a config apply
+    removes X from the fleet. When W times out, the failover re-route
+    must land on Y — the only endpoint that is both untried and still
+    CONFIGURED — and X must never receive an inference request."""
+    from production_stack_tpu.router.dynamic_config import (
+        DynamicConfigWatcher, DynamicRouterConfig)
+    from production_stack_tpu.router.routing import HashRing
+
+    async def body():
+        w = FakeEngine(model="m")
+        w.fault = {"mode": "stall", "count": -1, "scope": "inference"}
+        x = FakeEngine(model="m")
+        x.fault = {"mode": "reset", "count": -1, "scope": "inference"}
+        y = FakeEngine(model="m")
+        servers, urls = await _start_fakes(w, x, y)
+        w_url, x_url, y_url = urls
+
+        # find a session id that homes on W in the full ring and on X
+        # once W is excluded (the resurrection target)
+        full, sub = HashRing(), HashRing()
+        full.rebuild(urls)
+        sub.rebuild([x_url, y_url])
+        session = next(
+            s for s in (f"race-sess-{i}" for i in range(4096))
+            if full.lookup(s) == w_url and sub.lookup(s) == x_url)
+
+        app = build_app(_router_args(
+            urls, ["m", "m", "m"],
+            extra=["--routing-logic", "session",
+                   "--request-timeout", "1",
+                   "--failover-attempts", "3",
+                   "--breaker-threshold", "10"]))
+        watcher = DynamicConfigWatcher(app["state"], path="unused")
+        cfg = DynamicRouterConfig(
+            service_discovery="static", routing_logic="session",
+            static_backends=[w_url, y_url], static_models=["m", "m"])
+        async with TestClient(TestServer(app)) as client:
+            req = asyncio.ensure_future(client.post(
+                "/v1/chat/completions", json=_chat(),
+                headers={"x-user-id": session}))
+            await asyncio.sleep(0.4)      # W is mid-stall being retried
+            await watcher._apply(cfg)     # removes X from the fleet
+            resp = await req
+            assert resp.status == 200, await resp.text()
+            # Y (configured, untried) served it
+            assert y.last_headers, "Y never saw the failover re-route"
+            # the removed endpoint was NOT resurrected mid-failover
+            assert not x.last_headers, (
+                "X received an inference request AFTER the config "
+                "apply removed it from the fleet")
+        for s in servers:
+            await s.close()
+    asyncio.run(body())

@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Lint: every operator-facing CLI flag must appear in the docs.
+
+Scans the three long-running-process entry points — the router
+(``production_stack_tpu/router/app.py``), the engine server
+(``production_stack_tpu/engine/server.py``), and the autoscaler
+(``production_stack_tpu/autoscaler/__main__.py``) — for
+``add_argument("--flag")`` literals (the same registry-walk-by-scan
+pattern as ``check_metrics_documented.py``: no imports, no JAX), and
+checks that each flag name appears verbatim somewhere under
+``docs/*.md``. A flag an operator can set but cannot look up is how
+config drifts into folklore.
+
+Exit 1 lists every undocumented flag and which entry point registers
+it. Wired into the ci.yml lint job next to the other doc linters and
+into tier-1 via tests/test_observability.py, so a new flag cannot
+land without its row in the flag tables.
+"""
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS_DIR = REPO / "docs"
+
+SURFACES = {
+    "router": REPO / "production_stack_tpu" / "router" / "app.py",
+    "engine": REPO / "production_stack_tpu" / "engine" / "server.py",
+    "autoscaler": REPO / "production_stack_tpu" / "autoscaler"
+    / "__main__.py",
+}
+
+FLAG_RE = re.compile(r'add_argument\(\s*"(--[a-z0-9][a-z0-9-]*)"')
+
+
+def registered_flags() -> dict:
+    """{surface: sorted flag list} from a literal scan."""
+    out = {}
+    for surface, path in SURFACES.items():
+        text = path.read_text(encoding="utf-8")
+        out[surface] = sorted(set(FLAG_RE.findall(text)))
+    return out
+
+
+def docs_text() -> str:
+    return "\n".join(p.read_text(encoding="utf-8")
+                     for p in sorted(DOCS_DIR.glob("*.md")))
+
+
+def main() -> int:
+    docs = docs_text()
+    flags = registered_flags()
+    missing = [(surface, flag)
+               for surface, names in flags.items()
+               for flag in names if flag not in docs]
+    if missing:
+        print(f"{len(missing)} CLI flags are registered in code but "
+              f"absent from docs/*.md:", file=sys.stderr)
+        for surface, flag in missing:
+            print(f"  - [{surface}] {flag}", file=sys.stderr)
+        print("\nAdd each to the flag tables (docs/router.md, "
+              "docs/engine.md, docs/autoscaling.md — or wherever the "
+              "subsystem is documented).", file=sys.stderr)
+        return 1
+    total = sum(len(v) for v in flags.values())
+    print(f"ok: {total} CLI flags "
+          f"({', '.join(f'{k} {len(v)}' for k, v in flags.items())}) "
+          f"all documented under docs/")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
