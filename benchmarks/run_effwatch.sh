@@ -5,7 +5,9 @@
 # token-step fractions sum to 1, accounted decode tokens/s reconciles
 # with client-measured throughput within 10%, and zero XLA compiles
 # land in the steady window. Pass --anti-vacuity to prove the gates
-# can fail. Extra args are forwarded.
+# can fail. Pass --ab (plus a churny shape: --stagger/--mixed-tokens)
+# for the window-adaptation A/B vs --no-window-adapt — the committed
+# EFF_r17.json recipe. Extra args are forwarded (last flag wins).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 exec python -m production_stack_tpu.loadgen effwatch \

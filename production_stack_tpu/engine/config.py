@@ -27,6 +27,28 @@ class EngineConfig:
     # Sequences that stop mid-window discard the tail (vLLM's
     # num-scheduler-steps tradeoff). 1 = token-at-a-time.
     decode_window: int = 8
+    # Continuous batching ACROSS fused windows (docs/engine.md
+    # "Continuous batching across windows"): when window_adapt is on,
+    # every decode dispatch compacts live rows into the low slots and
+    # picks the smallest batch bucket covering them (parked rows stop
+    # generating pad token-steps), sizes the window from the live
+    # rows' remaining token budgets + an EOS-rate horizon (finished
+    # tails stop spanning a long window), and prefers the shortest
+    # window bucket while requests wait for admission (prefill — and
+    # therefore new-row admission — happens sooner). Bucket sets are
+    # power-of-two by default and auto-derived in __post_init__; the
+    # executable space is (batch bucket x window bucket x kv bucket),
+    # so keep both sets SMALL — warmup pre-compiles the grid so
+    # steady-state serving never compiles.
+    window_adapt: bool = True
+    # power-of-two batch buckets <= max_num_seqs the decode dispatch
+    # may shrink to (auto: 1, 2, 4, ..., max_num_seqs). Operators may
+    # pass arbitrary ascending sizes (e.g. a fleet whose typical
+    # concurrency is 6 adds a 6 bucket) at warmup-compile cost.
+    decode_batch_buckets: Tuple[int, ...] = ()
+    # window-length buckets <= decode_window the dispatch may shrink
+    # to (auto: 1, 2, 4, ..., decode_window)
+    decode_window_buckets: Tuple[int, ...] = ()
     # decode windows queued on the device at once (engine.step
     # pipelining). 2 keeps the device saturated in the common case:
     # window N+1 is queued while N runs, and the host processes N's
@@ -144,6 +166,15 @@ class EngineConfig:
             raise ValueError("expert_parallel_size must be >= 1")
         if not 0 <= self.speculative_ngram_tokens <= 16:
             raise ValueError("speculative_ngram_tokens must be in 0..16")
+        if self.speculative_ngram_tokens and self.window_adapt:
+            # the speculative executable is the most expensive compile,
+            # and warming it across the full (batch x window) grid
+            # would multiply warmup by the grid size — while leaving
+            # the grid cold trades that for multi-second mid-serving
+            # compile stalls at every geometry the adaptive dispatch
+            # reaches. Until the spec grid has its own bounded warmup
+            # story, speculation pins the full fixed geometry.
+            self.window_adapt = False
         if not 1 <= self.pipeline_depth <= 8:
             raise ValueError("pipeline_depth must be in 1..8 (each queued "
                              "window delays admission by one window)")
@@ -182,6 +213,32 @@ class EngineConfig:
         self.prefill_buckets = tuple(buckets)
         self.decode_window = max(1, min(self.decode_window,
                                         self.max_model_len))
+
+        def _bucket_set(given, cap: int, what: str) -> Tuple[int, ...]:
+            """Validate a user bucket set (ascending, positive,
+            <= cap, cap always covered) or derive the power-of-two
+            default 1, 2, 4, ..., cap."""
+            if given:
+                buckets = sorted({int(b) for b in given if 0 < b <= cap})
+                if not buckets:
+                    raise ValueError(
+                        f"{what} has no usable entries in [1, {cap}]: "
+                        f"{given}")
+            else:
+                buckets, b = [], 1
+                while b < cap:
+                    buckets.append(b)
+                    b *= 2
+            if not buckets or buckets[-1] < cap:
+                buckets.append(cap)
+            return tuple(buckets)
+
+        self.decode_batch_buckets = _bucket_set(
+            self.decode_batch_buckets, self.max_num_seqs,
+            "decode_batch_buckets")
+        self.decode_window_buckets = _bucket_set(
+            self.decode_window_buckets, self.decode_window,
+            "decode_window_buckets")
         if not self.kv_len_buckets:
             # powers of two from 512 (or the cache size if smaller) up to
             # max_model_len: at 32k context that's 7 buckets — bounded
@@ -231,3 +288,13 @@ class EngineConfig:
             if length <= b:
                 return b
         return self.kv_len_buckets[-1]
+
+    def batch_bucket_for(self, rows: int) -> int:
+        """Smallest decode batch bucket covering `rows` slots. (The
+        window axis has no covering lookup on purpose: the dispatch
+        picks the LARGEST window bucket under an expected-dead budget
+        — engine._choose_window — not the smallest covering one.)"""
+        for b in self.decode_batch_buckets:
+            if rows <= b:
+                return b
+        return self.decode_batch_buckets[-1]

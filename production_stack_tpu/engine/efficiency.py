@@ -103,15 +103,16 @@ class EngineEffAccounting:
         # modeled HBM traffic (decode windows only — see module doc)
         self.bytes_total = 0
         self.bytes_effective = 0
-        # XLA compile tracking: (kind, window, kv) -> [count, total_s]
-        self.compiles: Dict[Tuple[str, int, int], List] = {}
+        # XLA compile tracking:
+        # (kind, window, kv, batch) -> [count, total_s]
+        self.compiles: Dict[Tuple[str, int, int, int], List] = {}
         self.compiles_total = 0
         self.compile_s_total = 0.0
         self.compile_in_flight = 0
         self.last_compile_at: Optional[float] = None
         self._windows: "collections.deque[dict]" = collections.deque(
             maxlen=max(1, ring_entries))
-        # (start_mono, dur_s, kind, window, kv)
+        # (start_mono, dur_s, kind, window, kv, batch)
         self._compile_events: "collections.deque[tuple]" = \
             collections.deque(maxlen=128)
         self._lock = threading.Lock()
@@ -169,13 +170,15 @@ class EngineEffAccounting:
 
     # -- compile observer (ModelRunner hook) -----------------------------
 
-    def compile_started(self, kind: str, window: int, kv_len: int) -> None:
+    def compile_started(self, kind: str, window: int, kv_len: int,
+                        batch: int = 0) -> None:
         with self._lock:
             self.compile_in_flight += 1
 
     def compile_finished(self, kind: str, window: int, kv_len: int,
-                         started_at: float, dur_s: float) -> None:
-        key = (kind, int(window), int(kv_len))
+                         started_at: float, dur_s: float,
+                         batch: int = 0) -> None:
+        key = (kind, int(window), int(kv_len), int(batch))
         with self._lock:
             self.compile_in_flight = max(0, self.compile_in_flight - 1)
             slot = self.compiles.setdefault(key, [0, 0.0])
@@ -185,7 +188,8 @@ class EngineEffAccounting:
             self.compile_s_total += dur_s
             self.last_compile_at = started_at + dur_s
             self._compile_events.append(
-                (started_at, dur_s, kind, int(window), int(kv_len)))
+                (started_at, dur_s, kind, int(window), int(kv_len),
+                 int(batch)))
         if self.compile_hist is not None:
             self.compile_hist.observe(kind, str(window), str(kv_len),
                                       dur_s)
@@ -211,9 +215,11 @@ class EngineEffAccounting:
                 "compiles_total": self.compiles_total,
                 "compile_s_total": round(self.compile_s_total, 4),
                 "compile_in_flight": self.compile_in_flight,
-                "compiles": {f"{k}|{w}|{kv}": {"count": c[0],
-                                               "seconds": round(c[1], 4)}
-                             for (k, w, kv), c in self.compiles.items()},
+                "compiles": {f"{k}|{w}|{kv}|{b}":
+                             {"count": c[0],
+                              "seconds": round(c[1], 4)}
+                             for (k, w, kv, b), c in
+                             self.compiles.items()},
                 "weight_bytes": self.weight_bytes,
                 "kv_position_bytes": self.kv_position_bytes,
                 "hbm_peak_bytes_per_s": self.hbm_peak_bytes_per_s,
@@ -290,12 +296,12 @@ class EngineEffAccounting:
         with self._lock:
             events = list(self._compile_events)[-max(1, limit):]
         return [{"at": round(t, 4), "duration_s": round(d, 4),
-                 "kind": k, "window": w, "kv_bucket": kv}
-                for t, d, k, w, kv in events]
+                 "kind": k, "window": w, "kv_bucket": kv, "batch": b}
+                for t, d, k, w, kv, b in events]
 
     def compile_events_between(self, t0: float, t1: float
                                ) -> List[Tuple[float, float, str, int,
-                                               int]]:
+                                               int, int]]:
         """Compile events overlapping the monotonic interval
         ``[t0, t1]`` — the trace seal hook that makes a compile-stalled
         request visible in ``/debug/traces``."""

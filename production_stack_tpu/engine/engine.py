@@ -302,6 +302,15 @@ class LLMEngine:
         # device-carried; the host only has to stay out of the way
         # (no mirror uploads) until every queued window is processed.
         self._inflight: List[tuple] = []
+        # continuous batching across windows (docs/engine.md
+        # "Continuous batching across windows"): the device carry's
+        # current batch bucket (dispatches at a different bucket must
+        # re-upload the host mirrors), and an EWMA of the per-row-step
+        # probability of a non-length stop — the EOS-rate horizon the
+        # adaptive window sizing reads so finished tails cannot span a
+        # long window even when max_tokens gives no warning
+        self._carry_batch = engine_cfg.max_num_seqs
+        self._eos_rate = 0.0
         # real embedding encoder (models/encoder.py), built EAGERLY:
         # a lazy first-request load would run checkpoint reading on the
         # server's event loop (stalling every in-flight stream) and
@@ -545,6 +554,12 @@ class LLMEngine:
                and not self._decode_dirty and not self._sampling_dirty
                and not (self.cfg.speculative_ngram_tokens
                         and self._hist_dirty)
+               # mid-window admission preference: with a request
+               # waiting AND a slot to admit it into, an extra queued
+               # window only delays the admission pass it is waiting
+               # for
+               and not (self.cfg.window_adapt
+                        and self._admission_imminent())
                and self._worth_dispatch_ahead()):
             ahead = sum(w[4] for w in self._inflight)
             if not self._dispatch_decode(
@@ -565,6 +580,163 @@ class LLMEngine:
             s.options.max_tokens is None
             or s.options.max_tokens - len(s.output_tokens) > inflight_steps
             for s in live)
+
+    # adaptive window sizing: the largest window bucket whose EXPECTED
+    # dead fraction (finished-row tails, from remaining max_tokens
+    # budgets + the EOS-rate horizon) stays under this budget. A hard
+    # bound, not a target: real storms sit well below it because most
+    # windows have no finishing row at all.
+    _WINDOW_DEAD_BUDGET = 0.125
+
+    def _choose_window(self, ahead: int) -> int:
+        """Window length for the next decode dispatch (adaptive sizing,
+        docs/engine.md "Continuous batching across windows").
+
+        With ``window_adapt`` off this is the configured
+        ``decode_window``. Otherwise pick the LARGEST bucket from
+        ``decode_window_buckets`` whose expected dead fraction stays
+        under ``_WINDOW_DEAD_BUDGET``:
+
+        - **budget tails**: a row whose remaining ``max_tokens``
+          budget ends inside the window contributes its tail
+          ``W - remaining`` as dead steps. With one live row this
+          degenerates to "the smallest bucket covering the remaining
+          budget"; with a big churny batch it keeps windows LONG as
+          long as the occasional tail is an acceptable fraction of
+          ``live x W`` — ending the window at every first finish
+          would multiply per-window dispatch overhead past what the
+          saved tails buy back (measured on the r17 A/B);
+        - **EOS-rate horizon**: rows that have recently been stopping
+          on EOS/stop (not budget) are expected to stop at rate
+          ``_eos_rate`` per row-step, contributing ``rate x W^2 / 2``
+          expected tail steps per row — ``max_tokens`` gives no
+          warning for natural stops, so long windows get charged for
+          them the same way;
+        - **mid-window admission**: a request waiting WITH a free
+          slot to land in takes the next SHORTER bucket below the
+          capped choice — finishing the window sooner runs the
+          admission + prefill pass sooner, trading per-window fusion
+          for time-to-join. One bucket, not the minimum (under churny
+          closed loops someone is waiting at almost every dispatch),
+          and only when admission can actually happen: with the batch
+          full, the waiter needs a finish first — which the dead
+          budget above already steers the window toward.
+
+        ``ahead`` steps already in flight count against the budgets
+        (an optimistic window continues from where the queued ones
+        will end)."""
+        cfg = self.cfg
+        if not cfg.window_adapt:
+            return cfg.decode_window
+        buckets = cfg.decode_window_buckets
+        live = [s for s in self.scheduler.running.values()
+                if s.status is SeqStatus.RUNNING]
+        if not live:
+            return buckets[0]
+        # no spec_w scaling: speculation pins the full fixed geometry
+        # at config time (window_adapt is forced off), so this only
+        # ever runs with one token per row-step
+        budgets = [max(0, s.options.max_tokens - len(s.output_tokens)
+                       - ahead)
+                   for s in live if s.options.max_tokens is not None]
+        cap = buckets[0]
+        for w in buckets:
+            tail = sum(max(0, w - b) for b in budgets)
+            tail += self._eos_rate * len(live) * w * w / 2.0
+            if tail <= self._WINDOW_DEAD_BUDGET * len(live) * w:
+                cap = w
+        if self._admission_imminent():
+            i = buckets.index(cap)
+            cap = buckets[max(0, i - 1)]
+        return cap
+
+    def _admission_imminent(self) -> bool:
+        """A request is waiting and a slot is free to admit it into:
+        the next scheduler pass will admit — every queued window step
+        between now and then is time-to-join the waiter pays. A pass
+        that just deferred the head waiter on the KV admission gate
+        (`kv_deferred`) negates that premise: under pool pressure the
+        next pass will NOT admit, and shortening windows / pausing the
+        pipeline would cost fusion and device occupancy for nothing."""
+        return bool(self.scheduler.waiting
+                    and self.scheduler.free_slots
+                    and not self.scheduler.kv_deferred)
+
+    @staticmethod
+    def _grid_hot(seqs) -> bool:
+        """True when this batch composition lands on an executable
+        variant the warmup grid actually compiled — greedy or
+        plain-sampled, with no seeded/guided/penalized/top-k rows.
+        Only those variants may dispatch at adapted (batch, window)
+        geometry: every other variant warms at the FULL shape alone,
+        and adapting it would pay a cold multi-second compile per
+        geometry reached, mid-serving (the pre-r17 fixed dispatch
+        paid exactly one lazy compile per variant — keep that). Both
+        hot variants are closed under row subsetting, so a preemption
+        between this check and the dispatch cannot turn a hot window
+        cold."""
+        return (all(s.options.seed is None and s.grammar is None
+                    and not s.options.shaped
+                    and not s.options.top_logprobs for s in seqs)
+                and (all(s.options.temperature <= 0.0 for s in seqs)
+                     or all(s.options.top_p >= 1.0
+                            and not s.options.top_k
+                            and not s.options.min_p for s in seqs)))
+
+    def _compact_slots(self) -> None:
+        """Remap RUNNING sequences into the lowest slots (skipping
+        slots held by still-prefilling sequences) so the decode batch
+        bucket tracks the LIVE batch instead of historical slot
+        positions. Only legal between windows (nothing in flight):
+        the remap rewrites the host mirrors, and the next dispatch
+        rebuilds every device carry from them (the move marks decode/
+        sampling/history dirty; penalty counts and guided ids are
+        rebuilt from the sequences at dispatch). KV never moves — a
+        slot only indexes a block-table row, so the remap is two table
+        rows per moved sequence, not a cache copy."""
+        running = sorted(self.scheduler.running.values(),
+                         key=lambda s: s.slot)
+        if not running:
+            return
+        busy = {s.slot for s in self.scheduler._prefilling.values()}
+        target = 0
+        for seq in running:
+            while target in busy:
+                target += 1
+            if seq.slot != target:
+                # target < seq.slot and every lower-slotted live row
+                # already sits at an earlier target, so target is free
+                self._move_slot(seq, target)
+            target += 1
+
+    def _move_slot(self, seq: Sequence, new: int) -> None:
+        """Move a RUNNING sequence's slot: scheduler maps, every host
+        sampling/decode/guided mirror row, and the block-table row —
+        coherently, so the next dispatch's uploads see the sequence at
+        its new index."""
+        old = seq.slot
+        sched = self.scheduler
+        del sched.running[old]
+        sched.running[new] = seq
+        sched.free_slots.remove(new)
+        seq.slot = new
+        for arr in (self._slot_token, self._slot_pos, self._slot_temp,
+                    self._slot_top_p, self._slot_top_k,
+                    self._slot_adapter, self._slot_seed,
+                    self._slot_presence, self._slot_frequency,
+                    self._slot_repetition, self._slot_min_p,
+                    self._slot_min_tokens, self._slot_prompt_len,
+                    self._slot_bias_ids, self._slot_bias_vals,
+                    self._slot_stop_ids, self._slot_gstate):
+            arr[new] = arr[old]
+        self._set_table_row(new, seq.block_ids)
+        # park AFTER copying (resets old's mirrors, marks carries
+        # dirty); the moved row's sampling differs from the parked
+        # defaults park left at `new`, so force the sampling re-upload
+        self._park_slot(old)
+        self._set_table_row(old, [])
+        sched._free_slot(old)
+        self._sampling_dirty = True
 
     def _do_prefill(self, works) -> List[StepOutput]:
         """Batch-prefill every scheduled chunk: one device dispatch per
@@ -761,6 +933,20 @@ class LLMEngine:
     def _dispatch_decode(self, decode_seqs, ahead: int = 0) -> bool:
         """Launch one decode window (async dispatch; no host sync).
 
+        With ``window_adapt`` on, the dispatch tracks the LIVE batch
+        along three levers (docs/engine.md "Continuous batching across
+        windows"): live rows are first compacted into the low slots
+        (only between windows — the remap rebuilds every device carry
+        from the host mirrors, which are only current when nothing is
+        in flight), the batch bucket is the smallest one covering
+        them (parked rows above it are not computed at all), and the
+        window length comes from the live rows' remaining budgets +
+        the EOS-rate horizon — one bucket shorter when admission is
+        imminent, so waiters join sooner (_choose_window). Windows
+        needing a variant outside the warmed grid (seeded / guided /
+        penalized / top-k / full-sort sampling) pin the full fixed
+        geometry instead (_grid_hot).
+
         ahead > 0 = optimistic dispatch while the previous window's
         tokens are still unprocessed on the host: device positions are
         `ahead` steps past the host mirrors, so block coverage and the
@@ -770,8 +956,30 @@ class LLMEngine:
         preempt (parking rewrites the decode carry) or upload host
         mirrors (they lag the device by `ahead` steps until the synced
         window is processed) — the caller then falls back to the
-        ordinary process-first path."""
-        W = self.cfg.decode_window
+        ordinary process-first path. It also keeps the carry's batch
+        bucket (a bucket change is a mirror upload by definition)."""
+        live0 = [s for s in self.scheduler.running.values()
+                 if s.status is SeqStatus.RUNNING]
+        adapt = self.cfg.window_adapt and self._grid_hot(live0)
+        if adapt and live0:
+            # the warmup grid exists at the SMALLEST kv bucket only:
+            # adapted geometry at a larger bucket would compile cold
+            # per (batch, window) combination reached mid-serving —
+            # pin the full fixed geometry there instead (one lazy
+            # compile per variant, the pre-r17 cost). Long-context
+            # fleets that want adaptation should size
+            # --kv-len-buckets so the first bucket spans their
+            # serving contexts. Probed at the largest possible
+            # window so the actual kv pick (made after W below) can
+            # never exceed the probe.
+            probe = (max(s.next_position for s in live0)
+                     + self.cfg.decode_window + ahead + 1)
+            adapt = (self.cfg.kv_bucket_for(
+                min(probe, self.cfg.max_model_len))
+                == self.cfg.kv_len_buckets[0])
+        if ahead == 0 and adapt and not self._inflight:
+            self._compact_slots()
+        W = self._choose_window(ahead) if adapt else self.cfg.decode_window
         if self._roll_window:
             # free behind-window blocks BEFORE growing coverage: the
             # reclaimed blocks feed this very window's growth
@@ -794,6 +1002,30 @@ class LLMEngine:
         decode_seqs = list(self.scheduler.running.values())
         if not decode_seqs:
             return False
+        # batch bucket: smallest executable covering every live slot
+        # (compaction just packed them low). An optimistic dispatch
+        # continues the device carry, whose batch is fixed.
+        if ahead:
+            batch = self._carry_batch
+            if not adapt and batch != self.cfg.max_num_seqs:
+                # a pinned-geometry window (non-hot variant, or the kv
+                # probe crossed above the warmed grid's bucket) would
+                # continue a BUCKETED carry here — that (carry batch,
+                # full window, higher kv) executable was never warmed,
+                # and an optimistic dispatch may not reshape the
+                # carry. Fall back to the process-first path: its
+                # ahead == 0 dispatch re-uploads at the full batch.
+                return False
+        else:
+            # a non-hot variant window (adapt False) pins the full
+            # batch; crossing between that and a bucketed hot window
+            # is a carry reshape like any other bucket change
+            batch = (self.cfg.batch_bucket_for(
+                max(s.slot for s in decode_seqs) + 1)
+                if adapt else self.cfg.max_num_seqs)
+            if batch != self._carry_batch:
+                self._decode_dirty = True
+                self._hist_dirty = True
         max_pos = max(s.next_position for s in decode_seqs)
         greedy = all(s.options.temperature <= 0.0 for s in decode_seqs)
         self._ensure_dev_sampling()
@@ -843,7 +1075,7 @@ class LLMEngine:
         if spec and (self._hist_dirty or self._decode_dirty):
             # only built for windows that will actually read it; spec=0
             # windows skip the [B, S] host build + upload entirely
-            hist = np.zeros((self.cfg.max_num_seqs,
+            hist = np.zeros((batch,
                              self.cfg.max_model_len), np.int32)
             for s in decode_seqs:
                 row = s.prompt_tokens + s.output_tokens
@@ -853,11 +1085,17 @@ class LLMEngine:
             # counts/prompt-membership upload rides the same trigger as
             # the decode carry: any composition change. Within windows
             # the device updates counts itself (runner._decode_impl)
-            self.runner.set_penalty_state(*self._penalty_arrays())
+            counts_arr, seen_arr = self._penalty_arrays()
+            self.runner.set_penalty_state(counts_arr[:batch],
+                                          seen_arr[:batch])
         if self._decode_dirty or hist is not None:
-            self.runner.set_decode_state(self._slot_token, self._slot_pos,
-                                         self._slot_gstate, hist)
+            # mirrors are uploaded at the dispatch's batch bucket: the
+            # runner's carry shape IS the executable's batch axis
+            self.runner.set_decode_state(self._slot_token[:batch],
+                                         self._slot_pos[:batch],
+                                         self._slot_gstate[:batch], hist)
             self._decode_dirty = False
+        self._carry_batch = batch
         seeded = any(s.options.seed is not None for s in decode_seqs)
         # the API-default sampling shape (top_p=1, top_k=0, min_p=0)
         # needs no [B, V] sort — a separate executable skips it
@@ -871,7 +1109,7 @@ class LLMEngine:
             spec_ok=spec_ok, plain=plain, penalized=penalized, topk=topk)
         self._inflight.append((ids_dev, lps_dev, counts_dev, tops_dev,
                                W, list(decode_seqs), time.monotonic(),
-                               spec_ok, kv_len))
+                               spec_ok, kv_len, batch))
         return True
 
     def _drain_decode(self) -> List[StepOutput]:
@@ -885,14 +1123,14 @@ class LLMEngine:
 
     def _sync_inflight(self):
         """Device->host sync of the OLDEST in-flight window's arrays (no
-        token processing): (ids, lps, counts, tops, W, seqs, t0) or
-        None. t0
+        token processing): (ids, lps, counts, tops, W, seqs, t0,
+        spec_ok, kv_len, batch) or None. t0
         is clamped to the previous sync's completion so pipelined
         windows report per-window wall, not time-since-dispatch."""
         if not self._inflight:
             return None
         (ids_dev, lps_dev, counts_dev, tops_dev, W, seqs,
-         t0, spec_ok, kv_len) = self._inflight.pop(0)
+         t0, spec_ok, kv_len, batch) = self._inflight.pop(0)
         t0 = max(t0, getattr(self, "_last_sync_t", 0.0))
         ids = np.asarray(ids_dev)  # the window's single sync
         lps = np.asarray(lps_dev)
@@ -900,32 +1138,31 @@ class LLMEngine:
         tops = (None if tops_dev is None else
                 (np.asarray(tops_dev[0]), np.asarray(tops_dev[1])))
         self._last_sync_t = time.monotonic()
-        return ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len
+        return (ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len,
+                batch)
 
     def _process_window(self, synced) -> List[StepOutput]:
         if synced is None:
             return []
-        ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len = synced
+        ids, lps, counts, tops, W, seqs, t0, spec_ok, kv_len, B = synced
         dt = time.monotonic() - t0
         outputs: List[StepOutput] = []
         alive = [s for s in seqs if s.status is not SeqStatus.FINISHED]
-        # per-token latency: under speculation a macro-step emits several
-        # verified tokens, so divide the window wall by tokens EMITTED
-        if counts is None or not alive:
-            per_tok_dt = dt / W
-        else:
-            emitted = int(sum(counts[s.slot].sum() for s in alive))
-            per_tok_dt = dt / max(1, emitted)
-        # window efficiency accounting: every row computes W steps of P
-        # positions each (P = spec+1 under speculation). real counts
-        # tokens the client keeps (one per _accept_token); parked rows
-        # are pure padding; everything else a live row computed but did
-        # not emit — finished-row tails, rows finished/aborted between
-        # dispatch and drain, rejected draft positions — is dead.
-        B = self.cfg.max_num_seqs
+        walkers = len(alive)   # rows that will actually walk steps
+        # window efficiency accounting: every row of the DISPATCHED
+        # batch bucket B computes W steps of P positions each (P =
+        # spec+1 under speculation). real counts tokens the client
+        # keeps (one per _accept_token); non-live rows inside the
+        # bucket are pure padding; everything else a live row computed
+        # but did not emit — finished-row tails, rows finished/aborted
+        # between dispatch and drain, rejected draft positions — is
+        # dead.
         P = ids.shape[2] if counts is not None and ids.ndim == 3 else 1
         accepted = 0
+        eos_stops = 0
+        steps_walked = 0
         for j in range(W):
+            steps_walked = j + 1
             still = []
             for seq in alive:
                 if counts is None:
@@ -958,18 +1195,43 @@ class LLMEngine:
                             if l > -1e29]
                 finished = False
                 for token, lp in row:
-                    self.metrics.per_token.observe(per_tok_dt)
                     accepted += 1
                     outs = self._accept_token(seq, token, lp, alts)
                     outputs.extend(outs)
                     if outs[-1].finished:
                         finished = True
+                        if outs[-1].finish_reason == "stop":
+                            eos_stops += 1
                         break
                 if not finished:
                     still.append(seq)
             alive = still
             if not alive:
                 break
+        # per-token latency: the window wall over the steps actually
+        # WALKED (every alive row retiring at step j means steps past
+        # j never produced host-visible tokens — dividing by the full
+        # W would understate ITL under adaptive/early-retired
+        # windows); under speculation a macro-step emits several
+        # verified tokens, so divide by the tokens actually emitted.
+        # Observed after the walk (the divisor needs steps_walked);
+        # histogram totals are order-independent.
+        if accepted:
+            per_tok_dt = dt / (steps_walked if counts is None
+                               else accepted)
+            for _ in range(accepted):
+                self.metrics.per_token.observe(per_tok_dt)
+        # EOS-rate EWMA feeding the adaptive window horizon
+        # (_choose_window): observed per-row-step probability of a
+        # non-length stop this window, over the rows that actually
+        # WALKED steps — rows finished/aborted between dispatch and
+        # drain never walked, and a window with no walkers says
+        # nothing and leaves the rate alone (counting either would
+        # bias the rate low and under-charge long windows for
+        # finished tails).
+        if walkers and steps_walked:
+            obs = eos_stops / (walkers * steps_walked)
+            self._eos_rate = 0.8 * self._eos_rate + 0.2 * obs
         pad = (B - len(seqs)) * W * P
         dead = B * W * P - pad - accepted
         self.eff.note_window(steps=W, positions=P, batch=B,

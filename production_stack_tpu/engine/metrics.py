@@ -201,8 +201,9 @@ class EngineMetrics:
             "kept token (real / (real+pad+dead))")
         self._compiles = Counter(
             "tpu:engine_compiles",
-            "XLA executable compilations by (kind, window, kv bucket)",
-            list(labels) + ["kind", "window", "kv_bucket"],
+            "XLA executable compilations by (kind, window, kv bucket, "
+            "batch bucket)",
+            list(labels) + ["kind", "window", "kv_bucket", "batch"],
             registry=self.registry)
         self.compile_in_flight = gauge(
             "tpu:engine_compile_in_flight",
@@ -309,10 +310,11 @@ class EngineMetrics:
                                          **self._labels),
                 self._eff_last, f"prefill:{kind}", pre.get(kind, 0))
         for key, entry in (report.get("compiles") or {}).items():
-            kind, window, kv = key.split("|")
+            kind, window, kv, batch = (key.split("|") + ["0"])[:4]
             self._delta_inc(
                 self._compiles.labels(kind=kind, window=window,
-                                      kv_bucket=kv, **self._labels),
+                                      kv_bucket=kv, batch=batch,
+                                      **self._labels),
                 self._eff_last, f"compile:{key}", entry["count"])
         self.compile_in_flight.set(report.get("compile_in_flight", 0))
         self.effective_bytes_per_s.set(

@@ -207,10 +207,16 @@ class ModelRunner:
         # instead of bare log lines. None = no accounting (bare runner
         # in tests).
         self.compile_observer = None
-        # executable caches: decode keyed (steps, kv_len, greedy, seeded),
-        # prefill keyed (chunk bucket, kv bucket)
+        # executable caches: decode keyed (batch, steps, kv_len,
+        # variant), prefill keyed (chunk bucket, kv bucket)
         self._decode_fns = {}
         self._prefill_fns = {}
+        # per-batch-bucket sliced views of the sampling params and
+        # block tables (invalidated when the source object changes):
+        # batch-bucketed dispatches must not pay a 14-array re-slice
+        # per window
+        self._sampling_slices = (None, {})
+        self._tables_slices = (None, {})
         # KV-tiering primitives (kvcache/connector.py), cached per chunk size
         self._extract_fns = {}
         self._inject_fns = {}
@@ -570,13 +576,39 @@ class ModelRunner:
         self._dec_counts = jnp.asarray(out_counts, jnp.int32)
         self._dec_prompt_seen = jnp.asarray(prompt_seen, bool)
 
+    def _batch_sized(self, x, B: int):
+        """Slice a host/device array's leading axis to the dispatch
+        batch B (identity when already sized — the common steady
+        case pays nothing)."""
+        return x if x.shape[0] == B else x[:B]
+
+    def _cached_slice(self, store_attr: str, source, B: int, make):
+        """Memoize per-batch-bucket sliced views of a source object
+        (sampling params, block tables) until the source is replaced:
+        steady bucketed windows re-dispatch with the same inputs and
+        must not re-slice per window."""
+        src, cache = getattr(self, store_attr)
+        if src is not source:
+            cache = {}
+            setattr(self, store_attr, (source, cache))
+        out = cache.get(B)
+        if out is None:
+            out = cache[B] = make()
+        return out
+
     def decode(self, sampling: SamplingParams, steps: int = 1,
                kv_len: Optional[int] = None, greedy: bool = False,
                seeded: bool = False, guide_table=None, guide_ids=None,
                spec: int = 0, spec_ok=None, plain: bool = False,
                penalized: bool = False, topk: int = 0):
-        """Multi-step decode window over all slots, reading the
-        device-carried inputs (seed them with set_decode_state). Returns
+        """Multi-step decode window over the CARRIED batch: the batch
+        axis is whatever ``set_decode_state`` last uploaded — the
+        engine's batch-bucketed compaction (docs/engine.md "Continuous
+        batching across windows") uploads only the low ``B_bucket``
+        slots, and every input here (sampling mirrors, block tables,
+        guided ids, penalty carry) is sliced to that bucket, so parked
+        rows beyond it are simply not computed. Executables are cached
+        per (batch, steps, kv bucket, variant). Returns
         (ids, logprobs, counts, tops): without speculation ids/logprobs
         are [B, steps] and counts is None; with spec > 0 they are
         [B, steps, spec+1] plus counts [B, steps] of valid tokens per
@@ -595,12 +627,28 @@ class ModelRunner:
         plain = plain and not greedy
         guided = guide_table is not None
         gshape = guide_table.shape if guided else (1, 1, 1)
-        B = self.engine_cfg.max_num_seqs
+        # the dispatch batch IS the carried batch: the engine's
+        # compaction uploads bucketed mirrors, everything else here
+        # follows that shape
+        B = int(self._dec_tokens.shape[0])
+        src_sampling = sampling
+        sampling = self._cached_slice(
+            "_sampling_slices", src_sampling, B,
+            lambda: jax.tree_util.tree_map(
+                lambda x: self._batch_sized(x, B), src_sampling))
+        full_tables = self._dev_tables()
+        tables = self._cached_slice(
+            "_tables_slices", full_tables, B,
+            lambda: self._batch_sized(full_tables, B))
         if not guided:
             guide_table = jnp.zeros((1, 1, 1), jnp.int32)
             guide_ids = jnp.zeros((B,), jnp.int32)
+        else:
+            guide_ids = self._batch_sized(
+                jnp.asarray(guide_ids, jnp.int32), B)
         if penalized:
-            counts, seen = self._dec_counts, self._dec_prompt_seen
+            counts = self._batch_sized(self._dec_counts, B)
+            seen = self._batch_sized(self._dec_prompt_seen, B)
         else:
             # dummy carries: the unpenalized executable never reads or
             # writes them, so keep them tiny
@@ -608,19 +656,20 @@ class ModelRunner:
             seen = jnp.zeros((B, 1), bool)
         if spec:
             mixed = not greedy
-            args = (self.params, self.cache, self._dev_tables(),
+            args = (self.params, self.cache, tables,
                     self._dec_tokens, self._dec_pos, self._dec_hist,
-                    jnp.asarray(spec_ok, bool), sampling,
+                    self._batch_sized(jnp.asarray(spec_ok, bool), B),
+                    sampling,
                     self._next_key(), guide_table,
-                    jnp.asarray(guide_ids, jnp.int32), self._dec_gstate,
+                    guide_ids, self._dec_gstate,
                     counts, seen)
-            key = ("spec", steps, kv_len, spec, mixed, seeded, guided,
+            key = ("spec", B, steps, kv_len, spec, mixed, seeded, guided,
                    gshape, plain, penalized, topk)
 
             def make_spec():
                 logger.info("compiling speculative decode window "
-                            "(steps=%d kv=%d draft=%d%s%s%s%s)", steps,
-                            kv_len, spec,
+                            "(batch=%d steps=%d kv=%d draft=%d%s%s%s%s)",
+                            B, steps, kv_len, spec,
                             " mixed" if mixed else "",
                             " guided" if guided else "",
                             " penalized" if penalized else "",
@@ -636,24 +685,26 @@ class ModelRunner:
             fn = self._compile_with_fallback(self._decode_fns, key,
                                              make_spec, args,
                                              kind="decode_spec",
-                                             window=steps, kv_len=kv_len)
+                                             window=steps, kv_len=kv_len,
+                                             batch=B)
             (ids, lps, tis, tls, cnt, self._dec_tokens, self._dec_pos,
              self._dec_hist, self._dec_gstate, counts_out,
              self.cache) = fn(*args)
             if penalized:
                 self._dec_counts = counts_out
             return ids, lps, cnt, (tis, tls) if topk else None
-        cache_key = (steps, kv_len, greedy, seeded, guided, gshape, plain,
-                     penalized, topk)
-        args = (self.params, self.cache, self._dev_tables(),
+        cache_key = (B, steps, kv_len, greedy, seeded, guided, gshape,
+                     plain, penalized, topk)
+        args = (self.params, self.cache, tables,
                 self._dec_tokens, self._dec_pos,
                 sampling, self._next_key(), guide_table,
-                jnp.asarray(guide_ids, jnp.int32), self._dec_gstate,
+                guide_ids, self._dec_gstate,
                 counts, seen)
 
         def make_decode():
-            logger.info("compiling decode window (steps=%d kv=%d greedy=%s"
-                        "%s%s%s)", steps, kv_len, greedy,
+            logger.info("compiling decode window (batch=%d steps=%d "
+                        "kv=%d greedy=%s%s%s%s)", B, steps, kv_len,
+                        greedy,
                         " seeded" if seeded else "",
                         " guided" if guided else "",
                         " penalized" if penalized else "")
@@ -667,7 +718,7 @@ class ModelRunner:
         fn = self._compile_with_fallback(self._decode_fns, cache_key,
                                          make_decode, args,
                                          kind="decode", window=steps,
-                                         kv_len=kv_len)
+                                         kv_len=kv_len, batch=B)
         (ids, lps, tis, tls, self._dec_tokens, self._dec_pos,
          self._dec_gstate, counts_out, self.cache) = fn(*args)
         if penalized:
@@ -676,7 +727,7 @@ class ModelRunner:
 
     def _compile_with_fallback(self, cache: dict, key, make_fn, args,
                                kind: str = "", window: int = 0,
-                               kv_len: int = 0):
+                               kv_len: int = 0, batch: int = 0):
         """Fetch-or-compile an executable; if the pallas paged kernel
         fails to BUILD for this combination (backend or VMEM limits
         beyond paged_viable's estimate), recompile THIS key on the jnp
@@ -700,7 +751,7 @@ class ModelRunner:
         obs = self.compile_observer
         t0 = time.monotonic()
         if obs is not None:
-            obs.compile_started(kind, window, kv_len)
+            obs.compile_started(kind, window, kv_len, batch)
         try:
             try:
                 fn = make_fn()
@@ -718,7 +769,7 @@ class ModelRunner:
         finally:
             if obs is not None:
                 obs.compile_finished(kind, window, kv_len, t0,
-                                     time.monotonic() - t0)
+                                     time.monotonic() - t0, batch)
         cache[key] = fn
         return fn
 
@@ -774,7 +825,7 @@ class ModelRunner:
             self._prefill_fns,
             (Tb, kv_len, guided, gshape, penalized, topk),
             make_prefill, args, kind="prefill", window=Tb,
-            kv_len=kv_len)
+            kv_len=kv_len, batch=B)
         ids, lps, tis, tls, self.cache = fn(*args)
         return ids, lps, (tis, tls) if topk else None
 
@@ -940,49 +991,61 @@ class ModelRunner:
                         jnp.int32(start))
 
     def warmup(self) -> float:
-        """Compile the hot executables: a greedy decode window at the
-        smallest kv bucket + every prefill bucket at its minimal kv
-        bucket. Larger kv buckets and the sampled decode variant compile
-        lazily on first use (one-time, logged). Returns seconds spent."""
+        """Compile the hot executables at the smallest kv bucket:
+        with ``window_adapt`` on, the FULL (batch bucket x window
+        bucket) grid for the greedy and plain-sampled variants — the
+        adaptive dispatch walks that grid in steady state, and a
+        combination left cold here is a multi-second compile stall
+        mid-serving (the effwatch zero-steady-state-compiles gate
+        pins this) — plus the full-sort sampled variant and the
+        speculative executable at the full shape only. With adaptation
+        off, just the three variants at (max_num_seqs, decode_window).
+        Every prefill bucket compiles at its minimal kv bucket. Larger
+        kv buckets and rarely-hit variants (guided/penalized/topk,
+        adapted sampled-sort shapes) compile lazily on first use
+        (one-time, logged). Returns seconds spent."""
         import numpy as np
         t0 = time.time()
         cfg = self.engine_cfg
         B = cfg.max_num_seqs
         S = cfg.max_model_len
+        kv0 = cfg.kv_len_buckets[0]
         sampling = SamplingParams.filled(B)
-        # park every row at S: warmup writes only clamp onto S-1
-        self.set_decode_state(np.zeros((B,), np.int32),
-                              np.full((B,), S, np.int32))
-        # all three decode variants: greedy, plain-sampled, and
-        # full-sort sampled (the API default is temperature=1.0, so
-        # plain-sampled is the common serving case)
+
+        def park(b: int, history: bool = False) -> None:
+            # park every row at S: warmup writes only clamp onto S-1
+            self.set_decode_state(
+                np.zeros((b,), np.int32), np.full((b,), S, np.int32),
+                history=np.zeros((b, S), np.int32) if history else None)
+
         if cfg.speculative_ngram_tokens:
             # spec-enabled greedy windows use the speculative executable,
             # not the plain greedy one — compile the real hot path
-            self.set_decode_state(
-                np.zeros((B,), np.int32), np.full((B,), S, np.int32),
-                history=np.zeros((B, S), np.int32))
+            park(B, history=True)
             self.decode(sampling, steps=cfg.decode_window,
-                        kv_len=cfg.kv_len_buckets[0], greedy=True,
+                        kv_len=kv0, greedy=True,
                         spec=cfg.speculative_ngram_tokens,
                         spec_ok=np.ones((B,), bool))
-            self.set_decode_state(np.zeros((B,), np.int32),
-                                  np.full((B,), S, np.int32))
-        self.decode(sampling, steps=cfg.decode_window,
-                    kv_len=cfg.kv_len_buckets[0], greedy=True)
-        self.set_decode_state(np.zeros((B,), np.int32),
-                              np.full((B,), S, np.int32))
-        # the API default (temperature=1, top_p=1, top_k=0) runs the
-        # sort-free plain variant; truncated sampling (top_p<1 / top_k)
-        # runs the full-sort one — warm BOTH so neither first request
-        # pays a mid-serving compile
-        self.decode(sampling, steps=cfg.decode_window,
-                    kv_len=cfg.kv_len_buckets[0], greedy=False,
-                    plain=True)
-        self.set_decode_state(np.zeros((B,), np.int32),
-                              np.full((B,), S, np.int32))
-        self.decode(sampling, steps=cfg.decode_window,
-                    kv_len=cfg.kv_len_buckets[0], greedy=False)
+        batches = cfg.decode_batch_buckets if cfg.window_adapt else (B,)
+        windows = (cfg.decode_window_buckets if cfg.window_adapt
+                   else (cfg.decode_window,))
+        for b in batches:
+            for w in windows:
+                park(b)
+                self.decode(sampling, steps=w, kv_len=kv0, greedy=True)
+                # the API default (temperature=1, top_p=1, top_k=0)
+                # runs the sort-free plain variant — warm it across
+                # the grid too so default-sampling storms never pay a
+                # mid-serving compile either
+                park(b)
+                self.decode(sampling, steps=w, kv_len=kv0,
+                            greedy=False, plain=True)
+        # truncated sampling (top_p<1 / top_k / min_p) runs the
+        # full-sort executable: warm the full shape only (adapted
+        # shapes compile lazily — the sort dominates its cost anyway)
+        park(B)
+        self.decode(sampling, steps=cfg.decode_window, kv_len=kv0,
+                    greedy=False)
         for bucket in cfg.prefill_buckets:
             # prefill() falls back to the jnp path by itself if the
             # flash kernel cannot compile on this backend
@@ -993,7 +1056,7 @@ class ModelRunner:
         jax.block_until_ready(self.cache.k)
         dt = time.time() - t0
         logger.info(
-            "warmup compiled decode window (%d steps, kv %d) + %d prefill "
-            "buckets in %.1fs", cfg.decode_window, cfg.kv_len_buckets[0],
-            len(cfg.prefill_buckets), dt)
+            "warmup compiled decode grid (batch %s x window %s, kv %d) "
+            "+ %d prefill buckets in %.1fs", list(batches),
+            list(windows), kv0, len(cfg.prefill_buckets), dt)
         return dt

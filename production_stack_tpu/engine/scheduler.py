@@ -176,7 +176,15 @@ class Scheduler:
         self.prefill_chunk = prefill_chunk
         self.waiting: Deque[Sequence] = collections.deque()
         self.running: Dict[int, Sequence] = {}        # slot -> seq
+        # kept sorted DESCENDING so pop() hands out the LOWEST free
+        # slot: admissions fill the low slots first, which keeps the
+        # live batch dense and the engine's batch-bucketed decode
+        # dispatch (engine._compact_slots) mostly a no-op
         self.free_slots: List[int] = list(range(max_num_seqs - 1, -1, -1))
+        # last schedule() pass deferred the head waiter on the KV
+        # admission gate (can_admit): a waiter + free slot does not
+        # imply the next pass admits (read by engine._admission_imminent)
+        self.kv_deferred = False
         self._prefilling: Dict[int, Sequence] = {}    # slot -> seq
         # invoked right after a slot is assigned, before the first prefill
         # chunk is cut — may rewind seq.num_prefilled past a cached prefix
@@ -290,10 +298,16 @@ class Scheduler:
         helm/templates/deployment-vllm-multi.yaml:69-72).
         """
         works = [self._chunk_of(seq) for seq in self._prefilling.values()]
+        self.kv_deferred = False
         while self.waiting and self.free_slots:
             seq = self.waiting[0]
             if self.can_admit is not None and not self.can_admit(seq):
-                break   # KV pool pressure: keep FIFO order, retry later
+                # KV pool pressure: keep FIFO order, retry later. The
+                # flag tells the engine's mid-window-admission lever
+                # that a waiter + free slot does NOT mean the next
+                # pass admits — shortening windows buys nothing here
+                self.kv_deferred = True
+                break
             self.waiting.popleft()
             seq.slot = self.free_slots.pop()
             seq.status = SeqStatus.PREFILLING
@@ -330,7 +344,7 @@ class Scheduler:
         self.running.pop(slot, None)
         self._prefilling.pop(slot, None)
         if slot >= 0:
-            self.free_slots.append(slot)
+            self._free_slot(slot)
         seq.slot = -1
         seq.status = SeqStatus.WAITING
         seq.num_prefilled = 0
@@ -340,13 +354,19 @@ class Scheduler:
     def finish(self, seq: Sequence, reason: str) -> None:
         self._release(seq.slot, seq, reason)
 
+    def _free_slot(self, slot: int) -> None:
+        """Return a slot to the free list, keeping it sorted descending
+        (pop() hands out the lowest index)."""
+        self.free_slots.append(slot)
+        self.free_slots.sort(reverse=True)
+
     def _release(self, slot: int, seq: Sequence, reason: str) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
         seq.kv_prefetch = None   # finished seqs are retained; drop host KV
         if slot >= 0:
             self.running.pop(slot, None)
-            self.free_slots.append(slot)
+            self._free_slot(slot)
             seq.slot = -1
 
     # ------------------------------------------------------------------

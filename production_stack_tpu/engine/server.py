@@ -85,11 +85,11 @@ def _seal_engine_trace(tracer: TraceRecorder, trace, request: web.Request,
     now = time.monotonic()
     engine = request.app.get(ENGINE_KEY)
     if engine is not None:
-        for (start, dur, kind, window, kv) in \
+        for (start, dur, kind, window, kv, batch) in \
                 engine.engine.eff.compile_events_between(trace.t0, now):
             trace.add_event("xla_compile", start, dur,
                             attrs={"kind": kind, "window": window,
-                                   "kv_bucket": kv})
+                                   "kv_bucket": kv, "batch": batch})
     timing = request.get("seq_timing")
     tok_s = request.get("trace_tokenize_s")
     if timing is not None:
@@ -1309,6 +1309,21 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--kv-len-buckets", default=None,
                    help="comma-separated attention-length buckets "
                         "(default: powers of two up to max-model-len)")
+    p.add_argument("--no-window-adapt", action="store_true",
+                   help="disable continuous batching across fused "
+                        "windows: every decode dispatch computes "
+                        "max-num-seqs x decode-window token positions "
+                        "whatever the batch holds (the pre-r17 "
+                        "behavior; the effwatch A/B control)")
+    p.add_argument("--decode-batch-buckets", default=None,
+                   help="comma-separated decode batch buckets the "
+                        "adaptive dispatch may shrink to (default: "
+                        "powers of two up to max-num-seqs); each "
+                        "bucket is a warmed executable per window "
+                        "bucket, so keep the set small")
+    p.add_argument("--decode-window-buckets", default=None,
+                   help="comma-separated decode window-length buckets "
+                        "(default: powers of two up to decode-window)")
     p.add_argument("--tensor-parallel-size", type=int, default=1)
     p.add_argument("--pipeline-parallel-size", type=int, default=1,
                    help="multi-slice DCN passthrough knob (must be 1; "
@@ -1421,6 +1436,13 @@ def main(argv=None) -> None:
         hbm_peak_gbps=args.hbm_peak_gbps,
         perf_ring_entries=args.perf_ring_entries,
         decode_window=args.decode_window,
+        window_adapt=not args.no_window_adapt,
+        decode_batch_buckets=tuple(
+            int(x) for x in args.decode_batch_buckets.split(","))
+        if args.decode_batch_buckets else (),
+        decode_window_buckets=tuple(
+            int(x) for x in args.decode_window_buckets.split(","))
+        if args.decode_window_buckets else (),
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),
         enable_prefix_caching=args.enable_prefix_caching,

@@ -84,8 +84,10 @@ from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.disagg import (disagg_violations,
                                                  run_disagg)
-from production_stack_tpu.loadgen.effwatch import (effwatch_violations,
-                                                   run_effwatch)
+from production_stack_tpu.loadgen.effwatch import (effwatch_ab_violations,
+                                                   effwatch_violations,
+                                                   run_effwatch,
+                                                   run_effwatch_ab)
 from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
                                                     firedrill_violations,
                                                     run_firedrill)
@@ -305,20 +307,63 @@ def cmd_overload(args) -> int:
 
 
 def cmd_effwatch(args) -> int:
-    record = asyncio.run(run_effwatch(
+    mixed = ([int(x) for x in args.mixed_tokens.split(",")]
+             if args.mixed_tokens else None)
+    common = dict(
         engine=args.engine, users=args.users, duration_s=args.duration,
         warmup_s=args.warmup, num_tokens=args.num_tokens,
         sum_tolerance=args.sum_tolerance,
         rate_tolerance=args.rate_tolerance,
-        anti_vacuity=args.anti_vacuity,
+        stagger_s=args.stagger, mixed_tokens=mixed,
+        prompt_chars=args.prompt_chars,
+        engine_args=args.engine_args.split() if args.engine_args
+        else None,
         fake_pad_fraction=args.fake_pad_fraction,
         fake_dead_fraction=args.fake_dead_fraction,
         fake_skew=args.fake_skew,
         platform=args.platform, log_dir=args.log_dir,
-        startup_timeout_s=args.startup_timeout))
-    print(json.dumps(record, indent=2))
+        startup_timeout_s=args.startup_timeout)
     output = args.output or \
         f"EFF_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    if args.ab:
+        if args.anti_vacuity:
+            print("--anti-vacuity is a single-run falsifiability "
+                  "probe (mis-sized accounting window, gates must "
+                  "fail); it has no A/B semantics — run it without "
+                  "--ab", file=sys.stderr)
+            return 2
+        if args.no_window_adapt:
+            print("--no-window-adapt is the single-run control side "
+                  "by itself; --ab already runs both sides — pick "
+                  "one", file=sys.stderr)
+            return 2
+        record = asyncio.run(run_effwatch_ab(
+            live_floor=args.live_floor,
+            improve_floor=args.improve_floor,
+            rounds=args.rounds, **common))
+        print(json.dumps(record, indent=2))
+        report_mod.write_json(output, record)
+        violations = effwatch_ab_violations(
+            record, live_floor=args.live_floor,
+            improve_floor=args.improve_floor,
+            sum_tolerance=args.sum_tolerance,
+            rate_tolerance=args.rate_tolerance)
+        for v in violations:
+            print(f"EFFWATCH A/B VIOLATION: {v}", file=sys.stderr)
+        if not violations:
+            d = record["detail"]
+            print(f"effwatch A/B PASSED: accounted decode tok/s "
+                  f"{d['accounted_decode_tokens_per_s_adapt']} adapt "
+                  f"vs {d['accounted_decode_tokens_per_s_control']} "
+                  f"control (+{d['improvement_perc']}%), live "
+                  f"fraction {d['live_fraction_adapt']} vs "
+                  f"{d['live_fraction_control']}, all per-side gates "
+                  f"green")
+        return 1 if violations else 0
+    record = asyncio.run(run_effwatch(
+        anti_vacuity=args.anti_vacuity,
+        window_adapt=not args.no_window_adapt, **common))
+    print(json.dumps(record, indent=2))
     report_mod.write_json(output, record)
     violations = effwatch_violations(
         record, sum_tolerance=args.sum_tolerance,
@@ -897,6 +942,44 @@ def build_parser() -> argparse.ArgumentParser:
                          "before the warmup storm): the "
                          "reconciliation gate MUST fail; exit 0 iff "
                          "it does")
+    sp.add_argument("--ab", action="store_true",
+                    help="same-storm A/B: window adaptation on vs "
+                         "--no-window-adapt control (fresh engine per "
+                         "side); gates on per-side accounting PLUS "
+                         "adapt live fraction >= --live-floor and "
+                         "accounted tokens/s >= (1 + --improve-floor) "
+                         "x control")
+    sp.add_argument("--no-window-adapt", action="store_true",
+                    help="single run with adaptation disabled (the "
+                         "control side by itself)")
+    sp.add_argument("--live-floor", type=float, default=0.80,
+                    help="A/B: minimum adapt-side whole-window live "
+                         "fraction")
+    sp.add_argument("--improve-floor", type=float, default=0.20,
+                    help="A/B: minimum relative accounted-tokens/s "
+                         "improvement over the control")
+    sp.add_argument("--stagger", type=float, default=0.0,
+                    help="seconds between successive workers' first "
+                         "requests (staggered arrivals — the churny "
+                         "storm shape)")
+    sp.add_argument("--mixed-tokens", default=None,
+                    help="comma-separated max_tokens cycled per "
+                         "request, offset by worker (mixed short/long "
+                         "outputs), e.g. 8,48; overrides --num-tokens "
+                         "for the storm bodies")
+    sp.add_argument("--engine-args", default=None,
+                    help="extra engine CLI flags appended to the "
+                         "launch (space-separated; real engines only) "
+                         "— geometry overrides for the A/B, e.g. "
+                         "'--max-num-seqs 16'")
+    sp.add_argument("--prompt-chars", type=int, default=0,
+                    help="pad storm prompts to this many characters "
+                         "(longer live context — the per-row KV read "
+                         "dominates fixed dispatch overhead)")
+    sp.add_argument("--rounds", type=int, default=1,
+                    help="A/B rounds in alternating ABBA order; gates "
+                         "read per-side aggregates across rounds "
+                         "(single-host noise control)")
     sp.add_argument("--fake-pad-fraction", type=float, default=0.3,
                     help="fake engine: synthetic padding fraction")
     sp.add_argument("--fake-dead-fraction", type=float, default=0.1,

@@ -120,22 +120,44 @@ async def _one_stream(session: aiohttp.ClientSession, url: str,
 
 
 async def _storm(url: str, model: str, *, users: int, duration_s: float,
-                 num_tokens: int, tag: str) -> _StormCounters:
+                 num_tokens: int, tag: str,
+                 stagger_s: float = 0.0,
+                 mixed_tokens: Optional[List[int]] = None,
+                 prompt_chars: int = 0) -> _StormCounters:
     """Closed-loop storm: ``users`` workers re-issuing streams until
     the window elapses; in-flight requests run to completion so every
-    received token lies inside the surrounding scrape window."""
+    received token lies inside the surrounding scrape window.
+
+    The churny shape for the window-adaptation A/B: ``stagger_s``
+    offsets each worker's first request (staggered arrivals — batch
+    composition keeps changing instead of settling once), and
+    ``mixed_tokens`` cycles per-request ``max_tokens`` through the
+    given list offset by worker id (mixed short/long outputs — rows
+    finish at different steps, the finished-tail regime)."""
     c = _StormCounters()
     t_end = time.monotonic() + duration_s
 
     async def worker(wid: int):
         i = 0
+        if stagger_s > 0:
+            await asyncio.sleep(stagger_s * wid)
         async with aiohttp.ClientSession(
                 connector=aiohttp.TCPConnector(limit=0)) as session:
             while time.monotonic() < t_end:
+                toks = (mixed_tokens[(wid + i) % len(mixed_tokens)]
+                        if mixed_tokens else num_tokens)
                 i += 1
-                await _one_stream(session, url, model,
-                                  f"{tag} worker {wid} round {i}",
-                                  num_tokens, c)
+                prompt = f"{tag} worker {wid} round {i}"
+                if prompt_chars and len(prompt) < prompt_chars:
+                    # pad the prompt to a target length (longer live
+                    # context -> the per-row KV read dominates the
+                    # dispatch's fixed overhead; debug-tiny tokenizes
+                    # per character)
+                    prompt += " " + "ctx " * ((prompt_chars
+                                               - len(prompt)) // 4 + 1)
+                    prompt = prompt[:prompt_chars]
+                await _one_stream(session, url, model, prompt,
+                                  toks, c)
 
     await asyncio.gather(*(worker(w) for w in range(users)))
     return c
@@ -220,21 +242,36 @@ async def run_effwatch(*, engine: str = "debug-tiny",
                        sum_tolerance: float = 0.02,
                        rate_tolerance: float = 0.10,
                        anti_vacuity: bool = False,
+                       window_adapt: bool = True,
+                       stagger_s: float = 0.0,
+                       mixed_tokens: Optional[List[int]] = None,
+                       prompt_chars: int = 0,
+                       engine_args: Optional[List[str]] = None,
                        fake_pad_fraction: float = 0.3,
                        fake_dead_fraction: float = 0.1,
                        fake_skew: float = 0.0,
+                       fake_tokens_per_s: float = 200.0,
                        platform: str = "cpu",
                        log_dir: str = "loadgen-logs",
                        startup_timeout_s: float = 420.0) -> Dict:
     """Launch one engine, audit its efficiency accounting around a
     steady storm; return the EFF record (BENCH schema; headline =
-    accounted steady decode tokens/s)."""
+    accounted steady decode tokens/s).
+
+    ``window_adapt=False`` launches the real engine with
+    ``--no-window-adapt`` (the r17 A/B control: full-geometry windows
+    whatever the batch holds); ``stagger_s``/``mixed_tokens`` shape
+    the churny storm; ``engine_args`` appends raw engine CLI flags
+    (geometry overrides for the compile-budget tests)."""
     procs = []
     try:
-        extra = None
         if engine == "fake":
             extra = ["--num-tokens", str(num_tokens),
-                     "--tokens-per-s", "200"]
+                     "--tokens-per-s", str(fake_tokens_per_s)]
+        else:
+            extra = list(engine_args or [])
+            if not window_adapt:
+                extra.append("--no-window-adapt")
         proc = launch_engine(engine, free_port(), log_dir=log_dir,
                              platform=platform, extra_args=extra)
         procs.append(proc)
@@ -254,7 +291,9 @@ async def run_effwatch(*, engine: str = "debug-tiny",
         logger.info("effwatch warmup storm: %d users for %.0fs", users,
                     warmup_s)
         await _storm(proc.url, model, users=users, duration_s=warmup_s,
-                     num_tokens=num_tokens, tag="warmup")
+                     num_tokens=num_tokens, tag="warmup",
+                     stagger_s=stagger_s, mixed_tokens=mixed_tokens,
+                     prompt_chars=prompt_chars)
 
         if anti_vacuity:
             # deliberately mis-sized accounting window: the "before"
@@ -269,7 +308,9 @@ async def run_effwatch(*, engine: str = "debug-tiny",
                     duration_s)
         c = await _storm(proc.url, model, users=users,
                          duration_s=duration_s, num_tokens=num_tokens,
-                         tag="steady")
+                         tag="steady", stagger_s=stagger_s,
+                         mixed_tokens=mixed_tokens,
+                         prompt_chars=prompt_chars)
         after = await _scrape_perf(proc.url)
         t_after = time.monotonic()
         debug_perf = await _scrape_debug_perf(proc.url)
@@ -309,6 +350,10 @@ async def run_effwatch(*, engine: str = "debug-tiny",
             "duration_s": duration_s,
             "warmup_s": warmup_s,
             "num_tokens": num_tokens,
+            "window_adapt": window_adapt,
+            "stagger_s": stagger_s,
+            "mixed_tokens": mixed_tokens,
+            "prompt_chars": prompt_chars,
             "anti_vacuity": anti_vacuity,
             "window_s": round(window_s, 3),
             "requests": c.requests,
@@ -323,6 +368,12 @@ async def run_effwatch(*, engine: str = "debug-tiny",
                 (deltas["real"] + deltas["pad"] + deltas["dead"])
                 / deltas["token_steps_total"], 4)
             if deltas["token_steps_total"] else None,
+            # live fraction over the WHOLE measured window (delta-
+            # derived — the A/B gates on this, not on the ring's
+            # recent-horizon figure)
+            "live_fraction_window": round(
+                deltas["real"] / max(1, deltas["real"] + deltas["pad"]
+                                     + deltas["dead"]), 4),
             "live_fraction_steady": after.get("live_fraction"),
             "mbu_perc_steady": after.get("mbu_perc"),
             "effective_bytes_per_s_steady":
@@ -340,3 +391,152 @@ async def run_effwatch(*, engine: str = "debug-tiny",
         },
     }
     return record
+
+
+def effwatch_ab_violations(record: Dict,
+                           live_floor: float = 0.80,
+                           improve_floor: float = 0.20,
+                           sum_tolerance: float = 0.02,
+                           rate_tolerance: float = 0.10) -> List[str]:
+    """The A/B acceptance contract (CLI exits 1 on any):
+
+    - BOTH sides must individually pass every effwatch gate (sum-to-1,
+      client reconciliation, steady-window compile silence, zero
+      errors) — the anti-vacuity substrate holds under variable batch
+      and window geometry, or the win is unaccountable;
+    - the adapt side's whole-window live fraction must reach
+      ``live_floor`` AND beat the control's (directional: adaptation
+      off must actually cost live fraction, or the storm shape proves
+      nothing);
+    - accounted decode tokens/s must improve by ``improve_floor``
+      relative to the control.
+    """
+    d = record["detail"]
+    out = []
+    for side in ("adapt", "control"):
+        for v in effwatch_violations({"detail": d[side]},
+                                     sum_tolerance=sum_tolerance,
+                                     rate_tolerance=rate_tolerance):
+            out.append(f"[{side}] {v}")
+    live_a = d["adapt"].get("live_fraction_window") or 0.0
+    live_c = d["control"].get("live_fraction_window") or 0.0
+    if live_a < live_floor:
+        out.append(f"adapt-side live fraction {live_a:.3f} below the "
+                   f"{live_floor} floor")
+    if live_a <= live_c:
+        out.append(f"adapt-side live fraction {live_a:.3f} does not "
+                   f"beat the control's {live_c:.3f} — the storm "
+                   f"shape is not exercising the levers")
+    rate_a = d["adapt"]["accounted_decode_tokens_per_s"]
+    rate_c = d["control"]["accounted_decode_tokens_per_s"]
+    if rate_c <= 0:
+        out.append("control side accounted zero decode tokens/s")
+    elif rate_a < rate_c * (1.0 + improve_floor):
+        out.append(
+            f"accounted decode tokens/s improved only "
+            f"{100.0 * (rate_a / rate_c - 1.0):.1f}% "
+            f"({rate_a} vs {rate_c}; floor {100 * improve_floor:.0f}%)")
+    return out
+
+
+def _aggregate_side(details: List[Dict]) -> Dict:
+    """Fold one side's per-round details into an aggregate the A/B
+    gates read: counters and token counts SUM across rounds, rates
+    come from the summed tokens over the summed measured windows, so
+    no single round's host noise owns the comparison."""
+    deltas = {k: sum(d["deltas"][k] for d in details)
+              for k in ("real", "pad", "dead", "token_steps_total",
+                        "windows", "compiles_total")}
+    window_s = sum(d["window_s"] for d in details)
+    acct = sum(d["accounted_decode_tokens"] for d in details)
+    client = sum(d["client_decode_tokens"] for d in details)
+    kinds = deltas["real"] + deltas["pad"] + deltas["dead"]
+    return {
+        "rounds": len(details),
+        "window_adapt": details[0]["window_adapt"],
+        "errors": sum(d["errors"] for d in details),
+        "error_samples": [s for d in details
+                          for s in d["error_samples"]][:6],
+        "requests": sum(d["requests"] for d in details),
+        "deltas": deltas,
+        "window_s": round(window_s, 3),
+        "accounted_decode_tokens": acct,
+        "client_decode_tokens": client,
+        "accounted_decode_tokens_per_s": round(acct / window_s, 2),
+        "client_decode_tokens_per_s": round(client / window_s, 2),
+        "fraction_sum": round(kinds / deltas["token_steps_total"], 4)
+        if deltas["token_steps_total"] else None,
+        "live_fraction_window": round(deltas["real"] / max(1, kinds),
+                                      4),
+    }
+
+
+async def run_effwatch_ab(*, live_floor: float = 0.80,
+                          improve_floor: float = 0.20,
+                          rounds: int = 1,
+                          fake_control_pad_fraction: float = 0.40,
+                          fake_control_dead_fraction: float = 0.10,
+                          fake_control_tokens_per_s: float = 200.0,
+                          **kw) -> Dict:
+    """Same-storm A/B: window adaptation ON vs ``--no-window-adapt``
+    (identical storm shape, fresh engine process per side per round).
+    ``rounds`` > 1 repeats the pair in ABBA order (adapt-control /
+    control-adapt alternating) and gates on per-side AGGREGATES —
+    single-host run-to-run noise is comparable to the effect size, so
+    the committed record sums tokens and measured seconds across
+    rounds instead of trusting one pair. Returns an EFF record whose
+    detail carries both aggregates, every per-round detail, and the
+    comparison; headline value = accounted decode tokens/s
+    improvement (%).
+
+    Fake-engine mode is a PLUMBING smoke (delta math, per-side gates,
+    comparison arithmetic): the control side runs with deliberately
+    worse synthetic pad/dead fractions and pacing — the committed
+    acceptance record comes from the real-engine A/B
+    (benchmarks/run_effwatch.sh --ab)."""
+    ctrl_kw = dict(kw)
+    if ctrl_kw.get("engine") == "fake":
+        ctrl_kw.update(
+            fake_pad_fraction=fake_control_pad_fraction,
+            fake_dead_fraction=fake_control_dead_fraction,
+            fake_tokens_per_s=fake_control_tokens_per_s)
+    per: Dict[bool, List[Dict]] = {True: [], False: []}
+    for i in range(max(1, rounds)):
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for adapt_side in order:
+            logger.info("effwatch A/B round %d/%d: %s side", i + 1,
+                        max(1, rounds),
+                        "adapt" if adapt_side else "control")
+            rec = await run_effwatch(
+                window_adapt=adapt_side,
+                **(kw if adapt_side else ctrl_kw))
+            per[adapt_side].append(rec["detail"])
+    adapt = _aggregate_side(per[True])
+    control = _aggregate_side(per[False])
+    rate_a = adapt["accounted_decode_tokens_per_s"]
+    rate_c = control["accounted_decode_tokens_per_s"]
+    improvement = (100.0 * (rate_a / rate_c - 1.0)
+                   if rate_c > 0 else None)
+    return {
+        "metric": "continuous batching across fused decode windows: "
+                  "same-storm A/B, window adaptation (live-row "
+                  "compaction + adaptive window sizing + mid-window "
+                  "admission) vs --no-window-adapt",
+        "value": round(improvement, 2) if improvement is not None
+        else None,
+        "unit": "accounted_decode_tokens_per_s_improvement_perc",
+        "platform": kw.get("platform", "cpu"),
+        "detail": {
+            "adapt": adapt,
+            "control": control,
+            "rounds": {"adapt": per[True], "control": per[False]},
+            "accounted_decode_tokens_per_s_adapt": rate_a,
+            "accounted_decode_tokens_per_s_control": rate_c,
+            "improvement_perc": round(improvement, 2)
+            if improvement is not None else None,
+            "live_fraction_adapt": adapt["live_fraction_window"],
+            "live_fraction_control": control["live_fraction_window"],
+            "live_floor": live_floor,
+            "improve_floor": improve_floor,
+        },
+    }

@@ -110,17 +110,19 @@ def test_compile_tracking_and_event_overlap():
     hist = PhaseHistograms(("kind", "window", "kv_bucket"),
                            buckets=(1.0, 10.0))
     acct = EngineEffAccounting(now_fn=clock, compile_hist=hist)
-    acct.compile_started("decode", 8, 512)
+    acct.compile_started("decode", 8, 512, 4)
     assert acct.report()["compile_in_flight"] == 1
-    acct.compile_finished("decode", 8, 512, started_at=5.0, dur_s=2.5)
-    acct.compile_started("prefill", 64, 256)
+    acct.compile_finished("decode", 8, 512, started_at=5.0, dur_s=2.5,
+                          batch=4)
+    acct.compile_started("prefill", 64, 256, 8)
     acct.compile_finished("prefill", 64, 256, started_at=20.0,
-                          dur_s=0.5)
+                          dur_s=0.5, batch=8)
     r = acct.report()
     assert r["compile_in_flight"] == 0
     assert r["compiles_total"] == 2
-    assert r["compiles"]["decode|8|512"]["count"] == 1
-    assert r["compiles"]["decode|8|512"]["seconds"] == pytest.approx(2.5)
+    assert r["compiles"]["decode|8|512|4"]["count"] == 1
+    assert r["compiles"]["decode|8|512|4"]["seconds"] == \
+        pytest.approx(2.5)
     # duration histogram got both observations under their labels
     # (snapshot values are (cumulative buckets, sum, count))
     snap = hist.snapshot()
@@ -174,6 +176,203 @@ def test_rates_clamp_to_ring_coverage():
     assert acct2.rates(horizon_s=10.0,
                        now=2.0)["decode_tokens_per_s"] == \
         pytest.approx(5.0)
+
+
+def test_window_accounting_variable_geometry():
+    """Continuous batching across windows: consecutive windows change
+    batch bucket AND window length; the kind totals must still equal
+    the independent total and the byte-model rates stay finite."""
+    clock = _Clock()
+    acct = EngineEffAccounting(weight_bytes=500, kv_position_bytes=4,
+                               hbm_peak_bytes_per_s=1e6, now_fn=clock)
+    # (batch_bucket, steps, live_rows, real): a churny sequence —
+    # bucket 8 full, bucket 4 with a finished tail, bucket 2 draining,
+    # bucket 8 again after admissions, a 1-step mid-window-admission
+    # window
+    shapes = [(8, 8, 8, 64), (4, 8, 3, 20), (2, 4, 2, 8),
+              (8, 2, 7, 14), (1, 1, 1, 1)]
+    expect_total = 0
+    expect_real = 0
+    for i, (b, w, live, real) in enumerate(shapes):
+        clock.t = float(i + 1)
+        total = b * w
+        pad = (b - live) * w
+        dead = total - pad - real
+        assert dead >= 0
+        acct.note_window(steps=w, positions=1, batch=b, live_rows=live,
+                         kv_len=128, real=real, pad=pad, dead=dead,
+                         window_s=0.01 * w)
+        expect_total += total
+        expect_real += real
+    d = acct.report()["decode"]
+    assert d["token_steps_total"] == expect_total
+    assert d["real"] + d["pad"] + d["dead"] == expect_total
+    assert d["real"] == expect_real
+    rates = acct.rates(horizon_s=10.0, now=5.0)
+    for key in ("effective_bytes_per_s", "total_bytes_per_s",
+                "mbu_perc", "decode_tokens_per_s"):
+        v = rates[key]
+        assert v >= 0 and v == v and v != float("inf"), (key, v)
+    assert 0.0 < rates["live_fraction"] < 1.0
+    # the ring keeps per-window geometry for /debug/perf diagnosis
+    ring = acct.recent_windows(10)
+    assert [(w["batch"], w["steps"], w["live_rows"]) for w in ring] == \
+        [(b, w, l) for (b, w, l, _) in shapes]
+
+
+def test_config_bucket_derivation_and_lookup():
+    from production_stack_tpu.engine.config import EngineConfig
+    cfg = EngineConfig(max_num_seqs=8, decode_window=8)
+    assert cfg.window_adapt
+    assert cfg.decode_batch_buckets == (1, 2, 4, 8)
+    assert cfg.decode_window_buckets == (1, 2, 4, 8)
+    assert cfg.batch_bucket_for(3) == 4
+    assert cfg.batch_bucket_for(8) == 8
+    assert cfg.batch_bucket_for(99) == 8      # clamped to the cap
+    # non-power-of-two caps are always covered
+    cfg6 = EngineConfig(max_num_seqs=6, decode_window=6)
+    assert cfg6.decode_batch_buckets == (1, 2, 4, 6)
+    # custom sets: filtered to range, cap appended when missing
+    cfgc = EngineConfig(decode_batch_buckets=(2, 3, 99),
+                        decode_window_buckets=(4,))
+    assert cfgc.decode_batch_buckets == (2, 3, 8)
+    assert cfgc.decode_window_buckets == (4, 8)
+    with pytest.raises(ValueError):
+        EngineConfig(decode_batch_buckets=(0, -3))
+    # speculation pins fixed geometry: the spec executable only warms
+    # at the full shape, so adaptation would compile mid-serving
+    assert not EngineConfig(speculative_ngram_tokens=3).window_adapt
+
+
+def test_non_hot_variant_pins_fixed_geometry():
+    """A window needing an executable variant outside the warmed
+    (greedy/plain) grid — here full-sort sampling via top_p < 1 —
+    must dispatch at the FULL fixed geometry: that variant warms at
+    the full shape only, and adapting it would compile a cold
+    executable per geometry reached, mid-serving."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=4, prefill_chunk=32,
+                       prefill_buckets=(16, 32))
+    eng = LLMEngine(cfg)
+    eng.add_request(
+        eng.tokenizer.encode("full sort variant pins geometry"),
+        SamplingOptions(temperature=1.0, top_p=0.5, max_tokens=6,
+                        ignore_eos=True), seq_id="s")
+    for _ in range(200):
+        if any(o.finished for o in eng.step()):
+            break
+    ring = eng.eff.recent_windows(50)
+    assert ring, "no decode windows recorded"
+    assert all(w["batch"] == cfg.max_num_seqs
+               and w["steps"] == cfg.decode_window for w in ring), \
+        [(w["batch"], w["steps"]) for w in ring]
+
+
+def test_kv_bucket_above_grid_pins_fixed_geometry():
+    """The warmup grid exists at the smallest kv bucket only: a
+    window whose attention length lands in a LARGER bucket must
+    dispatch at the full fixed geometry (one lazy compile per
+    variant, the pre-r17 cost) instead of walking the adaptive grid
+    cold at that bucket."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    cfg = EngineConfig(model="debug-tiny", max_model_len=256,
+                       max_num_seqs=4, prefill_chunk=32,
+                       prefill_buckets=(32, 64),
+                       kv_len_buckets=(64, 256))
+    eng = LLMEngine(cfg)
+    # ~90-token prompt: every decode window's attention length sits
+    # in the 256 bucket, above the warmed 64 bucket
+    eng.add_request(
+        eng.tokenizer.encode("kv bucket pin " * 7),
+        SamplingOptions(temperature=0.0, max_tokens=6,
+                        ignore_eos=True), seq_id="s")
+    for _ in range(200):
+        if any(o.finished for o in eng.step()):
+            break
+    ring = eng.eff.recent_windows(50)
+    assert ring, "no decode windows recorded"
+    assert all(w["kv_len"] == 256 and w["batch"] == cfg.max_num_seqs
+               and w["steps"] == cfg.decode_window for w in ring), \
+        [(w["kv_len"], w["batch"], w["steps"]) for w in ring]
+
+
+def test_admission_imminent_respects_kv_gate():
+    """The mid-window-admission lever must not fire when the last
+    scheduler pass deferred the head waiter on the KV admission gate:
+    a waiter + free slot does not mean the next pass admits, and
+    shortening windows / pausing the pipeline under pool pressure
+    costs fusion for nothing."""
+    from production_stack_tpu.engine.scheduler import (Scheduler,
+                                                       SamplingOptions,
+                                                       Sequence)
+    sched = Scheduler(max_num_seqs=2, max_model_len=64,
+                      prefill_chunk=16)
+    sched.add(Sequence("w1", list(range(4)), SamplingOptions()))
+    admit = {"ok": False}
+    sched.can_admit = lambda seq: admit["ok"]
+    sched.schedule()
+    assert sched.waiting and sched.free_slots and sched.kv_deferred
+    admit["ok"] = True
+    sched.schedule()
+    assert not sched.kv_deferred and not sched.waiting
+
+
+def test_engine_variable_geometry_reconciles_with_compaction():
+    """A real (CPU, debug-tiny) engine through a churny composition:
+    three rows with different budgets admitted together, so windows
+    shrink as rows finish, the batch bucket steps down 4 -> 2 -> 1,
+    and the survivors are COMPACTED into the low slots mid-stream —
+    through all of it real+pad+dead must equal the independent total
+    and real must equal exactly the decode-emitted tokens."""
+    from production_stack_tpu.engine.config import EngineConfig
+    from production_stack_tpu.engine.engine import LLMEngine
+    from production_stack_tpu.engine.scheduler import SamplingOptions
+    cfg = EngineConfig(model="debug-tiny", max_model_len=128,
+                       max_num_seqs=4, prefill_chunk=32,
+                       prefill_buckets=(16, 32))
+    eng = LLMEngine(cfg)
+    budgets = {"a": 3, "b": 9, "c": 21}
+    for name, mt in budgets.items():
+        eng.add_request(
+            eng.tokenizer.encode("variable geometry " + name * 3),
+            SamplingOptions(temperature=0.0, max_tokens=mt,
+                            ignore_eos=True), seq_id=name)
+    done = set()
+    slots_seen = set()
+    for _ in range(400):
+        for out in eng.step():
+            if out.finished:
+                done.add(out.seq_id)
+        if "b" in done and "c" not in done:
+            # only c remains: compaction must have packed it low
+            slots_seen.add(eng.seqs["c"].slot)
+        if len(done) == 3:
+            break
+    assert done == set(budgets)
+    # c started at slot 2 (admission order) and must have been
+    # remapped to slot 0 once a and b finished
+    assert 0 in slots_seen
+    for name, mt in budgets.items():
+        assert len(eng.seqs[name].output_tokens) == mt
+    rep = eng.eff.report()
+    d = rep["decode"]
+    assert d["token_steps_total"] > 0
+    assert d["real"] + d["pad"] + d["dead"] == d["token_steps_total"]
+    # decode-real = every emitted token minus the prefill-sampled first
+    assert d["real"] == sum(budgets.values()) - len(budgets)
+    ring = eng.eff.recent_windows(100)
+    assert len({w["batch"] for w in ring}) >= 2, \
+        "batch bucket never adapted"
+    assert len({w["steps"] for w in ring}) >= 2, \
+        "window length never adapted"
+    assert all(w["batch"] >= w["live_rows"] for w in ring)
+    rates = eng.eff.rates()
+    assert rates["decode_tokens_per_s"] >= 0
 
 
 # --------------------------------------------------- block manager tier
@@ -327,11 +526,18 @@ def test_engine_perf_surfaces_and_compile_trace(cold_engine):
         dp = await r.json()
         assert dp["windows"], "no window breakdowns recorded"
         w = dp["windows"][-1]
-        assert w["batch"] == 2 and w["steps"] == 8
-        assert {"real", "pad", "dead", "kv_len",
+        # adaptive dispatch: one live row -> batch bucket 1 (not the
+        # configured max_num_seqs=2); the 5-step decode budget walks a
+        # 4-step window then a final 1-step one (the dead-budget cap
+        # rejects the 8 bucket: a 3-step tail on one live row)
+        assert w["batch"] == 1 and w["steps"] == 1
+        assert [x["steps"] for x in dp["windows"][-2:]] == [4, 1]
+        assert {"real", "pad", "dead", "kv_len", "live_rows",
                 "window_s"} <= set(w)
         kinds = [e["kind"] for e in dp["compiles"]]
         assert "decode" in kinds and "prefill" in kinds
+        # compile events carry the dispatched batch bucket
+        assert all("batch" in e for e in dp["compiles"])
         assert dp["kv_pool"]["active"] == 0   # request finished
         assert dp["totals"]["compiles_total"] == len(dp["compiles"])
         # the compile-stalled request's trace carries xla_compile
