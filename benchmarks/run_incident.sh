@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Fleet flight-recorder closed loop: the committed INCIDENT_r18.json
+# recipe — N peered routers + M fake engines + the obsplane
+# aggregator, SLO windows scaled to seconds. A clean baseline must
+# capture ZERO incident bundles while the online stitcher joins
+# chains; then each injected fault (one-engine TTFT inflation, an
+# engine SIGKILL, a shed storm aimed at one router) must fire its
+# alert, yield exactly one complete bundle (every fleet process
+# represented), and the bundle's attribution must name the injected
+# culprit process and the correct phase; plus the r7 overhead A/B run
+# with and without the obsplane scraping the serving pair.
+#
+#   ./benchmarks/run_incident.sh                        # full drill (fakes)
+#   SCENARIOS=slow_ttft ./benchmarks/run_incident.sh
+#   ENGINE=debug-tiny ./benchmarks/run_incident.sh      # no slow_ttft
+#
+# Exit 1 on any spurious capture, missed alert, missing/extra/
+# incomplete bundle, wrong attribution, or overhead-band breach.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ENGINE="${ENGINE:-fake}"
+OUT="${OUT:-INCIDENT_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ -n "${SCENARIOS:-}" ]; then
+  EXTRA+=(--scenarios "$SCENARIOS")
+fi
+if [ "${GUARD:-1}" = "1" ]; then
+  EXTRA+=(--overhead-guard)
+fi
+
+python -m production_stack_tpu.loadgen incident \
+  --engine "$ENGINE" \
+  --engines "${ENGINES:-3}" --routers "${ROUTERS:-2}" \
+  --users "${USERS:-8}" \
+  --baseline "${BASELINE:-10s}" \
+  --window-scale "${WINDOW_SCALE:-0.01}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "incident record: $OUT"

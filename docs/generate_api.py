@@ -61,6 +61,12 @@ MODULES = {
         "production_stack_tpu.autoscaler.actuator",
         "production_stack_tpu.autoscaler.controller",
     ],
+    "Fleet observability": [
+        "production_stack_tpu.obsplane.aggregator",
+        "production_stack_tpu.obsplane.stitch",
+        "production_stack_tpu.obsplane.recorder",
+        "production_stack_tpu.obsplane.app",
+    ],
     "Models and ops": [
         "production_stack_tpu.models.config",
         "production_stack_tpu.models.llama",

@@ -65,7 +65,11 @@ class EngineEffAccounting:
     ``(kind, window, kv_bucket)`` fed at compile completion (the
     metrics layer owns it so the family is registered standalone).
 
-    ``now_fn`` is injectable for deterministic tests.
+    ``now_fn`` is injectable for deterministic tests; ``wall_fn``
+    (wall clock) stamps ring entries with an ``at_unix`` timestamp so
+    an external reader — the obsplane flight recorder — can align
+    engine windows/compiles with trace spans and other processes'
+    rings without sharing this process's monotonic epoch.
     """
 
     def __init__(self, *, weight_bytes: int = 0,
@@ -73,12 +77,14 @@ class EngineEffAccounting:
                  hbm_peak_bytes_per_s: float = 0.0,
                  ring_entries: int = 256,
                  compile_hist=None,
-                 now_fn: Callable[[], float] = time.monotonic):
+                 now_fn: Callable[[], float] = time.monotonic,
+                 wall_fn: Callable[[], float] = time.time):
         self.weight_bytes = int(weight_bytes)
         self.kv_position_bytes = int(kv_position_bytes)
         self.hbm_peak_bytes_per_s = float(hbm_peak_bytes_per_s)
         self.compile_hist = compile_hist
         self._now = now_fn
+        self._wall = wall_fn
         self._started_at = now_fn()
         # decode-window token-step classification (cumulative ints).
         # token_steps_total accumulates batch*steps*positions in a
@@ -112,7 +118,7 @@ class EngineEffAccounting:
         self.last_compile_at: Optional[float] = None
         self._windows: "collections.deque[dict]" = collections.deque(
             maxlen=max(1, ring_entries))
-        # (start_mono, dur_s, kind, window, kv, batch)
+        # (start_mono, dur_s, kind, window, kv, batch, start_unix)
         self._compile_events: "collections.deque[tuple]" = \
             collections.deque(maxlen=128)
         self._lock = threading.Lock()
@@ -134,6 +140,7 @@ class EngineEffAccounting:
         eff_bytes = int(win_bytes * useful)
         entry = {
             "at": self._now(),
+            "at_unix": round(self._wall(), 4),
             "steps": steps,
             "positions": positions,
             "batch": batch,
@@ -187,9 +194,11 @@ class EngineEffAccounting:
             self.compiles_total += 1
             self.compile_s_total += dur_s
             self.last_compile_at = started_at + dur_s
+            # wall-clock stamp of the compile START (this call runs at
+            # compile END, so subtract the duration)
             self._compile_events.append(
                 (started_at, dur_s, kind, int(window), int(kv_len),
-                 int(batch)))
+                 int(batch), round(self._wall() - dur_s, 4)))
         if self.compile_hist is not None:
             self.compile_hist.observe(kind, str(window), str(kv_len),
                                       dur_s)
@@ -295,17 +304,20 @@ class EngineEffAccounting:
     def recent_compiles(self, limit: int = 50) -> List[dict]:
         with self._lock:
             events = list(self._compile_events)[-max(1, limit):]
-        return [{"at": round(t, 4), "duration_s": round(d, 4),
+        return [{"at": round(t, 4), "at_unix": wall,
+                 "duration_s": round(d, 4),
                  "kind": k, "window": w, "kv_bucket": kv, "batch": b}
-                for t, d, k, w, kv, b in events]
+                for t, d, k, w, kv, b, wall in events]
 
     def compile_events_between(self, t0: float, t1: float
                                ) -> List[Tuple[float, float, str, int,
                                                int, int]]:
         """Compile events overlapping the monotonic interval
         ``[t0, t1]`` — the trace seal hook that makes a compile-stalled
-        request visible in ``/debug/traces``."""
+        request visible in ``/debug/traces``. Rows are
+        ``(start_mono, dur_s, kind, window, kv, batch)`` — the ring's
+        wall-clock stamp is an exporter concern, not a span one."""
         with self._lock:
             events = list(self._compile_events)
-        return [e for e in events
+        return [e[:6] for e in events
                 if e[0] < t1 and e[0] + e[1] > t0]

@@ -67,6 +67,17 @@ trace    — launch router + engines (optionally the disagg split),
            unattributed time is <10%% at p50, and nothing errored
            (TRACE_*.json; --overhead-guard re-runs the r7 A/B with
            tracing on)
+incident — launch N peered routers + M engines + the obsplane fleet
+           flight recorder; a clean baseline must capture zero
+           incident bundles while the online stitcher joins chains,
+           then each injected fault (one-engine TTFT inflation,
+           engine SIGKILL, an aimed shed storm) must fire its alert,
+           yield exactly one complete bundle (every fleet process
+           represented), and the bundle's attribution must name the
+           injected culprit process and the correct phase; exit 1 on
+           any spurious capture, miss, or wrong attribution
+           (INCIDENT_*.json; --overhead-guard runs the r7 A/B with
+           and without the obsplane scraping the serving pair)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -91,6 +102,9 @@ from production_stack_tpu.loadgen.effwatch import (effwatch_ab_violations,
 from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
                                                     firedrill_violations,
                                                     run_firedrill)
+from production_stack_tpu.loadgen.incident import (
+    SCENARIO_NAMES as INCIDENT_SCENARIOS, incident_violations,
+    run_incident)
 from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
 from production_stack_tpu.loadgen.multirouter import (
@@ -579,6 +593,60 @@ def cmd_firedrill(args) -> int:
         if guard:
             msg += (f"; SLO-on overhead {guard['overhead_ratio']:.2f}x "
                     f"vs direct")
+        print(msg)
+    return 1 if violations else 0
+
+
+def cmd_incident(args) -> int:
+    scenarios = None
+    if args.scenarios:
+        scenarios = [s.strip() for s in args.scenarios.split(",")
+                     if s.strip()]
+    record = asyncio.run(run_incident(
+        engines=args.engines, routers=args.routers, engine=args.engine,
+        users=args.users, baseline_s=args.baseline,
+        window_scale=args.window_scale, scenarios=scenarios,
+        detect_timeout_s=args.detect_timeout,
+        resolve_timeout_s=args.resolve_timeout,
+        num_tokens=args.num_tokens,
+        fake_tokens_per_s=args.fake_tokens_per_s,
+        slow_ttft_arg_s=args.slow_ttft_arg,
+        ttft_threshold_s=args.ttft_threshold,
+        max_inflight=args.max_inflight,
+        burst_users=args.burst_users,
+        min_events=args.min_events, routing=args.routing,
+        platform=args.platform, log_dir=args.log_dir,
+        incident_dir=args.incident_dir,
+        poll_interval_s=args.poll_interval,
+        capture_cooldown_s=args.capture_cooldown,
+        startup_timeout_s=args.startup_timeout,
+        overhead_guard=args.overhead_guard,
+        overhead_users=args.overhead_users,
+        overhead_duration_s=args.overhead_duration))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"INCIDENT_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = incident_violations(
+        record, max_overhead_ratio=(args.max_overhead_ratio
+                                    if args.overhead_guard else None),
+        min_chain_fraction=args.min_chain_fraction)
+    for v in violations:
+        print(f"INCIDENT VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        stitch = d["baseline"]["stitch"]
+        msg = (f"incident drill PASSED: baseline clean "
+               f"({d['baseline']['storm']['ok']} ok, 0 bundles, "
+               f"{stitch.get('chains_complete', 0)} chains stitched "
+               f"at {stitch.get('complete_fraction', 0):.0%}), "
+               f"{len(d['scenarios'])}/{len(d['scenarios'])} faults "
+               f"detected+captured+attributed")
+        guard = d.get("overhead_guard")
+        if guard:
+            msg += (f"; scraped overhead {guard['overhead_ratio']:.2f}x"
+                    f" vs unscraped {guard['baseline_ratio']:.2f}x "
+                    f"(best of {guard['rounds']} alternating rounds)")
         print(msg)
     return 1 if violations else 0
 
@@ -1250,6 +1318,100 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write FIREDRILL_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_firedrill)
+
+    sp = sub.add_parser("incident",
+                        help="N peered routers + M engines + the "
+                             "obsplane flight recorder: a clean "
+                             "baseline captures zero bundles, each "
+                             "injected fault fires its alert and "
+                             "yields ONE complete bundle whose "
+                             "attribution names the culprit process "
+                             "and phase")
+    sp.add_argument("--engines", type=int, default=3,
+                    help="engine replica count behind the routers")
+    sp.add_argument("--routers", type=int, default=2,
+                    help="peered router replica count (r16 gossip)")
+    sp.add_argument("--engine", default="fake",
+                    help="'fake' (the /fault endpoint drives "
+                         "slow_ttft) or a real engine model name "
+                         "(engine_down + shed_storm only)")
+    sp.add_argument("--users", type=int, default=8,
+                    help="closed-loop storm concurrency, spread "
+                         "across the routers (80%% chat, 20%% "
+                         "x-slo-class: rag)")
+    sp.add_argument("--baseline", type=parse_duration, default=10.0,
+                    help="clean-phase duration (the zero-spurious-"
+                         "capture gate)")
+    sp.add_argument("--window-scale", type=float, default=0.01,
+                    help="drill SLO window scale (0.01 -> "
+                         "3s/18s/36s/216s)")
+    sp.add_argument("--scenarios", default=None,
+                    help=f"comma-separated subset of "
+                         f"{','.join(INCIDENT_SCENARIOS)} "
+                         f"(default: all)")
+    sp.add_argument("--detect-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds the expected alert has to show on "
+                         "the obsplane's /fleet view (default: sized "
+                         "to the scaled 1h window)")
+    sp.add_argument("--resolve-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds alerts have to resolve after the "
+                         "fault clears (default: sized to the scaled "
+                         "30m window)")
+    sp.add_argument("--num-tokens", type=int, default=4)
+    sp.add_argument("--fake-tokens-per-s", type=float, default=400.0)
+    sp.add_argument("--slow-ttft-arg", type=float, default=0.4,
+                    help="seconds of TTFT inflation injected on ONE "
+                         "engine for slow_ttft")
+    sp.add_argument("--ttft-threshold", type=float, default=None,
+                    help="drill chat_ttft SLO threshold (seconds; "
+                         "default 0.25 for the fake fleet, 2.0 for "
+                         "real engines — a real prefill would trip "
+                         "the fake-calibrated bar on a clean "
+                         "baseline)")
+    sp.add_argument("--max-inflight", type=int, default=24,
+                    help="per-router admission gate: the shed storm "
+                         "must blow through it, the baseline storm "
+                         "must sit well under it")
+    sp.add_argument("--burst-users", type=int, default=64,
+                    help="concurrency of the shed-storm burst aimed "
+                         "at router 0")
+    sp.add_argument("--min-events", type=int, default=4,
+                    help="drill SLO volume floor")
+    sp.add_argument("--routing", default="roundrobin",
+                    choices=["roundrobin", "session", "least_loaded",
+                             "prefix"])
+    sp.add_argument("--poll-interval", type=float, default=0.3,
+                    help="obsplane fleet scrape interval (seconds)")
+    sp.add_argument("--capture-cooldown", type=float, default=5.0,
+                    help="obsplane capture cooldown (seconds; the "
+                         "fleet quiet->burning edge is the primary "
+                         "dedup, this is the flap backstop)")
+    sp.add_argument("--incident-dir", default=None,
+                    help="bundle directory (default: "
+                         "<log-dir>/incidents)")
+    sp.add_argument("--min-chain-fraction", type=float, default=0.5,
+                    help="baseline stitched-chain completeness floor "
+                         "(the anti-vacuity gate on the online join)")
+    sp.add_argument("--overhead-guard", action="store_true",
+                    help="run the r7 A/B with and without the "
+                         "obsplane scraping the serving pair, embed "
+                         "both")
+    sp.add_argument("--overhead-users", type=int, default=48)
+    sp.add_argument("--overhead-duration", type=parse_duration,
+                    default=10.0)
+    sp.add_argument("--max-overhead-ratio", type=float, default=2.5,
+                    help="exit 1 if the scraped-side ratio exceeds "
+                         "this band AND the same-host unscraped "
+                         "baseline by >10%%")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write INCIDENT_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_incident)
 
     sp = sub.add_parser("multirouter",
                         help="N real routers (peer gossip + QoS "

@@ -420,7 +420,10 @@ async def run_firedrill(*, engines: int = 2,
                         engine, port, log_dir=log_dir,
                         platform=platform, extra_args=fake_args)
                     try:
-                        await wait_healthy(engine_procs[idx].url, 60.0)
+                        # a REAL engine re-pays its XLA warmup here:
+                        # the restart gets the same budget as launch
+                        await wait_healthy(engine_procs[idx].url,
+                                           startup_timeout_s)
                     except TimeoutError:
                         control.errors.append(
                             f"{engine_procs[idx].url} not healthy "

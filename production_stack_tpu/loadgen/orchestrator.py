@@ -137,6 +137,23 @@ def launch_router(backend_urls: List[str], model: str, port: int, *,
                   log_dir)
 
 
+def launch_obsplane(router_urls: List[str], engine_urls: List[str],
+                    port: int, *, log_dir: str,
+                    incident_dir: str,
+                    extra_args: Optional[List[str]] = None) -> Proc:
+    """The fleet observability aggregator (obsplane/app.py): scrapes
+    every router and engine, stitches traces online, and captures
+    alert-triggered incident bundles into ``incident_dir``."""
+    cmd = [sys.executable, "-m", "production_stack_tpu.obsplane",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--routers", ",".join(router_urls),
+           "--engines", ",".join(engine_urls),
+           "--incident-dir", incident_dir,
+           *(extra_args or [])]
+    return _spawn(f"obsplane-{port}", cmd, f"http://127.0.0.1:{port}",
+                  log_dir)
+
+
 async def wait_healthy(url: str, timeout_s: float,
                        require_endpoints: int = 0) -> None:
     """Poll /health until 200 (and, for the router, until it can route

@@ -185,11 +185,18 @@ async def run_overhead(*, engine: str = "fake",
                        warmup_requests: int = 32,
                        unique_prompts: bool = False,
                        prompt_chars: int = 768,
-                       router_extra_args: Optional[List[str]] = None
-                       ) -> Dict:
+                       router_extra_args: Optional[List[str]] = None,
+                       companion=None) -> Dict:
     """Launch engine + router, measure both sides, return the A/B
-    record (BENCH schema; headline value = router-side req/s)."""
+    record (BENCH schema; headline value = router-side req/s).
+
+    ``companion`` (optional) is a callable ``(engine_url, router_url)
+    -> async context manager`` entered after the stack is healthy and
+    exited after both sides are measured — the hook the obsplane
+    overhead guard uses to keep a fleet scraper attached to the
+    serving path for the WHOLE measured window."""
     procs = []
+    companion_cm = None
     try:
         # zero-think fake: argparse takes the LAST occurrence, so these
         # override launch_engine's paced defaults
@@ -207,6 +214,9 @@ async def run_overhead(*, engine: str = "fake",
                                extra_args=router_extra_args)
         procs.append(router)
         await wait_healthy(router.url, 60.0, require_endpoints=1)
+        if companion is not None:
+            companion_cm = companion(eng.url, router.url)
+            await companion_cm.__aenter__()
 
         if unique_prompts:
             payload = unique_payload_factory(model, num_tokens=num_tokens,
@@ -240,6 +250,8 @@ async def run_overhead(*, engine: str = "fake",
         logger.info("router:  %.1f req/s (%d finished, %d errors)",
                     via["req_per_s"], via["finished"], via["errors"])
     finally:
+        if companion_cm is not None:
+            await companion_cm.__aexit__(None, None, None)
         _stop(procs)
 
     ratio = (direct["req_per_s"] / via["req_per_s"]

@@ -1,0 +1,42 @@
+"""Fleet observability aggregator: online trace stitching + flight
+recorder.
+
+Every per-process signal this stack computes — r13 trace rings, r14
+burn-rate alerts, r15/r17 efficiency rings, r16 peer/QoS state — lives
+behind ONE process's ``/debug/*`` / ``/alerts`` / ``/load`` endpoint,
+and the only cross-process join is done offline inside ``loadgen
+trace`` after the fact. With an N-router/N-engine fleet, diagnosing an
+incident means hand-scraping 2R+N+1 endpoints after the evidence has
+rotated out of the bounded rings.
+
+The obsplane is the standalone process that closes that gap:
+
+- ``aggregator.FleetAggregator`` incrementally scrapes every router's
+  and engine's ``/debug/traces`` (the ``since_seq`` cursor), ``/load``
+  (via the shared ``signals.LoadPoller``), ``/debug/perf``,
+  ``/alerts``, and ``/health`` on one poll loop;
+- ``stitch.ChainStore`` joins router, prefill, and engine spans on
+  trace id ONLINE into bounded fleet-wide chains, exposing per-class
+  per-phase fleet percentiles and the current slowest chains at
+  ``GET /fleet/traces``;
+- ``recorder.IncidentRecorder`` is the flight recorder: when a
+  subscribed SLO alert transitions to firing (or an operator POSTs
+  ``/fleet/capture``), it snapshots the correlated state of every
+  fleet process into a self-contained on-disk incident bundle
+  (bounded retention) with a machine-written attribution summary
+  naming the guilty process and phase.
+
+CLI: ``python -m production_stack_tpu.obsplane --routers ...
+--engines ...``. Operator surface: docs/observability.md "Fleet
+observability"; closed loop: ``python -m production_stack_tpu.loadgen
+incident`` (INCIDENT_r18.json).
+"""
+
+from production_stack_tpu.obsplane.aggregator import (FleetAggregator,
+                                                      ProcessState)
+from production_stack_tpu.obsplane.recorder import (IncidentRecorder,
+                                                    attribute_incident)
+from production_stack_tpu.obsplane.stitch import ChainStore
+
+__all__ = ["FleetAggregator", "ProcessState", "IncidentRecorder",
+           "attribute_incident", "ChainStore"]

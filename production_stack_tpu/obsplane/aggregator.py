@@ -1,0 +1,435 @@
+"""The fleet poll loop: incremental scrapes, edge detection, capture.
+
+One ``FleetAggregator`` owns the whole scrape plane for a fleet of
+router and engine processes:
+
+- engine ``/load`` rides the shared ``signals.LoadPoller`` (attach
+  mode — the aggregator's own tick drives ``poll_now()``, so each
+  engine is scraped exactly once per tick no matter how many obsplane
+  consumers read the result);
+- every process's ``/debug/traces`` is read INCREMENTALLY through the
+  ``since_seq`` cursor (tracing.TraceRecorder): each trace row crosses
+  the wire once, and a slow poll interval loses traces only when the
+  ring itself rotates past them;
+- engines additionally surrender ``/debug/perf`` (timestamped window +
+  compile rings, kvpool census), routers ``/health`` (breakers, drain,
+  peers, QoS) and ``/alerts`` (the SLO state machine).
+
+The aggregator keeps the LAST-KNOWN payload of every process even
+while the process is unreachable — a flight recorder whose bundle
+drops the dead replica's final state would be recording everything
+except the crash.
+
+Alert-edge detection: a subscribed alert transitioning into ``firing``
+(keyed by its ``firing_since`` stamp, so a flapping alert re-triggers
+and a steadily-firing one does not) hands the correlated fleet state
+to the ``IncidentRecorder``. Shed attribution baselines reset on every
+quiet poll, so a capture's shed delta covers exactly the burn window.
+"""
+
+import asyncio
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import aiohttp
+
+from production_stack_tpu.obsplane.recorder import (IncidentRecorder,
+                                                    attribute_incident)
+from production_stack_tpu.obsplane.stitch import ChainStore
+from production_stack_tpu.signals import LoadPoller
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+class ProcessState:
+    """Everything the obsplane knows about one fleet process."""
+
+    __slots__ = ("url", "role", "ever_seen", "last_seen",
+                 "unreachable_since", "consecutive_failures",
+                 "trace_cursor", "load", "perf", "health", "alerts",
+                 "scrape_errors", "traces_read")
+
+    def __init__(self, url: str, role: str):
+        self.url = url.rstrip("/")
+        self.role = role
+        self.ever_seen = False
+        self.last_seen: Optional[float] = None
+        self.unreachable_since: Optional[float] = None
+        self.consecutive_failures = 0
+        self.trace_cursor = 0
+        self.load: Optional[dict] = None
+        self.perf: Optional[dict] = None
+        self.health: Optional[dict] = None
+        self.alerts: Optional[dict] = None
+        self.scrape_errors = 0
+        self.traces_read = 0
+
+    @property
+    def state(self) -> str:
+        if self.unreachable_since is not None:
+            return "unreachable"
+        return "live" if self.ever_seen else "pending"
+
+    def mark_ok(self, now: float) -> None:
+        self.ever_seen = True
+        self.last_seen = now
+        self.consecutive_failures = 0
+        self.unreachable_since = None
+
+    def mark_failed(self, now: float,
+                    unreachable_after: int = 2) -> None:
+        self.scrape_errors += 1
+        self.consecutive_failures += 1
+        if self.ever_seen and self.unreachable_since is None \
+                and self.consecutive_failures >= unreachable_after:
+            self.unreachable_since = now
+            logger.warning("fleet process unreachable: %s (%s)",
+                           self.url, self.role)
+
+    def to_json(self, include_payloads: bool = True) -> dict:
+        out = {
+            "url": self.url,
+            "role": self.role,
+            "state": self.state,
+            "ever_seen": self.ever_seen,
+            "last_seen": self.last_seen,
+            "unreachable_since": self.unreachable_since,
+            "consecutive_failures": self.consecutive_failures,
+            "scrape_errors": self.scrape_errors,
+            "trace_cursor": self.trace_cursor,
+            "traces_read": self.traces_read,
+        }
+        if include_payloads:
+            out["load"] = self.load
+            out["perf"] = self.perf
+            out["health"] = self.health
+            out["alerts"] = self.alerts
+        return out
+
+
+class _FleetLoadPoller(LoadPoller):
+    """LoadPoller subclass keeping BOTH the parsed EngineLoad and the
+    raw /load dict (bundles want the raw report; signal consumers the
+    parsed one)."""
+
+    def _build(self, data: dict) -> object:
+        from production_stack_tpu.signals import parse_load_report
+        return {"raw": data, "parsed": parse_load_report(data)}
+
+
+class FleetAggregator:
+    """See module docstring. ``capture_severities`` filters which
+    alert transitions trigger the flight recorder (default: pages
+    only — tickets describe the same burn more slowly and would
+    double-capture every incident)."""
+
+    def __init__(self, *, routers: Iterable[str],
+                 engines: Iterable[str],
+                 prefill: Iterable[str] = (),
+                 poll_interval_s: float = 1.0,
+                 timeout_s: float = 3.0,
+                 trace_batch: int = 500,
+                 perf_ring_limit: int = 50,
+                 unreachable_after: int = 2,
+                 attribution_lookback_s: float = 60.0,
+                 capture_severities: Tuple[str, ...] = ("page",),
+                 capture_on_alerts: bool = True,
+                 chain_store: Optional[ChainStore] = None,
+                 recorder: Optional[IncidentRecorder] = None,
+                 scrape_headers: Optional[dict] = None,
+                 now_fn=time.time):
+        self.processes: Dict[str, ProcessState] = {}
+        for url in routers:
+            self._add(url, "router")
+        for url in engines:
+            self._add(url, "engine")
+        for url in prefill:
+            self._add(url, "prefill")
+        if not self.processes:
+            raise ValueError("a fleet needs at least one process "
+                             "(--routers / --engines)")
+        self.poll_interval_s = poll_interval_s
+        self.trace_batch = max(1, trace_batch)
+        self.perf_ring_limit = max(1, perf_ring_limit)
+        self.unreachable_after = max(1, unreachable_after)
+        self.attribution_lookback_s = attribution_lookback_s
+        self.capture_severities = tuple(capture_severities)
+        self.capture_on_alerts = capture_on_alerts
+        self.chains = chain_store or ChainStore()
+        self.recorder = recorder
+        self._now = now_fn
+        self._timeout = aiohttp.ClientTimeout(total=timeout_s)
+        # /debug/* on secured engines requires the engine Bearer
+        # (loadgen trace precedent); late import keeps signals the only
+        # hard router dependency
+        if scrape_headers is None:
+            from production_stack_tpu.router.service_discovery import (
+                engine_auth_headers)
+            scrape_headers = engine_auth_headers()
+        self._headers = scrape_headers
+        self._load_poller = _FleetLoadPoller(
+            lambda: [p.url for p in self.processes.values()
+                     if p.role in ("engine", "prefill")],
+            timeout_s=timeout_s)
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._task: Optional[asyncio.Task] = None
+        # was any subscribed alert firing at the previous poll? An
+        # incident is the FLEET's quiet -> burning transition: the
+        # first subscribed alert to fire captures the bundle, and
+        # further alerts joining the same burn (the rag page catching
+        # up with the chat page) do not re-capture until the fleet has
+        # gone quiet again
+        self._was_burning = False
+        # per-router shed baseline, reset on every quiet poll
+        self._shed_baseline: Dict[str, float] = {}
+        self._shed_baseline_at: Dict[str, float] = {}
+        self.polls_total = 0
+        self.captures_triggered = 0
+        self.scrape_errors_total: Dict[str, int] = {
+            "router": 0, "engine": 0, "prefill": 0}
+        self.started_at = now_fn()
+
+    def _add(self, url: str, role: str) -> None:
+        state = ProcessState(url, role)
+        self.processes[state.url] = state
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self, poll: bool = True) -> None:
+        """``poll=False`` opens the session without the interval task —
+        deterministic tests drive every pass through ``poll_once()``."""
+        self._session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=0))
+        self._load_poller.attach(self._session)
+        if poll:
+            self._task = asyncio.create_task(self._loop(),
+                                             name="fleet-aggregator")
+
+    async def close(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        if self._session:
+            await self._session.close()
+            self._session = None
+
+    def healthy(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("fleet poll pass failed")
+            await asyncio.sleep(self.poll_interval_s)
+
+    # -- scraping --------------------------------------------------------
+
+    async def _get_json(self, url: str, path: str,
+                        params: Optional[dict] = None,
+                        accept=(200,)) -> Optional[dict]:
+        try:
+            async with self._session.get(
+                    f"{url}{path}", params=params,
+                    headers=self._headers,
+                    timeout=self._timeout) as r:
+                if r.status in accept:
+                    return await r.json()
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError, ValueError):
+            pass
+        return None
+
+    async def _scrape_traces(self, proc: ProcessState) -> bool:
+        data = await self._get_json(
+            proc.url, "/debug/traces",
+            params={"since_seq": str(proc.trace_cursor),
+                    "limit": str(self.trace_batch)})
+        if data is None:
+            return False
+        last_seq = int(data.get("last_seq") or 0)
+        if last_seq < proc.trace_cursor:
+            # the process restarted (fresh recorder, seq counter back
+            # near zero): rewind so the next pass re-reads the new
+            # ring from its start instead of filtering everything out
+            # against a cursor from the previous incarnation forever
+            proc.trace_cursor = 0
+            return True
+        traces = data.get("traces", [])
+        if traces:
+            self.chains.ingest(proc.url, proc.role, traces)
+            proc.traces_read += len(traces)
+        # advance to the ring's cursor even past traces the limit
+        # dropped: better to lose rows explicitly than re-read forever
+        proc.trace_cursor = last_seq
+        return True
+
+    async def _scrape_process(self, proc: ProcessState,
+                              now: float) -> None:
+        ok = False
+        if proc.role == "router":
+            # routers answer /health with 503 + a body while unhealthy;
+            # total silence is what "down" means
+            health = await self._get_json(proc.url, "/health",
+                                          accept=(200, 503))
+            alerts = await self._get_json(proc.url, "/alerts")
+            if health is not None:
+                proc.health = health
+                ok = True
+            if alerts is not None:
+                proc.alerts = alerts
+                ok = True
+        else:
+            load = self._load_poller.get().get(proc.url)
+            if load is not None:
+                proc.load = load["raw"]
+                ok = True
+            perf = await self._get_json(
+                proc.url, "/debug/perf",
+                params={"limit": str(self.perf_ring_limit)})
+            if perf is not None:
+                proc.perf = perf
+                ok = True
+        if await self._scrape_traces(proc):
+            ok = True
+        if ok:
+            proc.mark_ok(now)
+        else:
+            proc.mark_failed(now, self.unreachable_after)
+            self.scrape_errors_total[proc.role] = \
+                self.scrape_errors_total.get(proc.role, 0) + 1
+
+    async def poll_once(self) -> None:
+        """One full pass: /load fan-out, per-process scrapes, alert
+        edge detection, shed baseline upkeep."""
+        now = self._now()
+        self.polls_total += 1
+        await self._load_poller.poll_now()
+        await asyncio.gather(*(self._scrape_process(p, now)
+                               for p in self.processes.values()))
+        self._detect_alert_edges(now)
+        self._update_shed_baselines(now)
+
+    # -- alert edges + capture -------------------------------------------
+
+    def _iter_firing(self) -> List[Tuple[ProcessState, dict, str]]:
+        """Every currently-firing alert across the routers, with its
+        SLO kind resolved from the same payload."""
+        out = []
+        for proc in self.processes.values():
+            if proc.role != "router" or proc.alerts is None:
+                continue
+            kinds = {s["name"]: s.get("kind", "")
+                     for s in proc.alerts.get("slos", [])}
+            for row in proc.alerts.get("alerts", []):
+                if row.get("state") == "firing":
+                    out.append((proc, row, kinds.get(row.get("slo"),
+                                                     "")))
+        return out
+
+    def _detect_alert_edges(self, now: float) -> None:
+        subscribed = [(proc, row, kind)
+                      for proc, row, kind in self._iter_firing()
+                      if row.get("severity") in self.capture_severities]
+        burning = bool(subscribed)
+        was_burning, self._was_burning = self._was_burning, burning
+        if not self.capture_on_alerts or self.recorder is None:
+            return
+        if burning and not was_burning:
+            # the fleet just went from quiet to burning: ONE bundle,
+            # triggered by the first subscribed alert (the recorder
+            # cooldown additionally absorbs a flapping edge)
+            proc, row, kind = subscribed[0]
+            alert = {**row, "router": proc.url, "slo_kind": kind}
+            self.captures_triggered += 1
+            self.capture(trigger=f"alert:{row.get('name')}",
+                         alert=alert)
+
+    def _shed_total(self, proc: ProcessState) -> float:
+        total = 0.0
+        health = proc.health or {}
+        for v in (health.get("sheds") or {}).values():
+            total += float(v or 0)
+        for tier in ((health.get("qos") or {}).get("tiers") or ()):
+            total += float(tier.get("shed_total") or 0)
+        return total
+
+    def _update_shed_baselines(self, now: float) -> None:
+        """While no subscribed alert is firing, each router's shed
+        counter is its own baseline — so a capture's delta is 'sheds
+        since the burn began', not 'sheds since boot'."""
+        firing = any(row.get("severity") in self.capture_severities
+                     for _p, row, _k in self._iter_firing())
+        if firing:
+            return
+        for proc in self.processes.values():
+            if proc.role == "router" and proc.health is not None:
+                self._shed_baseline[proc.url] = self._shed_total(proc)
+                self._shed_baseline_at[proc.url] = now
+
+    def shed_deltas(self) -> Dict[str, float]:
+        out = {}
+        for proc in self.processes.values():
+            if proc.role != "router" or proc.health is None:
+                continue
+            base = self._shed_baseline.get(proc.url, 0.0)
+            out[proc.url] = max(0.0, self._shed_total(proc) - base)
+        return out
+
+    def capture(self, *, trigger: str, alert: Optional[dict] = None,
+                force: bool = False) -> Optional[dict]:
+        """Snapshot the fleet into one incident bundle (None when the
+        recorder is absent or the cooldown suppressed it)."""
+        if self.recorder is None:
+            return None
+        proc_json = {url: p.to_json(include_payloads=False)
+                     for url, p in self.processes.items()}
+        attribution = attribute_incident(
+            alert=alert,
+            processes=proc_json,
+            process_phase_stats=self.chains.process_phase_stats(
+                self.attribution_lookback_s),
+            shed_deltas=self.shed_deltas())
+        return self.recorder.capture(
+            trigger=trigger, alert=alert, force=force,
+            fleet=self.fleet_snapshot(full=True),
+            attribution=attribution)
+
+    # -- reads -----------------------------------------------------------
+
+    def fleet_snapshot(self, full: bool = False,
+                       slowest: int = 10) -> dict:
+        """The GET /fleet payload (``full`` adds every process's raw
+        payloads — the bundle body; the HTTP summary stays compact)."""
+        firing = [{"router": p.url, "name": row.get("name"),
+                   "slo": row.get("slo"),
+                   "severity": row.get("severity")}
+                  for p, row, _k in self._iter_firing()]
+        return {
+            "polls_total": self.polls_total,
+            "poll_interval_s": self.poll_interval_s,
+            "uptime_s": round(self._now() - self.started_at, 1),
+            "processes": {
+                url: p.to_json(include_payloads=full)
+                for url, p in sorted(self.processes.items())},
+            "firing_alerts": firing,
+            "shed_deltas": {u: int(d) for u, d
+                            in self.shed_deltas().items()},
+            "chains": self.chains.stats(),
+            "slowest_chains": self.chains.slowest(slowest),
+            "fleet_percentiles": self.chains.fleet_percentiles(),
+            "incidents": (self.recorder.index()
+                          if self.recorder else []),
+            "captures_triggered": self.captures_triggered,
+            "captures_suppressed": (self.recorder.suppressed_total
+                                    if self.recorder else 0),
+            "scrape_errors_total": dict(self.scrape_errors_total),
+        }

@@ -1,0 +1,250 @@
+"""Obsplane application: the ``/fleet`` HTTP surface + wiring + CLI.
+
+One aiohttp Application hosting the fleet aggregator task; endpoint
+surface (docs/observability.md "Fleet observability"):
+
+- ``GET /health``            — aggregator liveness + per-process
+                               reachability summary (probe surface)
+- ``GET /fleet``             — the fleet snapshot: processes, firing
+                               alerts, stitch stats, incident index
+- ``GET /fleet/traces``      — online-stitched chains: per-class
+                               per-phase fleet percentiles + the
+                               current slowest complete chains
+                               (``slowest=N``, ``class=``)
+- ``GET /fleet/incidents``   — the bounded on-disk bundle index
+- ``GET /fleet/incidents/{id}`` — one full bundle
+- ``POST /fleet/capture``    — operator-triggered capture (bypasses
+                               the alert cooldown)
+- ``GET /metrics``           — the ``tpu:fleet_*`` families
+
+Closed loop: ``python -m production_stack_tpu.loadgen incident``.
+"""
+
+import argparse
+import asyncio
+import signal
+from typing import Optional
+
+from aiohttp import web
+
+from production_stack_tpu.obsplane.aggregator import FleetAggregator
+from production_stack_tpu.obsplane.metrics import FleetMetrics
+from production_stack_tpu.obsplane.recorder import IncidentRecorder
+from production_stack_tpu.obsplane.stitch import ChainStore
+from production_stack_tpu.utils import (init_logger,
+                                        parse_comma_separated,
+                                        set_ulimit)
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+async def health(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    agg: FleetAggregator = state["aggregator"]
+    problems = []
+    if not agg.healthy():
+        problems.append("fleet poll task dead")
+    unreachable = [p.url for p in agg.processes.values()
+                   if p.state == "unreachable"]
+    body = {
+        "status": "ok" if not problems else "unhealthy",
+        "problems": problems,
+        "version": __version__,
+        "polls_total": agg.polls_total,
+        "processes": {p.url: p.state
+                      for p in agg.processes.values()},
+        "unreachable": unreachable,
+        "incidents_held": len(state["recorder"].index()),
+    }
+    return web.json_response(body,
+                             status=200 if not problems else 503)
+
+
+async def fleet(request: web.Request) -> web.Response:
+    agg = request.app["state"]["aggregator"]
+    return web.json_response(agg.fleet_snapshot(full=False))
+
+
+async def fleet_traces(request: web.Request) -> web.Response:
+    agg = request.app["state"]["aggregator"]
+    try:
+        slowest = max(1, int(request.query.get("slowest", "10")))
+    except ValueError:
+        slowest = 10
+    cls = request.query.get("class") or None
+    return web.json_response({
+        "stats": agg.chains.stats(),
+        "fleet_percentiles": agg.chains.fleet_percentiles(),
+        "slowest": agg.chains.slowest(slowest, cls=cls),
+    })
+
+
+async def fleet_incidents(request: web.Request) -> web.Response:
+    recorder = request.app["state"]["recorder"]
+    return web.json_response({"incidents": recorder.index()})
+
+
+async def fleet_incident(request: web.Request) -> web.Response:
+    recorder = request.app["state"]["recorder"]
+    bundle = recorder.load(request.match_info["incident_id"])
+    if bundle is None:
+        return web.json_response(
+            {"error": {"message": "unknown incident id",
+                       "type": "invalid_request_error"}}, status=404)
+    return web.json_response(bundle)
+
+
+async def fleet_capture(request: web.Request) -> web.Response:
+    """Operator-triggered capture; always produces a bundle (the
+    alert-path cooldown exists to absorb alert storms, not humans)."""
+    state = request.app["state"]
+    reason = "manual"
+    try:
+        body = await request.json()
+        if isinstance(body, dict) and body.get("reason"):
+            reason = f"manual:{str(body['reason'])[:80]}"
+    except ValueError:
+        pass
+    row = state["aggregator"].capture(trigger=reason, force=True)
+    state["manual_captures"] += 1
+    return web.json_response({"captured": row})
+
+
+async def metrics(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    state["metrics"].refresh(state["aggregator"], state["recorder"],
+                             state["manual_captures"])
+    return web.Response(body=state["metrics"].render(),
+                        content_type="text/plain")
+
+
+def build_app(args: argparse.Namespace) -> web.Application:
+    recorder = IncidentRecorder(
+        args.incident_dir, retention=args.incident_retention,
+        cooldown_s=args.capture_cooldown)
+    chains = ChainStore(max_chains=args.chain_entries)
+    aggregator = FleetAggregator(
+        routers=parse_comma_separated(args.routers),
+        engines=parse_comma_separated(args.engines),
+        prefill=parse_comma_separated(args.prefill_backends),
+        poll_interval_s=args.poll_interval,
+        timeout_s=args.scrape_timeout,
+        trace_batch=args.trace_batch,
+        attribution_lookback_s=args.attribution_lookback,
+        capture_severities=tuple(
+            parse_comma_separated(args.capture_severities)),
+        capture_on_alerts=not args.no_capture_on_alert,
+        chain_store=chains,
+        recorder=recorder)
+    app = web.Application()
+    app["state"] = {
+        "aggregator": aggregator,
+        "recorder": recorder,
+        "metrics": FleetMetrics(),
+        "manual_captures": 0,
+    }
+    app.router.add_get("/health", health)
+    app.router.add_get("/fleet", fleet)
+    app.router.add_get("/fleet/traces", fleet_traces)
+    app.router.add_get("/fleet/incidents", fleet_incidents)
+    app.router.add_get("/fleet/incidents/{incident_id}", fleet_incident)
+    app.router.add_post("/fleet/capture", fleet_capture)
+    app.router.add_get("/metrics", metrics)
+
+    async def on_startup(app):
+        await aggregator.start()
+
+    async def on_cleanup(app):
+        await aggregator.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "pstpu-obsplane",
+        description="fleet observability aggregator: online trace "
+                    "stitching + alert-triggered incident snapshots")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8200)
+    p.add_argument("--routers", default="",
+                   help="comma-separated router base URLs to scrape "
+                        "(/health, /alerts, /debug/traces)")
+    p.add_argument("--engines", default="",
+                   help="comma-separated engine base URLs to scrape "
+                        "(/load, /debug/perf, /debug/traces)")
+    p.add_argument("--prefill-backends", default="",
+                   help="comma-separated prefill-pool engine URLs "
+                        "(scraped like engines, stitched as the "
+                        "prefill side of a chain)")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="seconds between fleet scrape passes")
+    p.add_argument("--scrape-timeout", type=float, default=3.0,
+                   help="per-request scrape timeout; a process "
+                        "missing this twice in a row is marked "
+                        "unreachable")
+    p.add_argument("--trace-batch", type=int, default=500,
+                   help="max trace rows read per process per pass "
+                        "through the /debug/traces since_seq cursor")
+    p.add_argument("--chain-entries", type=int, default=4096,
+                   help="stitched chains held in memory (oldest "
+                        "evicted)")
+    p.add_argument("--incident-dir", default="incidents",
+                   help="directory incident bundles are written to")
+    p.add_argument("--incident-retention", type=int, default=32,
+                   help="bundles kept on disk (oldest deleted)")
+    p.add_argument("--capture-cooldown", type=float, default=30.0,
+                   help="seconds after a capture during which further "
+                        "alert-triggered captures are suppressed (an "
+                        "incident firing several alerts yields ONE "
+                        "bundle); POST /fleet/capture bypasses it")
+    p.add_argument("--capture-severities", default="page",
+                   help="comma-separated alert severities whose "
+                        "firing transition triggers a capture "
+                        "(default: page — tickets describe the same "
+                        "burn more slowly)")
+    p.add_argument("--attribution-lookback", type=float, default=60.0,
+                   help="seconds of per-process phase evidence the "
+                        "attribution scoreboard ranks at capture time")
+    p.add_argument("--no-capture-on-alert", action="store_true",
+                   help="disable alert-triggered captures (manual "
+                        "POST /fleet/capture only)")
+    args = p.parse_args(argv)
+    if not (args.routers or args.engines):
+        p.error("need --routers and/or --engines to scrape")
+    return args
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    set_ulimit()
+    app = build_app(args)
+
+    async def _serve():
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.port)
+        await site.start()
+        logger.info("obsplane listening on %s:%d (%d processes, "
+                    "poll every %.1fs, incidents -> %s)",
+                    args.host, args.port,
+                    len(app["state"]["aggregator"].processes),
+                    args.poll_interval, args.incident_dir)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await runner.cleanup()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Obsplane Prometheus surface: the ``tpu:fleet_*`` families.
+
+Refreshed from the aggregator's counters at scrape time (the stack's
+delta-free variant of the scrape-time-sync idiom — all values here are
+either gauges or cumulative counters the aggregator already holds, so
+the exposition just copies them; nothing prometheus-shaped sits near
+the poll loop). Documented in docs/observability.md "Fleet
+observability".
+"""
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+
+class FleetMetrics:
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.processes = Gauge(
+            "tpu:fleet_processes",
+            "Fleet processes the obsplane scrapes, by role and "
+            "reachability state (live / unreachable / pending)",
+            ["role", "state"], registry=self.registry)
+        self.scrape_errors = Gauge(
+            "tpu:fleet_scrape_errors_total",
+            "Cumulative failed scrape passes against fleet processes, "
+            "by role", ["role"], registry=self.registry)
+        self.chains_stitched = Gauge(
+            "tpu:fleet_chains_stitched_total",
+            "Cumulative cross-process trace chains completed by the "
+            "online stitcher (router + engine sides joined on "
+            "trace id)", registry=self.registry)
+        self.traces_ingested = Gauge(
+            "tpu:fleet_traces_ingested_total",
+            "Cumulative trace rows read through the /debug/traces "
+            "since_seq cursor across the fleet",
+            registry=self.registry)
+        self.alerts_firing = Gauge(
+            "tpu:fleet_alerts_firing",
+            "SLO alerts currently firing across every scraped "
+            "router", registry=self.registry)
+        self.incidents = Gauge(
+            "tpu:fleet_incidents_total",
+            "Cumulative incident bundles captured by the flight "
+            "recorder, by trigger kind (alert / manual)",
+            ["trigger"], registry=self.registry)
+        self.incidents_suppressed = Gauge(
+            "tpu:fleet_incidents_suppressed_total",
+            "Alert transitions that would have captured a bundle but "
+            "fell inside the capture cooldown",
+            registry=self.registry)
+        self.incidents_held = Gauge(
+            "tpu:fleet_incidents_held",
+            "Incident bundles currently on disk (bounded by "
+            "--incident-retention)", registry=self.registry)
+
+    def refresh(self, aggregator, recorder=None,
+                manual_captures: int = 0) -> None:
+        counts = {}
+        for proc in aggregator.processes.values():
+            counts[(proc.role, proc.state)] = \
+                counts.get((proc.role, proc.state), 0) + 1
+        # zero out stale label pairs by setting every known role/state
+        for role in ("router", "engine", "prefill"):
+            for state in ("live", "unreachable", "pending"):
+                self.processes.labels(role=role, state=state).set(
+                    counts.get((role, state), 0))
+        for role, n in aggregator.scrape_errors_total.items():
+            self.scrape_errors.labels(role=role).set(n)
+        self.chains_stitched.set(aggregator.chains.chains_complete)
+        self.traces_ingested.set(aggregator.chains.traces_ingested)
+        self.alerts_firing.set(len(aggregator._iter_firing()))
+        if recorder is not None:
+            alert_captures = recorder.captured_total - manual_captures
+            self.incidents.labels(trigger="alert").set(alert_captures)
+            self.incidents.labels(trigger="manual").set(manual_captures)
+            self.incidents_suppressed.set(recorder.suppressed_total)
+            self.incidents_held.set(len(recorder.index()))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,251 @@
+"""Flight recorder: alert-triggered incident bundles + attribution.
+
+When a subscribed SLO alert transitions to firing (or an operator
+POSTs ``/fleet/capture``), the aggregator hands this module the
+correlated state of every fleet process and it writes ONE
+self-contained incident bundle to disk: per-process snapshots (router
+health/alerts/QoS/peers/breakers, engine load + perf rings + kvpool
+census), the slowest stitched chains, the fleet percentiles — and a
+machine-written **attribution** naming the process and phase the
+evidence points at, so the bundle opens with a verdict instead of a
+scavenger hunt.
+
+Attribution ranks three evidence classes, strongest first:
+
+1. **A process stopped answering** — a replica that was scraped
+   successfully and then went dark is guilty of any availability-ish
+   burn (phase ``down``). Nothing latency-shaped outranks a corpse.
+2. **Shed-rate alerts** — intentional backpressure is a router-side
+   decision: the router with the largest shed delta since the last
+   clean poll is guilty, phase ``admission``.
+3. **Latency/availability alerts with everyone alive** — per-process
+   per-phase stats from recently-stitched chains: the (process, phase)
+   whose recent p95 most exceeds the fleet median for that phase wins.
+   Router-internal phases (``admission``/``routing``) indict the
+   router; backend phases observed engine-side indict the engine.
+
+Retention is bounded: the newest ``retention`` bundles are kept on
+disk, older ones deleted oldest-first.
+"""
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+# router-side phases that measure the backend, not the router: a slow
+# backend_ttfb/relay span says "the engine named in attrs.server is
+# slow", so these never indict the router process itself
+_ROUTER_BACKEND_PHASES = frozenset({"backend_ttfb", "relay"})
+# phases too generic to name as a verdict when a more specific phase
+# is in evidence (unattributed time loses ties to any named phase)
+_WEAK_PHASES = frozenset({"unattributed", "total"})
+
+
+def attribute_incident(*, alert: Optional[dict],
+                       processes: Dict[str, dict],
+                       process_phase_stats: Dict[str, Dict[str, dict]],
+                       shed_deltas: Optional[Dict[str, float]] = None
+                       ) -> dict:
+    """The verdict: ``{process, role, phase, confidence, reason,
+    evidence}``. ``processes`` is {url: ProcessState.to_json()};
+    ``alert`` the triggering alert row (None for manual captures)."""
+    # -- rule 1: a dead process outranks everything ---------------------
+    dead = [(url, p) for url, p in processes.items()
+            if p.get("unreachable_since") is not None
+            and p.get("ever_seen")]
+    if dead:
+        # the longest-dead first: a cascade's root cause died first
+        dead.sort(key=lambda kv: kv[1]["unreachable_since"])
+        url, p = dead[0]
+        return {
+            "process": url,
+            "role": p.get("role", "?"),
+            "phase": "down",
+            "confidence": "high",
+            "reason": (f"{url} ({p.get('role')}) stopped answering "
+                       f"scrapes at "
+                       f"{_iso(p['unreachable_since'])} and has not "
+                       f"come back"),
+            "evidence": {"unreachable": [u for u, _ in dead]},
+        }
+
+    slo_kind = (alert or {}).get("slo_kind", "")
+    slo_name = (alert or {}).get("slo", "")
+
+    # -- rule 2: sheds are a router admission decision ------------------
+    if slo_kind == "shed_rate" or "shed" in slo_name:
+        sheds = {url: d for url, d in (shed_deltas or {}).items()
+                 if d > 0}
+        if sheds:
+            url = max(sheds, key=sheds.get)
+            return {
+                "process": url,
+                "role": processes.get(url, {}).get("role", "router"),
+                "phase": "admission",
+                "confidence": "high",
+                "reason": (f"{url} shed {int(sheds[url])} requests "
+                           f"since the last clean poll — the largest "
+                           f"shed delta in the fleet"),
+                "evidence": {"shed_deltas": {u: int(d) for u, d
+                                             in sheds.items()}},
+            }
+
+    # -- rule 3: rank (process, phase) latency excess -------------------
+    # fleet median per phase, then each process's excess over it — the
+    # guilty pair is the one whose recent p95 most exceeds what the
+    # same phase costs elsewhere in the fleet (absolute excess, ms:
+    # ratios overweight microsecond phases)
+    from production_stack_tpu.obsplane.stitch import percentile
+    by_phase: Dict[str, List[float]] = {}
+    for url, phases in process_phase_stats.items():
+        for phase, row in phases.items():
+            by_phase.setdefault(phase, []).append(row["p95_ms"])
+    best = None
+    board = []
+    for url, phases in process_phase_stats.items():
+        for phase, row in phases.items():
+            if phase in _WEAK_PHASES:
+                continue
+            if processes.get(url, {}).get("role") == "router" \
+                    and phase in _ROUTER_BACKEND_PHASES:
+                continue    # measures the backend, not this router
+            med = percentile(by_phase[phase], 50)
+            excess = row["p95_ms"] - med
+            board.append({"process": url, "phase": phase,
+                          "p95_ms": row["p95_ms"],
+                          "fleet_median_ms": round(med, 2),
+                          "excess_ms": round(excess, 2),
+                          "n": row["n"]})
+            if best is None or excess > best["excess_ms"]:
+                best = board[-1]
+    board.sort(key=lambda r: r["excess_ms"], reverse=True)
+    if best is not None and best["excess_ms"] > 0:
+        url = best["process"]
+        return {
+            "process": url,
+            "role": processes.get(url, {}).get("role", "?"),
+            "phase": best["phase"],
+            "confidence": "medium",
+            "reason": (f"{url} {best['phase']} p95 "
+                       f"{best['p95_ms']:.0f}ms exceeds the fleet "
+                       f"median for that phase "
+                       f"({best['fleet_median_ms']:.0f}ms) by "
+                       f"{best['excess_ms']:.0f}ms — the largest "
+                       f"excess on the scoreboard"),
+            "evidence": {"scoreboard": board[:10]},
+        }
+    return {
+        "process": None,
+        "role": None,
+        "phase": None,
+        "confidence": "none",
+        "reason": "no process stood out: nothing dead, no shed "
+                  "deltas, no phase excess in the stitched chains",
+        "evidence": {"scoreboard": board[:10]},
+    }
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S",
+                         time.gmtime(ts)) + f".{int(ts % 1 * 1e3):03d}Z"
+
+
+class IncidentRecorder:
+    """Writes bounded-retention incident bundles; keeps an in-memory
+    index served on ``GET /fleet/incidents``."""
+
+    def __init__(self, incident_dir: str, retention: int = 32,
+                 cooldown_s: float = 30.0, now_fn=time.time):
+        self.incident_dir = incident_dir
+        self.retention = max(1, retention)
+        self.cooldown_s = cooldown_s
+        self._now = now_fn
+        self.captured_total = 0
+        self.suppressed_total = 0
+        self.last_capture_at: Optional[float] = None
+        self._index: List[dict] = []      # newest last
+        os.makedirs(incident_dir, exist_ok=True)
+
+    def in_cooldown(self) -> bool:
+        return (self.last_capture_at is not None
+                and self._now() - self.last_capture_at
+                < self.cooldown_s)
+
+    def capture(self, *, trigger: str, alert: Optional[dict],
+                fleet: dict,
+                attribution: dict,
+                force: bool = False) -> Optional[dict]:
+        """Write one bundle; returns its index row, or None when the
+        capture was suppressed by the cooldown (an alert storm must
+        yield ONE bundle, not one per alert transition). Manual
+        captures pass ``force=True``."""
+        now = self._now()
+        if not force and self.in_cooldown():
+            self.suppressed_total += 1
+            logger.info("incident capture suppressed (cooldown %.0fs): "
+                        "%s", self.cooldown_s, trigger)
+            return None
+        self.captured_total += 1
+        self.last_capture_at = now
+        incident_id = (time.strftime("%Y%m%dT%H%M%S", time.gmtime(now))
+                       + f"-{self.captured_total:04d}")
+        bundle = {
+            "schema": "tpu-incident-bundle/v1",
+            "incident_id": incident_id,
+            "captured_at": now,
+            "captured_at_iso": _iso(now),
+            "trigger": trigger,
+            "alert": alert,
+            "attribution": attribution,
+            "fleet": fleet,
+        }
+        path = os.path.join(self.incident_dir,
+                            f"incident-{incident_id}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(bundle, f, indent=1, default=str)
+        os.replace(tmp, path)       # readers never see a half bundle
+        row = {
+            "incident_id": incident_id,
+            "path": path,
+            "captured_at": now,
+            "trigger": trigger,
+            "alert": (alert or {}).get("name"),
+            "attribution": {k: attribution.get(k) for k in
+                            ("process", "role", "phase", "confidence",
+                             "reason")},
+        }
+        self._index.append(row)
+        self._enforce_retention()
+        logger.warning("incident bundle captured: %s (%s) -> %s | %s",
+                       incident_id, trigger, path,
+                       attribution.get("reason"))
+        return row
+
+    def _enforce_retention(self) -> None:
+        while len(self._index) > self.retention:
+            old = self._index.pop(0)
+            try:
+                os.remove(old["path"])
+            except OSError:
+                pass
+
+    # -- reads ----------------------------------------------------------
+
+    def index(self) -> List[dict]:
+        return list(self._index)
+
+    def load(self, incident_id: str) -> Optional[dict]:
+        for row in self._index:
+            if row["incident_id"] == incident_id:
+                try:
+                    with open(row["path"]) as f:
+                        return json.load(f)
+                except (OSError, ValueError):
+                    return None
+        return None

@@ -1,0 +1,267 @@
+"""Online cross-process trace stitching: join span rings on trace id.
+
+``loadgen trace`` proved the spans join — offline, once, after the
+storm. This module performs the same join *continuously*: every poll
+pass hands each process's freshly-scraped ``/debug/traces`` rows (the
+``since_seq`` cursor guarantees each row arrives exactly once per
+process) to ``ChainStore.ingest``, which groups them by trace id into
+fleet-wide chains. A chain is **complete** when both the router view
+and at least one engine view are present; prefill-pool views attach as
+a third side when the disagg topology runs.
+
+Everything is bounded: chains live in an insertion-ordered dict capped
+at ``max_chains`` (oldest evicted), per-(class, phase) fleet latency
+series and per-(process, phase) attribution series are fixed-length
+deques. All state is touched from the aggregator's event loop only —
+no locks.
+
+Two read products:
+
+- ``fleet_percentiles()`` — per-class per-phase p50/p95/p99 across the
+  whole fleet, phases qualified by side (``router.backend_ttfb``,
+  ``engine.prefill``, ...): the ``GET /fleet/traces`` payload;
+- ``process_phase_stats()`` — per-process recent phase latency, the
+  evidence ``recorder.attribute_incident`` ranks to name a guilty
+  process and phase.
+"""
+
+import collections
+import time
+from typing import Dict, List, Optional, Tuple
+
+# sides a scraped process can contribute to a chain, in the order the
+# request traverses them
+ROLES = ("router", "prefill", "engine")
+
+
+def percentile(values: List[float], p: float) -> float:
+    """Nearest-rank-with-interpolation percentile (mirrors
+    loadgen.report.percentile; duplicated so the obsplane stays
+    importable without the loadgen package)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    if len(ordered) == 1:
+        return ordered[0]
+    rank = (p / 100.0) * (len(ordered) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return ordered[lo] * (1.0 - frac) + ordered[hi] * frac
+
+
+class ChainStore:
+    """Bounded store of stitched cross-process trace chains."""
+
+    def __init__(self, max_chains: int = 4096,
+                 samples_per_series: int = 512,
+                 now_fn=time.time):
+        self.max_chains = max(16, max_chains)
+        self.samples_per_series = max(16, samples_per_series)
+        self._now = now_fn
+        # trace_id -> chain dict (insertion-ordered for eviction)
+        self._chains: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        # (class, qualified phase) -> deque[duration_ms] — fed once,
+        # when a chain first becomes complete
+        self._fleet_series: Dict[Tuple[str, str],
+                                 "collections.deque"] = {}
+        # (process url, phase) -> deque[(wall_ts, duration_ms)] — fed
+        # per ingested trace (attribution evidence needs no router join)
+        self._proc_series: Dict[Tuple[str, str],
+                                "collections.deque"] = {}
+        self.traces_ingested = 0
+        self.chains_created = 0
+        self.chains_complete = 0
+        self.chains_evicted = 0
+
+    # -- writes (aggregator poll loop) ----------------------------------
+
+    def ingest(self, url: str, role: str, traces: List[dict]) -> int:
+        """Fold one process's freshly-scraped trace rows in. ``role``
+        is the scraper's knowledge of what the process is ("router" /
+        "engine" / "prefill"); returns how many rows were new."""
+        if role not in ROLES:
+            raise ValueError(f"unknown role {role!r}; options: {ROLES}")
+        new = 0
+        for trace in traces:
+            tid = trace.get("trace_id")
+            if not tid:
+                continue
+            chain = self._chains.get(tid)
+            if chain is None:
+                chain = self._chains[tid] = {
+                    "trace_id": tid,
+                    "class": "",
+                    "started_at": None,
+                    "duration_ms": None,
+                    "status": None,
+                    "router": None,
+                    "router_url": None,
+                    "engines": {},
+                    "prefill": {},
+                    "complete": False,
+                }
+                self.chains_created += 1
+                self._evict()
+            if role == "router":
+                if chain["router"] is not None:
+                    continue        # duplicate scrape row
+                chain["router"] = trace
+                chain["router_url"] = url
+                chain["class"] = str(
+                    (trace.get("attrs") or {}).get("class") or "other")
+                chain["started_at"] = trace.get("started_at")
+                chain["duration_ms"] = trace.get("duration_ms")
+                chain["status"] = trace.get("status")
+            else:
+                side = chain[role + "s" if role == "engine"
+                             else role]
+                if url in side:
+                    continue
+                side[url] = trace
+            new += 1
+            self.traces_ingested += 1
+            self._feed_process_series(url, trace)
+            if not chain["complete"] and chain["router"] is not None \
+                    and chain["engines"]:
+                chain["complete"] = True
+                self.chains_complete += 1
+                self._feed_fleet_series(chain)
+        return new
+
+    def _evict(self) -> None:
+        while len(self._chains) > self.max_chains:
+            self._chains.popitem(last=False)
+            self.chains_evicted += 1
+
+    def _feed_process_series(self, url: str, trace: dict) -> None:
+        at = trace.get("started_at") or self._now()
+        for span in trace.get("spans", ()):
+            if span.get("kind") != "phase":
+                continue
+            key = (url, span["name"])
+            series = self._proc_series.get(key)
+            if series is None:
+                series = self._proc_series[key] = collections.deque(
+                    maxlen=self.samples_per_series)
+            series.append((at, span.get("duration_ms") or 0.0))
+        # a trace's unattributed time is evidence too (a stall no
+        # phase covers — e.g. a compile on an engine without the
+        # xla_compile hook — must still be rankable)
+        una = trace.get("unattributed_ms")
+        if una is not None:
+            key = (url, "unattributed")
+            series = self._proc_series.get(key)
+            if series is None:
+                series = self._proc_series[key] = collections.deque(
+                    maxlen=self.samples_per_series)
+            series.append((at, una))
+
+    def _feed_fleet_series(self, chain: dict) -> None:
+        cls = chain["class"] or "other"
+
+        def feed(qualified: str, dur_ms: float) -> None:
+            key = (cls, qualified)
+            series = self._fleet_series.get(key)
+            if series is None:
+                series = self._fleet_series[key] = collections.deque(
+                    maxlen=self.samples_per_series)
+            series.append(dur_ms)
+
+        for side, traces in (("router", [chain["router"]]),
+                             ("prefill", chain["prefill"].values()),
+                             ("engine", chain["engines"].values())):
+            for trace in traces:
+                sums: Dict[str, float] = {}
+                for span in trace.get("spans", ()):
+                    if span.get("kind") == "phase":
+                        sums[span["name"]] = sums.get(span["name"], 0.0) \
+                            + (span.get("duration_ms") or 0.0)
+                for name, ms in sums.items():
+                    feed(f"{side}.{name}", ms)
+        feed("total", chain["duration_ms"] or 0.0)
+
+    # -- reads ----------------------------------------------------------
+
+    def fleet_percentiles(self) -> Dict[str, Dict[str, dict]]:
+        """{class: {qualified phase: {p50, p95, p99, n}}} in ms."""
+        out: Dict[str, Dict[str, dict]] = {}
+        for (cls, phase), series in sorted(self._fleet_series.items()):
+            vals = list(series)
+            out.setdefault(cls, {})[phase] = {
+                "p50_ms": round(percentile(vals, 50), 2),
+                "p95_ms": round(percentile(vals, 95), 2),
+                "p99_ms": round(percentile(vals, 99), 2),
+                "n": len(vals),
+            }
+        return out
+
+    def process_phase_stats(self, lookback_s: Optional[float] = None
+                            ) -> Dict[str, Dict[str, dict]]:
+        """{process url: {phase: {p50_ms, p95_ms, n}}}, optionally
+        restricted to samples stamped within the trailing
+        ``lookback_s`` — the attribution evidence."""
+        cutoff = None if lookback_s is None else self._now() - lookback_s
+        out: Dict[str, Dict[str, dict]] = {}
+        for (url, phase), series in self._proc_series.items():
+            vals = [d for at, d in series
+                    if cutoff is None or at >= cutoff]
+            if not vals:
+                continue
+            out.setdefault(url, {})[phase] = {
+                "p50_ms": round(percentile(vals, 50), 2),
+                "p95_ms": round(percentile(vals, 95), 2),
+                "n": len(vals),
+            }
+        return out
+
+    def slowest(self, n: int = 10,
+                cls: Optional[str] = None) -> List[dict]:
+        """The current slowest COMPLETE chains, rendered compactly:
+        per-side phase sums instead of raw span lists (an operator
+        triaging an incident reads totals first, spans later)."""
+        chains = [c for c in self._chains.values()
+                  if c["complete"] and (cls is None
+                                        or c["class"] == cls)]
+        chains.sort(key=lambda c: c["duration_ms"] or 0.0, reverse=True)
+        return [self.render_chain(c) for c in chains[:max(1, n)]]
+
+    @staticmethod
+    def render_chain(chain: dict) -> dict:
+        def phase_sums(trace: dict) -> Dict[str, float]:
+            sums: Dict[str, float] = {}
+            for span in trace.get("spans", ()):
+                if span.get("kind") == "phase":
+                    sums[span["name"]] = round(
+                        sums.get(span["name"], 0.0)
+                        + (span.get("duration_ms") or 0.0), 3)
+            return sums
+
+        return {
+            "trace_id": chain["trace_id"],
+            "class": chain["class"],
+            "status": chain["status"],
+            "started_at": chain["started_at"],
+            "duration_ms": chain["duration_ms"],
+            "unattributed_ms": (chain["router"] or {}).get(
+                "unattributed_ms"),
+            "router": {"url": chain["router_url"],
+                       "phases_ms": phase_sums(chain["router"] or {})},
+            "prefill": {url: phase_sums(t)
+                        for url, t in chain["prefill"].items()},
+            "engines": {url: phase_sums(t)
+                        for url, t in chain["engines"].items()},
+        }
+
+    def stats(self) -> dict:
+        return {
+            "traces_ingested": self.traces_ingested,
+            "chains_created": self.chains_created,
+            "chains_complete": self.chains_complete,
+            "chains_evicted": self.chains_evicted,
+            "chains_held": len(self._chains),
+            "complete_fraction": round(
+                self.chains_complete / self.chains_created, 4)
+            if self.chains_created else 0.0,
+        }

@@ -46,6 +46,7 @@ from aiohttp import web
 
 from production_stack_tpu.router.resilience import backoff_s
 from production_stack_tpu.router.rewriter import NoopRequestRewriter
+from production_stack_tpu.slo import CLASS_HEADER, classify_request
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -318,6 +319,12 @@ async def route_general_request(request: web.Request,
     state = request.app["state"]
     trace = state["tracer"].begin(request.headers.get("traceparent"),
                                   name=endpoint_path)
+    # the request's SLO class rides on the trace so a cross-process
+    # reader (the obsplane stitcher) can bucket fleet percentiles per
+    # class without re-deriving header semantics; a QoS tier overrides
+    # it below exactly the way _slo_observe's classification does
+    trace.attrs["class"] = classify_request(endpoint_path,
+                                            request.headers)
     max_inflight = state.get("max_inflight") or 0
     qos = state.get("qos")
     tier = None
@@ -328,6 +335,8 @@ async def route_general_request(request: web.Request,
         # arrival at the full gate may preempt a background dispatch
         # instead of shedding
         tier = qos.resolve(request.headers)
+        if CLASS_HEADER not in request.headers:
+            trace.attrs["class"] = tier.name
         verdict, _victim = qos.admit(tier, state["proxied_inflight"],
                                      max_inflight)
         if verdict == "shed":

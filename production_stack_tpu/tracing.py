@@ -110,7 +110,7 @@ class RequestTrace:
 
     __slots__ = ("trace_id", "span_id", "parent_id", "sampled", "name",
                  "started_at", "t0", "spans", "status", "attrs",
-                 "_sealed", "duration_s")
+                 "_sealed", "duration_s", "seq")
 
     def __init__(self, trace_id: str, span_id: str,
                  parent_id: Optional[str], sampled: bool, name: str,
@@ -127,6 +127,10 @@ class RequestTrace:
         self.attrs = attrs or {}
         self._sealed = False
         self.duration_s = 0.0
+        # per-ring monotonic sequence number, assigned when the trace
+        # enters the recorder's ring (0 = never ringed) — the
+        # /debug/traces since_seq cursor an incremental scraper pages on
+        self.seq = 0
 
     # -- recording -------------------------------------------------------
 
@@ -193,6 +197,7 @@ class RequestTrace:
             "trace_id": self.trace_id,
             "span_id": self.span_id,
             "parent_id": self.parent_id,
+            "seq": self.seq,
             "name": self.name,
             "status": self.status,
             "started_at": round(self.started_at, 3),
@@ -324,6 +329,11 @@ class TraceRecorder:
             collections.deque(maxlen=max(1, ring_entries))
         self.traces_started = 0
         self.traces_recorded = 0
+        # last ring sequence number handed out: a scraper that read up
+        # to seq N asks /debug/traces?since_seq=N next pass and never
+        # re-reads (or misses, while its scrape interval outruns ring
+        # rotation) a trace
+        self.last_seq = 0
         self._rng = random.Random(os.urandom(8))
 
     def begin(self, traceparent: Optional[str] = None,
@@ -345,6 +355,8 @@ class TraceRecorder:
             return                    # double-finish must not re-ring
         trace.seal(status)
         if trace.sampled:
+            self.last_seq += 1
+            trace.seq = self.last_seq
             self.ring.append(trace)
             self.traces_recorded += 1
 
@@ -352,8 +364,14 @@ class TraceRecorder:
 
     def snapshot(self, trace_id: Optional[str] = None,
                  slowest: Optional[int] = None,
-                 limit: int = 100) -> List[dict]:
+                 limit: int = 100,
+                 since_seq: Optional[int] = None) -> List[dict]:
         traces = list(self.ring)
+        if since_seq is not None:
+            # cursor read: only traces ringed after the caller's last
+            # read; composes with the other filters (the ring is
+            # append-ordered, so this is a suffix scan)
+            traces = [t for t in traces if t.seq > since_seq]
         if trace_id:
             traces = [t for t in traces if t.trace_id == trace_id]
         if slowest:
@@ -368,32 +386,36 @@ def debug_traces_handler(get_recorder):
     """aiohttp handler factory for ``GET /debug/traces``.
 
     Query params: ``trace_id=<32 hex>`` (exact match), ``slowest=N``
-    (N slowest in the ring), ``limit=N`` (most recent N, default 100).
-    ``get_recorder`` is a zero-arg callable so app wiring can
-    late-bind."""
+    (N slowest in the ring), ``limit=N`` (most recent N, default 100),
+    ``since_seq=N`` (only traces ringed after sequence number N — the
+    incremental-scrape cursor; the response's ``last_seq`` is the next
+    cursor value). ``get_recorder`` is a zero-arg callable so app
+    wiring can late-bind."""
     from aiohttp import web
 
     async def handler(request: web.Request) -> web.Response:
         rec: TraceRecorder = get_recorder()
 
-        def intq(key, default=None):
+        def intq(key, default=None, floor=1):
             raw = request.query.get(key)
             if raw is None:
                 return default
             try:
-                return max(1, int(raw))
+                return max(floor, int(raw))
             except ValueError:
                 return default
 
         traces = rec.snapshot(
             trace_id=request.query.get("trace_id"),
             slowest=intq("slowest"),
-            limit=intq("limit", 100) or 100)
+            limit=intq("limit", 100) or 100,
+            since_seq=intq("since_seq", None, floor=0))
         return web.json_response({
             "service": rec.service,
             "ring_entries": rec.ring.maxlen,
             "traces_started": rec.traces_started,
             "traces_recorded": rec.traces_recorded,
+            "last_seq": rec.last_seq,
             "sample_rate": rec.sample_rate,
             "returned": len(traces),
             "traces": traces,

@@ -81,10 +81,23 @@ removes from the decode pool.
 Tracing (the trace rig's lever): the fake continues an inbound W3C
 ``traceparent`` (or mints a context), stamps ``x-trace-id`` on its
 responses, and records a minimal engine-side span set — ``prefill``
-(ttft/kv pacing) and ``decode`` (tick pacing) — into a bounded ring
-served on ``GET /debug/traces``, so cross-process span-chain tests and
-``loadgen trace`` run without a real engine
-(production_stack_tpu/tracing.py; docs/observability.md "Tracing").
+(ttft/kv pacing, INCLUDING any injected slow_ttft delay, so latency
+faults land in the phase a real engine's queue/prefill stall would)
+and ``decode`` (tick pacing) — into a bounded ring served on
+``GET /debug/traces`` (with the real handler's ``since_seq`` cursor),
+so cross-process span-chain tests and ``loadgen trace`` run without a
+real engine (production_stack_tpu/tracing.py; docs/observability.md
+"Tracing").
+
+Debug-perf surface (the obsplane flight recorder's lever): the fake
+serves ``GET /debug/perf`` in the real engine server's shape — totals
++ rates from the synthetic perf block, a wall-clock-stamped window
+ring (one synthetic entry per served request), a synthetic compile
+ring (entries appear when the ``compiles_total`` perf override
+rises), a static kv_pool census — plus a ``fault`` block exposing the
+currently-injected fault mode / error_rate / signal overrides, so an
+incident bundle captured from a fake fleet shows the injected fault
+exactly where a real engine's rings would show the real one.
 """
 
 import asyncio
@@ -205,6 +218,12 @@ class FakeEngine:
         self.perf_prefill_real = 0
         import collections as _collections
         self._perf_events = _collections.deque(maxlen=4096)
+        # /debug/perf rings, mirroring EngineEffAccounting's shape:
+        # wall-clock-stamped window entries (one per served request)
+        # and synthetic compile events (one per compiles_total
+        # override increment)
+        self._perf_windows = _collections.deque(maxlen=256)
+        self._perf_compiles = _collections.deque(maxlen=128)
         import random as _random
         self._error_rng = _random.Random(0xE44)
         # engine-side tracing (production_stack_tpu/tracing.py): the
@@ -237,6 +256,7 @@ class FakeEngine:
         from production_stack_tpu.tracing import debug_traces_handler
         app.router.add_get("/debug/traces",
                            debug_traces_handler(lambda: self.tracer))
+        app.router.add_get("/debug/perf", self.debug_perf)
         return app
 
     async def _tick(self):
@@ -393,11 +413,24 @@ class FakeEngine:
     def _note_served(self, n_tokens: int) -> None:
         """One finished inference request that served ``n_tokens``:
         n-1 decode real token-steps (first token = prefill, like the
-        real engine) + the fake's canonical 3 prompt tokens."""
+        real engine) + the fake's canonical 3 prompt tokens. Also
+        appends one synthetic window-ring entry (the real engine's
+        per-window granularity collapses to per-request here)."""
         real = max(0, n_tokens - 1)
         self.perf_real += real
         self.perf_prefill_real += 3
         self._perf_events.append((time.monotonic(), real))
+        p = self.perf
+        denom = max(1e-9, 1.0 - p["pad_fraction"] - p["dead_fraction"])
+        pad = int(round(real * p["pad_fraction"] / denom))
+        dead = int(round(real * p["dead_fraction"] / denom))
+        self._perf_windows.append({
+            "at": round(time.monotonic(), 4),
+            "at_unix": round(time.time(), 4),
+            "steps": real, "positions": 1, "batch": 1, "live_rows": 1,
+            "kv_len": 0, "real": real, "pad": pad, "dead": dead,
+            "window_s": 0.0, "bytes": 0, "effective_bytes": 0,
+        })
 
     def _perf_block(self) -> dict:
         """Mirror of the real engine's /load ``perf`` block, derived
@@ -447,7 +480,20 @@ class FakeEngine:
                 self.perf[key] = float(cfg[key] or 0.0)
         for key in ("compiles_total", "compile_in_flight"):
             if key in cfg:
+                before = int(self.perf[key])
                 self.perf[key] = int(cfg[key] or 0)
+                if key == "compiles_total":
+                    # the compile RING must tell the same story as the
+                    # counter: each override increment lands one
+                    # wall-clock-stamped synthetic event (bounded)
+                    for _ in range(min(128,
+                                       max(0, self.perf[key] - before))):
+                        self._perf_compiles.append({
+                            "at": round(time.monotonic(), 4),
+                            "at_unix": round(time.time(), 4),
+                            "duration_s": 0.5, "kind": "decode",
+                            "window": 8, "kv_bucket": 512, "batch": 8,
+                        })
         for key in ("mbu_perc", "effective_bytes_per_s"):
             if key in cfg:
                 v = cfg[key]
@@ -671,7 +717,12 @@ class FakeEngine:
         try:
             n = min(body.get("max_tokens") or self.num_tokens,
                     self.num_tokens)
-            t_pf = time.monotonic()
+            # the prefill phase opens at the TRACE start, not here: an
+            # injected slow_ttft delay (applied above, before the body
+            # read) must land in the phase a real engine's queue/
+            # prefill stall would occupy, or a latency fault shows up
+            # as unattributed time no stitcher can pin to a phase
+            t_pf = trace.t0
             if self.ttft_s:
                 await asyncio.sleep(self.ttft_s)
             prompt_text = ""
@@ -822,6 +873,53 @@ class FakeEngine:
                 "remote_breaker_open": self._kv_store.breaker_open(),
             }
         return web.json_response(report)
+
+    async def debug_perf(self, request: web.Request) -> web.Response:
+        """Mirror of the real engine server's ``GET /debug/perf``
+        (engine/server.py debug_perf): totals/rates plus the
+        wall-clock-stamped window and compile rings and a kv_pool
+        census — with one fake-only addition, a ``fault`` block
+        exposing whatever is currently injected, so an incident bundle
+        captured from a fake fleet carries the ground truth the rig
+        asserts attribution against."""
+        try:
+            limit = max(1, int(request.query.get("limit", "50")))
+        except ValueError:
+            limit = 50
+        perf = self._perf_block()
+        return web.json_response({
+            "totals": {
+                "decode": perf["token_steps"],
+                "prefill": perf["prefill_tokens"],
+                "bytes_total": 0, "bytes_effective": 0,
+                "compiles_total": perf["compiles_total"],
+                "compile_s_total": perf["compile_s_total"],
+                "compile_in_flight": perf["compile_in_flight"],
+                "compiles": {}, "weight_bytes": 0,
+                "kv_position_bytes": 0, "hbm_peak_bytes_per_s": 0.0,
+            },
+            "rates": {k: perf[k] for k in
+                      ("horizon_s", "effective_bytes_per_s",
+                       "total_bytes_per_s", "mbu_perc", "live_fraction",
+                       "decode_tokens_per_s")},
+            "windows": list(self._perf_windows)[-limit:],
+            "compiles": list(self._perf_compiles)[-limit:],
+            "kv_pool": {
+                "num_blocks": 1024, "free": 1024, "active": 0,
+                "cached": 0, "usage": 0.0, "allocs": 0,
+                "blocks_allocated": 0, "alloc_failures_exhausted": 0,
+                "alloc_failures_fragmented": 0, "cache_evictions": 0,
+            },
+            "fault": {
+                "fault": self.fault,
+                "faults_served": self.faults_served,
+                "error_rate": self.error_rate,
+                "errors_injected": self.errors_injected,
+                "capacity_override": self.capacity_override,
+                "queue_delay_override": self.queue_delay_override,
+                "perf_overrides": dict(self.perf),
+            },
+        })
 
     async def metrics(self, request: web.Request) -> web.Response:
         lines = []

@@ -573,3 +573,27 @@ def test_debug_perf_behind_api_key(cold_engine):
         assert r.status == 200
         assert "perf" in await r.json()
     _with_client(cold_engine, body, api_key="sk")
+
+
+def test_ring_entries_carry_wall_clock_stamps():
+    """Window and compile ring entries are stamped with ``at_unix``
+    (wall clock) alongside the monotonic ``at`` — the obsplane flight
+    recorder aligns engine rings with trace spans across processes,
+    which monotonic stamps (per-process epoch) cannot do."""
+    wall = _Clock(1000.0)
+    acct = EngineEffAccounting(now_fn=_Clock(5.0), wall_fn=wall)
+    acct.note_window(steps=2, positions=1, batch=4, live_rows=3,
+                     kv_len=256, real=6, pad=2, dead=0, window_s=0.1)
+    entry = acct.recent_windows(1)[0]
+    assert entry["at_unix"] == pytest.approx(1000.0)
+    assert entry["at"] == pytest.approx(5.0)
+    acct.compile_started("decode", 8, 512, 4)
+    acct.compile_finished("decode", 8, 512, started_at=5.0, dur_s=2.0,
+                          batch=4)
+    row = acct.recent_compiles(1)[0]
+    # wall stamp of the compile START: wall-at-finish minus duration
+    assert row["at_unix"] == pytest.approx(998.0)
+    assert row["duration_s"] == pytest.approx(2.0)
+    # the trace-seal hook keeps its 6-tuple shape (server.py unpacks)
+    events = acct.compile_events_between(5.5, 6.0)
+    assert len(events) == 1 and len(events[0]) == 6

@@ -1,0 +1,243 @@
+"""Fleet flight-recorder rig (loadgen incident): contract units,
+bundle-completeness units, and the end-to-end smoke.
+
+Tiers:
+- contract units — incident_violations over synthetic records (each
+  gate trips independently: spurious baseline capture, missed alert,
+  missing/extra/incomplete bundle, wrong attribution process/phase,
+  non-resolution, vacuous stitching, overhead band);
+- bundle completeness — every fleet process must be represented with
+  the payloads its role owes;
+- rig — ONE-scenario subprocess smoke (2 routers + 3 fake engines +
+  the real obsplane, seconds-scale windows: clean baseline captures
+  nothing while chains stitch, a one-engine TTFT inflation fires
+  chat_ttft_page and yields one complete bundle attributing that
+  engine's prefill phase). The full three-scenario drill and the
+  real-engine mode stay behind ``slow`` (the committed
+  INCIDENT_r18.json is produced by benchmarks/run_incident.sh).
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from production_stack_tpu.loadgen.incident import (SCENARIO_NAMES,
+                                                   bundle_completeness,
+                                                   incident_violations,
+                                                   run_incident)
+
+
+# ------------------------------------------------------------ units
+
+def _clean_record():
+    return {
+        "detail": {
+            "control_errors": [],
+            "baseline": {
+                "storm": {"launched": 100, "ok": 100, "http_5xx": 0,
+                          "http_4xx": 0, "shed": 0,
+                          "transport_errors": 0, "samples": []},
+                "bundles_captured": 0,
+                "firing_alerts": [],
+                "process_states": {"http://r1": "live"},
+                "stitch": {"chains_created": 50,
+                           "chains_complete": 48,
+                           "complete_fraction": 0.96},
+                "fleet_percentile_classes": ["chat", "rag"],
+            },
+            "scenarios": [{
+                "name": "slow_ttft",
+                "expected_alert": "chat_ttft_page",
+                "expected_process": "http://e3",
+                "expected_phase": "prefill",
+                "injected_ok": True, "cleared_ok": True,
+                "t_inject_s": 10.0, "detected_in_s": 9.0,
+                "captured_in_s": 0.3,
+                "bundles_captured": 1,
+                "bundle_id": "x-0001",
+                "bundle_missing": [],
+                "attribution": {"process": "http://e3",
+                                "role": "engine", "phase": "prefill",
+                                "confidence": "medium", "reason": "r"},
+                "attribution_process_ok": True,
+                "attribution_phase_ok": True,
+                "resolved_in_s": 5.0, "post_settle_quiet": True,
+            }],
+            "detect_timeout_s": 40.0, "resolve_timeout_s": 28.0,
+            "final": {"firing_alerts": [], "bundles_total": 1,
+                      "captures_suppressed": 0, "stitch": {},
+                      "scrape_errors_total": {}},
+            "overhead_guard": None,
+        },
+    }
+
+
+def test_violations_clean_record_passes():
+    assert incident_violations(_clean_record()) == []
+
+
+def test_violations_catch_each_contract():
+    r = _clean_record()
+    r["detail"]["baseline"]["bundles_captured"] = 1
+    assert any("spurious" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["baseline"]["stitch"]["chains_complete"] = 0
+    assert any("vacuous" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["baseline"]["stitch"]["complete_fraction"] = 0.2
+    assert any("leaking" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["detected_in_s"] = None
+    assert any("missed detection" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["bundles_captured"] = 0
+    assert any("no incident bundle" in v
+               for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["bundles_captured"] = 2
+    assert any("dedup failed" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["bundle_missing"] = ["http://e1: ..."]
+    assert any("incomplete" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["attribution_process_ok"] = False
+    assert any("attribution named" in v
+               for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["attribution_phase_ok"] = False
+    assert any("phase" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["scenarios"][0]["resolved_in_s"] = None
+    assert any("did not resolve" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["final"]["bundles_total"] = 3
+    assert any("expected 1" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["baseline"]["storm"]["http_5xx"] = 2
+    assert any("baseline storm" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["control_errors"] = ["GET /fleet -> HTTP 500"]
+    assert any("control-plane" in v for v in incident_violations(r))
+
+    r = _clean_record()
+    r["detail"]["overhead_guard"] = {
+        "overhead_ratio": 4.0, "baseline_ratio": 2.0, "rounds": 2,
+        "scraped": {"router_req_per_s": 500, "errors": 0},
+        "baseline": {"router_req_per_s": 1000, "errors": 0}}
+    assert any("band" in v
+               for v in incident_violations(r, max_overhead_ratio=2.5))
+    # escape 2 — same-host ratio normalization: a slow host measuring
+    # 4.0x unscraped keeps the 4.2x scraped side inside +10%
+    r2 = copy.deepcopy(r)
+    r2["detail"]["overhead_guard"]["overhead_ratio"] = 4.2
+    r2["detail"]["overhead_guard"]["baseline_ratio"] = 4.0
+    assert not any("band" in v for v in
+                   incident_violations(r2, max_overhead_ratio=2.5))
+    # escape 3 — router-side throughput within 10% of the unscraped
+    # baseline: the ratio's denominator swung, not the router
+    r3 = copy.deepcopy(r)
+    r3["detail"]["overhead_guard"]["scraped"][
+        "router_req_per_s"] = 950
+    assert not any("band" in v for v in
+                   incident_violations(r3, max_overhead_ratio=2.5))
+    # errors on either side always flag
+    r4 = copy.deepcopy(r)
+    r4["detail"]["overhead_guard"]["scraped"]["errors"] = 3
+    assert any("suspect" in v for v in
+               incident_violations(r4, max_overhead_ratio=2.5))
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError):
+        asyncio.run(run_incident(scenarios=["nope"]))
+
+
+def test_bundle_completeness_unit():
+    expected = {"http://r1": "router", "http://e1": "engine"}
+    bundle = {"fleet": {"processes": {
+        "http://r1": {"health": {"status": "ok"}, "alerts": {}},
+        "http://e1": {"load": {}, "perf": {}},
+    }}}
+    assert bundle_completeness(bundle, expected) == []
+    # a dead engine keeps last-known payloads: still complete
+    bundle["fleet"]["processes"]["http://e1"]["state"] = "unreachable"
+    assert bundle_completeness(bundle, expected) == []
+    # a router without its /alerts snapshot is incomplete
+    bundle["fleet"]["processes"]["http://r1"]["alerts"] = None
+    assert any("alerts" in m
+               for m in bundle_completeness(bundle, expected))
+    # an absent process is incomplete
+    del bundle["fleet"]["processes"]["http://e1"]
+    assert any("absent" in m
+               for m in bundle_completeness(bundle, expected))
+
+
+# ------------------------------------------------------------ rig
+
+def _assert_drill_clean(record):
+    violations = incident_violations(record)
+    assert not violations, violations
+    d = record["detail"]
+    assert d["baseline"]["storm"]["ok"] > 0
+    assert d["baseline"]["stitch"]["chains_complete"] > 0
+    for s in d["scenarios"]:
+        assert s["detected_in_s"] is not None
+        assert s["bundles_captured"] == 1
+        assert s["attribution"]["process"] == s["expected_process"]
+        assert s["attribution"]["phase"] == s["expected_phase"]
+
+
+def test_incident_smoke_fake_fleet(tmp_path):
+    """Tier-1 one-scenario smoke: 2 peered routers + 3 fake engines +
+    the obsplane; clean baseline captures nothing while chains stitch,
+    a one-engine TTFT inflation fires chat_ttft_page and yields one
+    complete bundle naming that engine's prefill phase."""
+    record = asyncio.run(run_incident(
+        engines=3, routers=2, engine="fake", users=6,
+        baseline_s=5.0, window_scale=0.004,
+        scenarios=["slow_ttft"],
+        log_dir=str(tmp_path / "logs")))
+    _assert_drill_clean(record)
+    s = record["detail"]["scenarios"][0]
+    assert s["expected_alert"] == "chat_ttft_page"
+    assert s["attribution"]["role"] == "engine"
+
+
+@pytest.mark.slow
+def test_incident_full_fake_fleet(tmp_path):
+    """All three scenarios, including the SIGKILL (attribution rule 1)
+    and the aimed shed storm (rule 2) — the committed-record shape."""
+    record = asyncio.run(run_incident(
+        engines=3, routers=2, engine="fake", users=8,
+        baseline_s=8.0, window_scale=0.01,
+        scenarios=list(SCENARIO_NAMES),
+        log_dir=str(tmp_path / "logs")))
+    _assert_drill_clean(record)
+    assert len(record["detail"]["scenarios"]) == len(SCENARIO_NAMES)
+
+
+@pytest.mark.slow
+def test_incident_real_engine(tmp_path):
+    """Real-engine mode: the fake-only slow_ttft drops; a SIGKILLed
+    debug-tiny must still yield a complete attributed bundle."""
+    record = asyncio.run(run_incident(
+        engines=2, routers=1, engine="debug-tiny", users=4,
+        baseline_s=10.0, window_scale=0.02,
+        scenarios=["engine_down", "slow_ttft"],   # slow_ttft dropped
+        num_tokens=4, log_dir=str(tmp_path / "logs")))
+    d = record["detail"]
+    assert [s["name"] for s in d["scenarios"]] == ["engine_down"]
+    _assert_drill_clean(record)

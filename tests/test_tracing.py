@@ -469,3 +469,49 @@ def test_preempted_sequence_queue_wait_not_double_counted():
     # preemption after first token: prefill clamps to zero under the
     # engine's max() math (first_token < admit)
     assert seq.first_token_time < seq.admit_time
+
+
+def test_debug_traces_since_seq_cursor():
+    """The incremental-scrape cursor: seq numbers are monotonic per
+    ring, ``since_seq=N`` returns only traces ringed after N, and the
+    response's ``last_seq`` is the next cursor value — so an obsplane
+    scraper never re-reads a row (and misses only on ring rotation)."""
+    async def body():
+        fake = FakeEngine(model="m")
+        servers, urls = await _start_fakes(fake)
+        app = build_app(_router_args(urls, ["m"]))
+        async with TestClient(TestServer(app)) as client:
+            for i in range(3):
+                r = await client.post("/v1/chat/completions", json={
+                    "model": "m",
+                    "messages": [{"role": "user", "content": f"q{i}"}]})
+                assert r.status == 200
+            r = await client.get("/debug/traces",
+                                 params={"since_seq": "0"})
+            data = await r.json()
+            assert data["last_seq"] == 3
+            assert [t["seq"] for t in data["traces"]] == [1, 2, 3]
+            cursor = data["last_seq"]
+            # nothing new: the cursor read is empty, not a re-read
+            r = await client.get("/debug/traces",
+                                 params={"since_seq": str(cursor)})
+            data = await r.json()
+            assert data["returned"] == 0
+            assert data["last_seq"] == 3
+            # new traffic appears after the cursor, exactly once
+            r = await client.post("/v1/chat/completions", json={
+                "model": "m",
+                "messages": [{"role": "user", "content": "q"}]})
+            assert r.status == 200
+            r = await client.get("/debug/traces",
+                                 params={"since_seq": str(cursor)})
+            data = await r.json()
+            assert [t["seq"] for t in data["traces"]] == [4]
+            # the cursor composes with the existing filters
+            r = await client.get("/debug/traces",
+                                 params={"since_seq": "2",
+                                         "slowest": "1"})
+            assert (await r.json())["returned"] == 1
+        for s in servers:
+            await s.close()
+    asyncio.run(body())

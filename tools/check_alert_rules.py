@@ -17,6 +17,12 @@ tier-1 via tests/test_observability.py):
    annotation pointing at a ``docs/runbooks.md`` anchor whose heading
    exists: an alert that fires at 3am must come with its diagnosis
    steps.
+4. **Fleet evidence linked** — every alert's runbook section must
+   link the ``#incident-bundle`` anchor (and that anchor's heading
+   must exist): with the obsplane deployed, the alert's firing
+   transition already captured the fleet-wide evidence, and a runbook
+   that does not say so sends the responder scraping 2R+N endpoints
+   by hand.
 """
 
 import re
@@ -39,14 +45,24 @@ def _registered_metrics() -> set:
     return mod.registered_metrics()
 
 
-def _runbook_anchors(text: str) -> set:
-    """GitHub-style anchors of every heading in docs/runbooks.md."""
-    anchors = set()
-    for m in re.finditer(r"^#+\s+(.+?)\s*$", text, re.M):
-        title = m.group(1).strip().lower()
-        anchors.add(re.sub(r"[^a-z0-9_\- ]", "", title)
-                    .replace(" ", "-"))
-    return anchors
+def _anchor(title: str) -> str:
+    """GitHub-style anchor slug of one heading title."""
+    return re.sub(r"[^a-z0-9_\- ]", "", title.strip().lower()) \
+        .replace(" ", "-")
+
+
+def _runbook_sections(text: str) -> dict:
+    """{anchor: section body} — every heading (any level) up to the
+    next heading; the anchor set and the section map are derived from
+    the SAME heading walk so checks 3 and 4 cannot disagree about
+    which headings exist."""
+    sections = {}
+    matches = list(re.finditer(r"^#+\s+(.+?)\s*$", text, re.M))
+    for i, m in enumerate(matches):
+        end = matches[i + 1].start() if i + 1 < len(matches) \
+            else len(text)
+        sections[_anchor(m.group(1))] = text[m.end():end]
+    return sections
 
 
 def main() -> int:
@@ -70,9 +86,13 @@ def main() -> int:
     doc = yaml.safe_load(RULES.read_text()) if RULES.exists() else None
     registered = _registered_metrics()
     runbook_text = RUNBOOKS.read_text() if RUNBOOKS.exists() else ""
-    anchors = _runbook_anchors(runbook_text)
+    sections = _runbook_sections(runbook_text)
+    anchors = set(sections)
     if not RUNBOOKS.exists():
         problems.append(f"{RUNBOOKS} is missing")
+    if "incident-bundle" not in anchors:
+        problems.append("docs/runbooks.md has no 'Incident bundle' "
+                        "section (#incident-bundle)")
 
     n_rules = 0
     for group in (doc or {}).get("groups", []):
@@ -97,6 +117,12 @@ def main() -> int:
                 problems.append(
                     f"alert {name}: runbook anchor #{m.group(1)} has "
                     f"no matching heading in docs/runbooks.md")
+            elif "#incident-bundle" not in sections.get(m.group(1),
+                                                        ""):
+                problems.append(
+                    f"alert {name}: runbook section #{m.group(1)} "
+                    f"does not link the fleet evidence "
+                    f"(#incident-bundle)")
     if doc is not None and n_rules == 0:
         problems.append("alert-rules.yaml contains zero rules")
 

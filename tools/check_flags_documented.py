@@ -1,10 +1,11 @@
 #!/usr/bin/env python3
 """Lint: every operator-facing CLI flag must appear in the docs.
 
-Scans the three long-running-process entry points — the router
+Scans the long-running-process entry points — the router
 (``production_stack_tpu/router/app.py``), the engine server
-(``production_stack_tpu/engine/server.py``), and the autoscaler
-(``production_stack_tpu/autoscaler/__main__.py``) — for
+(``production_stack_tpu/engine/server.py``), the autoscaler
+(``production_stack_tpu/autoscaler/__main__.py``), and the obsplane
+(``production_stack_tpu/obsplane/app.py``) — for
 ``add_argument("--flag")`` literals (the same registry-walk-by-scan
 pattern as ``check_metrics_documented.py``: no imports, no JAX), and
 checks that each flag name appears verbatim somewhere under
@@ -29,6 +30,7 @@ SURFACES = {
     "engine": REPO / "production_stack_tpu" / "engine" / "server.py",
     "autoscaler": REPO / "production_stack_tpu" / "autoscaler"
     / "__main__.py",
+    "obsplane": REPO / "production_stack_tpu" / "obsplane" / "app.py",
 }
 
 FLAG_RE = re.compile(r'add_argument\(\s*"(--[a-z0-9][a-z0-9-]*)"')

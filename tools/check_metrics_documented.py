@@ -58,7 +58,16 @@ def main() -> int:
     docs = DOCS.read_text(encoding="utf-8")
     wildcards = {m.group(1) for m in
                  re.finditer(r"((?:tpu|vllm):[a-z0-9_]+_)\*", docs)}
-    missing = sorted(n for n in registered_metrics()
+    registered = registered_metrics()
+    # the walk is a literal scan, so a moved package silently drops
+    # its families from the check — pin the prefixes the scan must
+    # keep finding (the obsplane's tpu:fleet_* joined in r18)
+    for prefix in ("tpu:fleet_", "tpu:slo_", "tpu:engine_"):
+        if not any(n.startswith(prefix) for n in registered):
+            print(f"registry walk found NO {prefix}* families — the "
+                  f"scan lost a package", file=sys.stderr)
+            return 1
+    missing = sorted(n for n in registered
                      if not documented(n, docs, wildcards))
     if missing:
         print(f"{len(missing)} metric families are registered in code "
