@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# KV memory plane: the committed KVMIGRATE_r19.json recipe — the
+# fragmentation storm (planner ON must erase engine-census admission
+# failures at constant aggregate blocks; OFF must keep failing) plus
+# the raw-vs-int4 codec capacity re-run of the kvshare storm.
+#
+#   ./benchmarks/run_kvmigrate.sh          # fake engines (data path)
+#   CODEC=int8 ./benchmarks/run_kvmigrate.sh
+#
+# Exit 1 if migration fails to erase the fragmented regime (second-half
+# failure rate > 2%), the OFF phase recovers on its own (anti-vacuity),
+# the planner executed no moves, aggregate blocks change (block mint),
+# the compressed tier holds < 2x logical bytes per physical byte, or
+# median follow-up TTFT through the codec exceeds raw by > 25%.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${OUT:-KVMIGRATE_$(date +%Y%m%d_%H%M%S).json}"
+
+python -m production_stack_tpu.loadgen kvmigrate \
+  --codec "${CODEC:-int4}" \
+  --storm-duration "${STORM_DURATION:-8s}" \
+  --storm-workers "${STORM_WORKERS:-4}" \
+  --sessions "${SESSIONS:-4}" --rounds "${ROUNDS:-6}" \
+  --output "$OUT" "$@"
+
+echo "kvmigrate record: $OUT"

@@ -94,6 +94,12 @@ MODULES = {
         "production_stack_tpu.kvcache.protocol",
         "production_stack_tpu.kvcache.server",
         "production_stack_tpu.kvcache.store",
+        "production_stack_tpu.kvcache.codec",
+        "production_stack_tpu.kvcache.pipeline",
+    ],
+    "KV memory plane": [
+        "production_stack_tpu.kvplane.planner",
+        "production_stack_tpu.kvplane.app",
     ],
     "Shared": [
         "production_stack_tpu.protocol",

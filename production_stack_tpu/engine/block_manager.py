@@ -63,12 +63,16 @@ class BlockManager:
         # (just fewer than it needs) hit the fragmentation regime —
         # free capacity exists but is insufficient for this request,
         # the admission-failure class fleet-level migration/defrag
-        # (ROADMAP item 3) exists to erase.
+        # (the kvplane) exists to erase.
         self.allocs = 0
         self.blocks_allocated = 0
         self.alloc_failures_exhausted = 0
         self.alloc_failures_fragmented = 0
         self.cache_evictions = 0
+        # kvplane intra-replica defrag: the engine runs defrag()
+        # between fused windows when fragmented failures rose
+        self.defrag_runs = 0
+        self.defrag_block_moves = 0
         # optional occupancy observer (the engine wires this to the
         # metrics layer's plain-int histogram): called with the pool
         # usage fraction at every allocation attempt, so the histogram
@@ -122,7 +126,36 @@ class BlockManager:
             "alloc_failures_exhausted": self.alloc_failures_exhausted,
             "alloc_failures_fragmented": self.alloc_failures_fragmented,
             "cache_evictions": self.cache_evictions,
+            "free_contiguity": round(self.free_contiguity(), 4),
+            "defrag_runs": self.defrag_runs,
+            "defrag_block_moves": self.defrag_block_moves,
         }
+
+    def free_contiguity(self) -> float:
+        """Fraction of adjacent free-block-id pairs: 1.0 when the free
+        list is one dense run, ->0 as frees scatter across the pool.
+        Device DMA batches contiguous block ranges, so scattered frees
+        cost extra descriptors per transfer — the quantity defrag()
+        restores between fused windows."""
+        if len(self._free) < 2:
+            return 1.0
+        s = sorted(self._free)
+        runs = sum(1 for a, b in zip(s, s[1:]) if b == a + 1)
+        return runs / (len(s) - 1)
+
+    def defrag(self) -> int:
+        """Compact the free list: reorder it so subsequent pops hand
+        out ascending, maximally dense block-id runs (pops take from
+        the list tail). Pure host-side bookkeeping over indices — KV
+        bytes never move, refcounts and the prefix cache are untouched,
+        so this is safe between any two fused windows. Returns the
+        number of list positions that changed."""
+        self.defrag_runs += 1
+        target = sorted(self._free, reverse=True)
+        moved = sum(1 for a, b in zip(self._free, target) if a != b)
+        self._free = target
+        self.defrag_block_moves += moved
+        return moved
 
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)

@@ -114,6 +114,11 @@ class EngineConfig:
     # kvcache/connector.py). Keys: kv_role, chunk_size, local_cpu_gb,
     # local_disk_path, local_disk_gb, remote_url.
     kv_transfer_config: Optional[Dict[str, Any]] = None
+    # kvplane intra-replica defrag: when a step's admissions hit the
+    # fragmented-failure regime, compact the BlockManager free list
+    # between fused windows (block_manager.defrag — host-side index
+    # reordering, KV bytes never move)
+    kvplane_defrag: bool = True
     # Multi-LoRA serving (reference: --enable-lora + LoraAdapter CRD
     # proposal, helm/templates/deployment-vllm-multi.yaml:65-67).
     # name -> .npz path (models/lora.py format), or name -> "random:SEED"

@@ -173,6 +173,9 @@ class LLMEngine:
         # buckets (one bisect per allocation attempt, not per token)
         self.block_mgr.on_alloc_occupancy = \
             self.metrics.kvpool_occ_hist.observe
+        # kvplane defrag trigger state: fragmented-failure count at the
+        # last end-of-step check (engine lock only)
+        self._defrag_seen_failures = 0
         # engine efficiency accounting (engine/efficiency.py;
         # docs/engine.md "Efficiency telemetry"): classifies every
         # fused window's token-steps, models HBM traffic for the
@@ -541,8 +544,98 @@ class LLMEngine:
                     decode_seqs = list(self.scheduler.running.values())
                     if decode_seqs:
                         self._dispatch_decode(decode_seqs)
+            self._maybe_defrag()
             self._refresh_gauges()
             return outputs
+
+    def _maybe_defrag(self) -> None:
+        """kvplane intra-replica defrag, between fused windows: if this
+        step's admissions hit the fragmented-failure regime, compact
+        the free list so the next allocations hand out dense block-id
+        runs. Called under the engine lock at the end of step() — the
+        one point where no allocation is mid-flight."""
+        if not self.cfg.kvplane_defrag:
+            return
+        frag = self.block_mgr.alloc_failures_fragmented
+        if frag > self._defrag_seen_failures:
+            self._defrag_seen_failures = frag
+            self.block_mgr.defrag()
+
+    def migrate_out(self, max_seqs: int = 2,
+                    target_blocks: int = 0) -> Dict[str, object]:
+        """kvplane live migration, source side: publish the victim
+        sequences' computed chunks to the shared tiers, preempt them
+        (freeing their blocks for the admissions that were failing),
+        flush the write-through, and hand back the chunk keys so the
+        planner can warm the destination replica and re-home routing.
+
+        Victims are the sequences holding the most blocks (fewest
+        preemptions per freed block). Preempted victims are re-
+        prefetched from the tiers before their next admission, so
+        migration costs them a tier read, not a recompute. A planner
+        crash after this call leaves only published chunks + preempted
+        sequences — both states the stack already recovers from
+        (recompute + checksummed tier reads), so migration is torn-safe
+        by construction."""
+        if self.connector is None or not self.connector.cfg.is_producer:
+            return {"migrated": [], "freed_blocks": 0, "keys": [],
+                    "error": "kv tiering with a producer role is "
+                             "required for migration"}
+        keys: List[bytes] = []
+        victims = []
+        freed = 0
+        with self._lock:
+            candidates = list(self.scheduler.running.values()) \
+                + list(self.scheduler._prefilling.values())
+            candidates.sort(
+                key=lambda s: len([b for b in s.block_ids if b]),
+                reverse=True)
+            for seq in candidates:
+                if len(victims) >= max(1, max_seqs):
+                    break
+                if target_blocks and freed >= target_blocks:
+                    break
+                held = len([b for b in seq.block_ids if b])
+                if held == 0:
+                    continue
+                keys.extend(self.connector.on_migrate(
+                    seq, salt=self._adapter_salt(seq.adapter_id)))
+                self._preempt(seq)
+                freed += held
+                victims.append(seq)
+            self.metrics.kvplane_migrations.inc(len(victims))
+            self.metrics.kvplane_migrated_blocks.inc(freed)
+        # outside the lock: make the published chunks tier-visible
+        # before the planner acts on the keys, then re-prefetch each
+        # victim so its re-admission injects instead of recomputing
+        # (benign race: a victim admitted before its prefetch lands
+        # simply recomputes, the pre-migration behavior)
+        self.connector.flush(timeout=10.0)
+        for seq in victims:
+            pf = self.connector.prefetch(
+                seq.prompt_tokens,
+                salt=self._adapter_salt(seq.adapter_id))
+            if pf is not None and seq.kv_prefetch is None:
+                seq.kv_prefetch = pf
+        return {"migrated": [s.seq_id for s in victims],
+                "freed_blocks": freed,
+                "keys": [k.hex() for k in keys]}
+
+    def warm_chunks(self, hex_keys: List[str]) -> Dict[str, int]:
+        """kvplane migration, destination side: pull the given chunk
+        keys through the tier walk so hits promote into this replica's
+        fastest tier (connector.warm_keys). Runs on the caller's
+        thread — never the engine loop."""
+        if self.connector is None:
+            return {"warmed": 0, "missed": 0}
+        try:
+            keys = [bytes.fromhex(k) for k in hex_keys]
+        except ValueError:
+            return {"warmed": 0, "missed": len(hex_keys)}
+        # connector.warmed_chunks totals delta-sync into
+        # tpu:kvplane_warmed_chunks_total at scrape time
+        warmed, missed = self.connector.warm_keys(keys)
+        return {"warmed": warmed, "missed": missed}
 
     def _top_up_pipeline(self) -> None:
         """Queue optimistic decode windows behind the in-flight one(s)
@@ -1651,6 +1744,12 @@ class LLMEngine:
             # this lock-free path reports WHILE the engine lock is held
             # across the compile itself. Parsed by signals.EngineLoad.
             "perf": self.eff.perf_block(),
+            # kvplane census: block-state counts + allocation-failure
+            # classification (block_manager.frag_report — plain-int
+            # reads). The migration planner's trigger signal: fragmented
+            # failures rising here while another replica reports free
+            # headroom is exactly the stranded capacity it reclaims.
+            "kv_pool": self.block_mgr.frag_report(),
         }
         if self.connector is not None:
             # tier hit/miss/bytes counters (all in-memory totals — no

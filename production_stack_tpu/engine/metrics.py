@@ -240,6 +240,59 @@ class EngineMetrics:
             "tpu:kvpool_alloc_occupancy",
             "Pool occupancy fraction observed at each allocation "
             "attempt", self.kvpool_occ_hist))
+        # kvplane: fleet KV memory management (migration / defrag /
+        # codecs / pipelined prefetch — docs/kv-tiering.md "Migration,
+        # defrag, and codecs"). Counters inc'd directly on the admin
+        # paths (migrate_out/warm run off the engine loop) or
+        # delta-synced from connector totals at scrape time.
+        self.kvplane_migrations = counter(
+            "tpu:kvplane_migrations_total",
+            "Sequences migrated out (published to the tiers and "
+            "preempted) by /admin/kvplane/migrate_out")
+        self.kvplane_migrated_blocks = counter(
+            "tpu:kvplane_migrated_blocks_total",
+            "KV pool blocks freed by migrate_out victims")
+        self.kvplane_warmed_chunks = counter(
+            "tpu:kvplane_warmed_chunks_total",
+            "Chunks pulled warm by /admin/kvplane/warm (destination "
+            "side of a migration: tier hits promoted into the fastest "
+            "local tier)")
+        self.kvplane_migrated_chunks = counter(
+            "tpu:kvplane_migrated_chunks_total",
+            "Chunks published by the migration source path "
+            "(connector.on_migrate)")
+        self.kvplane_defrag_runs = counter(
+            "tpu:kvplane_defrag_runs_total",
+            "Free-list compactions run between fused windows")
+        self.kvplane_defrag_block_moves = counter(
+            "tpu:kvplane_defrag_block_moves_total",
+            "Free-list positions reordered by defrag")
+        self.kvplane_free_contiguity = gauge(
+            "tpu:kvplane_free_contiguity",
+            "Fraction of adjacent free-block-id pairs (1.0 = one dense "
+            "run; the quantity defrag restores)")
+        self.kvplane_chunk_deadline_hits = counter(
+            "tpu:kvplane_prefetch_chunk_deadline_hits_total",
+            "Prefetch walks cut because one chunk blew its fair-share "
+            "slice of the budget (per-remaining-chunk accounting)")
+        self.kvplane_pipelined_fetches = counter(
+            "tpu:kvplane_pipelined_fetches_total",
+            "Chunk reads issued while an earlier chunk was still "
+            "being consumed (pipelined prefetch overlap)")
+        self._kvplane_codec_bytes_in = Counter(
+            "tpu:kvplane_codec_bytes_in",
+            "Logical chunk-body bytes entering a tier codec's encoder",
+            list(labels) + ["tier", "codec"], registry=self.registry)
+        self._kvplane_codec_bytes_out = Counter(
+            "tpu:kvplane_codec_bytes_out",
+            "Encoded bytes written to the tier (bytes_in/bytes_out = "
+            "the tier's capacity multiplier)",
+            list(labels) + ["tier", "codec"], registry=self.registry)
+        self._kvplane_codec_rejects = Counter(
+            "tpu:kvplane_codec_rejects",
+            "Encoded payloads rejected by the post-encode checksum "
+            "(torn/corrupt values read as misses and evicted)",
+            list(labels) + ["tier", "codec"], registry=self.registry)
         self._labels = labels
         self._kv_last: dict = {}
         self._eff_last: dict = {}
@@ -257,6 +310,10 @@ class EngineMetrics:
         ("dropped_saves", "kv_dropped_saves"),
         ("published_chunks", "kv_published_chunks"),
         ("progress_published_chunks", "kv_progress_published_chunks"),
+        ("prefetch_chunk_deadline_hits", "kvplane_chunk_deadline_hits"),
+        ("pipelined_fetches", "kvplane_pipelined_fetches"),
+        ("migrated_chunks", "kvplane_migrated_chunks"),
+        ("warmed_chunks", "kvplane_warmed_chunks"),
     )
 
     def sync_kv(self, report: dict) -> None:
@@ -286,6 +343,17 @@ class EngineMetrics:
                 st.get("bytes", 0))
             self._kv_tier_items.labels(tier=tier, **self._labels).set(
                 st.get("count", 0))
+        for row in report.get("codecs") or []:
+            tier, codec = row.get("tier", "?"), row.get("codec", "?")
+            for src, metric in (
+                    ("bytes_in", self._kvplane_codec_bytes_in),
+                    ("bytes_out", self._kvplane_codec_bytes_out),
+                    ("rejects", self._kvplane_codec_rejects)):
+                self._delta_inc(
+                    metric.labels(tier=tier, codec=codec,
+                                  **self._labels),
+                    self._kv_last, f"codec:{tier}:{codec}:{src}",
+                    row.get(src, 0))
 
     def _delta_inc(self, metric, last: dict, key: str, total) -> None:
         delta = total - last.get(key, 0)
@@ -336,6 +404,13 @@ class EngineMetrics:
         self._delta_inc(self.kvpool_cache_evictions, self._kvpool_last,
                         "cache_evictions",
                         report.get("cache_evictions", 0))
+        self._delta_inc(self.kvplane_defrag_runs, self._kvpool_last,
+                        "defrag_runs", report.get("defrag_runs", 0))
+        self._delta_inc(self.kvplane_defrag_block_moves,
+                        self._kvpool_last, "defrag_block_moves",
+                        report.get("defrag_block_moves", 0))
+        self.kvplane_free_contiguity.set(
+            report.get("free_contiguity", 1.0))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

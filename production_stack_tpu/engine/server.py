@@ -1159,6 +1159,44 @@ async def metrics(request: web.Request) -> web.Response:
                         content_type="text/plain")
 
 
+async def admin_kvplane_migrate_out(request: web.Request) -> web.Response:
+    """kvplane planner entry point: evict victim sequences to the KV
+    tier store and free their blocks. The victims' chunks are published
+    before preemption, so a re-admission here (or a warm on the
+    destination replica) injects instead of recomputing — a miss at
+    worst, never corruption. Body: {"max_seqs": n, "target_blocks": n}."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    max_seqs = int(body.get("max_seqs", 2))
+    target_blocks = int(body.get("target_blocks", 0))
+    # migrate_out takes the engine lock and then flushes the KV writer
+    # (blocking I/O) — keep it off the event loop
+    result = await asyncio.to_thread(
+        engine.engine.migrate_out, max_seqs=max_seqs,
+        target_blocks=target_blocks)
+    status = 409 if "error" in result else 200
+    return web.json_response(result, status=status)
+
+
+async def admin_kvplane_warm(request: web.Request) -> web.Response:
+    """kvplane planner destination side: pull the named chunk keys
+    through the tier stack so the fastest tier holds them before the
+    migrated traffic lands. Body: {"keys": ["<hex>", ...]}."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    keys = body.get("keys") or []
+    if not isinstance(keys, list):
+        return _error(400, "keys must be a list of hex strings")
+    result = await asyncio.to_thread(engine.engine.warm_chunks, keys)
+    return web.json_response(result)
+
+
 async def tokenize(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
     body = await request.json()
@@ -1262,6 +1300,9 @@ def build_app(engine: AsyncLLMEngine,
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/tokenize", tokenize)
     app.router.add_post("/detokenize", detokenize)
+    app.router.add_post("/admin/kvplane/migrate_out",
+                        admin_kvplane_migrate_out)
+    app.router.add_post("/admin/kvplane/warm", admin_kvplane_warm)
 
     async def on_startup(app):
         # warmup (if any) was done before the loop started
@@ -1416,6 +1457,10 @@ def parse_args(argv=None) -> argparse.Namespace:
                         '"remote_url": "tpukv://cache:8100"}\' '
                         "(the reference engine's --kv-transfer-config "
                         "equivalent; see kvcache/connector.py)")
+    p.add_argument("--no-kvplane-defrag", action="store_true",
+                   help="disable the between-windows free-list defrag "
+                        "pass the engine runs after fragmented "
+                        "allocation failures (docs/kv-tiering.md)")
     return p.parse_args(argv)
 
 
@@ -1446,6 +1491,7 @@ def main(argv=None) -> None:
         kv_len_buckets=tuple(int(x) for x in args.kv_len_buckets.split(","))
         if args.kv_len_buckets else (),
         enable_prefix_caching=args.enable_prefix_caching,
+        kvplane_defrag=not args.no_kvplane_defrag,
         kv_block_size=args.kv_block_size,
         kv_pool_tokens=args.kv_pool_tokens,
         tensor_parallel_size=args.tensor_parallel_size,

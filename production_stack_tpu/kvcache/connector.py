@@ -59,8 +59,24 @@ class KVTransferConfig:
     remote_breaker_cooldown_s: float = 10.0
     # hard wall-clock budget for one prefetch's tier walk: past it the
     # walk stops and the request prefills the rest (bounds TTFT under a
-    # slow tier; the per-op timeouts bound each individual chunk read)
+    # slow tier; the per-op timeouts bound each individual chunk read).
+    # The budget is accounted per remaining chunk (chunk i of n must
+    # land by budget*(i+1)/n), so one stalled chunk is cut at roughly
+    # its fair share instead of consuming the whole wall and starving
+    # every later fetch.
     prefetch_timeout_s: float = 2.0
+    # pipelined prefetch: up to `prefetch_workers` chunk reads in
+    # flight while earlier chunks are still being consumed (tier
+    # latency overlaps tier latency instead of serializing into TTFT).
+    # `prefetch_pipeline: false` falls back to one read at a time —
+    # the fair-share deadline accounting applies either way.
+    prefetch_pipeline: bool = True
+    prefetch_workers: int = 4
+    # per-tier codec choice, e.g. {"disk": "int8", "remote": "int4"}
+    # (kvcache/codec.py: raw | int8 | int4 | fp8). Unmapped tiers stay
+    # raw byte-exact. Encoded payloads are checksummed POST-encode, so
+    # torn values still read as misses, never as dequantized garbage.
+    tier_codecs: Optional[dict] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "KVTransferConfig":
@@ -139,6 +155,25 @@ class KVConnector:
                              f"{kv_dtype!r} (supported: {list(dtype_map)})")
         self._np_dtype = dtype_map[kv_dtype]
         self._chunk_bytes = int(np.prod(shape)) * self._np_dtype.itemsize
+        if cfg.tier_codecs and store is None:
+            # wrap each configured tier with its codec (kvplane): the
+            # wrap happens on the tiers the connector itself built; an
+            # injected test store is used as-is
+            from production_stack_tpu.kvcache.codec import \
+                apply_tier_codecs
+            self.store = apply_tier_codecs(
+                self.store, dict(cfg.tier_codecs),
+                np_dtype=self._np_dtype,
+                head_dim=model_cfg.head_dim_,
+                chunk_body_bytes=2 * self._chunk_bytes)
+        # shared pool for pipelined chunk reads (consumer role only)
+        self._fetcher = None
+        if cfg.is_consumer:
+            from production_stack_tpu.kvcache.pipeline import \
+                PipelinedFetcher
+            self._fetcher = PipelinedFetcher(
+                workers=cfg.prefetch_workers if cfg.prefetch_pipeline
+                else 1)
         # writer thread: (keys, [(k_dev, v_dev)]) tuples; bounded so a slow
         # remote tier backpressures into drops, never into the engine loop
         self._save_q: "queue.Queue" = queue.Queue(maxsize=64)
@@ -166,9 +201,20 @@ class KVConnector:
         self.progress_published_chunks = 0   # ...of which mid-prefill
         self.rejected_chunks = 0    # size/checksum-invalid values
         self.prefetch_deadline_hits = 0
+        # walks cut because ONE chunk blew its fair-share slice (the
+        # per-remaining-chunk deadline accounting)
+        self.prefetch_chunk_deadline_hits = 0
+        # chunk reads issued while an earlier chunk was still being
+        # consumed (pipelined overlap evidence)
+        self.pipelined_fetches = 0
         self.dropped_saves = 0
         # chunk hits by the tier that served them (cpu / disk / remote)
         self.tier_hits: "dict[str, int]" = {}
+        # kvplane migration accounting: chunks published by migrate_out
+        # on this (source) replica / chunks pulled warm by the admin
+        # warm endpoint on this (destination) replica
+        self.migrated_chunks = 0
+        self.warmed_chunks = 0
         # phase-latency sink (tracing.PhaseHistograms, ("phase",) keyed)
         # — the owning engine attaches its metrics.engine_phases so
         # kv_prefetch / kv_publish durations land next to the request
@@ -198,19 +244,22 @@ class KVConnector:
         chunks: List[Tuple[np.ndarray, np.ndarray]] = []
         hit_keys: List[bytes] = []
         foreign: List[bool] = []
-        # hard budget on the whole walk: each chunk read is already
-        # bounded by the store's own timeouts, but a *slow-not-dead*
-        # tier must not stack N of those onto one request's TTFT
+        # hard budget on the whole walk, accounted per remaining chunk
+        # and pipelined across `prefetch_workers` concurrent tier reads
+        # (kvcache/pipeline.py): a slow tier costs bounded overlap, not
+        # serialized TTFT, and one stalled chunk can no longer consume
+        # the budget every later chunk was owed
         t0 = time.monotonic()
-        deadline = t0 + self.cfg.prefetch_timeout_s
-        for key in keys:
-            if time.monotonic() >= deadline:
-                self.prefetch_deadline_hits += 1
-                break
-            val, tier = self.store.get_with_tier(key)
-            if val is None:
-                self.chunk_misses += 1
-                break
+        fetched, walk = self._fetcher.fetch_walk(
+            keys, self.store.get_with_tier,
+            self.cfg.prefetch_timeout_s)
+        if walk.deadline_hits or walk.chunk_deadline_hits:
+            self.prefetch_deadline_hits += 1
+            self.prefetch_chunk_deadline_hits += walk.chunk_deadline_hits
+        elif len(fetched) < len(keys):
+            self.chunk_misses += 1
+        self.pipelined_fetches += walk.pipelined_fetches
+        for key, val, tier in fetched:
             kv = self._deserialize(key, val)
             if kv is None:
                 break
@@ -278,6 +327,32 @@ class KVConnector:
             return
         self._publish(seq, (seq.prompt_tokens + seq.output_tokens)[:-1],
                       getattr(seq, "slot", -1), salt)
+
+    def on_migrate(self, seq, salt: str = "") -> List[bytes]:
+        """Publish a LIVE sequence's computed full chunks for kvplane
+        migration and return every key of that computed range (already
+        published ones included — the destination warms them all).
+
+        Mid-prefill victims publish only their prefilled prompt
+        prefix; decoding victims publish like ``on_finish`` (the last
+        sampled token's KV position was never computed). Runs on the
+        engine loop under the engine lock, same as
+        ``on_prefill_progress`` — the write-through itself happens on
+        the writer thread, and ``flush()`` afterwards makes it tier-
+        visible before the planner re-homes routing."""
+        if not self.cfg.is_producer:
+            return []
+        if seq.num_prefilled < len(seq.prompt_tokens):
+            tokens = seq.prompt_tokens[:seq.num_prefilled]
+        else:
+            tokens = (seq.prompt_tokens + seq.output_tokens)[:-1]
+        n_chunks = self.hasher.num_full_chunks(len(tokens))
+        if n_chunks == 0:
+            return []
+        keys = self.hasher.chunk_keys(tokens, salt=salt)[:n_chunks]
+        self._publish(seq, tokens, getattr(seq, "slot", -1), salt)
+        self.migrated_chunks += len(keys)
+        return keys
 
     def _publish(self, seq, tokens, slot: int, salt: str,
                  progress: bool = False) -> None:
@@ -412,8 +487,33 @@ class KVConnector:
                                                         TieredStore)
         stores = self.store.tiers if isinstance(self.store, TieredStore) \
             else [self.store]
+        # a codec-wrapped tier hides the RemoteStore one level down
+        stores = [getattr(s, "inner", s) for s in stores]
         return any(s.breaker_open() for s in stores
                    if isinstance(s, RemoteStore))
+
+    def codec_stats(self) -> list:
+        """Per-tier codec accounting ({tier, codec, bytes_in/out,
+        rejects}) — empty when no tier_codecs are configured."""
+        from production_stack_tpu.kvcache.codec import codec_stats_of
+        return codec_stats_of(self.store)
+
+    def warm_keys(self, keys: List[bytes]) -> Tuple[int, int]:
+        """Pull raw chunk values for ``keys`` through the tier walk so
+        hits promote into this replica's fastest tier (the kvplane
+        migration destination path: the planner hands over the keys the
+        source's migrate_out published). No deserialization — the
+        promotion side effect IS the work. Returns (warmed, missed)."""
+        warmed = missed = 0
+        for key in keys:
+            val, _tier = self.store.get_with_tier(key)
+            if val is None:
+                missed += 1
+            else:
+                warmed += 1
+                self.warmed_chunks += 1
+                self._mark_seen(key)
+        return warmed, missed
 
     def tier_stats(self) -> dict:
         """{tier_name: {bytes, count, ...}} for the occupancy gauges."""
@@ -444,6 +544,12 @@ class KVConnector:
             "rejected_chunks": self.rejected_chunks,
             "dropped_saves": self.dropped_saves,
             "prefetch_deadline_hits": self.prefetch_deadline_hits,
+            "prefetch_chunk_deadline_hits":
+                self.prefetch_chunk_deadline_hits,
+            "pipelined_fetches": self.pipelined_fetches,
+            "migrated_chunks": self.migrated_chunks,
+            "warmed_chunks": self.warmed_chunks,
+            "codecs": self.codec_stats(),
             "tier_hits": dict(self.tier_hits),
             "remote_breaker_open": self.remote_breaker_open(),
             # remote occupancy lives on the cache server's own surface;
@@ -466,4 +572,6 @@ class KVConnector:
         self.flush(timeout=5.0)
         self._stop.set()
         self._writer.join(timeout=5.0)
+        if self._fetcher is not None:
+            self._fetcher.close()
         self.store.close()

@@ -1,0 +1,23 @@
+"""kvplane: fleet-wide KV memory management (ISSUE 16 / ROADMAP 3).
+
+The control-plane half of the KV memory plane: a planner process that
+watches every replica's ``/load`` kv_pool census, detects the
+fragmentation admission-failure regime (``tpu:kvpool_alloc_failures_
+total{reason="fragmented"}`` rising on one replica while the fleet
+still holds free HBM), and erases it by live-migrating victim
+sequences' KV replica-to-replica over the existing tier-transfer path:
+
+    source  POST /admin/kvplane/migrate_out   (publish + preempt)
+    dest    POST /admin/kvplane/warm          (tier promotion)
+    router  POST /admin/kvplane/rehome        (locality follows bytes)
+
+The data-plane halves live elsewhere: per-tier codecs in
+``kvcache/codec.py``, the pipelined prefetch walk in
+``kvcache/pipeline.py``, intra-replica free-list defrag in
+``engine/block_manager.py``. Run the planner with
+``python -m production_stack_tpu.kvplane`` (docs/kv-tiering.md).
+"""
+
+from production_stack_tpu.kvplane.planner import (Decision,  # noqa: F401
+                                                  MigrationPlanner,
+                                                  ReplicaState)

@@ -1,0 +1,4 @@
+from production_stack_tpu.kvplane.app import main
+
+if __name__ == "__main__":
+    main()

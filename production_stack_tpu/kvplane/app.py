@@ -1,0 +1,424 @@
+"""kvplane planner application: poll loop + HTTP surface + CLI.
+
+One aiohttp Application hosting the migration poll loop; endpoint
+surface (docs/kv-tiering.md "Migration, defrag, and codecs"):
+
+- ``GET /health``   — planner liveness + per-replica reachability
+- ``GET /status``   — last polled census per replica, recent moves,
+                      decision tallies
+- ``GET /metrics``  — the ``tpu:kvplane_planner_*`` families
+
+Each poll pass reads every replica's ``GET /load`` kv_pool census,
+feeds it to ``planner.MigrationPlanner``, and executes any decisions:
+
+1. ``POST {src}/admin/kvplane/migrate_out`` — the source publishes the
+   victims' computed KV to the shared tier and frees their blocks.
+2. ``POST {dst}/admin/kvplane/warm`` — the destination pulls the
+   returned chunk keys through its tier stack (fastest tier warm).
+3. ``POST {router}/admin/kvplane/rehome`` — the router's decode
+   locality ring follows the bytes (whole-replica form: the engine's
+   chunk keys and the router's prompt digests are different hash
+   spaces, so the planner rehomes the source's evidence wholesale).
+
+Every step is at-most-once and failure-isolated: a dead destination
+leaves the chunks published (re-admission on the source re-prefetches
+them — a miss costs recompute, never corruption), and a dead router
+only costs locality-score freshness.
+
+Closed loop: ``python -m production_stack_tpu.loadgen kvmigrate``.
+"""
+
+import argparse
+import asyncio
+import collections
+import signal
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+from aiohttp import web
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+from production_stack_tpu.kvplane.planner import (Decision,
+                                                  MigrationPlanner,
+                                                  ReplicaState)
+from production_stack_tpu.utils import (init_logger,
+                                        parse_comma_separated,
+                                        set_ulimit)
+from production_stack_tpu.version import __version__
+
+logger = init_logger(__name__)
+
+
+class PlannerMetrics:
+    """``tpu:kvplane_planner_*`` exposition, refreshed from the
+    poller's plain-int counters at scrape time (the obsplane
+    delta-free idiom — nothing prometheus-shaped near the poll
+    loop)."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.polls = Gauge(
+            "tpu:kvplane_planner_polls_total",
+            "Cumulative census poll passes across the replica fleet",
+            registry=self.registry)
+        self.poll_errors = Gauge(
+            "tpu:kvplane_planner_poll_errors_total",
+            "Cumulative failed replica /load polls (timeout, refused, "
+            "no kv_pool block)", registry=self.registry)
+        self.decisions = Gauge(
+            "tpu:kvplane_planner_decisions_total",
+            "Cumulative planner decisions by action (migrate / "
+            "hold_cooldown / skip_no_dst)",
+            ["action"], registry=self.registry)
+        self.moves = Gauge(
+            "tpu:kvplane_planner_moves_total",
+            "Cumulative executed migrations (migrate_out + warm "
+            "hand-offs that freed at least one block)",
+            registry=self.registry)
+        self.moved_blocks = Gauge(
+            "tpu:kvplane_planner_moved_blocks_total",
+            "Cumulative KV blocks freed on migration sources",
+            registry=self.registry)
+        self.warmed = Gauge(
+            "tpu:kvplane_planner_warmed_chunks_total",
+            "Cumulative chunks warmed on migration destinations",
+            registry=self.registry)
+        self.move_errors = Gauge(
+            "tpu:kvplane_planner_move_errors_total",
+            "Cumulative migrations that failed mid-execution "
+            "(source refused, destination warm failed)",
+            registry=self.registry)
+        self.replica_blocks = Gauge(
+            "tpu:kvplane_replica_blocks",
+            "Last-polled kv_pool census per replica, by state "
+            "(free / active / cached)",
+            ["replica", "state"], registry=self.registry)
+
+    def refresh(self, poller: "KVPlanePoller") -> None:
+        self.polls.set(poller.polls)
+        self.poll_errors.set(poller.poll_errors)
+        for action, n in poller.planner.decisions.items():
+            self.decisions.labels(action=action).set(n)
+        self.moves.set(poller.moves)
+        self.moved_blocks.set(poller.moved_blocks)
+        self.warmed.set(poller.warmed_chunks)
+        self.move_errors.set(poller.move_errors)
+        for url, state in poller.last_census.items():
+            for field in ("free", "active", "cached"):
+                self.replica_blocks.labels(
+                    replica=url, state=field).set(
+                    getattr(state, field))
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class KVPlanePoller:
+    """The poll-decide-execute loop over the replica fleet."""
+
+    def __init__(self, replicas: List[str],
+                 router: Optional[str] = None,
+                 poll_interval_s: float = 1.0,
+                 timeout_s: float = 3.0,
+                 planner: Optional[MigrationPlanner] = None,
+                 dry_run: bool = False):
+        self.replicas = [u.rstrip("/") for u in replicas]
+        self.router = router.rstrip("/") if router else None
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+        self.planner = planner or MigrationPlanner()
+        self.dry_run = dry_run
+        self.polls = 0
+        self.poll_errors = 0
+        self.moves = 0
+        self.moved_blocks = 0
+        self.warmed_chunks = 0
+        self.move_errors = 0
+        self.last_census: Dict[str, ReplicaState] = {}
+        self.unreachable: Dict[str, str] = {}
+        self.recent_moves: "collections.deque" = \
+            collections.deque(maxlen=64)
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=self.timeout_s))
+        self._task = asyncio.create_task(self._loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._session is not None:
+            await self._session.close()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - loop must survive
+                logger.exception("kvplane poll pass failed")
+            await asyncio.sleep(self.poll_interval_s)
+
+    async def poll_once(self) -> List[Decision]:
+        """One pass: census every replica, plan, execute. Public so
+        tests (and the kvmigrate rig's assertions) can step the loop
+        deterministically."""
+        self.polls += 1
+        states: List[ReplicaState] = []
+        for url in self.replicas:
+            state = await self._poll_replica(url)
+            if state is None:
+                continue
+            states.append(state)
+            self.last_census[url] = state
+            self.unreachable.pop(url, None)
+        decisions = self.planner.observe(states, now=time.monotonic())
+        for d in decisions:
+            await self._execute(d)
+        return decisions
+
+    async def _poll_replica(self, url: str) -> Optional[ReplicaState]:
+        try:
+            async with self._session.get(url + "/load") as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"/load -> {resp.status}")
+                report = await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - census best-effort
+            self.poll_errors += 1
+            self.unreachable[url] = str(exc)
+            return None
+        state = ReplicaState.from_load(url, report)
+        if state is None:
+            # reachable but no kv_pool census: count it so a fleet of
+            # engines predating the census shows up on /metrics
+            # instead of silently planning over nothing
+            self.poll_errors += 1
+            self.unreachable[url] = "no kv_pool census on /load"
+        return state
+
+    async def _execute(self, d: Decision) -> None:
+        record = {"at_unix": round(time.time(), 3), "src": d.src,
+                  "dst": d.dst, "target_blocks": d.target_blocks,
+                  "freed_blocks": 0, "warmed": 0, "rehomed": None,
+                  "dry_run": self.dry_run, "error": None}
+        self.recent_moves.append(record)
+        if self.dry_run:
+            return
+        try:
+            async with self._session.post(
+                    d.src + "/admin/kvplane/migrate_out",
+                    json={"max_seqs": self.planner.max_seqs,
+                          "target_blocks": d.target_blocks}) as resp:
+                body = await resp.json()
+                if resp.status != 200:
+                    raise RuntimeError(
+                        f"migrate_out -> {resp.status}: {body}")
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - move best-effort
+            self.move_errors += 1
+            record["error"] = f"migrate_out: {exc}"
+            logger.warning("kvplane: migrate_out on %s failed: %s",
+                           d.src, exc)
+            return
+        freed = int(body.get("freed_blocks", 0))
+        keys = body.get("keys") or []
+        record["freed_blocks"] = freed
+        if not freed:
+            return
+        self.moves += 1
+        self.moved_blocks += freed
+        try:
+            async with self._session.post(
+                    d.dst + "/admin/kvplane/warm",
+                    json={"keys": keys}) as resp:
+                warm = await resp.json()
+            record["warmed"] = int(warm.get("warmed", 0))
+            self.warmed_chunks += record["warmed"]
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:  # noqa: BLE001 - warm best-effort
+            # chunks stay published in the shared tier: the migrated
+            # traffic pays a remote fetch instead of a local hit
+            self.move_errors += 1
+            record["error"] = f"warm: {exc}"
+            logger.warning("kvplane: warm on %s failed: %s",
+                           d.dst, exc)
+        if self.router is not None:
+            try:
+                async with self._session.post(
+                        self.router + "/admin/kvplane/rehome",
+                        json={"from": d.src, "to": d.dst}) as resp:
+                    rh = await resp.json()
+                record["rehomed"] = rh.get("rehomed")
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # noqa: BLE001 - locality only
+                record["error"] = (record["error"] or "") + \
+                    f" rehome: {exc}"
+                logger.warning("kvplane: rehome via %s failed: %s",
+                               self.router, exc)
+        logger.info("kvplane: migrated %d blocks %s -> %s "
+                    "(warmed %d chunks, rehomed %s)",
+                    freed, d.src, d.dst, record["warmed"],
+                    record["rehomed"])
+
+    def status(self) -> dict:
+        return {
+            "version": __version__,
+            "replicas": {
+                url: ({"num_blocks": s.num_blocks, "free": s.free,
+                       "active": s.active, "cached": s.cached,
+                       "alloc_failures_fragmented":
+                           s.alloc_failures_fragmented,
+                       "alloc_failures_exhausted":
+                           s.alloc_failures_exhausted,
+                       "free_contiguity": s.free_contiguity}
+                      if (s := self.last_census.get(url)) else None)
+                for url in self.replicas},
+            "unreachable": dict(self.unreachable),
+            "router": self.router,
+            "dry_run": self.dry_run,
+            "polls": self.polls,
+            "poll_errors": self.poll_errors,
+            "decisions": dict(self.planner.decisions),
+            "moves": self.moves,
+            "moved_blocks": self.moved_blocks,
+            "warmed_chunks": self.warmed_chunks,
+            "move_errors": self.move_errors,
+            "recent_moves": list(self.recent_moves),
+        }
+
+
+async def health(request: web.Request) -> web.Response:
+    poller = request.app["state"]["poller"]
+    body = {"status": "ok", "polls": poller.polls,
+            "replicas": len(poller.replicas),
+            "unreachable": sorted(poller.unreachable)}
+    return web.json_response(body)
+
+
+async def status(request: web.Request) -> web.Response:
+    return web.json_response(request.app["state"]["poller"].status())
+
+
+async def metrics(request: web.Request) -> web.Response:
+    state = request.app["state"]
+    state["metrics"].refresh(state["poller"])
+    return web.Response(body=state["metrics"].render(),
+                        content_type="text/plain")
+
+
+def build_app(args: argparse.Namespace) -> web.Application:
+    planner = MigrationPlanner(
+        migrate_fraction=args.migrate_fraction,
+        dst_min_free=args.dst_min_free_blocks,
+        cooldown_s=args.move_cooldown,
+        max_seqs=args.max_migrate_seqs)
+    poller = KVPlanePoller(
+        replicas=parse_comma_separated(args.replicas),
+        router=args.router or None,
+        poll_interval_s=args.poll_interval,
+        timeout_s=args.poll_timeout,
+        planner=planner,
+        dry_run=args.dry_run)
+    app = web.Application()
+    app["state"] = {"poller": poller, "metrics": PlannerMetrics()}
+    app.router.add_get("/health", health)
+    app.router.add_get("/status", status)
+    app.router.add_get("/metrics", metrics)
+
+    async def on_startup(app):
+        await poller.start()
+
+    async def on_cleanup(app):
+        await poller.close()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+    return app
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        "pstpu-kvplane",
+        description="fleet KV memory planner: live migration/defrag "
+                    "control plane over the replicas' kv_pool census")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8300)
+    p.add_argument("--replicas", default="",
+                   help="comma-separated engine base URLs to manage "
+                        "(/load census + /admin/kvplane/* surface)")
+    p.add_argument("--router", default="",
+                   help="router base URL whose decode-locality ring is "
+                        "rehomed after each migration (optional; the "
+                        "hand-off is best-effort)")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="seconds between census poll passes")
+    p.add_argument("--poll-timeout", type=float, default=3.0,
+                   help="per-request timeout for census polls and "
+                        "migration/warm/rehome calls")
+    p.add_argument("--migrate-fraction", type=float, default=0.25,
+                   help="fraction of a fragmented source pool to shed "
+                        "per migration (the census does not expose "
+                        "per-request block demand)")
+    p.add_argument("--dst-min-free-blocks", type=int, default=8,
+                   help="free-block headroom a destination must keep "
+                        "AFTER absorbing a migration (a squeezed "
+                        "destination would become the next source)")
+    p.add_argument("--move-cooldown", type=float, default=5.0,
+                   help="seconds a source is immune after a migration "
+                        "(one poll glitch must not thrash a replica "
+                        "with back-to-back preemptions)")
+    p.add_argument("--max-migrate-seqs", type=int, default=4,
+                   help="victim-sequence cap per migrate_out call")
+    p.add_argument("--dry-run", action="store_true",
+                   help="plan and log decisions without executing "
+                        "them (census polling still live)")
+    args = p.parse_args(argv)
+    if not args.replicas:
+        p.error("need --replicas to manage")
+    return args
+
+
+def main(argv=None) -> None:
+    args = parse_args(argv)
+    set_ulimit()
+    app = build_app(args)
+
+    async def _serve():
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, args.host, args.port)
+        await site.start()
+        logger.info("kvplane planner listening on %s:%d (%d replicas, "
+                    "poll every %.1fs%s)",
+                    args.host, args.port,
+                    len(app["state"]["poller"].replicas),
+                    args.poll_interval,
+                    ", DRY RUN" if args.dry_run else "")
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await runner.cleanup()
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

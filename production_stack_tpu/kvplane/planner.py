@@ -1,0 +1,159 @@
+"""Migration decision logic for the kvplane planner (pure, no I/O).
+
+A replica is a migration SOURCE when its fragmented allocation-failure
+counter rose since the previous poll — the BlockManager's signal that
+free capacity exists fleet-wide but this pool cannot seat a request —
+and a DESTINATION when it can absorb the source's shed blocks and
+still keep ``dst_min_free`` of its own headroom. The planner never
+migrates on occupancy alone: a full pool serving every admission is
+healthy; a half-empty pool refusing admissions is the pathology.
+
+Decisions are rate-limited per source (``cooldown_s``) so one poll
+glitch cannot thrash a replica with back-to-back preemptions, and each
+pass emits at most one migration per source. All clock reads are
+injected (``now``) so tests drive time explicitly.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+
+@dataclass
+class ReplicaState:
+    """One replica's kv_pool census as polled from ``GET /load``."""
+
+    url: str
+    num_blocks: int = 0
+    free: int = 0
+    active: int = 0
+    cached: int = 0
+    alloc_failures_fragmented: int = 0
+    alloc_failures_exhausted: int = 0
+    free_contiguity: float = 1.0
+
+    @classmethod
+    def from_load(cls, url: str, report: dict) -> Optional["ReplicaState"]:
+        pool = report.get("kv_pool")
+        if not isinstance(pool, dict):
+            return None
+        return cls(
+            url=url,
+            num_blocks=int(pool.get("num_blocks", 0)),
+            free=int(pool.get("free", 0)),
+            active=int(pool.get("active", 0)),
+            cached=int(pool.get("cached", 0)),
+            alloc_failures_fragmented=int(
+                pool.get("alloc_failures_fragmented", 0)),
+            alloc_failures_exhausted=int(
+                pool.get("alloc_failures_exhausted", 0)),
+            free_contiguity=float(pool.get("free_contiguity", 1.0)))
+
+    @property
+    def allocatable(self) -> int:
+        return self.free + self.cached
+
+
+@dataclass
+class Decision:
+    """One planned migration: shed ``target_blocks`` from ``src`` and
+    warm the published chunks on ``dst``."""
+
+    src: str
+    dst: str
+    target_blocks: int
+    reason: str = "fragmented"
+
+
+@dataclass
+class _SourceTrack:
+    last_failures: int = -1
+    last_move_at: float = field(default=float("-inf"))
+
+
+class MigrationPlanner:
+    """Stateful fragmented-delta watcher -> migration decisions.
+
+    ``migrate_fraction`` sizes each move relative to the source pool
+    (the census does not expose per-request block demand, so the
+    planner sheds a pool fraction large enough to seat any admissible
+    request rather than chasing an unknown exact need).
+    """
+
+    def __init__(self, migrate_fraction: float = 0.25,
+                 dst_min_free: int = 8,
+                 cooldown_s: float = 5.0,
+                 max_seqs: int = 4):
+        self.migrate_fraction = min(1.0, max(0.01, migrate_fraction))
+        self.dst_min_free = max(0, dst_min_free)
+        self.cooldown_s = cooldown_s
+        self.max_seqs = max(1, max_seqs)
+        self._tracks: Dict[str, _SourceTrack] = {}
+        # decision tally by action, served on /status and /metrics
+        self.decisions: Dict[str, int] = {
+            "migrate": 0, "hold_cooldown": 0, "skip_no_dst": 0}
+
+    def _track(self, url: str) -> _SourceTrack:
+        t = self._tracks.get(url)
+        if t is None:
+            t = self._tracks[url] = _SourceTrack()
+        return t
+
+    def observe(self, states: List[ReplicaState],
+                now: float) -> List[Decision]:
+        """One poll pass -> migration decisions (possibly empty).
+
+        The first observation of a replica only baselines its failure
+        counter (a planner restart must not re-migrate for failures
+        that predate it)."""
+        out: List[Decision] = []
+        by_url = {s.url: s for s in states}
+        # drop tracks for replicas that left the fleet
+        for url in list(self._tracks):
+            if url not in by_url:
+                del self._tracks[url]
+        for state in states:
+            track = self._track(state.url)
+            prev = track.last_failures
+            track.last_failures = state.alloc_failures_fragmented
+            if prev < 0 or state.alloc_failures_fragmented <= prev:
+                continue                     # baseline or no new pain
+            if now - track.last_move_at < self.cooldown_s:
+                self.decisions["hold_cooldown"] += 1
+                continue
+            target = max(1, int(state.num_blocks *
+                                self.migrate_fraction))
+            target = min(target, state.active)
+            dst = self._pick_destination(state, states, target)
+            if dst is None or target <= 0:
+                self.decisions["skip_no_dst"] += 1
+                logger.warning(
+                    "kvplane: %s fragmented (+%d failures) but no "
+                    "destination can absorb %d blocks",
+                    state.url,
+                    state.alloc_failures_fragmented - prev, target)
+                continue
+            track.last_move_at = now
+            self.decisions["migrate"] += 1
+            out.append(Decision(src=state.url, dst=dst.url,
+                                target_blocks=target))
+        return out
+
+    def _pick_destination(self, src: ReplicaState,
+                          states: List[ReplicaState],
+                          target: int) -> Optional[ReplicaState]:
+        """Most-free replica that can hold the shed blocks and keep
+        its own admission headroom (a destination squeezed to zero
+        free would become the next migration source)."""
+        best = None
+        for s in states:
+            if s.url == src.url:
+                continue
+            if s.free < target + self.dst_min_free:
+                continue
+            if best is None or s.free > best.free:
+                best = s
+        return best

@@ -78,6 +78,16 @@ incident — launch N peered routers + M engines + the obsplane fleet
            any spurious capture, miss, or wrong attribution
            (INCIDENT_*.json; --overhead-guard runs the r7 A/B with
            and without the obsplane scraping the serving pair)
+kvmigrate — the kvplane closed loop: a fragmentation storm (one
+           replica's pool injected into the fragmented-admission
+           regime behind the router) run with and without the kvplane
+           planner — migration ON must collapse the engine-census
+           fragmented-failure rate to ~0 in the second half at
+           constant aggregate blocks, migration OFF must keep failing
+           (anti-vacuity) — plus the kvshare storm re-run through the
+           raw vs int4 tier codecs: >=2x logical/physical capacity at
+           equal bytes with hit TTFT within tolerance
+           (KVMIGRATE_*.json)
 
 Reproduction one-liners live in docs/benchmarks.md and BASELINE.md.
 """
@@ -105,6 +115,8 @@ from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
 from production_stack_tpu.loadgen.incident import (
     SCENARIO_NAMES as INCIDENT_SCENARIOS, incident_violations,
     run_incident)
+from production_stack_tpu.loadgen.kvmigrate import (kvmigrate_violations,
+                                                    run_kvmigrate)
 from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
 from production_stack_tpu.loadgen.multirouter import (
@@ -486,6 +498,41 @@ def cmd_kvshare(args) -> int:
               f"{d['cached']['foreign_share']:.0%}), follow-up TTFT "
               f"{ttft['cached']:.0f}ms vs {ttft['recompute']:.0f}ms "
               f"recompute ({ttft['improvement_pct']:.0f}% faster)")
+    return 1 if violations else 0
+
+
+def cmd_kvmigrate(args) -> int:
+    record = asyncio.run(run_kvmigrate(
+        storm_duration_s=args.storm_duration,
+        storm_workers=args.storm_workers,
+        poll_interval_s=args.poll_interval,
+        codec=args.codec, sessions=args.sessions, rounds=args.rounds,
+        seed=args.seed, platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"KVMIGRATE_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = kvmigrate_violations(
+        record, max_on_failure_rate=args.max_on_failure_rate,
+        min_off_failure_rate=args.min_off_failure_rate,
+        min_capacity_ratio=args.min_capacity_ratio,
+        ttft_tolerance=args.ttft_tolerance)
+    for v in violations:
+        print(f"KVMIGRATE VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        on2 = d["storm"]["on"]["halves"][1]
+        off2 = d["storm"]["off"]["halves"][1]
+        ratios = d["codec"]["capacity_ratio"]
+        print(f"kvmigrate PASSED: migration erased the fragmented "
+              f"regime ({on2['failure_rate']:.1%} second-half failure "
+              f"rate vs {off2['failure_rate']:.1%} with migration "
+              f"OFF, {d['storm']['on']['planner']['moves']} moves, "
+              f"aggregate blocks constant); codec "
+              f"{d['codec']['name']} capacity "
+              f"{ratios[d['codec']['name']]:.2f}x vs raw "
+              f"{ratios['raw']:.2f}x at equal logical bytes")
     return 1 if violations else 0
 
 
@@ -1163,6 +1210,52 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write KVSHARE_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_kvshare)
+
+    sp = sub.add_parser(
+        "kvmigrate",
+        help="kvplane closed loop: fragmentation storm with/without "
+             "the migration planner (engine-census failure rate must "
+             "collapse only when migration is ON, at constant "
+             "aggregate blocks) + raw-vs-int4 codec capacity re-run "
+             "of the kvshare storm")
+    sp.add_argument("--storm-duration", type=parse_duration,
+                    default=8.0,
+                    help="per-phase storm length; gates read the "
+                         "second half, so the planner gets the first "
+                         "half to react")
+    sp.add_argument("--storm-workers", type=int, default=4,
+                    help="closed-loop chat workers through the router")
+    sp.add_argument("--poll-interval", type=float, default=0.3,
+                    help="planner census poll interval (s)")
+    sp.add_argument("--codec", default="int4",
+                    choices=["int8", "int4", "fp8"],
+                    help="compressed tier codec for the capacity "
+                         "phase (the >=2x gate wants int4)")
+    sp.add_argument("--sessions", type=int, default=4,
+                    help="codec phase: concurrent QA sessions")
+    sp.add_argument("--rounds", type=int, default=6,
+                    help="codec phase: rounds per session (round 1 "
+                         "is cold)")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--max-on-failure-rate", type=float, default=0.02,
+                    help="migration ON second-half fragmented-failure "
+                         "rate ceiling")
+    sp.add_argument("--min-off-failure-rate", type=float, default=0.2,
+                    help="anti-vacuity: migration OFF second-half "
+                         "failure rate floor")
+    sp.add_argument("--min-capacity-ratio", type=float, default=2.0,
+                    help="compressed tier logical/physical bytes "
+                         "floor")
+    sp.add_argument("--ttft-tolerance", type=float, default=0.25,
+                    help="compressed hit TTFT may exceed raw by at "
+                         "most this fraction")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write KVMIGRATE_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_kvmigrate)
 
     sp = sub.add_parser("disagg",
                         help="P/D split (prefill pool + decode pool + "

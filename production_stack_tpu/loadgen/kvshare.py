@@ -190,7 +190,13 @@ async def _run_phase(*, cached: bool, engines: int, engine: str,
                      round_chars: int, num_tokens: int,
                      prefill_ms_per_char: float, kv_chunk_chars: int,
                      routing: str, seed: int, platform: str,
-                     log_dir: str, startup_timeout_s: float) -> Dict:
+                     log_dir: str, startup_timeout_s: float,
+                     kv_codec: Optional[str] = None) -> Dict:
+    """``kv_codec`` (fake engines only) publishes deterministic
+    pseudo-KV chunk bodies through the named REAL tier codec
+    (kvcache/codec.py) instead of text bytes — the kvmigrate codec
+    phase's lever for measuring tier-capacity ratios against the cache
+    server's physical footprint."""
     procs: List[Proc] = []
     try:
         cache_url = None
@@ -206,6 +212,8 @@ async def _run_phase(*, cached: bool, engines: int, engine: str,
             if cached:
                 extra += ["--kv-remote-url", cache_url,
                           "--kv-chunk-chars", str(kv_chunk_chars)]
+                if kv_codec:
+                    extra += ["--kv-codec", kv_codec]
         else:
             extra = []
             if cached:
@@ -259,6 +267,24 @@ async def _run_phase(*, cached: bool, engines: int, engine: str,
                                   num_tokens=num_tokens, seed=seed)
         elapsed = time.monotonic() - t0
         kv_after = await _scrape_kv([e.url for e in engine_procs])
+        cache_stats = None
+        if cached:
+            # physical footprint on the shared tier (the denominator
+            # of the codec capacity ratio): the cache server's STATS
+            # op counts stored — i.e. ENCODED — bytes
+            def _cache_stats():
+                from production_stack_tpu.kvcache.store import \
+                    RemoteStore
+                store = RemoteStore(cache_url, connect_timeout=2.0,
+                                    io_timeout=5.0)
+                try:
+                    return store.stats()
+                finally:
+                    store.close()
+            try:
+                cache_stats = await asyncio.to_thread(_cache_stats)
+            except (OSError, ConnectionError):
+                cache_stats = None
         kv = {
             url: {key: stats.get(key, 0)
                   - kv_before.get(url, {}).get(key, 0)
@@ -295,7 +321,9 @@ async def _run_phase(*, cached: bool, engines: int, engine: str,
         "query_tokens": total_q,
         "hit_tokens": total_h,
         "foreign_hit_tokens": total_f,
+        "bytes_saved": sum(e.get("bytes_saved", 0) for e in kv.values()),
         "per_engine_kv": kv,
+        "cache_server": cache_stats,
     }
 
 

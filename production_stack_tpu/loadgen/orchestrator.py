@@ -154,6 +154,22 @@ def launch_obsplane(router_urls: List[str], engine_urls: List[str],
                   log_dir)
 
 
+def launch_kvplane(replica_urls: List[str], port: int, *,
+                   log_dir: str, router_url: Optional[str] = None,
+                   extra_args: Optional[List[str]] = None) -> Proc:
+    """The fleet KV memory planner (kvplane/app.py): polls every
+    replica's /load kv_pool census and erases fragmented-admission
+    failures by migrating KV replica-to-replica."""
+    cmd = [sys.executable, "-m", "production_stack_tpu.kvplane",
+           "--host", "127.0.0.1", "--port", str(port),
+           "--replicas", ",".join(replica_urls)]
+    if router_url:
+        cmd += ["--router", router_url]
+    cmd += extra_args or []
+    return _spawn(f"kvplane-{port}", cmd, f"http://127.0.0.1:{port}",
+                  log_dir)
+
+
 async def wait_healthy(url: str, timeout_s: float,
                        require_endpoints: int = 0) -> None:
     """Poll /health until 200 (and, for the router, until it can route

@@ -172,6 +172,51 @@ async def admin_drain(request: web.Request) -> web.Response:
     return web.json_response({"draining": tracker.draining()})
 
 
+async def admin_kvplane_rehome(request: web.Request) -> web.Response:
+    """kvplane migration hand-off: rewrite decode-locality evidence
+    after KV chunks moved replica-to-replica, so transfer-cost scoring
+    follows the bytes instead of steering migrated prefixes back at
+    the replica that shed them. Body: {"from": url, "to": url,
+    "digests": ["<hex>", ...]} — digests optional (omit to rehome every
+    entry naming "from")."""
+    state = request.app["state"]
+    try:
+        body = await request.json()
+        from_url = body["from"].rstrip("/")
+        to_url = body["to"].rstrip("/")
+    except (ValueError, KeyError, AttributeError, TypeError):
+        return web.json_response(
+            {"error": {"message": "body must be JSON with 'from' and "
+                                  "'to' URL fields (and optional "
+                                  "'digests' hex list)",
+                       "type": "invalid_request_error"}}, status=400)
+    digests = None
+    if body.get("digests") is not None:
+        try:
+            digests = [bytes.fromhex(d) for d in body["digests"]]
+        except (ValueError, TypeError):
+            return web.json_response(
+                {"error": {"message": "digests must be hex strings",
+                           "type": "invalid_request_error"}},
+                status=400)
+    disagg = state.get("disagg")
+    selector = disagg.selector if disagg is not None else None
+    if selector is None:
+        # nothing to rewrite — not an error: the planner runs the same
+        # hand-off against routers with and without disagg scoring
+        return web.json_response({"enabled": False, "rehomed": 0})
+    # a typo'd destination would silently collect locality credit for
+    # a replica that does not exist (admin_drain precedent)
+    known = {ep.url for ep in state["discovery"].all_endpoints()}
+    if to_url not in known:
+        return web.json_response(
+            {"error": {"message": f"unknown endpoint {to_url!r}; "
+                                  f"known: {sorted(known)}",
+                       "type": "invalid_request_error"}}, status=404)
+    moved = selector.rehome(from_url, to_url, digests)
+    return web.json_response({"enabled": True, "rehomed": moved})
+
+
 async def version(request: web.Request) -> web.Response:
     return web.json_response({"version": __version__})
 
@@ -444,6 +489,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
     # joining later can start polling before this one learns about it
     app.router.add_get("/peers", peers_endpoint)
     app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_post("/admin/kvplane/rehome", admin_kvplane_rehome)
 
     if args.enable_files_api or args.enable_batch_api:
         from production_stack_tpu.router.files_api import mount_files_api

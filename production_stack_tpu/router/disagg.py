@@ -182,6 +182,32 @@ class DecodeSelector:
                 if not urls:
                     del self._chunks[d]
 
+    def rehome(self, from_url: str, to_url: str,
+               digests: Optional[Sequence[bytes]] = None) -> int:
+        """kvplane migration hand-off: chunks whose KV just moved
+        replica-to-replica now live on ``to_url``, so the locality
+        evidence must follow — otherwise transfer-cost scoring keeps
+        steering the migrated prefixes at the replica that no longer
+        holds them (recreating the very pressure the migration
+        relieved). ``digests=None`` rehomes every entry naming
+        ``from_url`` (whole-replica drain); a digest list restricts the
+        rewrite to the migrated chunks. Returns entries rewritten."""
+        if from_url == to_url:
+            return 0
+        keys = list(self._chunks) if digests is None else digests
+        moved = 0
+        for d in keys:
+            urls = self._chunks.get(d)
+            if not urls or from_url not in urls:
+                continue
+            urls.remove(from_url)
+            if to_url not in urls:
+                urls.append(to_url)
+            moved += 1
+        if moved:
+            self._seen_urls.add(to_url)
+        return moved
+
     def evict_except(self, live_urls) -> None:
         """Drop locality evidence for decode engines that left the
         fleet (dynamic-config swaps) — a departed URL must not keep

@@ -759,4 +759,33 @@ def compile_prometheus_rules(config: Optional[SLOConfig] = None) -> dict:
                 "runbook": r.runbook(),
             },
         })
-    return {"groups": [{"name": "tpu-stack-slo-burn", "rules": rules}]}
+    # static (non-burn-rate) rules ride in their own group: symptoms
+    # with a dedicated control loop rather than an error budget
+    kvplane_rules = [{
+        # fragmentation, not exhaustion: admissions failing while the
+        # pool still holds free blocks. With the kvplane planner
+        # deployed this should self-heal within a poll interval — a
+        # firing alert means the planner is down, cooldown-pinned, or
+        # the fleet has no destination with headroom.
+        "alert": "KVPoolFragmented",
+        "expr": ('sum by (model_name) (rate(\n'
+                 '  tpu:kvpool_alloc_failures_total{reason="fragmented"}'
+                 '[5m]\n)) > 0\n'
+                 'and\n'
+                 'sum by (model_name) '
+                 '(tpu:kvpool_blocks{state="free"}) > 0'),
+        "for": "120s",
+        "labels": {"severity": "ticket", "component": "kvplane"},
+        "annotations": {
+            "summary": ("KV pool refusing admissions while free "
+                        "blocks exist — fragmented, not exhausted"),
+            "description": ("alloc failures with reason=fragmented "
+                            "rising while the pool reports free "
+                            "capacity; live migration / defrag is "
+                            "not reclaiming it"),
+            "runbook": "docs/runbooks.md#kv-fragmentation",
+        },
+    }]
+    return {"groups": [{"name": "tpu-stack-slo-burn", "rules": rules},
+                       {"name": "tpu-stack-kvplane",
+                        "rules": kvplane_rules}]}

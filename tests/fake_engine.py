@@ -59,6 +59,21 @@ keys adjusts signals without touching the active fault mode; ``null``
 clears an override (capacity falls back to the overload-fault-derived
 value, queue delay to 0).
 
+KV-pool injection (the kvplane storm rig's lever): ``POST /fault``
+also accepts a ``kv_pool`` census dict ({num_blocks, free, active,
+cached, blocks_per_request, free_contiguity}). While set, every
+inference request must claim ``blocks_per_request`` allocatable
+blocks or it answers 503 + Retry-After, counting the refusal as
+fragmented (free capacity remains) or exhausted (none) exactly like
+the real BlockManager; the census is served on /load ``kv_pool``,
+/metrics (``tpu:kvpool_*``) and /debug/perf. ``POST
+/admin/kvplane/migrate_out`` / ``/admin/kvplane/warm`` mirror the
+real engine's kvplane surface: migrate frees active blocks and
+returns synthetic chunk keys, warm claims free blocks into the
+cached state — so a planner-driven migrate->warm hand-off keeps the
+fleet's aggregate resident blocks constant. ``kv_pool: null``
+clears the model (no admission gating).
+
 Shared-KV simulation (the kvshare rig's lever): ``--kv-remote-url
 tpukv://host:port`` makes every chat request chunk-hash its prompt
 text, walk a REAL TPKV cache server for the cached prefix, pace TTFT by
@@ -135,6 +150,8 @@ class FakeEngine:
                  prefill_s_per_char: float = 0.0,
                  kv_role: str = "kv_both",
                  prefill_decode_interference: float = 0.0,
+                 kv_codec: Optional[str] = None,
+                 kv_bytes_per_char: int = 256,
                  trace_ring_entries: int = 4096):
         self.model = model
         self.ttft_s = ttft_s
@@ -168,6 +185,32 @@ class FakeEngine:
                 kv_remote_url, connect_timeout=0.5, io_timeout=1.0,
                 breaker_threshold=2, breaker_cooldown_s=2.0)
         self._kv_published = set()       # digests this replica published
+        # pseudo-KV codec simulation (the kvmigrate codec phase's
+        # lever): instead of the chunk's text bytes, publish a
+        # deterministic dense pseudo-KV body of
+        # kv_chunk_chars * kv_bytes_per_char bytes run through the REAL
+        # tier codec (kvcache/codec.py) — so the cache server's
+        # physical footprint vs the logical bytes_saved accounting
+        # measures the actual codec's capacity ratio, not a toy one
+        self._kv_codec = None
+        self._kv_logical_chunk_bytes = 0
+        if kv_codec:
+            import numpy as _np
+            from production_stack_tpu.kvcache import codec as _codecmod
+            self._np = _np
+            self._kv_codecmod = _codecmod
+            self._kv_codec = _codecmod.make_codec(
+                kv_codec, np_dtype=_np.dtype(_np.float16), head_dim=64)
+            self._kv_logical_chunk_bytes = \
+                self.kv_chunk_chars * max(1, int(kv_bytes_per_char))
+        # injected paged-KV-pool model (POST /fault {"kv_pool": ...}):
+        # None = no admission gating; a census dict makes every
+        # inference request claim blocks_per_request allocatable
+        # blocks or answer 503 + Retry-After, counting the failure as
+        # fragmented (free capacity remains) or exhausted (none) like
+        # the real BlockManager (engine/block_manager.py)
+        self.kv_pool: Optional[dict] = None
+        self._mig_seq = 0                # migration key counter
         self.kv_counters = {
             "queries": 0, "query_tokens": 0, "hit_tokens": 0,
             "foreign_hit_tokens": 0, "bytes_loaded": 0, "bytes_saved": 0,
@@ -253,6 +296,10 @@ class FakeEngine:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/fault", self.set_fault)
         app.router.add_get("/fault", self.get_fault)
+        app.router.add_post("/admin/kvplane/migrate_out",
+                            self.admin_kvplane_migrate_out)
+        app.router.add_post("/admin/kvplane/warm",
+                            self.admin_kvplane_warm)
         from production_stack_tpu.tracing import debug_traces_handler
         app.router.add_get("/debug/traces",
                            debug_traces_handler(lambda: self.tracer))
@@ -286,6 +333,164 @@ class FakeEngine:
         return chain_digest_bytes(text.encode("utf-8", "ignore"),
                                   self.kv_chunk_chars)
 
+    def _kv_chunk_payload(self, digest: bytes):
+        """Deterministic pseudo-KV chunk body for ``digest``, run
+        through the real tier codec. Returns (encoded payload to
+        store, logical body bytes it stands for). Seeded from the
+        digest so a republish writes byte-identical payloads."""
+        rng = self._np.random.default_rng(
+            int.from_bytes(digest[:8], "little"))
+        n = self._kv_logical_chunk_bytes // 2          # float16 elems
+        body = rng.standard_normal(n, dtype=self._np.float32) \
+            .astype(self._np.float16).tobytes()
+        return self._kv_codecmod.encode_payload(self._kv_codec, body), \
+            len(body)
+
+    # -- injected KV pool (kvplane storm rig) ---------------------------
+
+    def _kv_pool_try_alloc(self):
+        """Claim blocks_per_request allocatable blocks for one request.
+        Returns (blocks_held, None) on admission or (0, 503 response)
+        on failure — classified fragmented/exhausted exactly like
+        BlockManager.alloc (free capacity remaining vs none)."""
+        pool = self.kv_pool
+        if not pool:
+            return 0, None
+        bpr = max(1, int(pool.get("blocks_per_request", 1)))
+        pool["allocs"] = pool.get("allocs", 0) + 1
+        avail = int(pool.get("free", 0)) + int(pool.get("cached", 0))
+        if avail < bpr:
+            if avail <= 0:
+                reason = "exhausted"
+                pool["alloc_failures_exhausted"] = \
+                    pool.get("alloc_failures_exhausted", 0) + 1
+            else:
+                reason = "fragmented"
+                pool["alloc_failures_fragmented"] = \
+                    pool.get("alloc_failures_fragmented", 0) + 1
+            resp = web.json_response(
+                {"error": {"message": f"KV pool admission failed "
+                                      f"({reason}): need {bpr} blocks, "
+                                      f"{avail} allocatable",
+                           "type": "engine_overloaded_error",
+                           "code": f"kv_pool_{reason}"}},
+                status=503, headers={"Retry-After": "1"})
+            return 0, resp
+        take_free = min(int(pool.get("free", 0)), bpr)
+        pool["free"] = int(pool.get("free", 0)) - take_free
+        rem = bpr - take_free
+        if rem:
+            pool["cached"] = int(pool.get("cached", 0)) - rem
+            pool["cache_evictions"] = \
+                pool.get("cache_evictions", 0) + rem
+        pool["active"] = int(pool.get("active", 0)) + bpr
+        pool["blocks_allocated"] = \
+            pool.get("blocks_allocated", 0) + bpr
+        return bpr, None
+
+    def _kv_pool_release(self, held: int) -> None:
+        pool = self.kv_pool
+        if not pool or not held:
+            return
+        pool["active"] = int(pool.get("active", 0)) - held
+        pool["free"] = int(pool.get("free", 0)) + held
+
+    def _kv_pool_report(self) -> dict:
+        """frag_report()-parity census of the injected pool (the real
+        engine's /load kv_pool block shape, engine/block_manager.py)."""
+        pool = self.kv_pool or {}
+        num = int(pool.get("num_blocks", 1024))
+        active = int(pool.get("active", 0))
+        report = {
+            "num_blocks": num,
+            "free": int(pool.get("free", num)),
+            "active": active,
+            "cached": int(pool.get("cached", 0)),
+            "usage": round(active / num, 4) if num else 0.0,
+            "allocs": int(pool.get("allocs", 0)),
+            "blocks_allocated": int(pool.get("blocks_allocated", 0)),
+            "alloc_failures_exhausted":
+                int(pool.get("alloc_failures_exhausted", 0)),
+            "alloc_failures_fragmented":
+                int(pool.get("alloc_failures_fragmented", 0)),
+            "cache_evictions": int(pool.get("cache_evictions", 0)),
+            "free_contiguity": float(pool.get("free_contiguity", 1.0)),
+            "defrag_runs": int(pool.get("defrag_runs", 0)),
+            "defrag_block_moves": int(pool.get("defrag_block_moves", 0)),
+            "migrations": int(pool.get("migrations", 0)),
+            "migrated_blocks": int(pool.get("migrated_blocks", 0)),
+            "warmed_chunks": int(pool.get("warmed_chunks", 0)),
+        }
+        return report
+
+    async def admin_kvplane_migrate_out(self,
+                                        request: web.Request
+                                        ) -> web.Response:
+        """Mirror of the real engine's POST /admin/kvplane/migrate_out:
+        shed resident blocks to the shared tier and return the chunk
+        keys a destination replica can warm — here the 'sequences' are
+        the injected census's phantom residents, so the blocks simply
+        move active -> free and the keys are synthesized (one per
+        freed block, deterministic per replica)."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        pool = self.kv_pool
+        if not pool:
+            return web.json_response(
+                {"error": "kv_pool simulation not active "
+                          "(POST /fault {\"kv_pool\": {...}} first)"},
+                status=409)
+        bpr = max(1, int(pool.get("blocks_per_request", 1)))
+        max_seqs = int(body.get("max_seqs", 2))
+        target = int(body.get("target_blocks", 0))
+        want = target if target > 0 else max_seqs * bpr
+        freed = min(int(pool.get("active", 0)), want)
+        pool["active"] = int(pool.get("active", 0)) - freed
+        pool["free"] = int(pool.get("free", 0)) + freed
+        import hashlib
+        keys = []
+        for i in range(freed):
+            keys.append(hashlib.blake2b(
+                f"{self.model}:mig:{self._mig_seq + i}".encode(),
+                digest_size=16).hexdigest())
+        self._mig_seq += freed
+        victims = [f"fake-seq-{self._mig_seq - freed + j}"
+                   for j in range(max(1, freed // bpr))] if freed else []
+        if freed:
+            pool["migrations"] = pool.get("migrations", 0) + len(victims)
+            pool["migrated_blocks"] = \
+                pool.get("migrated_blocks", 0) + freed
+        return web.json_response({"migrated": victims,
+                                  "freed_blocks": freed, "keys": keys})
+
+    async def admin_kvplane_warm(self,
+                                 request: web.Request) -> web.Response:
+        """Mirror of the real engine's POST /admin/kvplane/warm: pull
+        the named chunks into the local tiers — here each warmed key
+        claims one free block into the cached (evictable) state, so
+        the fleet's aggregate resident blocks stay constant across a
+        migrate_out -> warm hand-off."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        keys = body.get("keys") or []
+        if not isinstance(keys, list):
+            return web.json_response(
+                {"error": "keys must be a list"}, status=400)
+        pool = self.kv_pool
+        if not pool:
+            return web.json_response(
+                {"warmed": 0, "missed": len(keys)})
+        take = min(len(keys), int(pool.get("free", 0)))
+        pool["free"] = int(pool.get("free", 0)) - take
+        pool["cached"] = int(pool.get("cached", 0)) + take
+        pool["warmed_chunks"] = pool.get("warmed_chunks", 0) + take
+        return web.json_response({"warmed": take,
+                                  "missed": len(keys) - take})
+
     def _kv_prefetch_sync(self, digests):
         """Walk the shared tier until the first miss (sync; runs in a
         worker thread). Returns (hit_chunks, foreign_chunks, bytes)."""
@@ -297,8 +502,18 @@ class FakeEngine:
             val = self._kv_store.get(d)
             if val is None:
                 break
+            if self._kv_codec is not None:
+                # decode through the real codec: a torn or foreign
+                # payload reads as a MISS (walk stops), exactly like
+                # CodecStore.get
+                body = self._kv_codecmod.decode_payload(
+                    self._kv_codec, val, self._kv_logical_chunk_bytes)
+                if body is None:
+                    break
+                loaded += len(body)
+            else:
+                loaded += len(val)
             hits += 1
-            loaded += len(val)
             if d not in self._kv_published:
                 foreign += 1
         # a digest we remember publishing that now MISSES means the
@@ -318,10 +533,14 @@ class FakeEngine:
         for i, d in enumerate(digests):
             if d in self._kv_published:
                 continue
-            chunk = data[i * self.kv_chunk_chars:
-                         (i + 1) * self.kv_chunk_chars]
+            if self._kv_codec is not None:
+                chunk, logical = self._kv_chunk_payload(d)
+            else:
+                chunk = data[i * self.kv_chunk_chars:
+                             (i + 1) * self.kv_chunk_chars]
+                logical = len(chunk)
             if self._kv_store.put(d, chunk):
-                self.kv_counters["bytes_saved"] += len(chunk)
+                self.kv_counters["bytes_saved"] += logical
                 self.kv_counters["published_chunks"] += 1
                 self._kv_published.add(d)
 
@@ -371,11 +590,15 @@ class FakeEngine:
             covered = (i + 1) * self.kv_chunk_chars
             if d in self._kv_published:
                 continue
-            chunk = data[i * self.kv_chunk_chars:
-                         (i + 1) * self.kv_chunk_chars]
+            if self._kv_codec is not None:
+                chunk, logical = self._kv_chunk_payload(d)
+            else:
+                chunk = data[i * self.kv_chunk_chars:
+                             (i + 1) * self.kv_chunk_chars]
+                logical = len(chunk)
             ok = await asyncio.to_thread(self._kv_store.put, d, chunk)
             if ok:
-                self.kv_counters["bytes_saved"] += len(chunk)
+                self.kv_counters["bytes_saved"] += logical
                 self.kv_counters["published_chunks"] += 1
                 self.kv_counters["progress_published_chunks"] += 1
                 self._kv_published.add(d)
@@ -614,6 +837,19 @@ class FakeEngine:
             v = body["error_rate"]
             self.error_rate = 0.0 if v is None else \
                 min(1.0, max(0.0, float(v)))
+        if "kv_pool" in body:
+            v = body["kv_pool"]
+            if v is None:
+                self.kv_pool = None          # admission gating off
+            else:
+                pool = dict(v)
+                num = int(pool.get("num_blocks", 1024))
+                pool.setdefault("num_blocks", num)
+                pool.setdefault("free", num)
+                pool.setdefault("active", 0)
+                pool.setdefault("cached", 0)
+                pool.setdefault("blocks_per_request", 1)
+                self.kv_pool = pool
         if self.capacity_override is not None:
             self.gauges["tpu:engine_capacity_seqs"] = \
                 self.capacity_override
@@ -628,7 +864,8 @@ class FakeEngine:
         signal_only = bool(body) and set(body) <= {"capacity",
                                                    "queue_delay_ms",
                                                    "error_rate",
-                                                   "perf"}
+                                                   "perf",
+                                                   "kv_pool"}
         if signal_only:
             self._apply_signal_overrides(body)
             return web.json_response(
@@ -636,7 +873,8 @@ class FakeEngine:
                  "capacity": self.capacity_override,
                  "queue_delay_ms": self.queue_delay_override,
                  "error_rate": self.error_rate,
-                 "perf": self.perf})
+                 "perf": self.perf,
+                 "kv_pool": self.kv_pool})
         mode = body.get("mode")
         if mode is None:
             # a mode-clearing POST also resets the partial error rate
@@ -704,6 +942,14 @@ class FakeEngine:
             injected.headers["x-trace-id"] = trace.trace_id
             self.tracer.finish(trace, "fault:error_rate")
             return injected
+        # injected KV-pool admission (kvplane storm rig): claim
+        # blocks_per_request allocatable blocks or 503 like a real
+        # engine whose paged pool cannot seat the request
+        held, denied = self._kv_pool_try_alloc()
+        if denied is not None:
+            denied.headers["x-trace-id"] = trace.trace_id
+            self.tracer.finish(trace, "kv_pool:denied")
+            return denied
         # keep the exact wire bytes: the router's passthrough fast path
         # promises byte identity (tests/test_router_fastpath.py)
         self.last_raw = await request.read()
@@ -779,6 +1025,7 @@ class FakeEngine:
             resp.headers["x-engine-id"] = self._engine_id(request)
             return resp
         finally:
+            self._kv_pool_release(held)
             self._in_flight -= 1
             self.gauges["vllm:num_requests_running"] = float(self._in_flight)
 
@@ -792,6 +1039,9 @@ class FakeEngine:
         injected = self._draw_partial_error()
         if injected is not None:
             return injected
+        held, denied = self._kv_pool_try_alloc()
+        if denied is not None:
+            return denied
         trace = self.tracer.begin(request.headers.get("traceparent"),
                                   name="/v1/completions")
         t_pf = time.monotonic()
@@ -801,6 +1051,7 @@ class FakeEngine:
             ("/v1/completions", request.headers.get("x-user-id"),
              body.get("model")))
         n = min(body.get("max_tokens") or self.num_tokens, self.num_tokens)
+        self._kv_pool_release(held)
         self._note_served(n)
         trace.add_phase("prefill", t_pf, time.monotonic())
         self.tracer.finish(trace, "ok")
@@ -863,6 +1114,12 @@ class FakeEngine:
             "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
             "perf": self._perf_block(),
         }
+        # the kvplane planner's poll surface: same block the real
+        # engine's /load always carries (engine.load_report kv_pool);
+        # without an injected pool this is the default-healthy census
+        report["kv_pool"] = self._kv_pool_report()
+        if self.kv_pool is not None:
+            report["free_kv_blocks"] = report["kv_pool"]["free"]
         if self._kv_store is not None:
             c = self.kv_counters
             report["kv_cache"] = {
@@ -904,7 +1161,8 @@ class FakeEngine:
                        "decode_tokens_per_s")},
             "windows": list(self._perf_windows)[-limit:],
             "compiles": list(self._perf_compiles)[-limit:],
-            "kv_pool": {
+            "kv_pool": self._kv_pool_report() if self.kv_pool is not None
+            else {
                 "num_blocks": 1024, "free": 1024, "active": 0,
                 "cached": 0, "usage": 0.0, "allocs": 0,
                 "blocks_allocated": 0, "alloc_failures_exhausted": 0,
@@ -955,6 +1213,35 @@ class FakeEngine:
         lines.append(f'tpu:engine_compiles_total{{model_name='
                      f'"{self.model}",kind="decode",window="8",'
                      f'kv_bucket="512"}} {perf["compiles_total"]}')
+        if self.kv_pool is not None:
+            # surface parity with the real engine's tpu:kvpool_* family
+            # (engine/metrics.py sync_kvpool): /load and /metrics must
+            # agree so the planner can poll either
+            pool = self._kv_pool_report()
+            lines.append("# TYPE tpu_kvpool_blocks gauge")
+            for state in ("free", "active", "cached"):
+                lines.append(f'tpu:kvpool_blocks{{model_name='
+                             f'"{self.model}",state="{state}"}} '
+                             f'{pool[state]}')
+            lines.append("# TYPE tpu_kvpool_alloc_failures counter")
+            for reason in ("exhausted", "fragmented"):
+                lines.append(
+                    f'tpu:kvpool_alloc_failures_total{{model_name='
+                    f'"{self.model}",reason="{reason}"}} '
+                    f'{pool["alloc_failures_" + reason]}')
+            lines.append("# TYPE tpu_kvpool_cache_evictions counter")
+            lines.append(f'tpu:kvpool_cache_evictions_total{{model_name='
+                         f'"{self.model}"}} {pool["cache_evictions"]}')
+            lines.append("# TYPE tpu_kvplane_migrations counter")
+            lines.append(f'tpu:kvplane_migrations_total{{model_name='
+                         f'"{self.model}"}} {pool["migrations"]}')
+            lines.append("# TYPE tpu_kvplane_migrated_blocks counter")
+            lines.append(
+                f'tpu:kvplane_migrated_blocks_total{{model_name='
+                f'"{self.model}"}} {pool["migrated_blocks"]}')
+            lines.append("# TYPE tpu_kvplane_warmed_chunks counter")
+            lines.append(f'tpu:kvplane_warmed_chunks_total{{model_name='
+                         f'"{self.model}"}} {pool["warmed_chunks"]}')
         if self._kv_store is not None:
             # surface parity with the real engine's tpu:kvcache_* family
             for key in ("query_tokens", "hit_tokens",
@@ -1004,6 +1291,16 @@ def main(argv=None) -> None:
                         "simulation against a real cache server")
     p.add_argument("--kv-chunk-chars", type=int, default=64,
                    help="chunk granularity (chars) of the KV simulation")
+    p.add_argument("--kv-codec", default=None,
+                   help="publish deterministic pseudo-KV chunk bodies "
+                        "through this REAL tier codec (raw/int8/int4/"
+                        "fp8, kvcache/codec.py) instead of text bytes "
+                        "— the kvmigrate codec phase's capacity-ratio "
+                        "lever")
+    p.add_argument("--kv-bytes-per-char", type=int, default=256,
+                   help="logical pseudo-KV bytes per prompt char in "
+                        "--kv-codec mode (chunk body = this * "
+                        "--kv-chunk-chars)")
     p.add_argument("--prefill-ms-per-char", type=float, default=0.0,
                    help="TTFT pacing per UNCACHED prompt char (the "
                         "lever that makes tier hits measurable)")
@@ -1036,6 +1333,8 @@ def main(argv=None) -> None:
                      kv_chunk_chars=args.kv_chunk_chars,
                      prefill_s_per_char=args.prefill_ms_per_char / 1e3,
                      kv_role=args.kv_role,
+                     kv_codec=args.kv_codec,
+                     kv_bytes_per_char=args.kv_bytes_per_char,
                      prefill_decode_interference=args.
                      prefill_decode_interference,
                      trace_ring_entries=args.trace_ring_entries)

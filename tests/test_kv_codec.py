@@ -1,0 +1,354 @@
+"""kvplane pillars 2+3 unit tier: per-tier KV codecs (raw / int8 /
+int4 / fp8) and the pipelined fair-deadline prefetch walk.
+
+The contracts pinned here:
+
+- codecs round-trip byte lengths exactly and values within their
+  quantization error; the encoded payload's own checksum makes any
+  torn / truncated / foreign payload a MISS (None), never garbage —
+  the property the torn-migration guarantee rests on;
+- ``CodecStore`` preserves the connector wire format end to end
+  (strip digest -> encode -> checksum; verify -> decode -> fresh
+  digest) and deletes corrupt entries so a later publish heals them;
+- ``apply_tier_codecs`` wraps exactly the mapped tiers of a
+  ``TieredStore`` and promotion between tiers re-encodes per-tier;
+- ``PipelinedFetcher`` consumes in key order, stops at the first
+  miss, and charges each chunk its cumulative fair share of the
+  budget instead of letting the first stall eat the whole wall.
+"""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from production_stack_tpu.kvcache import codec as codecmod
+from production_stack_tpu.kvcache.codec import (CodecStore,
+                                                apply_tier_codecs,
+                                                codec_names,
+                                                codec_stats_of,
+                                                decode_payload,
+                                                encode_payload,
+                                                make_codec)
+from production_stack_tpu.kvcache.pipeline import PipelinedFetcher
+from production_stack_tpu.kvcache.store import HostMemoryStore, TieredStore
+
+HEAD_DIM = 64
+DTYPE = np.dtype(np.float16)  # stand-in for the bf16 wire dtype
+
+
+def _body(seed: int = 0, rows: int = 32) -> bytes:
+    rng = np.random.default_rng(seed)
+    arr = rng.standard_normal((rows, HEAD_DIM)).astype(np.float32)
+    # a few outlier rows so absmax scaling is actually exercised
+    arr[::7] *= 40.0
+    return arr.astype(DTYPE).tobytes()
+
+
+def _as_f32(body: bytes) -> np.ndarray:
+    return np.frombuffer(body, dtype=DTYPE).astype(np.float32)
+
+
+def _connector_value(body: bytes) -> bytes:
+    """body + blake2b-8(body) — the connector's serialized chunk."""
+    return body + hashlib.blake2b(body, digest_size=8).digest()
+
+
+# ---------------------------------------------------------------------------
+# codec round-trips
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", codec_names())
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_codec_roundtrip_within_quantization_error(name, seed):
+    codec = make_codec(name, np_dtype=DTYPE, head_dim=HEAD_DIM)
+    body = _body(seed)
+    out = codec.decode(codec.encode(body), len(body))
+    assert len(out) == len(body)           # exact byte-length contract
+    orig, rec = _as_f32(body), _as_f32(out)
+    if name == "raw":
+        assert out == body
+        return
+    # per-row relative error bounded by the codec's step size
+    scale = np.abs(orig).reshape(-1, HEAD_DIM).max(axis=1)
+    err = np.abs(orig - rec).reshape(-1, HEAD_DIM).max(axis=1)
+    rel = err / np.maximum(scale, 1e-6)
+    bound = {"int8": 0.02, "int4": 0.16, "fp8": 0.13}[name]
+    assert rel.max() < bound, (name, rel.max())
+
+
+def test_codec_compression_ratios():
+    """The capacity headline: int8 ~1.9x, int4 ~3.2x over the wire
+    dtype; the >=2x tier-capacity gate needs int4."""
+    body = _body(rows=256)
+    for name, lo, hi in [("raw", 0.99, 1.01), ("int8", 1.8, 2.0),
+                         ("int4", 3.0, 3.3)]:
+        codec = make_codec(name, np_dtype=DTYPE, head_dim=HEAD_DIM)
+        ratio = len(body) / len(codec.encode(body))
+        assert lo <= ratio <= hi, (name, ratio)
+    int4 = make_codec("int4", np_dtype=DTYPE, head_dim=HEAD_DIM)
+    assert len(body) / len(int4.encode(body)) >= 2.0  # the gate codec
+
+
+def test_make_codec_unknown_name():
+    with pytest.raises(ValueError, match="unknown KV codec"):
+        make_codec("zstd", np_dtype=DTYPE, head_dim=HEAD_DIM)
+
+
+def test_fp8_gated_on_ml_dtypes(monkeypatch):
+    """fp8 without float8_e4m3fn must fail at config time — never a
+    silent raw fallback."""
+    monkeypatch.setattr(codecmod, "_FP8_DTYPE", None)
+    with pytest.raises(ValueError, match="ml_dtypes"):
+        make_codec("fp8", np_dtype=DTYPE, head_dim=HEAD_DIM)
+    assert "fp8" not in codecmod.codec_names() or \
+        codecmod._FP8_DTYPE is None  # names reflect the gate
+
+
+# ---------------------------------------------------------------------------
+# payload checksum: torn -> miss, never garbage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name", codec_names())
+def test_payload_roundtrip_and_rejection(name):
+    codec = make_codec(name, np_dtype=DTYPE, head_dim=HEAD_DIM)
+    body = _body()
+    payload = encode_payload(codec, body)
+    out = decode_payload(codec, payload, len(body))
+    assert out is not None and len(out) == len(body)
+
+    # truncation at EVERY boundary class reads as a miss (the
+    # mid-migration SIGKILL shapes: partial header, partial body,
+    # missing checksum tail)
+    for cut in (0, 1, codecmod.HEADER.size,
+                len(payload) // 2, len(payload) - 1):
+        assert decode_payload(codec, payload[:cut], len(body)) is None
+
+    # a single flipped bit anywhere invalidates the whole payload
+    for pos in (0, 3, len(payload) // 2, len(payload) - 1):
+        torn = bytearray(payload)
+        torn[pos] ^= 0x40
+        assert decode_payload(codec, bytes(torn), len(body)) is None
+
+    # wrong body_len (a chunk-geometry change across restarts)
+    assert decode_payload(codec, payload, len(body) + DTYPE.itemsize
+                          * HEAD_DIM) is None
+
+
+def test_payload_foreign_codec_is_miss():
+    """A tier whose configured codec changed across restarts reads its
+    old entries as misses (heals via republish), never decodes with
+    the wrong codec."""
+    body = _body()
+    int8 = make_codec("int8", np_dtype=DTYPE, head_dim=HEAD_DIM)
+    raw = make_codec("raw", np_dtype=DTYPE, head_dim=HEAD_DIM)
+    payload = encode_payload(int8, body)
+    assert decode_payload(raw, payload, len(body)) is None
+    assert decode_payload(int8, payload, len(body)) is not None
+
+
+# ---------------------------------------------------------------------------
+# CodecStore: the connector wire format survives the boundary
+# ---------------------------------------------------------------------------
+
+
+def test_codec_store_preserves_connector_format():
+    body = _body()
+    st = CodecStore(HostMemoryStore(1 << 20, force_python=True),
+                    make_codec("int8", np_dtype=DTYPE,
+                               head_dim=HEAD_DIM),
+                    chunk_body_bytes=len(body))
+    assert st.put(b"k1", _connector_value(body))
+    got = st.get(b"k1")
+    assert got is not None
+    # tail is a FRESH digest over the DECODED body — the connector's
+    # _deserialize integrity check verifies what the engine consumes
+    got_body, digest = got[:-8], got[-8:]
+    assert len(got_body) == len(body)
+    assert hashlib.blake2b(got_body, digest_size=8).digest() == digest
+    val, tier = st.get_with_tier(b"k1")
+    assert val == got and tier == "cpu"
+    s = st.codec_stats()
+    assert s["codec"] == "int8" and s["decoded_chunks"] >= 1
+    assert 0 < s["bytes_out"] < s["bytes_in"]  # compression happened
+
+
+def test_codec_store_torn_put_dropped():
+    """A value torn BEFORE the codec boundary (bad connector digest)
+    is refused — never encode garbage."""
+    body = _body()
+    st = CodecStore(HostMemoryStore(1 << 20, force_python=True),
+                    make_codec("int4", np_dtype=DTYPE,
+                               head_dim=HEAD_DIM),
+                    chunk_body_bytes=len(body))
+    assert not st.put(b"k", _connector_value(body)[:-3])
+    assert not st.put(b"k", body)  # digest over wrong bytes
+    assert st.get(b"k") is None
+
+
+def test_codec_store_torn_migration_reads_as_miss_and_heals():
+    """The torn-migration guarantee at the store layer: a destination
+    killed mid-PUT leaves a truncated encoded payload; the next read
+    is a MISS (rejected + evicted), and a later publish heals it."""
+    body = _body()
+    inner = HostMemoryStore(1 << 20, force_python=True)
+    st = CodecStore(inner, make_codec("int4", np_dtype=DTYPE,
+                                      head_dim=HEAD_DIM),
+                    chunk_body_bytes=len(body))
+    assert st.put(b"k", _connector_value(body))
+    whole = inner.get(b"k")
+    inner.put(b"k", whole[:len(whole) // 2])   # the SIGKILL artifact
+    assert st.get(b"k") is None                # miss, not garbage
+    assert st.rejects == 1
+    assert not inner.exists(b"k")              # evicted for healing
+    assert st.put(b"k", _connector_value(body))  # republish heals
+    assert st.get(b"k") is not None
+
+
+def test_apply_tier_codecs_tiered_promotion_reencodes():
+    """disk tier int4-wrapped, cpu tier raw-unwrapped: a disk hit
+    promotes into cpu THROUGH the codec boundary — each tier's put
+    sees plain serialized chunks, so cpu holds a byte-exact connector
+    value while disk keeps the quantized payload."""
+    body = _body()
+    cpu = HostMemoryStore(1 << 20, force_python=True)
+    cpu.tier_name = "cpu"
+    disk = HostMemoryStore(1 << 20, force_python=True)
+    disk.tier_name = "disk"
+    tiered = apply_tier_codecs(
+        TieredStore([cpu, disk]), {"disk": "int4"},
+        np_dtype=DTYPE, head_dim=HEAD_DIM,
+        chunk_body_bytes=len(body))
+    assert [t.tier_name for t in tiered.tiers] == ["cpu", "disk"]
+    assert isinstance(tiered.tiers[1], CodecStore)
+    assert not isinstance(tiered.tiers[0], CodecStore)
+
+    value = _connector_value(body)
+    assert tiered.put(b"k", value)
+    cpu.delete(b"k")                      # force the next hit to disk
+    val, tier = tiered.get_with_tier(b"k")
+    assert tier == "disk"
+    got_body, digest = val[:-8], val[-8:]
+    assert hashlib.blake2b(got_body, digest_size=8).digest() == digest
+    # promotion rewrote cpu with the DECODED connector value
+    promoted = cpu.get(b"k")
+    assert promoted == val
+    # while disk still physically holds the int4 payload (smaller)
+    assert len(disk.get(b"k")) < len(value)
+    stats = codec_stats_of(tiered)
+    assert [s["tier"] for s in stats] == ["disk"]
+
+
+def test_apply_tier_codecs_rejects_unknown_tier():
+    with pytest.raises(ValueError, match="unknown tier"):
+        apply_tier_codecs(HostMemoryStore(1 << 20, force_python=True),
+                          {"hbm": "int8"}, np_dtype=DTYPE,
+                          head_dim=HEAD_DIM, chunk_body_bytes=128)
+
+
+# ---------------------------------------------------------------------------
+# pipelined fair-deadline walk
+# ---------------------------------------------------------------------------
+
+
+def _keys(n):
+    return [bytes([i]) * 8 for i in range(n)]
+
+
+def test_fetch_walk_in_order_stops_at_first_miss():
+    data = {k: b"v" + k for k in _keys(8)}
+    del data[_keys(8)[5]]
+    fetcher = PipelinedFetcher(workers=4)
+    try:
+        results, stats = fetcher.fetch_walk(
+            _keys(8), lambda k: (data.get(k), "cpu"), budget_s=5.0)
+    finally:
+        fetcher.close()
+    assert [k for k, _, _ in results] == _keys(8)[:5]  # chain order
+    assert all(v == b"v" + k for k, v, _ in results)
+    assert stats.pipelined_fetches > 0
+    assert stats.deadline_hits == 0 and stats.chunk_deadline_hits == 0
+
+
+def test_fetch_walk_single_stall_charged_fair_share_not_whole_wall():
+    """The budget fix: chunk 0 of 8 stalls forever; it must be
+    abandoned after ~budget/8, not after the whole budget."""
+    stall = threading.Event()
+    calls = []
+
+    def get_fn(k):
+        calls.append(k)
+        if k == _keys(8)[0]:
+            stall.wait(10.0)
+            return None, None
+        return b"v", "cpu"
+
+    fetcher = PipelinedFetcher(workers=4)
+    t0 = time.monotonic()
+    try:
+        results, stats = fetcher.fetch_walk(_keys(8), get_fn,
+                                            budget_s=2.0)
+    finally:
+        elapsed = time.monotonic() - t0
+        stall.set()
+        fetcher.close()
+    assert results == []
+    assert stats.chunk_deadline_hits == 1
+    # fair share for chunk 0 is budget/8 = 0.25s; the old behavior
+    # (one shared wall) would have sat the full 2s
+    assert elapsed < 1.0, elapsed
+
+
+def test_fetch_walk_uniformly_slow_tier_keeps_whole_budget():
+    """Slack rolls forward: n chunks each taking just under budget/n
+    must ALL complete — cumulative deadlines, not per-chunk walls."""
+    n, budget = 5, 2.0
+
+    def get_fn(k):
+        time.sleep(budget / n * 0.6)
+        return b"v", "remote"
+
+    fetcher = PipelinedFetcher(workers=1)  # serial: worst case
+    try:
+        results, stats = fetcher.fetch_walk(_keys(n), get_fn,
+                                            budget_s=budget)
+    finally:
+        fetcher.close()
+    assert len(results) == n, stats.__dict__
+    assert stats.wait_s <= budget
+
+
+def test_fetch_walk_overlaps_reads():
+    """With workers=4, 8 chunks of 80ms each must beat serial 640ms
+    by a wide margin — the pipelining is real."""
+    def get_fn(k):
+        time.sleep(0.08)
+        return b"v", "remote"
+
+    fetcher = PipelinedFetcher(workers=4)
+    t0 = time.monotonic()
+    try:
+        results, _ = fetcher.fetch_walk(_keys(8), get_fn, budget_s=5.0)
+    finally:
+        fetcher.close()
+    elapsed = time.monotonic() - t0
+    assert len(results) == 8
+    assert elapsed < 0.45, elapsed  # serial would be ~0.64s
+
+
+def test_fetch_walk_read_error_is_miss():
+    def get_fn(k):
+        if k == _keys(4)[2]:
+            raise OSError("sick tier")
+        return b"v", "cpu"
+
+    fetcher = PipelinedFetcher(workers=2)
+    try:
+        results, _ = fetcher.fetch_walk(_keys(4), get_fn, budget_s=2.0)
+    finally:
+        fetcher.close()
+    assert [k for k, _, _ in results] == _keys(4)[:2]

@@ -1,0 +1,504 @@
+"""kvplane pillar 1 unit/integration tier: intra-replica defrag
+(BlockManager), the migration planner's decision logic, the fake
+engine's injected kv_pool (the storm rig's engine-free census model),
+the router's locality rehome hand-off, and the planner poll loop
+end-to-end against in-process fake replicas.
+
+The full closed loop (real subprocess planner + router + storm) runs
+in ``python -m production_stack_tpu.loadgen kvmigrate``
+(KVMIGRATE_r19.json); these tests pin each layer separately.
+"""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.engine.block_manager import BlockManager
+from production_stack_tpu.kvplane import (Decision, MigrationPlanner,
+                                          ReplicaState)
+from production_stack_tpu.kvplane.app import KVPlanePoller
+from production_stack_tpu.router.app import build_app as build_router_app
+from production_stack_tpu.router.app import parse_args as router_args
+from production_stack_tpu.router.disagg import DecodeSelector
+from tests.fake_engine import FakeEngine
+
+# ---------------------------------------------------------------------------
+# BlockManager: free-list defrag between fused windows
+# ---------------------------------------------------------------------------
+
+
+def test_free_contiguity_measures_id_density():
+    bm = BlockManager(num_blocks=17, block_size=8)
+    assert bm.free_contiguity() == 1.0          # virgin pool: one run
+    seqs = [bm.alloc(2) for _ in range(8)]      # drain the pool
+    for s in seqs[::2]:                         # free every OTHER pair
+        bm.free(s)
+    # freed ids are scattered pairs: 8 blocks, runs only inside pairs
+    assert bm.free_contiguity() < 0.8
+    for s in seqs[1::2]:
+        bm.free(s)
+    assert bm.free_contiguity() == 1.0          # dense again
+
+
+def test_defrag_restores_ascending_dense_pops():
+    bm = BlockManager(num_blocks=33, block_size=8)
+    seqs = [bm.alloc(4) for _ in range(8)]
+    # free in an interleaved order so the free LIST is scrambled
+    for s in seqs[1::2] + seqs[0::2]:
+        bm.free(s)
+    moved = bm.defrag()
+    assert moved > 0
+    # pops come from the list tail -> the next alloc must hand out the
+    # lowest ids as one ascending dense run (DMA-batchable)
+    got = bm.alloc(4)
+    assert got == sorted(got)
+    assert got[-1] - got[0] == 3
+    rep = bm.frag_report()
+    assert rep["defrag_runs"] == 1
+    assert rep["defrag_block_moves"] == moved
+    # idempotent: a second pass finds nothing to move
+    assert bm.defrag() == 0
+
+
+def test_defrag_leaves_refcounts_and_cache_alone():
+    bm = BlockManager(num_blocks=17, block_size=8,
+                      enable_prefix_caching=True)
+    held = bm.alloc(4)
+    tokens = list(range(16))        # fully covers the first 2 blocks
+    assert bm.register(tokens, held[:2]) == 2
+    bm.free(held)                   # registered blocks -> evictable
+    before = bm.frag_report()
+    bm.defrag()
+    after = bm.frag_report()
+    assert after["active"] == before["active"]
+    assert after["cached"] == before["cached"] == 2
+    assert after["free"] == before["free"]
+    # prefix cache intact: the registered chain is still discoverable
+    keys = bm.hasher.chunk_keys(tokens)
+    assert len(bm.match_keys(keys)) == 2
+
+
+# ---------------------------------------------------------------------------
+# MigrationPlanner: pure decision logic
+# ---------------------------------------------------------------------------
+
+
+def _state(url, free=4, active=252, failures=0, num_blocks=256,
+           cached=0):
+    return ReplicaState(url=url, num_blocks=num_blocks, free=free,
+                        active=active, cached=cached,
+                        alloc_failures_fragmented=failures)
+
+
+def test_replica_state_from_load():
+    s = ReplicaState.from_load("http://e:1", {"kv_pool": {
+        "num_blocks": 128, "free": 8, "active": 100, "cached": 20,
+        "alloc_failures_fragmented": 3, "free_contiguity": 0.5}})
+    assert s.num_blocks == 128 and s.allocatable == 28
+    assert s.alloc_failures_fragmented == 3
+    # engines predating the census (or a /load without the block)
+    assert ReplicaState.from_load("http://e:1", {}) is None
+    assert ReplicaState.from_load("http://e:1",
+                                  {"kv_pool": None}) is None
+
+
+def test_planner_first_observation_only_baselines():
+    """A planner restart must not re-migrate for failures that
+    predate it — the first pass records, never decides."""
+    p = MigrationPlanner()
+    fleet = [_state("http://a:1", failures=50),
+             _state("http://b:1", free=200, active=40)]
+    assert p.observe(fleet, now=0.0) == []
+    assert p.decisions["migrate"] == 0
+
+
+def test_planner_migrates_on_failure_delta():
+    p = MigrationPlanner(migrate_fraction=0.25, dst_min_free=8)
+    a = _state("http://a:1", failures=50)
+    b = _state("http://b:1", free=200, active=40)
+    p.observe([a, b], now=0.0)
+    a2 = _state("http://a:1", failures=51)       # +1 since baseline
+    out = p.observe([a2, b], now=10.0)
+    assert out == [Decision(src="http://a:1", dst="http://b:1",
+                            target_blocks=64)]   # 256 * 0.25
+    assert p.decisions["migrate"] == 1
+    # no NEW failures next pass -> no decision (not occupancy-driven)
+    assert p.observe([a2, b], now=20.0) == []
+
+
+def test_planner_target_capped_at_active_blocks():
+    p = MigrationPlanner(migrate_fraction=0.5)
+    a = _state("http://a:1", free=2, active=30, failures=0)
+    b = _state("http://b:1", free=220, active=20)
+    p.observe([a, b], now=0.0)
+    a2 = _state("http://a:1", free=2, active=30, failures=1)
+    out = p.observe([a2, b], now=10.0)
+    assert out[0].target_blocks == 30   # can't shed more than active
+
+
+def test_planner_cooldown_holds_back_to_back_moves():
+    p = MigrationPlanner(cooldown_s=5.0)
+    b = _state("http://b:1", free=200, active=40)
+    p.observe([_state("http://a:1", failures=0), b], now=0.0)
+    assert len(p.observe([_state("http://a:1", failures=1), b],
+                         now=1.0)) == 1
+    # more failures 2s later: still inside the cooldown window
+    assert p.observe([_state("http://a:1", failures=2), b],
+                     now=3.0) == []
+    assert p.decisions["hold_cooldown"] == 1
+    # past the window the source is eligible again
+    assert len(p.observe([_state("http://a:1", failures=3), b],
+                         now=7.0)) == 1
+
+
+def test_planner_skips_without_viable_destination():
+    """Destinations must absorb the shed AND keep dst_min_free —
+    a squeezed destination would become the next source."""
+    p = MigrationPlanner(migrate_fraction=0.25, dst_min_free=8)
+    b = _state("http://b:1", free=66, active=190)  # 66 < 64 + 8
+    p.observe([_state("http://a:1", failures=0), b], now=0.0)
+    out = p.observe([_state("http://a:1", failures=1), b], now=10.0)
+    assert out == []
+    assert p.decisions["skip_no_dst"] == 1
+
+
+def test_planner_picks_most_free_destination():
+    p = MigrationPlanner(migrate_fraction=0.25)
+    b = _state("http://b:1", free=120, active=130)
+    c = _state("http://c:1", free=200, active=50)
+    p.observe([_state("http://a:1", failures=0), b, c], now=0.0)
+    out = p.observe([_state("http://a:1", failures=1), b, c],
+                    now=10.0)
+    assert out[0].dst == "http://c:1"
+
+
+def test_planner_departed_replica_rebaselines_on_return():
+    p = MigrationPlanner()
+    b = _state("http://b:1", free=200, active=40)
+    p.observe([_state("http://a:1", failures=5), b], now=0.0)
+    p.observe([b], now=1.0)                # a left the fleet
+    # a returns with a HIGHER counter: must baseline, not migrate
+    # (a restart reset its counters; stale deltas would be garbage)
+    assert p.observe([_state("http://a:1", failures=9), b],
+                     now=10.0) == []
+
+
+# ---------------------------------------------------------------------------
+# DecodeSelector.rehome: locality evidence follows the bytes
+# ---------------------------------------------------------------------------
+
+
+def test_selector_rehome_digest_scoped_and_whole_replica():
+    sel = DecodeSelector(chunk_chars=4)
+    d1, d2, d3 = b"d1" * 4, b"d2" * 4, b"d3" * 4
+    sel.on_decode_routed([d1, d2], "http://a:1")
+    sel.on_decode_routed([d3], "http://a:1")
+    sel.on_decode_routed([d2], "http://b:1")
+
+    assert sel.rehome("http://a:1", "http://a:1") == 0   # no-op
+    assert sel.rehome("http://a:1", "http://c:1",
+                      digests=[d1]) == 1
+    assert sel._chunks[d1] == ["http://c:1"]
+    assert "http://a:1" in sel._chunks[d2]               # untouched
+
+    # whole-replica form (the planner's: engine chunk keys and router
+    # prompt digests are different hash spaces)
+    moved = sel.rehome("http://a:1", "http://b:1")
+    assert moved == 2                                    # d2 + d3
+    assert sel._chunks[d2] == ["http://b:1"]             # deduped
+    assert sel._chunks[d3] == ["http://b:1"]
+    assert all("http://a:1" not in urls
+               for urls in sel._chunks.values())
+    assert "http://b:1" in sel._seen_urls
+
+
+def test_router_rehome_endpoint():
+    async def body():
+        decode = FakeEngine(model="fake-model")
+        prefill = FakeEngine(model="fake-model")
+        decode_srv = TestServer(decode.build_app())
+        prefill_srv = TestServer(prefill.build_app())
+        await decode_srv.start_server()
+        await prefill_srv.start_server()
+        decode_url = f"http://127.0.0.1:{decode_srv.port}"
+        args = router_args([
+            "--service-discovery", "static",
+            "--static-backends", decode_url,
+            "--static-models", "fake-model",
+            "--prefill-backends",
+            f"http://127.0.0.1:{prefill_srv.port}",
+            "--prefill-models", "fake-model"])
+        router = build_router_app(args)
+        sel = router["state"]["disagg"].selector
+        assert sel is not None
+        d = b"x" * 16
+        sel.on_decode_routed([d], "http://old:1")
+        async with TestClient(TestServer(router)) as client:
+            # unknown destination -> 404 (typo'd URL must not collect
+            # locality credit)
+            r = await client.post("/admin/kvplane/rehome", json={
+                "from": "http://old:1", "to": "http://nope:9"})
+            assert r.status == 404
+            # malformed -> 400
+            r = await client.post("/admin/kvplane/rehome", json={
+                "from": "http://old:1"})
+            assert r.status == 400
+            r = await client.post("/admin/kvplane/rehome", json={
+                "from": "http://old:1", "to": decode_url,
+                "digests": [d.hex()]})
+            assert r.status == 200
+            out = await r.json()
+            assert out == {"enabled": True, "rehomed": 1}
+            assert sel._chunks[d] == [decode_url]
+        await decode_srv.close()
+        await prefill_srv.close()
+    asyncio.run(body())
+
+
+def test_router_rehome_disabled_without_selector():
+    async def body():
+        eng = FakeEngine(model="fake-model")
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        url = f"http://127.0.0.1:{srv.port}"
+        args = router_args([
+            "--service-discovery", "static",
+            "--static-backends", url,
+            "--static-models", "fake-model"])
+        router = build_router_app(args)
+        async with TestClient(TestServer(router)) as client:
+            r = await client.post("/admin/kvplane/rehome", json={
+                "from": "http://a:1", "to": url})
+            assert r.status == 200
+            assert await r.json() == {"enabled": False, "rehomed": 0}
+        await srv.close()
+    asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# fake engine: injected kv_pool census (the storm rig's engine)
+# ---------------------------------------------------------------------------
+
+FRAGMENTED = {"num_blocks": 128, "free": 4, "active": 124, "cached": 0,
+              "blocks_per_request": 16, "free_contiguity": 0.1}
+
+
+def _chat_body(tag="q"):
+    return {"model": "fake-model", "max_tokens": 2,
+            "messages": [{"role": "user", "content": f"hello {tag}"}]}
+
+
+def test_fake_engine_kv_pool_admission_and_migration():
+    async def body():
+        eng = FakeEngine(model="fake-model", num_tokens=2,
+                         tokens_per_s=0)
+        async with TestClient(TestServer(eng.build_app())) as client:
+            # no pool injected: /load carries the default-healthy census
+            r = await client.get("/load")
+            pool = (await r.json())["kv_pool"]
+            assert pool["alloc_failures_fragmented"] == 0
+
+            r = await client.post("/fault", json={
+                "kv_pool": dict(FRAGMENTED)})
+            assert r.status == 200
+
+            # 4 free + 0 cached < 16 per request -> fragmented 503
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body())
+            assert r.status == 503
+            assert r.headers.get("Retry-After") == "1"
+            err = (await r.json())["error"]
+            assert err["code"] == "kv_pool_fragmented"
+
+            r = await client.get("/load")
+            pool = (await r.json())["kv_pool"]
+            assert pool["alloc_failures_fragmented"] == 1
+            assert pool["allocs"] == 1
+
+            # migrate_out frees blocks and returns one key per block
+            r = await client.post("/admin/kvplane/migrate_out",
+                                  json={"target_blocks": 48})
+            out = await r.json()
+            assert r.status == 200
+            assert out["freed_blocks"] == 48
+            assert len(out["keys"]) == 48
+            assert out["migrated"]
+
+            # admission now succeeds; census invariant: blocks moved
+            # free<->active, num_blocks constant
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("after"))
+            assert r.status == 200
+            r = await client.get("/load")
+            pool = (await r.json())["kv_pool"]
+            assert pool["num_blocks"] == 128
+            assert pool["free"] == 4 + 48
+            assert pool["active"] == 124 - 48
+
+            # a destination warm claims free blocks into cached
+            r = await client.post("/admin/kvplane/warm",
+                                  json={"keys": out["keys"][:8]})
+            warm = await r.json()
+            assert warm["warmed"] == 8
+            r = await client.get("/load")
+            pool = (await r.json())["kv_pool"]
+            assert pool["cached"] == 8
+
+            # metrics surface the census + kvplane counters
+            r = await client.get("/metrics")
+            lines = (await r.text()).splitlines()
+
+            def value_of(family, label=None):
+                for ln in lines:
+                    if ln.startswith(family) and \
+                            (label is None or label in ln):
+                        return float(ln.rsplit(" ", 1)[1])
+                return None
+
+            assert value_of("tpu:kvpool_alloc_failures_total",
+                            'reason="fragmented"') == 1
+            # per-victim-sequence, like the real engine's
+            # metrics.kvplane_migrations.inc(len(victims)):
+            # 48 blocks / 16 per request = 3 victims
+            assert value_of("tpu:kvplane_migrations_total") == 3
+            assert value_of("tpu:kvplane_warmed_chunks_total") == 8
+            assert value_of("tpu:kvpool_blocks",
+                            'state="cached"') == 8
+
+            # kv_pool: null clears the injection entirely
+            r = await client.post("/fault", json={"kv_pool": None})
+            assert r.status == 200
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body("cleared"))
+            assert r.status == 200
+    asyncio.run(body())
+
+
+def test_fake_engine_kv_pool_exhausted_vs_fragmented():
+    async def body():
+        eng = FakeEngine(model="fake-model", num_tokens=2,
+                         tokens_per_s=0)
+        async with TestClient(TestServer(eng.build_app())) as client:
+            await client.post("/fault", json={"kv_pool": {
+                "num_blocks": 32, "free": 0, "active": 32,
+                "blocks_per_request": 4}})
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat_body())
+            assert r.status == 503
+            err = (await r.json())["error"]
+            assert err["code"] == "kv_pool_exhausted"
+            r = await client.get("/load")
+            pool = (await r.json())["kv_pool"]
+            assert pool["alloc_failures_exhausted"] == 1
+            assert pool["alloc_failures_fragmented"] == 0
+    asyncio.run(body())
+
+
+def test_fake_engine_migrate_out_without_pool_409():
+    async def body():
+        eng = FakeEngine(model="fake-model")
+        async with TestClient(TestServer(eng.build_app())) as client:
+            r = await client.post("/admin/kvplane/migrate_out",
+                                  json={})
+            assert r.status == 409
+            r = await client.post("/admin/kvplane/warm",
+                                  json={"keys": "nope"})
+            assert r.status == 400
+    asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# planner poll loop end-to-end against in-process replicas
+# ---------------------------------------------------------------------------
+
+
+def test_poller_migrates_fragmented_replica_end_to_end():
+    """Two fake replicas, A fragmented / B free: one failure delta
+    must produce exactly one migrate_out -> warm hand-off, after
+    which A admits requests again — at constant aggregate blocks."""
+    async def body():
+        a = FakeEngine(model="fake-model", num_tokens=2, tokens_per_s=0)
+        b = FakeEngine(model="fake-model", num_tokens=2, tokens_per_s=0)
+        srv_a = TestServer(a.build_app())
+        srv_b = TestServer(b.build_app())
+        await srv_a.start_server()
+        await srv_b.start_server()
+        url_a = f"http://127.0.0.1:{srv_a.port}"
+        url_b = f"http://127.0.0.1:{srv_b.port}"
+        poller = KVPlanePoller([url_a, url_b], poll_interval_s=99,
+                               planner=MigrationPlanner(
+                                   migrate_fraction=0.25,
+                                   cooldown_s=0.0))
+        import aiohttp
+        poller._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=3))
+        try:
+            async with TestClient(srv_a) as ca:
+                await ca.post("/fault", json={"kv_pool": {
+                    "num_blocks": 256, "free": 4, "active": 252,
+                    "cached": 0, "blocks_per_request": 16}})
+                async with TestClient(srv_b) as cb:
+                    await cb.post("/fault", json={"kv_pool": {
+                        "num_blocks": 256, "free": 224, "active": 32,
+                        "cached": 0, "blocks_per_request": 16}})
+
+                    # pass 1 baselines — no failures yet, no decisions
+                    assert await poller.poll_once() == []
+
+                    r = await ca.post("/v1/chat/completions",
+                                      json=_chat_body())
+                    assert r.status == 503
+
+                    decisions = await poller.poll_once()
+                    assert len(decisions) == 1
+                    assert decisions[0].src == url_a
+                    assert decisions[0].dst == url_b
+                    assert poller.moves == 1
+                    assert poller.moved_blocks == 64   # 256 * 0.25
+                    assert poller.warmed_chunks == 64
+                    assert poller.move_errors == 0
+
+                    # A admits again; fleet blocks conserved
+                    r = await ca.post("/v1/chat/completions",
+                                      json=_chat_body("after"))
+                    assert r.status == 200
+                    la = (await (await ca.get("/load")).json())["kv_pool"]
+                    lb = (await (await cb.get("/load")).json())["kv_pool"]
+                    assert la["free"] == 4 + 64
+                    assert lb["cached"] == 64
+                    assert la["num_blocks"] + lb["num_blocks"] == 512
+
+                    st = poller.status()
+                    assert st["moves"] == 1
+                    assert st["recent_moves"][0]["freed_blocks"] == 64
+                    assert st["replicas"][url_a] is not None
+        finally:
+            await poller._session.close()
+            await srv_a.close()
+            await srv_b.close()
+    asyncio.run(body())
+
+
+def test_poller_counts_unreachable_and_censusless_replicas():
+    async def body():
+        eng = FakeEngine(model="fake-model")
+        srv = TestServer(eng.build_app())
+        await srv.start_server()
+        url = f"http://127.0.0.1:{srv.port}"
+        dead = "http://127.0.0.1:1"          # nothing listens there
+        poller = KVPlanePoller([url, dead], timeout_s=1.0)
+        import aiohttp
+        poller._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=1))
+        try:
+            await poller.poll_once()
+            # the fake always carries a census; only the dead replica
+            # counts as a poll error
+            assert poller.poll_errors == 1
+            assert dead in poller.unreachable
+            assert url in poller.last_census
+        finally:
+            await poller._session.close()
+            await srv.close()
+    asyncio.run(body())

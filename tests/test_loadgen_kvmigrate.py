@@ -1,0 +1,192 @@
+"""kvmigrate rig tier: the kvplane closed loop (KVMIGRATE_r19.json)
+must be reproducible from a fresh clone, and its pass/fail contract
+must actually discriminate.
+
+Tier-1: the violations contract over synthetic records (every gate
+fires on the record shape the rig writes, including the anti-vacuity
+breach), the CLI wiring, and the full rig smoke — fragmented storm
+with the real subprocess planner + the raw-vs-int4 codec capacity
+re-run, all on fake engines.
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from production_stack_tpu.loadgen.kvmigrate import (kvmigrate_violations,
+                                                    run_kvmigrate)
+
+
+def _half(attempts=100, failures=0, errors=0):
+    return {"alloc_attempts": attempts,
+            "fragmented_failures": failures,
+            "failure_rate": round(failures / attempts, 4)
+            if attempts else 0.0,
+            "client": {"requests": attempts, "ok": attempts - failures,
+                       "rejected_503": failures, "errors": errors}}
+
+
+def _passing_record():
+    storm_on = {
+        "migration": True,
+        "halves": [_half(failures=30), _half(failures=0)],
+        "aggregate_blocks_before": 512,
+        "aggregate_blocks_after": 512,
+        "planner": {"moves": 1, "moved_blocks": 64,
+                    "warmed_chunks": 64, "move_errors": 0,
+                    "decisions": {"migrate": 1}, "recent_moves": []},
+    }
+    storm_off = {
+        "migration": False,
+        "halves": [_half(failures=40), _half(failures=45)],
+        "aggregate_blocks_before": 512,
+        "aggregate_blocks_after": 512,
+        "planner": None,
+    }
+
+    def phase(ratio, ttft):
+        physical = int(80 * 16384 / ratio)
+        return {"errors": 0, "hit_rate": 0.75,
+                "bytes_saved": 80 * 16384,
+                "cache_server": {"bytes": physical, "count": 80},
+                "ttft_followup": {"mean": ttft, "p50": ttft}}
+
+    return {
+        "metric": "kvplane migration storm",
+        "value": 0.0,
+        "detail": {
+            "storm": {"on": storm_on, "off": storm_off},
+            "codec": {
+                "name": "int4",
+                "chunk_logical_bytes": 16384,
+                "raw": phase(1.0, 150.0),
+                "compressed": phase(3.2, 170.0),
+                "capacity_ratio": {"raw": 1.0, "int4": 3.2},
+                "ttft_followup_p50_ms": {"raw": 150.0,
+                                         "int4": 170.0},
+                "ttft_followup_mean_ms": {"raw": 150.0,
+                                          "int4": 170.0},
+            },
+        },
+    }
+
+
+def test_violations_pass_on_healthy_record():
+    assert kvmigrate_violations(_passing_record()) == []
+
+
+def test_violations_migration_did_not_recover():
+    rec = _passing_record()
+    rec["detail"]["storm"]["on"]["halves"][1] = _half(failures=20)
+    out = kvmigrate_violations(rec)
+    assert any("did not erase" in v for v in out)
+
+
+def test_violations_require_planner_moves():
+    """Recovery without planner moves means something ELSE fixed the
+    pool — the rig must refuse to credit kvplane."""
+    rec = _passing_record()
+    rec["detail"]["storm"]["on"]["planner"]["moves"] = 0
+    out = kvmigrate_violations(rec)
+    assert any("no migrations" in v for v in out)
+
+
+def test_violations_anti_vacuity_off_phase_must_fail():
+    rec = _passing_record()
+    rec["detail"]["storm"]["off"]["halves"][1] = _half(failures=2)
+    out = kvmigrate_violations(rec)
+    assert any("anti-vacuity" in v for v in out)
+
+
+def test_violations_aggregate_blocks_must_be_conserved():
+    rec = _passing_record()
+    rec["detail"]["storm"]["on"]["aggregate_blocks_after"] = 576
+    out = kvmigrate_violations(rec)
+    assert any("mint" in v for v in out)
+
+
+def test_violations_storm_client_errors():
+    rec = _passing_record()
+    rec["detail"]["storm"]["off"]["halves"][0] = _half(errors=3)
+    out = kvmigrate_violations(rec)
+    assert any("non-503 client errors" in v for v in out)
+
+
+def test_violations_no_alloc_attempts_is_vacuous():
+    rec = _passing_record()
+    rec["detail"]["storm"]["on"]["halves"][1] = _half(attempts=0)
+    out = kvmigrate_violations(rec)
+    assert any("never exercised" in v for v in out)
+
+
+def test_violations_capacity_ratio_floor():
+    rec = _passing_record()
+    rec["detail"]["codec"]["capacity_ratio"]["int4"] = 1.7
+    out = kvmigrate_violations(rec)
+    assert any("capacity ratio" in v and "1.70x" in v for v in out)
+
+
+def test_violations_raw_ratio_sanity_band():
+    """An inflated raw ratio means the logical/physical accounting is
+    broken — the int4 gate would be meaningless."""
+    rec = _passing_record()
+    rec["detail"]["codec"]["capacity_ratio"]["raw"] = 1.4
+    out = kvmigrate_violations(rec)
+    assert any("accounting" in v for v in out)
+
+
+def test_violations_unmeasured_capacity_ratio():
+    rec = _passing_record()
+    rec["detail"]["codec"]["capacity_ratio"]["int4"] = None
+    out = kvmigrate_violations(rec)
+    assert any("unmeasured" in v for v in out)
+
+
+def test_violations_compressed_ttft_tolerance():
+    rec = _passing_record()
+    rec["detail"]["codec"]["ttft_followup_p50_ms"]["int4"] = 200.0
+    out = kvmigrate_violations(rec)
+    assert any("TTFT" in v and "exceeds" in v for v in out)
+    # and within-tolerance passes
+    rec["detail"]["codec"]["ttft_followup_p50_ms"]["int4"] = 185.0
+    assert kvmigrate_violations(rec) == []
+
+
+def test_violations_codec_hit_rate_floor():
+    rec = _passing_record()
+    rec["detail"]["codec"]["compressed"]["hit_rate"] = 0.3
+    out = kvmigrate_violations(rec)
+    assert any("hit rate" in v for v in out)
+
+
+def test_cli_parser_kvmigrate_defaults():
+    from production_stack_tpu.loadgen.__main__ import build_parser
+    args = build_parser().parse_args(["kvmigrate"])
+    assert args.fn.__name__ == "cmd_kvmigrate"
+    assert args.codec == "int4"           # the >=2x gate codec
+    assert args.min_capacity_ratio == 2.0
+    assert args.max_on_failure_rate == 0.02
+    assert args.min_off_failure_rate == 0.2
+    assert args.storm_workers == 4
+
+
+def test_fake_engine_kvmigrate_smoke(tmp_path):
+    """The full closed loop at reduced scale: fragmentation storm with
+    the real subprocess planner (ON must collapse engine-census
+    failures, OFF must keep failing) plus the raw-vs-int4 codec
+    capacity phases against a real cache server."""
+    record = asyncio.run(run_kvmigrate(
+        storm_duration_s=6.0, storm_workers=3, sessions=3, rounds=6,
+        log_dir=str(tmp_path / "logs")))
+    # reduced scale sits near the default hit-rate floor (3 sessions
+    # leave the cold round a large fraction of all fetches) and makes
+    # the ms-scale TTFT delta noisy; the committed artifact runs the
+    # full-scale rig against the strict defaults
+    violations = kvmigrate_violations(record, min_hit_rate=0.5,
+                                      ttft_tolerance=0.5)
+    assert violations == [], violations
+    d = record["detail"]
+    assert d["storm"]["on"]["planner"]["moves"] >= 1
+    assert d["storm"]["off"]["halves"][1]["fragmented_failures"] > 0
+    assert d["codec"]["capacity_ratio"]["int4"] >= 2.0

@@ -31,6 +31,7 @@ SURFACES = {
     "autoscaler": REPO / "production_stack_tpu" / "autoscaler"
     / "__main__.py",
     "obsplane": REPO / "production_stack_tpu" / "obsplane" / "app.py",
+    "kvplane": REPO / "production_stack_tpu" / "kvplane" / "app.py",
 }
 
 FLAG_RE = re.compile(r'add_argument\(\s*"(--[a-z0-9][a-z0-9-]*)"')

@@ -62,7 +62,8 @@ def main() -> int:
     # the walk is a literal scan, so a moved package silently drops
     # its families from the check — pin the prefixes the scan must
     # keep finding (the obsplane's tpu:fleet_* joined in r18)
-    for prefix in ("tpu:fleet_", "tpu:slo_", "tpu:engine_"):
+    for prefix in ("tpu:fleet_", "tpu:slo_", "tpu:engine_",
+                   "tpu:kvplane_"):
         if not any(n.startswith(prefix) for n in registered):
             print(f"registry walk found NO {prefix}* families — the "
                   f"scan lost a package", file=sys.stderr)
