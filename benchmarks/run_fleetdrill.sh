@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Fleet-pilot closed loop: the committed FLEETDRILL_r20.json recipe.
+# Three scenarios, SLO windows scaled to seconds:
+#
+#   burn       — the SAME latency burn run twice: the burn-rate pilot
+#                (FleetSignalCollector off the obsplane's /fleet,
+#                --burn-rate-input) must scale on the page alert
+#                (reason burn_rate, signal source fleet) and resolve
+#                with zero shed at LOWER replica-seconds than the
+#                embedded queue-delay-only control run.
+#   remediate  — slow_ttft on ONE engine of a fixed fleet: the armed
+#                remediator must drain, restart, breaker-reset and
+#                verify the alert resolves hands-off, with EXACTLY ONE
+#                executed remediation in the decision log and zero
+#                client-visible errors.
+#   killswitch — the same injection with the kill-switch down: the
+#                attempt must be logged suppressed_killswitch, nothing
+#                may actuate, and the alert must still be burning when
+#                the drill checks (anti-vacuity).
+#
+#   ./benchmarks/run_fleetdrill.sh                     # all three
+#   SCENARIOS=burn ./benchmarks/run_fleetdrill.sh
+#
+# Exit 1 on any violation: missed/unresolved alert, wrong scale-up
+# reason or signal source, pilot not beating the control, shed or
+# client-visible errors, wrong remediation count/target/outcome, or
+# an unproven kill-switch suppression.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${OUT:-FLEETDRILL_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ -n "${SCENARIOS:-}" ]; then
+  EXTRA+=(--scenarios "$SCENARIOS")
+fi
+
+python -m production_stack_tpu.loadgen fleetdrill \
+  --engines "${ENGINES:-3}" \
+  --users "${USERS:-6}" \
+  --baseline "${BASELINE:-6s}" \
+  --window-scale "${WINDOW_SCALE:-0.01}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "fleetdrill record: $OUT"

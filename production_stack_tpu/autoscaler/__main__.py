@@ -17,6 +17,14 @@ fleet. Two actuator modes:
 Signals come from ``--engines`` (comma-separated engine URLs, each
 polled on ``/load``) plus ``--router-url`` for the router's healthy
 count. ``--metrics-port`` serves tpu:autoscaler_* gauges.
+
+**Fleet pilot** (docs/autoscaling.md "Fleet pilot"): ``--obsplane-url``
+switches the collector to the obsplane's ``GET /fleet`` (burn-rate
+alerts + per-stage phase percentiles ride along; raw ``/load`` polling
+stays wired as the degradation path). ``--burn-rate-input``,
+``--phase-p95-target`` and ``--schedule`` enable the three pilot
+policy inputs; ``--remediate`` (kill-switch, default off) arms the
+bounded incident remediator against the same obsplane.
 """
 
 import argparse
@@ -27,11 +35,16 @@ from aiohttp import web
 
 from production_stack_tpu.autoscaler.actuator import (Actuator,
                                                       KubernetesActuator)
-from production_stack_tpu.autoscaler.collector import SignalCollector
+from production_stack_tpu.autoscaler.collector import (
+    FleetSignalCollector, SignalCollector)
 from production_stack_tpu.autoscaler.controller import (Autoscaler,
                                                         AutoscalerMetrics)
 from production_stack_tpu.autoscaler.policy import (AutoscalerPolicy,
-                                                    PolicyConfig)
+                                                    PolicyConfig,
+                                                    parse_phase_targets,
+                                                    parse_schedule)
+from production_stack_tpu.autoscaler.remediator import (RemediationPolicy,
+                                                        Remediator)
 from production_stack_tpu.utils import init_logger, parse_comma_separated
 
 logger = init_logger(__name__)
@@ -73,6 +86,21 @@ def add_policy_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--down-cooldown", type=float, default=60.0)
     p.add_argument("--up-breach-ticks", type=int, default=2)
     p.add_argument("--down-breach-ticks", type=int, default=3)
+    p.add_argument("--burn-rate-input", action="store_true",
+                   help="fleet pilot: a firing page-severity burn-rate "
+                        "alert in GET /fleet is an immediate scale-up "
+                        "breach (no consecutive-tick requirement) and "
+                        "blocks scale-down while burning")
+    p.add_argument("--phase-p95-target", default="",
+                   help="fleet pilot: per-stage p95 bounds from the "
+                        "obsplane's stitched phase percentiles, e.g. "
+                        "'engine.prefill=250,engine.queued=500' (ms); "
+                        "any breach is a scale-up signal")
+    p.add_argument("--schedule", default="",
+                   help="fleet pilot: wall-clock replica floors for "
+                        "predictable ramps, e.g. "
+                        "'08:00-18:00=3,18:00-22:00=2' (end before "
+                        "start wraps midnight)")
 
 
 def policy_config(args: argparse.Namespace) -> PolicyConfig:
@@ -87,7 +115,11 @@ def policy_config(args: argparse.Namespace) -> PolicyConfig:
         up_cooldown_s=args.up_cooldown,
         down_cooldown_s=args.down_cooldown,
         up_breach_ticks=args.up_breach_ticks,
-        down_breach_ticks=args.down_breach_ticks).validate()
+        down_breach_ticks=args.down_breach_ticks,
+        burn_rate_input=args.burn_rate_input,
+        phase_p95_targets=(parse_phase_targets(args.phase_p95_target)
+                           or None),
+        scheduled_floors=parse_schedule(args.schedule)).validate()
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -107,10 +139,47 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "annotates every decision record (defaults to "
                         "--router-url when that is set; 'off' "
                         "disables)")
+    p.add_argument("--obsplane-url", default=None,
+                   help="fleet pilot: obsplane base URL; the collector "
+                        "consumes GET /fleet (alerts + phase "
+                        "percentiles ride along) and degrades to raw "
+                        "/load polling whenever it is unreachable or "
+                        "stale")
+    p.add_argument("--fleet-freshness", type=float, default=10.0,
+                   help="max age (s) of a /fleet per-engine sample "
+                        "before the pilot treats the snapshot as stale "
+                        "and falls back to /load")
     p.add_argument("--interval", type=float, default=5.0,
                    help="seconds between control ticks")
     p.add_argument("--decision-log", default=None,
                    help="append one JSON line per tick here")
+    p.add_argument("--decision-log-max-bytes", type=int,
+                   default=16 * 1024 * 1024,
+                   help="rotate the decision log to .1 at this size "
+                        "(disk footprint stays bounded at ~2x)")
+    p.add_argument("--remediate", action="store_true",
+                   help="KILL-SWITCH for incident auto-remediation "
+                        "(default off): when set AND --obsplane-url "
+                        "is given, high-confidence incident "
+                        "attributions are drained/breaker-reset "
+                        "within the bounds below; without it every "
+                        "attempt is logged suppressed_killswitch")
+    p.add_argument("--remediate-confidence", default="high",
+                   choices=("high", "medium", "none"),
+                   help="minimum attribution confidence the "
+                        "remediator will act on")
+    p.add_argument("--remediate-rate", type=int, default=1,
+                   help="max executed remediations per window")
+    p.add_argument("--remediate-window", type=float, default=600.0,
+                   help="the rate-limit window (s)")
+    p.add_argument("--remediate-cooldown", type=float, default=120.0,
+                   help="seconds after an executed remediation before "
+                        "the next may run")
+    p.add_argument("--remediate-verify-timeout", type=float,
+                   default=60.0,
+                   help="bounded wait for the triggering alert to "
+                        "leave the firing set before the attempt is "
+                        "logged unresolved")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="serve tpu:autoscaler_* on this port (0 = off)")
     p.add_argument("--k8s-deployment", default=None,
@@ -182,9 +251,17 @@ async def amain(args: argparse.Namespace) -> None:
             dry_run=not args.k8s_live)
     else:
         actuator = _ObserveOnlyActuator(initial)
-    collector = SignalCollector(lambda: urls,
-                                router_url=args.router_url,
-                                poll_interval_s=args.interval)
+    if args.obsplane_url:
+        collector = FleetSignalCollector(
+            lambda: urls,
+            obsplane_url=args.obsplane_url,
+            router_url=args.router_url,
+            poll_interval_s=args.interval,
+            freshness_s=args.fleet_freshness)
+    else:
+        collector = SignalCollector(lambda: urls,
+                                    router_url=args.router_url,
+                                    poll_interval_s=args.interval)
     alerts_fetch = None
     # with N router replicas, alerts come from the first listed one
     # (every replica computes its own burn off its own traffic; any
@@ -193,10 +270,28 @@ async def amain(args: argparse.Namespace) -> None:
     alerts_url = args.alerts_url or first_router
     if alerts_url and alerts_url != "off":
         alerts_fetch = make_alerts_fetch(alerts_url.rstrip("/"))
+    remediator = None
+    if args.obsplane_url:
+        # constructed even with the kill-switch down: suppressed
+        # attempts must land in the decision log so "the pilot saw it
+        # and chose not to act" is auditable
+        remediator = Remediator(
+            obsplane_url=args.obsplane_url,
+            router_urls=args.router_url or [],
+            policy=RemediationPolicy(
+                enabled=args.remediate,
+                confidence_floor=args.remediate_confidence,
+                max_per_window=args.remediate_rate,
+                window_s=args.remediate_window,
+                cooldown_s=args.remediate_cooldown,
+                verify_timeout_s=args.remediate_verify_timeout),
+            engine_urls_fn=lambda: urls)
     scaler = Autoscaler(AutoscalerPolicy(policy_config(args)), actuator,
                         collector, interval_s=args.interval,
                         decision_log_path=args.decision_log,
-                        alerts_fetch=alerts_fetch)
+                        decision_log_max_bytes=args.decision_log_max_bytes,
+                        alerts_fetch=alerts_fetch,
+                        remediator=remediator)
     runner = await serve_metrics(scaler.metrics, args.metrics_port)
     await scaler.start()
     try:
@@ -204,6 +299,8 @@ async def amain(args: argparse.Namespace) -> None:
             await asyncio.sleep(3600)
     finally:
         await scaler.close()
+        if remediator is not None:
+            await remediator.close()
         if alerts_fetch is not None:
             await alerts_fetch.aclose()
         if runner is not None:

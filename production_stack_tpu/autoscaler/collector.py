@@ -18,6 +18,15 @@ Pass ``poller=`` to share an existing poller (e.g. the router's
 ``EngineStatsScraper`` when the autoscaler runs in the router process)
 so each engine is scraped once per interval no matter how many
 consumers read it.
+
+``FleetSignalCollector`` (r20 fleet pilot) consumes the obsplane's
+``GET /fleet`` instead: the same per-engine numbers (scraped once for
+the whole fleet by the aggregator), PLUS the burn-rate alerts and
+live per-stage phase percentiles the raw loop never sees. When the
+obsplane is unreachable or stale it degrades to exactly the raw
+``/load`` pass above — the pilot is never *less* robust than the dumb
+loop it replaces — and every signal carries its ``source`` so the
+decision log shows which path produced each decision.
 """
 
 import asyncio
@@ -27,7 +36,9 @@ from typing import Callable, Dict, Iterable, Optional
 import aiohttp
 
 from production_stack_tpu.autoscaler.policy import FleetSignal
-from production_stack_tpu.signals import EngineLoad, LoadPoller, coerce_load
+from production_stack_tpu.signals import (EngineLoad, LoadPoller,
+                                          coerce_load,
+                                          parse_load_report)
 from production_stack_tpu.utils import init_logger
 
 logger = init_logger(__name__)
@@ -134,3 +145,129 @@ class SignalCollector:
                 return body.get("healthy_endpoints")
         except (aiohttp.ClientError, asyncio.TimeoutError, ValueError):
             return None
+
+
+class FleetSignalCollector(SignalCollector):
+    """The fleet pilot's collector: ``GET /fleet`` first, raw ``/load``
+    as the degradation path (module docstring).
+
+    Freshness is judged per engine from the snapshot's own sample ages
+    (``autoscaler_signal[url].age_s``): an obsplane that answers HTTP
+    but whose poll loop died serves stale rows, and stale rows for
+    every managed engine mean the payload is unusable — fall back,
+    same as unreachable."""
+
+    def __init__(self, get_urls: Callable[[], Iterable[str]], *,
+                 obsplane_url: str,
+                 router_url=None,
+                 poller: Optional[LoadPoller] = None,
+                 poll_interval_s: float = 5.0,
+                 freshness_s: float = 10.0,
+                 fleet_timeout_s: float = 3.0):
+        super().__init__(get_urls, router_url=router_url,
+                         poller=poller,
+                         poll_interval_s=poll_interval_s,
+                         freshness_s=freshness_s)
+        self.obsplane_url = obsplane_url.rstrip("/")
+        self._fleet_timeout = aiohttp.ClientTimeout(
+            total=fleet_timeout_s)
+        self.last_source: Optional[str] = None
+        self.fleet_polls = 0
+        self.fleet_failures = 0
+        # last USABLE fleet rows, for per_engine() victim picking
+        self._fleet_rows: Dict[str, dict] = {}
+
+    async def _fetch_fleet(self) -> Optional[dict]:
+        if self._session is None:
+            return None
+        try:
+            async with self._session.get(
+                    f"{self.obsplane_url}/fleet",
+                    timeout=self._fleet_timeout) as r:
+                if r.status != 200:
+                    return None
+                return await r.json()
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError, ValueError):
+            return None
+
+    def _note_source(self, source: str) -> None:
+        if source != self.last_source:
+            if source == "load":
+                logger.warning(
+                    "fleet pilot degrading to raw /load polling "
+                    "(obsplane %s unreachable or stale)",
+                    self.obsplane_url)
+            else:
+                logger.info("fleet pilot consuming %s/fleet",
+                            self.obsplane_url)
+        self.last_source = source
+
+    async def collect(self,
+                      replicas: Optional[int] = None) -> FleetSignal:
+        self.fleet_polls += 1
+        fleet = await self._fetch_fleet()
+        urls = [u.rstrip("/") for u in self._get_urls()]
+        fresh: Dict[str, dict] = {}
+        if fleet is not None:
+            block = fleet.get("autoscaler_signal") or {}
+            fresh = {
+                u: row for u, row in block.items()
+                if u in urls and row.get("state") == "live"
+                and row.get("age_s") is not None
+                and row["age_s"] <= self.freshness_s
+                and "in_flight" in row}
+        if fleet is None or (urls and not fresh):
+            # unreachable, or every managed engine's row is stale or
+            # missing: the raw pass is strictly better information
+            self.fleet_failures += 1
+            self._fleet_rows = {}
+            sig = await super().collect(replicas=replicas)
+            self._note_source("load")
+            return sig
+        self._note_source("fleet")
+        self._fleet_rows = fresh
+        n = len(urls) if replicas is None else replicas
+        ready = max(0, min(n, n - (len(urls) - len(fresh))))
+        bounded = {u: row for u, row in fresh.items()
+                   if row.get("capacity")}
+        advertised = [row["capacity"] for row in bounded.values()]
+        percentiles = fleet.get("fleet_percentiles") or {}
+        phase_p95: Dict[str, float] = {}
+        for phases in percentiles.values():
+            for phase, row in phases.items():
+                p95 = row.get("p95_ms")
+                if p95 is not None:
+                    phase_p95[phase] = max(phase_p95.get(phase, 0.0),
+                                           p95)
+        return FleetSignal(
+            replicas=n,
+            ready=ready,
+            in_flight=sum(row["in_flight"] for row in fresh.values()),
+            capacity=sum(advertised) if advertised else None,
+            bounded_in_flight=(sum(row["in_flight"]
+                                   for row in bounded.values())
+                               if advertised else None),
+            queue_delay_ms=max(
+                (row.get("est_queue_delay_ms") or 0.0
+                 for row in fresh.values()), default=0.0),
+            router_healthy=await self._router_healthy(),
+            source="fleet",
+            alerts_firing=tuple(fleet.get("firing_alerts") or ()),
+            phase_p95_ms=phase_p95 or None,
+        )
+
+    def per_engine(self) -> Dict[str, EngineLoad]:
+        """Victim picking rides the same source as the decision: the
+        fleet rows when the last collect used them, the raw poller
+        otherwise."""
+        if self._fleet_rows:
+            return {
+                url: parse_load_report({
+                    "running": row.get("in_flight"),
+                    "capacity": row.get("capacity"),
+                    "est_queue_delay_ms":
+                        row.get("est_queue_delay_ms"),
+                })
+                for url, row in self._fleet_rows.items()}
+        return super().per_engine()

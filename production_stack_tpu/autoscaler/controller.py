@@ -19,16 +19,30 @@ and-retire is in progress the loop does not evaluate new decisions, so
 cooldowns are measured from *completed* fleet changes and a slow drain
 can never overlap a concurrent scale-up on stale signals.
 
+The decision-log FILE is size-capped (``decision_log_max_bytes``,
+single ``.1`` rotation): a week-long pilot run appends every tick and
+must not grow disk without bound; the newest full generation plus the
+live file always survive.
+
+A ``remediator`` (autoscaler/remediator.py) can ride the same loop:
+after each decision is logged, its tick runs and every remediation
+attempt — executed or suppressed — lands in the SAME decision log
+(``kind: "remediation"``), so one file answers both "why did it
+scale?" and "what did it do to the sick replica?".
+
 Metrics (rendered by ``AutoscalerMetrics``, served by the standalone
 CLI's ``/metrics``):
 
 - ``tpu:autoscaler_replicas{state}``        — ready / starting / draining
 - ``tpu:autoscaler_decisions_total{direction,reason}``
+- ``tpu:autoscaler_signal_source{source}``  — 1 on the active path
+- ``tpu:autoscaler_remediations_total{action,outcome}``
 """
 
 import asyncio
 import collections
 import json
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -58,15 +72,33 @@ class AutoscalerMetrics:
             "Autoscaler decisions by direction and reason (holds "
             "included — every tick is accounted for)",
             ["direction", "reason"], registry=self.registry)
+        self.signal_source = Gauge(
+            "tpu:autoscaler_signal_source",
+            "1 on the signal path the last decision consumed: "
+            "source=fleet (obsplane GET /fleet) or source=load (raw "
+            "per-engine /load degradation path)",
+            ["source"], registry=self.registry)
+        self.remediations = Counter(
+            "tpu:autoscaler_remediations",
+            "Incident remediation attempts by action "
+            "(drain_restart / breaker_reset) and outcome (resolved / "
+            "unresolved / failed / suppressed_*) — suppressions are "
+            "attempts the bounded policy refused, counted so a "
+            "kill-switched pilot is visibly NOT acting",
+            ["action", "outcome"], registry=self.registry)
 
     def observe(self, decision, *, ready: int, draining: int,
-                replicas: int) -> None:
+                replicas: int, source: Optional[str] = None) -> None:
         self.decisions.labels(direction=decision.direction,
                               reason=decision.reason).inc()
         self.replicas.labels(state="ready").set(ready)
         self.replicas.labels(state="draining").set(draining)
         self.replicas.labels(state="starting").set(
             max(0, replicas - ready - draining))
+        if source is not None:
+            for s in ("fleet", "load"):
+                self.signal_source.labels(source=s).set(
+                    1.0 if s == source else 0.0)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -79,18 +111,26 @@ class Autoscaler:
                  collector: SignalCollector, *,
                  interval_s: float = 2.0,
                  decision_log_path: Optional[str] = None,
+                 decision_log_max_bytes: int = 16 * 1024 * 1024,
                  metrics: Optional[AutoscalerMetrics] = None,
                  max_decisions: int = 4096,
-                 alerts_fetch=None):
+                 alerts_fetch=None,
+                 remediator=None):
         self.policy = policy
         self.actuator = actuator
         self.collector = collector
         self.interval_s = interval_s
         self.decision_log_path = decision_log_path
+        self.decision_log_max_bytes = max(4096, decision_log_max_bytes)
         self.metrics = metrics or AutoscalerMetrics()
         self.decisions: collections.deque = collections.deque(
             maxlen=max_decisions)
         self.scale_events: List[dict] = []
+        self.remediation_events: List[dict] = []
+        self.remediator = remediator
+        if remediator is not None and \
+                getattr(remediator, "metrics", None) is None:
+            remediator.metrics = self.metrics
         # optional async callable returning the router's firing
         # burn-rate alert names (slo.py; the standalone CLI wires it to
         # GET {router}/alerts) — each tick's decision record is
@@ -137,7 +177,12 @@ class Autoscaler:
         sig = await self.collector.collect(
             replicas=self.actuator.replicas)
         decision = self.policy.decide(sig, now)
-        record = {"ts": round(time.time(), 3), **decision.to_json()}
+        record = {"ts": round(time.time(), 3),
+                  # top-level provenance stamp: the pilot must make
+                  # "which signal path produced this?" grep-able
+                  # without digging into the signal dict
+                  "signal_source": sig.source,
+                  **decision.to_json()}
         if self._alerts_fetch is not None:
             # annotation only: a dead router must never stall scaling
             try:
@@ -180,6 +225,14 @@ class Autoscaler:
                 self.scale_events.append(record)
 
         self._log(record, sig)
+        if self.remediator is not None:
+            # remediation rides the same loop but must never stall
+            # scaling: its failures are logged, not raised
+            try:
+                for rem in await self.remediator.tick(now):
+                    self._log_remediation(rem)
+            except Exception:
+                logger.exception("remediator tick failed")
         return record
 
     def _pick_victims(self, count: int) -> List[str]:
@@ -198,13 +251,44 @@ class Autoscaler:
             _DecisionView(record["direction"], record["reason"]),
             ready=sig.ready,
             draining=len(self.actuator.draining_urls()),
-            replicas=sig.replicas)
-        if self.decision_log_path:
-            try:
-                with open(self.decision_log_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
-            except OSError:
-                logger.exception("decision log write failed")
+            replicas=sig.replicas,
+            source=record.get("signal_source"))
+        self._append_log_line(record)
+
+    def _log_remediation(self, record: dict) -> None:
+        record = {"kind": "remediation", **record}
+        record.setdefault("ts", round(time.time(), 3))
+        self.decisions.append(record)
+        self.remediation_events.append(record)
+        self.metrics.remediations.labels(
+            action=record.get("action", "none"),
+            outcome=record.get("outcome", "unknown")).inc()
+        self._append_log_line(record)
+
+    def _append_log_line(self, record: dict) -> None:
+        if not self.decision_log_path:
+            return
+        try:
+            self._maybe_rotate_log()
+            with open(self.decision_log_path, "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            logger.exception("decision log write failed")
+
+    def _maybe_rotate_log(self) -> None:
+        """Size-capped rotation: one ``.1`` generation, so the log's
+        disk footprint is bounded at ~2x the cap however long the
+        pilot runs."""
+        try:
+            size = os.path.getsize(self.decision_log_path)
+        except OSError:
+            return
+        if size < self.decision_log_max_bytes:
+            return
+        os.replace(self.decision_log_path,
+                   self.decision_log_path + ".1")
+        logger.info("decision log rotated at %d bytes -> %s.1",
+                    size, self.decision_log_path)
 
     # -- reporting ------------------------------------------------------
 
@@ -226,6 +310,7 @@ class Autoscaler:
                 (e["target"] for e in ups),
                 default=self.actuator.replicas),
             "scale_events": self.scale_events,
+            "remediations": self.remediation_events,
         }
 
 

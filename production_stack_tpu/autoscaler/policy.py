@@ -26,11 +26,28 @@ in-process controller must own):
 - **Settling gate** — while launched replicas are not yet reporting
   load (XLA warmup), scale-down holds: retiring capacity based on a
   fleet that is not fully in service yet double-counts headroom.
+
+Fleet-pilot inputs (r20, ROADMAP item 4) — each one is opt-in and
+rides the same clamps/cooldowns as the raw signals:
+
+- **Burn-rate input** (``burn_rate_input``) — a page-severity SLO
+  alert firing on the fleet IS the breach: scale up immediately
+  (reason ``burn_rate``) without waiting for queue delay to cross its
+  target or for breach ticks — the alert's own multi-window
+  persistence already debounced it. While a page fires, scale-down is
+  off the table.
+- **Phase-percentile input** (``phase_p95_targets``) — live per-stage
+  p95s from the obsplane's stitched chains (e.g. ``engine.prefill``)
+  breach like queue delay does (reason ``phase_p95``), so a pool can
+  be right-sized on the stage it is actually slow in.
+- **Scheduled floors** (``scheduled_floors``) — wall-clock replica
+  floors for diurnal ramps (reason ``scheduled``): capacity is up
+  BEFORE the morning traffic, not two breach ticks after it.
 """
 
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Optional
+from typing import Dict, Optional, Tuple
 
 UP = "up"
 DOWN = "down"
@@ -63,6 +80,15 @@ class PolicyConfig:
     # Size it to comfortably exceed engine warmup at your tick
     # interval (default 120 ticks = 4 min at the 2 s default).
     settling_grace_ticks: int = 120
+    # fleet-pilot inputs (module docstring); all default off so the
+    # raw-signal loop is byte-identical without them
+    burn_rate_input: bool = False
+    # qualified stitched phase -> p95 bound in ms, e.g.
+    # {"engine.prefill": 250.0} (parse_phase_targets)
+    phase_p95_targets: Optional[Dict[str, float]] = None
+    # ((start_minute, end_minute, floor), ...) minutes-of-day local
+    # time; end < start wraps midnight (parse_schedule)
+    scheduled_floors: Tuple[Tuple[int, int, int], ...] = ()
 
     def validate(self) -> "PolicyConfig":
         if self.min_replicas < 1:
@@ -81,7 +107,58 @@ class PolicyConfig:
             raise ValueError("breach tick counts must be >= 1")
         if self.settling_grace_ticks < 1:
             raise ValueError("settling_grace_ticks must be >= 1")
+        for phase, bound in (self.phase_p95_targets or {}).items():
+            if bound <= 0:
+                raise ValueError(f"phase_p95_targets[{phase!r}] must "
+                                 f"be a positive ms bound")
+        for start, end, floor in self.scheduled_floors:
+            if not (0 <= start < 1440 and 0 <= end < 1440):
+                raise ValueError("scheduled floor windows must use "
+                                 "minutes-of-day in [0, 1440)")
+            if floor < 1 or floor > self.max_replicas:
+                raise ValueError(f"scheduled floor {floor} outside "
+                                 f"[1, max_replicas]")
         return self
+
+
+def parse_phase_targets(spec: str) -> Dict[str, float]:
+    """``"engine.prefill=250,router.backend_ttfb=400"`` -> bounds
+    dict keyed by the obsplane's qualified phase names (ms)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"phase target {part!r}: expected "
+                             f"phase=ms")
+        phase, _, ms = part.partition("=")
+        out[phase.strip()] = float(ms)
+    return out
+
+
+def parse_schedule(spec: str) -> Tuple[Tuple[int, int, int], ...]:
+    """``"08:00-18:00=3,22:30-01:00=2"`` -> minute-of-day floor
+    windows; end before start wraps midnight."""
+    def minute(hhmm: str) -> int:
+        hh, _, mm = hhmm.strip().partition(":")
+        m = int(hh) * 60 + int(mm or 0)
+        if not 0 <= m < 1440:
+            raise ValueError(f"bad time of day {hhmm!r}")
+        return m
+
+    out = []
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        window, _, floor = part.partition("=")
+        start, _, end = window.partition("-")
+        if not (start and end and floor):
+            raise ValueError(f"schedule entry {part!r}: expected "
+                             f"HH:MM-HH:MM=replicas")
+        out.append((minute(start), minute(end), int(floor)))
+    return tuple(out)
 
 
 @dataclass
@@ -99,6 +176,17 @@ class FleetSignal:
     bounded_in_flight: Optional[float] = None
     queue_delay_ms: float = 0.0         # max est_queue_delay_ms
     router_healthy: Optional[int] = None  # router's own healthy count
+    # fleet-pilot inputs (FleetSignalCollector); absent on the raw
+    # /load path, so the dumb loop's signals are unchanged
+    source: str = "load"                # "fleet" | "load"
+    # ({"name", "slo", "severity", "router"}, ...) currently firing
+    alerts_firing: Tuple[dict, ...] = ()
+    # qualified stitched phase -> live p95 ms (max across classes)
+    phase_p95_ms: Optional[Dict[str, float]] = None
+
+    def page_alerts(self) -> Tuple[dict, ...]:
+        return tuple(a for a in self.alerts_firing
+                     if a.get("severity") == "page")
 
     @property
     def utilization(self) -> Optional[float]:
@@ -129,13 +217,16 @@ class AutoscalerPolicy:
     actuation does not start a cooldown.
     """
 
-    def __init__(self, cfg: PolicyConfig):
+    def __init__(self, cfg: PolicyConfig, wallclock_fn=None):
         self.cfg = cfg.validate()
         self._up_streak = 0
         self._down_streak = 0
         self._settling_streak = 0
         self._last_up_at = float("-inf")
         self._last_down_at = float("-inf")
+        # scheduled floors read wall-clock local time, injectable for
+        # tests (returns a struct_time)
+        self._wallclock = wallclock_fn or time.localtime
 
     # -- controller feedback -------------------------------------------
 
@@ -149,6 +240,23 @@ class AutoscalerPolicy:
         self._up_streak = 0
         self._down_streak = 0
 
+    # -- scheduled floors ----------------------------------------------
+
+    def scheduled_floor(self) -> int:
+        """The largest replica floor whose wall-clock window covers
+        now (0 when none do)."""
+        if not self.cfg.scheduled_floors:
+            return 0
+        lt = self._wallclock()
+        m = lt.tm_hour * 60 + lt.tm_min
+        floor = 0
+        for start, end, n in self.cfg.scheduled_floors:
+            inside = (start <= m < end if start <= end
+                      else m >= start or m < end)
+            if inside:
+                floor = max(floor, n)
+        return floor
+
     # -- the decision ---------------------------------------------------
 
     def decide(self, sig: FleetSignal,
@@ -160,10 +268,21 @@ class AutoscalerPolicy:
         def hold(reason):
             return self._decision(HOLD, sig, sig.replicas, reason, util)
 
+        # phase-percentile input: any configured stage over its bound
+        # breaches like queue delay (same ticks, same cooldowns)
+        phase_breach = None
+        if cfg.phase_p95_targets and sig.phase_p95_ms:
+            for phase, bound in cfg.phase_p95_targets.items():
+                v = sig.phase_p95_ms.get(phase)
+                if v is not None and v > bound:
+                    phase_breach = phase
+                    break
         breach_up = (sig.queue_delay_ms > cfg.target_queue_delay_ms or
-                     (util is not None and util > cfg.target_utilization))
+                     (util is not None and util > cfg.target_utilization)
+                     or phase_breach is not None)
         breach_down = (sig.queue_delay_ms < cfg.down_queue_delay_ms and
-                       (util is None or util < cfg.down_utilization))
+                       (util is None or util < cfg.down_utilization)
+                       and phase_breach is None)
         self._up_streak = self._up_streak + 1 if breach_up else 0
         self._down_streak = self._down_streak + 1 if breach_down else 0
         # the settling gate, with a grace bound: a replica that stays
@@ -173,6 +292,32 @@ class AutoscalerPolicy:
                                  if sig.ready < sig.replicas else 0)
         settling = (sig.ready < sig.replicas and
                     self._settling_streak <= cfg.settling_grace_ticks)
+
+        # burn-rate input: a firing page IS the breach — no tick
+        # accumulation (the alert's multi-window evaluation already
+        # debounced it), but max/settling/cooldown still bind, and a
+        # burning fleet never scales down (the fall-through below can
+        # only hold or go up while pages fire)
+        if cfg.burn_rate_input and sig.page_alerts():
+            if sig.replicas >= cfg.max_replicas:
+                return hold("at_max")
+            if settling:
+                return hold("settling")
+            if now - self._last_up_at < cfg.up_cooldown_s:
+                return hold("cooldown_up")
+            target = min(sig.replicas + cfg.up_step, cfg.max_replicas)
+            return self._decision(UP, sig, target, "burn_rate", util)
+
+        # scheduled floor: pre-provision the diurnal ramp (no breach
+        # ticks — the schedule is the operator's explicit intent)
+        floor = min(self.scheduled_floor(), cfg.max_replicas)
+        if sig.replicas < floor:
+            if settling:
+                return hold("settling")
+            if now - self._last_up_at < cfg.up_cooldown_s:
+                return hold("cooldown_up")
+            target = min(sig.replicas + cfg.up_step, floor)
+            return self._decision(UP, sig, target, "scheduled", util)
 
         if breach_up:
             if sig.replicas >= cfg.max_replicas:
@@ -186,13 +331,17 @@ class AutoscalerPolicy:
             if now - self._last_up_at < cfg.up_cooldown_s:
                 return hold("cooldown_up")
             target = min(sig.replicas + cfg.up_step, cfg.max_replicas)
-            reason = ("queue_delay"
-                      if sig.queue_delay_ms > cfg.target_queue_delay_ms
-                      else "utilization")
+            if sig.queue_delay_ms > cfg.target_queue_delay_ms:
+                reason = "queue_delay"
+            elif util is not None and util > cfg.target_utilization:
+                reason = "utilization"
+            else:
+                reason = "phase_p95"
             return self._decision(UP, sig, target, reason, util)
 
         if breach_down:
-            if sig.replicas <= cfg.min_replicas:
+            if sig.replicas <= max(cfg.min_replicas, floor):
+                # a scheduled floor holds like min_replicas does
                 return hold("at_min")
             if settling:
                 return hold("settling")
@@ -204,7 +353,8 @@ class AutoscalerPolicy:
             if now - max(self._last_up_at,
                          self._last_down_at) < cfg.down_cooldown_s:
                 return hold("cooldown_down")
-            target = max(sig.replicas - cfg.down_step, cfg.min_replicas)
+            target = max(sig.replicas - cfg.down_step,
+                         cfg.min_replicas, floor)
             return self._decision(DOWN, sig, target, "idle", util)
 
         return hold("in_band")
@@ -224,4 +374,15 @@ class AutoscalerPolicy:
                 "router_healthy": sig.router_healthy,
                 "up_streak": self._up_streak,
                 "down_streak": self._down_streak,
+                # fleet-pilot provenance: every decision names the
+                # signal path that produced it
+                "source": sig.source,
+                "alerts_firing": [a.get("name")
+                                  for a in sig.alerts_firing],
+                "phase_p95_ms": ({
+                    ph: round(sig.phase_p95_ms[ph], 1)
+                    for ph in (self.cfg.phase_p95_targets or {})
+                    if sig.phase_p95_ms
+                    and sig.phase_p95_ms.get(ph) is not None
+                } or None),
             })

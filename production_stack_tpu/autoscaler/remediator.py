@@ -1,0 +1,329 @@
+"""Bounded incident auto-remediation: the runbook as code.
+
+The obsplane's flight recorder (r18) already writes machine
+attribution into every incident bundle — "engine X, phase prefill,
+confidence high". This module closes that loop: it polls the
+obsplane's ``GET /fleet/incidents`` index and, for an incident whose
+attribution names a guilty engine, executes the runbook the human
+would have followed:
+
+1. **drain** the culprit at every router (``POST /admin/drain`` — the
+   same plumbing the actuator's drain-safe scale-down uses),
+2. wait for its in-flight work to finish (bounded),
+3. **restart** it via the injected ``restart_fn`` (the orchestration
+   layer owns process lifecycles; a k8s deployment would delete the
+   pod) — or fall back to a breaker reset when no restart hook is
+   wired,
+4. **undrain** + ``POST /admin/breaker`` reset so routing resumes,
+5. **verify**: poll ``GET /fleet`` until the triggering alert leaves
+   the firing set (bounded) — a remediation that does not resolve its
+   alert is logged ``unresolved``, never silently declared victory.
+
+Every attempt — executed or refused — is returned to the controller
+and lands in the decision log with an outcome; the bounded policy is
+the point:
+
+- **kill-switch** (``enabled``, default OFF): nothing actuates until
+  an operator opts in; suppressions are still logged, so a
+  kill-switched pilot is visibly *choosing* not to act.
+- **confidence floor**: weak attributions ("medium"/"none") are not
+  chased by default.
+- **rate limit**: at most ``max_per_window`` executed remediations
+  per ``window_s`` — an attribution gone wrong must not be able to
+  roll the whole fleet.
+- **cooldown**: after any executed remediation the loop waits
+  ``cooldown_s`` before the next, so verify windows never overlap.
+- **role filter**: only engine/prefill processes are remediable; a
+  guilty *router* is somebody's pager, not this loop's business.
+"""
+
+import asyncio
+import collections
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import aiohttp
+
+from production_stack_tpu.utils import init_logger
+
+logger = init_logger(__name__)
+
+_CONFIDENCE_RANK = {"none": 0, "medium": 1, "high": 2}
+
+
+@dataclass
+class RemediationPolicy:
+    """Bounds; every one must hold before anything actuates."""
+
+    enabled: bool = False               # the kill-switch (default OFF)
+    confidence_floor: str = "high"      # minimum attribution confidence
+    target_roles: Tuple[str, ...] = ("engine", "prefill")
+    max_per_window: int = 1             # executed remediations...
+    window_s: float = 600.0             # ...per this window
+    cooldown_s: float = 120.0           # after each executed one
+    drain_timeout_s: float = 30.0       # bounded wait for in-flight 0
+    drain_poll_s: float = 0.5
+    verify_timeout_s: float = 60.0      # bounded wait for alert clear
+    verify_poll_s: float = 1.0
+
+    def validate(self) -> "RemediationPolicy":
+        if self.confidence_floor not in _CONFIDENCE_RANK:
+            raise ValueError(f"confidence_floor must be one of "
+                             f"{sorted(_CONFIDENCE_RANK)}")
+        if self.max_per_window < 1:
+            raise ValueError("max_per_window must be >= 1")
+        if self.window_s <= 0 or self.cooldown_s < 0:
+            raise ValueError("window_s must be positive, cooldown_s "
+                             "non-negative")
+        return self
+
+
+class Remediator:
+    """Polls the incident index, executes the bounded runbook.
+
+    ``restart_fn(url) -> awaitable[bool]`` is injected by whatever
+    owns process lifecycles (the fleetdrill relaunches the fake
+    engine; a k8s operator would delete the pod). Without one, the
+    action degrades to drain + breaker reset + undrain
+    (``breaker_reset``) — enough for a wedged breaker, explicit in
+    the log when it was all we could do.
+    """
+
+    def __init__(self, *, obsplane_url: str, router_urls,
+                 policy: Optional[RemediationPolicy] = None,
+                 restart_fn=None,
+                 session: Optional[aiohttp.ClientSession] = None,
+                 engine_urls_fn=None,
+                 now_fn=time.monotonic,
+                 wall_fn=time.time,
+                 metrics=None):
+        self.obsplane_url = obsplane_url.rstrip("/")
+        if isinstance(router_urls, str):
+            router_urls = [u.strip() for u in router_urls.split(",")
+                           if u.strip()]
+        self.router_urls = [u.rstrip("/") for u in router_urls]
+        self.policy = (policy or RemediationPolicy()).validate()
+        self.restart_fn = restart_fn
+        self._session = session
+        self._owns_session = session is None
+        # optional managed-endpoint enumerator (actuator.endpoint_urls):
+        # when present, attributions naming processes outside the
+        # managed set are refused — this loop must never drain an
+        # engine some other controller owns
+        self._engine_urls_fn = engine_urls_fn
+        self._now = now_fn
+        self._wall = wall_fn
+        self.metrics = metrics            # AutoscalerMetrics or None
+        self._timeout = aiohttp.ClientTimeout(total=5)
+        # incident cursor: only incidents captured after the
+        # remediator came up are actionable (a restart must not replay
+        # a week of stale bundles), and each id is acted on once
+        self._since_captured_at = wall_fn()
+        self._seen: set = set()
+        self._executed_at: collections.deque = collections.deque()
+        self._last_executed_at: Optional[float] = None
+
+    async def start(self) -> None:
+        if self._owns_session and self._session is None:
+            self._session = aiohttp.ClientSession()
+
+    async def close(self) -> None:
+        if self._owns_session and self._session:
+            await self._session.close()
+            self._session = None
+
+    # -- HTTP helpers ----------------------------------------------------
+
+    async def _get_json(self, url: str,
+                        params: Optional[dict] = None) -> Optional[dict]:
+        try:
+            async with self._session.get(
+                    url, params=params, timeout=self._timeout) as r:
+                if r.status == 200:
+                    return await r.json()
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError, ValueError):
+            pass
+        return None
+
+    async def _post_json(self, url: str, body: dict) -> bool:
+        try:
+            async with self._session.post(
+                    url, json=body, timeout=self._timeout) as r:
+                return r.status == 200
+        except (aiohttp.ClientError, ConnectionError, OSError,
+                asyncio.TimeoutError):
+            return False
+
+    # -- the tick --------------------------------------------------------
+
+    async def tick(self, now: Optional[float] = None) -> List[dict]:
+        """Process every new incident once; returns the remediation
+        records (executed AND suppressed) for the decision log."""
+        now = self._now() if now is None else now
+        if self._session is None:
+            await self.start()
+        data = await self._get_json(
+            f"{self.obsplane_url}/fleet/incidents",
+            params={"since": repr(self._since_captured_at),
+                    "role": ",".join(self.policy.target_roles)})
+        if data is None:
+            return []
+        out: List[dict] = []
+        for row in data.get("incidents", []):
+            incident_id = row.get("incident_id")
+            if not incident_id or incident_id in self._seen:
+                continue
+            self._seen.add(incident_id)
+            out.append(await self._handle(row, now))
+        return out
+
+    def _window_count(self, now: float) -> int:
+        cutoff = now - self.policy.window_s
+        while self._executed_at and self._executed_at[0] < cutoff:
+            self._executed_at.popleft()
+        return len(self._executed_at)
+
+    async def _handle(self, row: dict, now: float) -> dict:
+        attribution = row.get("attribution") or {}
+        record = {
+            "incident_id": row.get("incident_id"),
+            "alert": row.get("alert"),
+            "target": attribution.get("process"),
+            "role": attribution.get("role"),
+            "phase": attribution.get("phase"),
+            "confidence": attribution.get("confidence"),
+            "action": ("drain_restart" if self.restart_fn is not None
+                       else "breaker_reset"),
+        }
+        pol = self.policy
+        confidence = attribution.get("confidence") or "none"
+        # guards, cheapest first; each refusal is an explicit outcome
+        if not pol.enabled:
+            record.update(outcome="suppressed_killswitch",
+                          detail="remediation disabled (--remediate "
+                                 "not set)")
+            return self._finish(record)
+        if _CONFIDENCE_RANK.get(confidence, 0) < \
+                _CONFIDENCE_RANK[pol.confidence_floor]:
+            record.update(outcome="suppressed_confidence",
+                          detail=f"attribution confidence "
+                                 f"{confidence!r} below floor "
+                                 f"{pol.confidence_floor!r}")
+            return self._finish(record)
+        target = (attribution.get("process") or "").rstrip("/")
+        role = attribution.get("role")
+        if not target or role not in pol.target_roles:
+            record.update(outcome="suppressed_role",
+                          detail=f"attributed role {role!r} is not "
+                                 f"remediable")
+            return self._finish(record)
+        if self._engine_urls_fn is not None:
+            managed = {u.rstrip("/") for u in self._engine_urls_fn()}
+            if target not in managed:
+                record.update(outcome="suppressed_unmanaged",
+                              detail=f"{target} is not a managed "
+                                     f"endpoint")
+                return self._finish(record)
+        if self._last_executed_at is not None and \
+                now - self._last_executed_at < pol.cooldown_s:
+            record.update(outcome="suppressed_cooldown",
+                          detail=f"{pol.cooldown_s:.0f}s cooldown "
+                                 f"since the last remediation")
+            return self._finish(record)
+        if self._window_count(now) >= pol.max_per_window:
+            record.update(outcome="suppressed_rate_limit",
+                          detail=f"{pol.max_per_window} remediation(s)"
+                                 f" already executed in the last "
+                                 f"{pol.window_s:.0f}s")
+            return self._finish(record)
+
+        # every bound passed: execute, then verify
+        self._executed_at.append(now)
+        self._last_executed_at = now
+        record["executed_at"] = round(self._wall(), 3)
+        try:
+            await self._execute(record, target)
+        except Exception as e:      # a half-done runbook is an outcome
+            logger.exception("remediation of %s failed", target)
+            record.update(outcome="failed",
+                          detail=f"{type(e).__name__}: {e}")
+        return self._finish(record)
+
+    def _finish(self, record: dict) -> dict:
+        level = (logger.warning
+                 if record["outcome"].startswith(("failed",
+                                                  "unresolved"))
+                 else logger.info)
+        level("remediation %s: %s (%s) — %s",
+              record.get("incident_id"), record["outcome"],
+              record.get("target"), record.get("detail", ""))
+        return record
+
+    async def _execute(self, record: dict, target: str) -> None:
+        steps: List[str] = []
+        record["steps"] = steps
+        # 1. drain at every router (idempotent; end_drain is always
+        # re-entered in `finally`-style below even on failure paths)
+        for router in self.router_urls:
+            ok = await self._post_json(f"{router}/admin/drain",
+                                       {"url": target, "drain": True})
+            steps.append(f"drain@{router}:{'ok' if ok else 'FAIL'}")
+        try:
+            # 2. bounded wait for the victim's in-flight to reach zero
+            drained = await self._wait_drained(target)
+            steps.append("drained" if drained else "drain_timeout")
+            # 3. restart (injected) or breaker reset only
+            if self.restart_fn is not None:
+                restarted = bool(await self.restart_fn(target))
+                steps.append("restart" if restarted
+                             else "restart_FAIL")
+                if not restarted:
+                    record.update(outcome="failed",
+                                  detail="restart hook returned "
+                                         "failure")
+                    return
+        finally:
+            # 4. routing resumes whatever happened above: a drained
+            # flag left behind would be a remediation-caused outage
+            for router in self.router_urls:
+                await self._post_json(f"{router}/admin/drain",
+                                      {"url": target, "drain": False})
+                await self._post_json(f"{router}/admin/breaker",
+                                      {"url": target,
+                                       "action": "reset"})
+            steps.append("undrain+breaker_reset")
+        # 5. verify the triggering alert actually leaves the firing set
+        resolved = await self._verify_resolved(record.get("alert"))
+        record.update(
+            outcome="resolved" if resolved else "unresolved",
+            detail=("alert cleared within verify window" if resolved
+                    else f"alert still firing after "
+                         f"{self.policy.verify_timeout_s:.0f}s"))
+
+    async def _wait_drained(self, target: str) -> bool:
+        deadline = self._now() + self.policy.drain_timeout_s
+        while self._now() < deadline:
+            load = await self._get_json(f"{target}/load")
+            if load is not None:
+                in_flight = (float(load.get("queue_depth") or 0)
+                             + float(load.get("running") or 0))
+                if in_flight <= 0:
+                    return True
+            await asyncio.sleep(self.policy.drain_poll_s)
+        return False
+
+    async def _verify_resolved(self, alert: Optional[str]) -> bool:
+        if not alert:
+            return False
+        deadline = self._now() + self.policy.verify_timeout_s
+        while self._now() < deadline:
+            fleet = await self._get_json(f"{self.obsplane_url}/fleet")
+            if fleet is not None:
+                firing = {a.get("name")
+                          for a in fleet.get("firing_alerts") or ()}
+                if alert not in firing:
+                    return True
+            await asyncio.sleep(self.policy.verify_poll_s)
+        return False

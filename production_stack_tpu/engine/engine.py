@@ -569,14 +569,18 @@ class LLMEngine:
         flush the write-through, and hand back the chunk keys so the
         planner can warm the destination replica and re-home routing.
 
-        Victims are the sequences holding the most blocks (fewest
-        preemptions per freed block). Preempted victims are re-
-        prefetched from the tiers before their next admission, so
-        migration costs them a tier read, not a recompute. A planner
-        crash after this call leaves only published chunks + preempted
-        sequences — both states the stack already recovers from
-        (recompute + checksummed tier reads), so migration is torn-safe
-        by construction."""
+        Victims are the LEAST recently active sequences first (oldest
+        ``last_active`` stamp, arrival time as the tie-break): their KV
+        is the coldest on this replica, they are the least likely to be
+        mid-burst, and the stall a migration adds lands on the request
+        that has already waited longest — instead of yanking the
+        hottest sequence just because it holds the most blocks.
+        Preempted victims are re-prefetched from the tiers before their
+        next admission, so migration costs them a tier read, not a
+        recompute. A planner crash after this call leaves only
+        published chunks + preempted sequences — both states the stack
+        already recovers from (recompute + checksummed tier reads), so
+        migration is torn-safe by construction."""
         if self.connector is None or not self.connector.cfg.is_producer:
             return {"migrated": [], "freed_blocks": 0, "keys": [],
                     "error": "kv tiering with a producer role is "
@@ -588,8 +592,7 @@ class LLMEngine:
             candidates = list(self.scheduler.running.values()) \
                 + list(self.scheduler._prefilling.values())
             candidates.sort(
-                key=lambda s: len([b for b in s.block_ids if b]),
-                reverse=True)
+                key=lambda s: (s.last_active, s.arrival_time))
             for seq in candidates:
                 if len(victims) >= max(1, max_seqs):
                     break
@@ -1354,6 +1357,7 @@ class LLMEngine:
                       logprob: Optional[float] = None,
                       top_alts=None) -> List[StepOutput]:
         seq.output_tokens.append(token)
+        seq.last_active = time.monotonic()
         seq.output_logprobs.append(logprob)
         if seq.options.top_logprobs:
             seq.output_top.append(top_alts)

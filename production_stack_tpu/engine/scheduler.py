@@ -97,6 +97,12 @@ class Sequence:
     output_top: List[Optional[list]] = field(default_factory=list)
     num_prefilled: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
+    # last forward-progress stamp (prefill chunk landed / token
+    # emitted): kvplane victim selection retires the LEAST recently
+    # active sequence first — its KV is coldest and its owner has
+    # waited longest already, so re-prefilling it elsewhere wastes the
+    # least warm state. Set from arrival in __post_init__.
+    last_active: float = 0.0
     # phase attribution (tracing.py): queue time accumulates across
     # admissions so a preempted-and-requeued sequence never
     # double-counts wall time — enqueued_time stamps each entry into
@@ -140,6 +146,7 @@ class Sequence:
 
     def __post_init__(self):
         self.enqueued_time = self.arrival_time
+        self.last_active = self.arrival_time
 
     @property
     def num_tokens(self) -> int:
@@ -329,6 +336,7 @@ class Scheduler:
     def on_prefill_done(self, work: PrefillWork) -> None:
         seq = work.seq
         seq.num_prefilled += len(work.chunk)
+        seq.last_active = time.monotonic()
         if work.is_last:
             seq.status = SeqStatus.RUNNING
             self._prefilling.pop(seq.slot, None)

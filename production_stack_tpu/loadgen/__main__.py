@@ -78,6 +78,16 @@ incident — launch N peered routers + M engines + the obsplane fleet
            any spurious capture, miss, or wrong attribution
            (INCIDENT_*.json; --overhead-guard runs the r7 A/B with
            and without the obsplane scraping the serving pair)
+fleetdrill — the r20 fleet-pilot closed loop: (1) the same latency
+           burn run twice — burn-rate-driven pilot vs queue-delay-only
+           control — the pilot must scale on the page alert (reason
+           burn_rate, signal source fleet) and resolve with zero shed
+           at LOWER replica-seconds; (2) a slow engine must be
+           detected, drained, restarted and verified hands-off with
+           EXACTLY ONE remediation in the decision log; (3) the same
+           injection with the kill-switch down must log
+           suppressed_killswitch while the alert keeps burning
+           (FLEETDRILL_*.json)
 kvmigrate — the kvplane closed loop: a fragmentation storm (one
            replica's pool injected into the fragmented-admission
            regime behind the router) run with and without the kvplane
@@ -112,6 +122,9 @@ from production_stack_tpu.loadgen.effwatch import (effwatch_ab_violations,
 from production_stack_tpu.loadgen.firedrill import (SCENARIO_NAMES,
                                                     firedrill_violations,
                                                     run_firedrill)
+from production_stack_tpu.loadgen.fleetdrill import (
+    SCENARIO_NAMES as FLEETDRILL_SCENARIOS, fleetdrill_violations,
+    run_fleetdrill)
 from production_stack_tpu.loadgen.incident import (
     SCENARIO_NAMES as INCIDENT_SCENARIOS, incident_violations,
     run_incident)
@@ -695,6 +708,57 @@ def cmd_incident(args) -> int:
                     f" vs unscraped {guard['baseline_ratio']:.2f}x "
                     f"(best of {guard['rounds']} alternating rounds)")
         print(msg)
+    return 1 if violations else 0
+
+
+def cmd_fleetdrill(args) -> int:
+    scenarios = None
+    if args.scenarios:
+        scenarios = [s.strip() for s in args.scenarios.split(",")
+                     if s.strip()]
+    record = asyncio.run(run_fleetdrill(
+        scenarios=scenarios, window_scale=args.window_scale,
+        users=args.users, engines=args.engines,
+        baseline_s=args.baseline,
+        detect_timeout_s=args.detect_timeout,
+        resolve_timeout_s=args.resolve_timeout,
+        burn_ttft_s=args.burn_ttft,
+        queue_ramp_ms_per_s=args.queue_ramp,
+        queue_plateau_ms=args.queue_plateau,
+        max_replicas=args.max_replicas,
+        slow_ttft_arg_s=args.slow_ttft_arg,
+        tick_interval_s=args.tick_interval,
+        min_events=args.min_events, platform=args.platform,
+        log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"FLEETDRILL_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = fleetdrill_violations(record)
+    for v in violations:
+        print(f"FLEETDRILL VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        parts = []
+        burn = d.get("burn")
+        if burn:
+            parts.append(
+                f"burn-rate scale-up saved "
+                f"{burn['replica_seconds_saved']} replica-seconds vs "
+                f"the queue-delay control (pilot fired "
+                f"{burn['pilot']['fired_in_s']}s vs control "
+                f"{burn['control']['fired_in_s']}s)")
+        rem = d.get("remediate")
+        if rem:
+            parts.append(
+                f"slow engine drained+restarted hands-off in "
+                f"{rem['duration_s']}s (1 remediation, outcome "
+                f"resolved)")
+        if d.get("killswitch"):
+            parts.append("kill-switch verifiably suppressed the "
+                         "remediation while the alert kept burning")
+        print("fleetdrill PASSED: " + "; ".join(parts))
     return 1 if violations else 0
 
 
@@ -1505,6 +1569,70 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write INCIDENT_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_incident)
+
+    sp = sub.add_parser("fleetdrill",
+                        help="the r20 fleet pilot closed loop: "
+                             "burn-rate scale-up must beat the "
+                             "queue-delay-only control on "
+                             "replica-seconds to resolution; a slow "
+                             "engine must be drained+restarted "
+                             "hands-off with exactly one remediation "
+                             "logged; the kill-switch run must show "
+                             "the suppression AND the alert still "
+                             "burning")
+    sp.add_argument("--scenarios", default=None,
+                    help=f"comma-separated subset of "
+                         f"{','.join(FLEETDRILL_SCENARIOS)} "
+                         f"(default: all)")
+    sp.add_argument("--window-scale", type=float, default=0.01,
+                    help="drill SLO window scale (0.01 -> "
+                         "3s/18s/36s/216s)")
+    sp.add_argument("--users", type=int, default=6,
+                    help="closed-loop storm concurrency")
+    sp.add_argument("--engines", type=int, default=3,
+                    help="fixed fleet size for the remediation "
+                         "scenarios (the burn scenario scales 1 -> "
+                         "--max-replicas)")
+    sp.add_argument("--baseline", type=parse_duration, default=6.0,
+                    help="clean-phase duration before each injection")
+    sp.add_argument("--detect-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds the page alert has to fire "
+                         "(default: sized to the scaled 1h window)")
+    sp.add_argument("--resolve-timeout", type=parse_duration,
+                    default=None,
+                    help="seconds the alert has to resolve after "
+                         "relief (default: sized to the scaled 30m "
+                         "window)")
+    sp.add_argument("--burn-ttft", type=float, default=0.4,
+                    help="burn scenario: injected per-request TTFT at "
+                         "1 replica (seconds; divided by the live "
+                         "replica count — scale-up IS the relief)")
+    sp.add_argument("--queue-ramp", type=float, default=60.0,
+                    help="burn scenario: queue-delay ramp (ms per "
+                         "second of incident, split across replicas) "
+                         "— slow enough that the burn-rate alert "
+                         "beats the queue-delay threshold")
+    sp.add_argument("--queue-plateau", type=float, default=1200.0,
+                    help="burn scenario: queue-delay ramp ceiling "
+                         "(ms) so the control's trigger stays "
+                         "bounded")
+    sp.add_argument("--max-replicas", type=int, default=2,
+                    help="burn scenario scale-up ceiling")
+    sp.add_argument("--slow-ttft-arg", type=float, default=0.6,
+                    help="remediation scenarios: TTFT inflation "
+                         "injected on ONE engine (seconds)")
+    sp.add_argument("--tick-interval", type=float, default=0.5,
+                    help="autoscaler control-loop interval (seconds)")
+    sp.add_argument("--min-events", type=int, default=4,
+                    help="drill SLO volume floor")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=420.0)
+    sp.add_argument("--output", default=None,
+                    help="write FLEETDRILL_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_fleetdrill)
 
     sp = sub.add_parser("multirouter",
                         help="N real routers (peer gossip + QoS "

@@ -28,6 +28,8 @@ quiet poll, so a capture's shed delta covers exactly the burn window.
 """
 
 import asyncio
+import json
+import os
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -138,6 +140,7 @@ class FleetAggregator:
                  chain_store: Optional[ChainStore] = None,
                  recorder: Optional[IncidentRecorder] = None,
                  scrape_headers: Optional[dict] = None,
+                 engines_config: Optional[str] = None,
                  now_fn=time.time):
         self.processes: Dict[str, ProcessState] = {}
         for url in routers:
@@ -146,7 +149,13 @@ class FleetAggregator:
             self._add(url, "engine")
         for url in prefill:
             self._add(url, "prefill")
-        if not self.processes:
+        # an elastic fleet: re-read the autoscaler's dynamic-config
+        # file each poll so scaled-up engines are scraped without an
+        # obsplane restart (and retired ones stop counting as
+        # unreachable forever)
+        self.engines_config = engines_config
+        self._engines_config_mtime: Optional[float] = None
+        if not self.processes and not engines_config:
             raise ValueError("a fleet needs at least one process "
                              "(--routers / --engines)")
         self.poll_interval_s = poll_interval_s
@@ -193,6 +202,36 @@ class FleetAggregator:
     def _add(self, url: str, role: str) -> None:
         state = ProcessState(url, role)
         self.processes[state.url] = state
+
+    def _sync_engines_config(self) -> None:
+        """Mirror the autoscaler's dynamic-config ``static_backends``
+        into the scraped engine set (mtime-gated, so an unchanged file
+        costs one stat per poll). Routers and prefill processes are
+        never touched; an unreadable/absent file keeps the last set."""
+        if not self.engines_config:
+            return
+        try:
+            mtime = os.stat(self.engines_config).st_mtime
+        except OSError:
+            return
+        if mtime == self._engines_config_mtime:
+            return
+        try:
+            with open(self.engines_config) as f:
+                urls = json.load(f).get("static_backends") or []
+        except (OSError, ValueError):
+            return
+        self._engines_config_mtime = mtime
+        want = {u.rstrip("/") for u in urls if isinstance(u, str)}
+        have = {u for u, p in self.processes.items()
+                if p.role == "engine"}
+        for url in want - have:
+            self._add(url, "engine")
+            logger.info("fleet engine joined (dynamic config): %s", url)
+        for url in have - want:
+            del self.processes[url]
+            logger.info("fleet engine retired (dynamic config): %s",
+                        url)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -312,6 +351,7 @@ class FleetAggregator:
         edge detection, shed baseline upkeep."""
         now = self._now()
         self.polls_total += 1
+        self._sync_engines_config()
         await self._load_poller.poll_now()
         await asyncio.gather(*(self._scrape_process(p, now)
                                for p in self.processes.values()))
@@ -405,6 +445,31 @@ class FleetAggregator:
 
     # -- reads -----------------------------------------------------------
 
+    def autoscaler_signal(self) -> Dict[str, dict]:
+        """Compact per-engine scale signal for the fleet pilot
+        (autoscaler/collector.py FleetSignalCollector): the parsed
+        /load numbers the raw-polling collector would have derived
+        itself, plus reachability state and sample age so the pilot
+        can judge freshness without the full payloads."""
+        now = self._now()
+        out: Dict[str, dict] = {}
+        from production_stack_tpu.signals import parse_load_report
+        for url, proc in self.processes.items():
+            if proc.role not in ("engine", "prefill"):
+                continue
+            row = {"role": proc.role, "state": proc.state,
+                   "age_s": (None if proc.last_seen is None
+                             else round(now - proc.last_seen, 3))}
+            if proc.load is not None:
+                load = parse_load_report(proc.load)
+                row.update({
+                    "in_flight": load.in_flight,
+                    "capacity": load.capacity,
+                    "est_queue_delay_ms": load.est_queue_delay_ms,
+                })
+            out[url] = row
+        return out
+
     def fleet_snapshot(self, full: bool = False,
                        slowest: int = 10) -> dict:
         """The GET /fleet payload (``full`` adds every process's raw
@@ -421,6 +486,7 @@ class FleetAggregator:
                 url: p.to_json(include_payloads=full)
                 for url, p in sorted(self.processes.items())},
             "firing_alerts": firing,
+            "autoscaler_signal": self.autoscaler_signal(),
             "shed_deltas": {u: int(d) for u, d
                             in self.shed_deltas().items()},
             "chains": self.chains.stats(),

@@ -81,8 +81,37 @@ async def fleet_traces(request: web.Request) -> web.Response:
 
 
 async def fleet_incidents(request: web.Request) -> web.Response:
+    """The bounded bundle index, filterable for machine consumers
+    (autoscaler/remediator.py): ``?since=<captured_at>`` returns only
+    strictly-newer incidents, ``?confidence=high`` (or a comma list)
+    filters on attribution confidence, ``?role=engine,prefill`` on
+    the attributed role. Rows stay newest-last."""
     recorder = request.app["state"]["recorder"]
-    return web.json_response({"incidents": recorder.index()})
+    rows = recorder.index()
+    q = request.query
+    if "since" in q:
+        try:
+            since = float(q["since"])
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "since must be a captured_at "
+                                      "float",
+                           "type": "invalid_request_error"}},
+                status=400)
+        rows = [r for r in rows
+                if (r.get("captured_at") or 0.0) > since]
+    confidences = {c.strip() for c in q.get("confidence", "").split(",")
+                   if c.strip()}
+    if confidences:
+        rows = [r for r in rows
+                if (r.get("attribution") or {}).get("confidence")
+                in confidences]
+    roles = {r.strip() for r in q.get("role", "").split(",")
+             if r.strip()}
+    if roles:
+        rows = [r for r in rows
+                if (r.get("attribution") or {}).get("role") in roles]
+    return web.json_response({"incidents": rows})
 
 
 async def fleet_incident(request: web.Request) -> web.Response:
@@ -136,7 +165,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
             parse_comma_separated(args.capture_severities)),
         capture_on_alerts=not args.no_capture_on_alert,
         chain_store=chains,
-        recorder=recorder)
+        recorder=recorder,
+        engines_config=args.engines_config or None)
     app = web.Application()
     app["state"] = {
         "aggregator": aggregator,
@@ -180,6 +210,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="comma-separated prefill-pool engine URLs "
                         "(scraped like engines, stitched as the "
                         "prefill side of a chain)")
+    p.add_argument("--engines-config", default="",
+                   help="path to the autoscaler's dynamic-config JSON "
+                        "(static_backends): re-read every poll so the "
+                        "scraped engine set follows an elastic fleet "
+                        "without an obsplane restart")
     p.add_argument("--poll-interval", type=float, default=1.0,
                    help="seconds between fleet scrape passes")
     p.add_argument("--scrape-timeout", type=float, default=3.0,
@@ -213,8 +248,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="disable alert-triggered captures (manual "
                         "POST /fleet/capture only)")
     args = p.parse_args(argv)
-    if not (args.routers or args.engines):
-        p.error("need --routers and/or --engines to scrape")
+    if not (args.routers or args.engines or args.engines_config):
+        p.error("need --routers, --engines and/or --engines-config "
+                "to scrape")
     return args
 
 

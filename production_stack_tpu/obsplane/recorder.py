@@ -170,6 +170,50 @@ class IncidentRecorder:
         self.last_capture_at: Optional[float] = None
         self._index: List[dict] = []      # newest last
         os.makedirs(incident_dir, exist_ok=True)
+        self._rebuild_index()
+
+    def _rebuild_index(self) -> None:
+        """Reload the index from bundles already on disk, so an
+        obsplane restart does not orphan incidents a remediation
+        consumer (autoscaler/remediator.py) has not acted on yet.
+        Unreadable files are skipped, not fatal — a half-written
+        bundle cannot exist (atomic replace), but a truncated disk
+        can produce one."""
+        rows = []
+        try:
+            names = sorted(os.listdir(self.incident_dir))
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("incident-")
+                    and name.endswith(".json")):
+                continue
+            path = os.path.join(self.incident_dir, name)
+            try:
+                with open(path) as f:
+                    bundle = json.load(f)
+            except (OSError, ValueError):
+                continue
+            attribution = bundle.get("attribution") or {}
+            rows.append({
+                "incident_id": bundle.get("incident_id"),
+                "path": path,
+                "captured_at": bundle.get("captured_at"),
+                "trigger": bundle.get("trigger"),
+                "alert": (bundle.get("alert") or {}).get("name"),
+                "attribution": {k: attribution.get(k) for k in
+                                ("process", "role", "phase",
+                                 "confidence", "reason")},
+            })
+        rows.sort(key=lambda r: r.get("captured_at") or 0.0)
+        self._index = rows[-self.retention:]
+        # keep incident ids (timestamp + counter) collision-free
+        # across the restart
+        self.captured_total = len(self._index)
+        if self._index:
+            logger.info("incident index rebuilt from disk: %d "
+                        "bundle(s), newest %s", len(self._index),
+                        self._index[-1]["incident_id"])
 
     def in_cooldown(self) -> bool:
         return (self.last_capture_at is not None

@@ -172,6 +172,33 @@ async def admin_drain(request: web.Request) -> web.Response:
     return web.json_response({"draining": tracker.draining()})
 
 
+async def admin_breaker(request: web.Request) -> web.Response:
+    """Administratively reset one endpoint's breaker to CLOSED (clears
+    consecutive-failure and windowed-rate state). The remediator calls
+    this after restarting a drained engine so routing resumes without
+    waiting out the open-state cooldown.
+    Body: {"url": "http://engine:8100"} (optional "action": "reset")."""
+    state = request.app["state"]
+    tracker = state["health"]
+    try:
+        body = await request.json()
+        url = body["url"].rstrip("/")
+        action = body.get("action", "reset")
+    except (ValueError, KeyError, AttributeError, TypeError):
+        return web.json_response(
+            {"error": {"message": "body must be JSON with a 'url' "
+                                  "field",
+                       "type": "invalid_request_error"}}, status=400)
+    if action != "reset":
+        return web.json_response(
+            {"error": {"message": f"unknown action {action!r}; only "
+                                  f"'reset' is supported",
+                       "type": "invalid_request_error"}}, status=400)
+    tracker.reset(url)
+    return web.json_response({"url": url,
+                              "state": tracker.state_of(url)})
+
+
 async def admin_kvplane_rehome(request: web.Request) -> web.Response:
     """kvplane migration hand-off: rewrite decode-locality evidence
     after KV chunks moved replica-to-replica, so transfer-cost scoring
@@ -489,6 +516,7 @@ def build_app(args: argparse.Namespace) -> web.Application:
     # joining later can start polling before this one learns about it
     app.router.add_get("/peers", peers_endpoint)
     app.router.add_post("/admin/drain", admin_drain)
+    app.router.add_post("/admin/breaker", admin_breaker)
     app.router.add_post("/admin/kvplane/rehome", admin_kvplane_rehome)
 
     if args.enable_files_api or args.enable_batch_api:

@@ -200,6 +200,17 @@ class HealthTracker:
         else:
             self.record_failure(url, "probe")
 
+    def reset(self, url: str) -> None:
+        """Administrative breaker reset (``POST /admin/breaker``): the
+        remediation loop restarts a sick engine and must not wait out
+        the open-state cooldown before routing resumes — the operator
+        (human or remediator) is asserting the endpoint is healthy
+        again, and the next real failure will re-open it normally."""
+        h = self._eps.get(url)
+        if h is None:
+            return          # never tracked -> already CLOSED
+        self._close(url, h, "admin reset")
+
     def record_shed(self, url: str) -> None:
         """An upstream 429/503-with-Retry-After: the engine shed the
         request under overload protection. Shed ≠ sick — counted (for

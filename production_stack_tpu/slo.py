@@ -786,6 +786,30 @@ def compile_prometheus_rules(config: Optional[SLOConfig] = None) -> dict:
             "runbook": "docs/runbooks.md#kv-fragmentation",
         },
     }]
+    autoscaler_rules = [{
+        # the remediation loop's own failure is an alert, not a log
+        # line: an executed runbook that did not resolve its alert
+        # (or failed mid-way) means the pilot is actuating on the
+        # fleet without fixing it — a human must take the incident
+        # over before the rate limit resets and it tries again
+        "alert": "RemediationFailing",
+        "expr": ('sum by (action) (increase(\n'
+                 '  tpu:autoscaler_remediations_total'
+                 '{outcome=~"failed|unresolved"}[30m]\n)) > 0'),
+        "for": "60s",
+        "labels": {"severity": "ticket", "component": "autoscaler"},
+        "annotations": {
+            "summary": ("auto-remediation executed but did not "
+                        "resolve its alert (or failed mid-runbook)"),
+            "description": ("remediations with outcome failed/"
+                            "unresolved in the last 30m; the bounded "
+                            "policy rate-limits retries, so the "
+                            "incident is now a human's"),
+            "runbook": "docs/runbooks.md#auto-remediation",
+        },
+    }]
     return {"groups": [{"name": "tpu-stack-slo-burn", "rules": rules},
                        {"name": "tpu-stack-kvplane",
-                        "rules": kvplane_rules}]}
+                        "rules": kvplane_rules},
+                       {"name": "tpu-stack-autoscaler",
+                        "rules": autoscaler_rules}]}

@@ -34,6 +34,16 @@ to the next ``count`` requests (-1 = until cleared):
 - ``deadline``       — answer 504 + ``x-deadline-expired`` (what a real
                        engine returns when the client's
                        x-request-deadline-ms expired in its queue)
+- ``wedge``          — the zombie: every inference request hangs
+                       FOREVER (the stuck work is counted in-flight),
+                       while /health, /v1/models, /load and /metrics
+                       keep answering green. Persistent while set
+                       (``count`` is ignored) and never extended to
+                       probes by ``scope: "all"`` — looking alive IS
+                       the fault. What a wedged accelerator runtime
+                       looks like: liveness probes pass, throughput is
+                       zero, only phase evidence (stitched traces show
+                       queue growth and no decode) can convict it.
 
 ``scope: "all"`` extends reset/error/stall to ``/v1/models`` too, so
 health probes fail along with inference (a fully-dead engine); the
@@ -127,7 +137,7 @@ from production_stack_tpu.tracing import TraceRecorder
 
 
 FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft",
-               "overload", "deadline")
+               "overload", "deadline", "wedge")
 
 
 class FakeEngine:
@@ -736,8 +746,14 @@ class FakeEngine:
         if path == "/v1/models":
             if f.get("scope", "inference") != "all" or \
                     mode in ("die_mid_stream", "slow_ttft", "overload",
-                             "deadline"):
+                             "deadline", "wedge"):
                 return None
+        if mode == "wedge":
+            # persistent like overload, and scope-immune on probes: a
+            # wedge that failed health checks would just be "dead",
+            # and dead is the easy case
+            self.faults_served += 1
+            return dict(f)
         if mode == "overload":
             # persistent capacity gate, not a per-request burst: only
             # an OVERFLOW consumes a fault application (and never the
@@ -785,6 +801,20 @@ class FakeEngine:
             return resp
         if mode == "stall":
             await asyncio.sleep(fault.get("arg") or 3600.0)
+            return None
+        if mode == "wedge":
+            # count the stuck request in-flight (a real wedge's queue
+            # grows), then hang until the connection is torn down —
+            # there is deliberately no timeout arm on this one
+            self._in_flight += 1
+            self.gauges["vllm:num_requests_running"] = \
+                float(self._in_flight)
+            try:
+                await asyncio.Event().wait()
+            finally:
+                self._in_flight -= 1
+                self.gauges["vllm:num_requests_running"] = \
+                    float(self._in_flight)
             return None
         if mode == "slow_ttft":
             await asyncio.sleep(fault.get("arg") or 1.0)

@@ -14,15 +14,18 @@ OBS = os.path.join(os.path.dirname(os.path.dirname(
 
 def _exported_metrics():
     """Union of metric names the engine + router + obsplane + kvplane
-    register."""
+    + autoscaler register."""
     from prometheus_client import CollectorRegistry
+    from production_stack_tpu.autoscaler.controller import \
+        AutoscalerMetrics
     from production_stack_tpu.engine.metrics import EngineMetrics
     from production_stack_tpu.kvplane.app import PlannerMetrics
     from production_stack_tpu.obsplane.metrics import FleetMetrics
     from production_stack_tpu.router.metrics import RouterMetrics
     names = set()
     for metrics in (EngineMetrics(model="test"), RouterMetrics(),
-                    FleetMetrics(), PlannerMetrics()):
+                    FleetMetrics(), PlannerMetrics(),
+                    AutoscalerMetrics()):
         for collector in metrics.registry._collector_to_names:
             for m in collector.describe() if hasattr(collector, "describe") \
                     else []:

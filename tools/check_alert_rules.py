@@ -23,6 +23,11 @@ tier-1 via tests/test_observability.py):
    transition already captured the fleet-wide evidence, and a runbook
    that does not say so sends the responder scraping 2R+N endpoints
    by hand.
+5. **Automation stated** — every alert's runbook section must carry
+   an ``**Automated:** yes/no/partial`` line: with the fleet pilot's
+   remediation loop deployable (autoscaler/remediator.py), the first
+   question a 3am responder asks is "is a robot already on this?" —
+   a runbook that does not answer it invites double-driving.
 """
 
 import re
@@ -34,6 +39,7 @@ RULES = REPO / "observability" / "alert-rules.yaml"
 RUNBOOKS = REPO / "docs" / "runbooks.md"
 
 METRIC_RE = re.compile(r"((?:tpu|vllm):[a-z][a-z0-9_]*)")
+AUTOMATED_RE = re.compile(r"\*\*Automated:\*\*\s+(yes|no|partial)\b")
 
 
 def _registered_metrics() -> set:
@@ -117,12 +123,18 @@ def main() -> int:
                 problems.append(
                     f"alert {name}: runbook anchor #{m.group(1)} has "
                     f"no matching heading in docs/runbooks.md")
-            elif "#incident-bundle" not in sections.get(m.group(1),
-                                                        ""):
-                problems.append(
-                    f"alert {name}: runbook section #{m.group(1)} "
-                    f"does not link the fleet evidence "
-                    f"(#incident-bundle)")
+            else:
+                body = sections.get(m.group(1), "")
+                if "#incident-bundle" not in body:
+                    problems.append(
+                        f"alert {name}: runbook section #{m.group(1)} "
+                        f"does not link the fleet evidence "
+                        f"(#incident-bundle)")
+                if not AUTOMATED_RE.search(body):
+                    problems.append(
+                        f"alert {name}: runbook section #{m.group(1)} "
+                        f"has no '**Automated:** yes/no/partial' line "
+                        f"(is a robot already on this?)")
     if doc is not None and n_rules == 0:
         problems.append("alert-rules.yaml contains zero rules")
 
