@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Heterogeneous-fleet closed loop: two named pools (multi-model +
+# runtime LoRA adapters) behind one pooled router with per-tenant
+# buckets and per-pool autoscalers on a shared actuation budget
+# (model-correct routing, zero cross-pool interference through adapter
+# churn + engine SIGKILL, noisy-neighbor containment, per-pool scale
+# events). Committed record: TENANT_r21.json. See docs/benchmarks.md
+# "Multi-tenant fleet".
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${OUT:-TENANT_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ "${NO_TENANT_BUCKETS:-0}" = "1" ]; then
+  # anti-vacuity: this run MUST fail the peer-goodput gate (exit 1)
+  EXTRA+=(--no-tenant-buckets)
+fi
+
+python -m production_stack_tpu.loadgen multitenant \
+  --baseline-duration "${BASELINE_DURATION:-6s}" \
+  --churn-duration "${CHURN_DURATION:-14s}" \
+  --noisy-duration "${NOISY_DURATION:-8s}" \
+  --surge-duration "${SURGE_DURATION:-8s}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "multitenant record: $OUT"

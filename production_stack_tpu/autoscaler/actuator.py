@@ -74,6 +74,58 @@ class Actuator(ABC):
         pass
 
 
+class PoolConfigWriter:
+    """One shared dynamic-config document for N per-pool actuators.
+
+    With named pools (router/pools.py) every pool's membership lives in
+    ONE ``pools`` key of the router's dynamic config — so per-pool
+    actuators cannot each own the file (the last writer would wipe the
+    other pools). This writer holds the full table; each actuator calls
+    ``set_pool`` with just ITS pool's membership and the whole document
+    is rewritten atomically. The router's diff-and-swap apply keeps
+    untouched pools' policy state, so pool A scaling never resets pool
+    B's rings (the r11/r12 contract the multitenant rig gates on).
+
+    ``history`` keeps every URL a pool has EVER contained — the rig's
+    routing-correctness audit joins ok-responses' x-engine-id against
+    it, because a response served just before a scale-down lands after
+    the membership shrank.
+    """
+
+    def __init__(self, path: str, extra_config: Optional[Dict] = None):
+        self.path = path
+        self.extra_config = dict(extra_config or {})
+        self.pools: Dict[str, dict] = {}
+        self.history: Dict[str, set] = {}
+        self.writes = 0
+
+    def set_pool(self, name: str, urls: List[str], models: List[str],
+                 routing_logic: str = "roundrobin",
+                 session_key: str = "x-user-id") -> None:
+        self.pools[name] = {
+            "backends": list(urls),
+            "models": list(models),
+            "routing_logic": routing_logic,
+            "session_key": session_key,
+        }
+        self.history.setdefault(name, set()).update(urls)
+        self._write()
+
+    def total_endpoints(self) -> int:
+        """Fleet-wide endpoint count of the CURRENT document — what the
+        router's /health reports once the swap applies."""
+        return sum(len(p["backends"]) for p in self.pools.values())
+
+    def _write(self) -> None:
+        cfg = {"pools": {n: dict(p) for n, p in self.pools.items()},
+               **self.extra_config}
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(cfg, f, indent=1)
+        os.replace(tmp, self.path)
+        self.writes += 1
+
+
 class LocalProcessActuator(Actuator):
     """Real engine processes + the router's dynamic-config hot reload.
 
@@ -82,6 +134,13 @@ class LocalProcessActuator(Actuator):
     the loadgen orchestrator. ``router_url`` may be set after
     construction — the orchestration order is engines first, router
     (pointing at them) second, drains third.
+
+    **Pool mode** (``config_writer`` + ``pool``): instead of owning the
+    whole config file, the actuator publishes its membership as ONE
+    named pool through a shared :class:`PoolConfigWriter` — N per-pool
+    actuators coexist on one router, and the applied-config wait
+    checks the fleet-wide endpoint count (the union the router's
+    /health reports), not just this pool's.
     """
 
     def __init__(self, *, engine: str = "fake",
@@ -96,11 +155,22 @@ class LocalProcessActuator(Actuator):
                  drain_poll_s: float = 0.25,
                  config_apply_timeout_s: float = 30.0,
                  extra_config: Optional[Dict] = None,
+                 pool: Optional[str] = None,
+                 pool_models: Optional[List[str]] = None,
+                 config_writer: Optional[PoolConfigWriter] = None,
                  spawn: Optional[Callable[[], Awaitable[object]]] = None,
                  kill: Optional[
                      Callable[[object], Awaitable[None]]] = None):
         self.engine = engine
         self.model = "fake-model" if engine == "fake" else engine
+        # pool mode: this actuator's membership is one named pool in a
+        # shared pools document (see class docstring)
+        self.pool = pool
+        self.pool_models = list(pool_models or [])
+        self.config_writer = config_writer
+        if (config_writer is None) != (pool is None):
+            raise ValueError("pool mode needs BOTH config_writer and "
+                             "pool (or neither)")
         self.dynamic_config_path = dynamic_config_path
         self.router_url = router_url
         self.routing_logic = routing_logic
@@ -191,8 +261,9 @@ class LocalProcessActuator(Actuator):
         added = await self._launch(count)
         self._write_config()
         self.events.append(("config_swap", tuple(self.endpoint_urls())))
-        await self._wait_router_applied(len(self._handles))
-        logger.info("scale-up: +%d -> %d replicas (%s)", count,
+        await self._wait_router_applied(self._expected_fleet())
+        logger.info("scale-up%s: +%d -> %d replicas (%s)",
+                    f" [{self.pool}]" if self.pool else "", count,
                     self.replicas, ", ".join(added))
 
     # -- scale-down (the drain-safe ordering contract) -------------------
@@ -213,7 +284,7 @@ class LocalProcessActuator(Actuator):
             self._write_config()
             self.events.append(("config_swap",
                                 tuple(self.endpoint_urls())))
-            await self._wait_router_applied(len(self._handles))
+            await self._wait_router_applied(self._expected_fleet())
             # the endpoint is out of discovery; clear the stale flag so
             # a future replica reusing the port is not born draining
             await self._set_drain(url, False)
@@ -264,8 +335,21 @@ class LocalProcessActuator(Actuator):
 
     # -- dynamic-config swap --------------------------------------------
 
+    def _expected_fleet(self) -> int:
+        """Endpoint count the router should report once the last write
+        applies: fleet-wide (all pools) in pool mode, else this
+        actuator's own fleet."""
+        if self.config_writer is not None:
+            return self.config_writer.total_endpoints()
+        return len(self._handles)
+
     def _write_config(self) -> None:
         urls = self.endpoint_urls()
+        if self.config_writer is not None:
+            self.config_writer.set_pool(
+                self.pool, urls, self.pool_models or [self.model],
+                routing_logic=self.routing_logic)
+            return
         cfg = {
             "service_discovery": "static",
             "routing_logic": self.routing_logic,

@@ -104,6 +104,42 @@ class AutoscalerMetrics:
         return generate_latest(self.registry)
 
 
+class ActuationBudget:
+    """One shared fleet-change budget across N per-pool control loops.
+
+    With named pools, prefill/decode splits, and multi-model fleets,
+    several ``Autoscaler`` instances run against ONE router and one
+    host's process/chip budget. Each serializes its own actuation with
+    its own collection (module docstring), but nothing serialized them
+    with EACH OTHER — two pools deciding to scale up in the same tick
+    would launch simultaneously and overshoot the shared budget. This
+    object is the cross-loop gate: at most ``max_concurrent`` fleet
+    changes in flight at once; a loop that cannot acquire DEFERS its
+    decision (logged ``deferred: actuation_budget``, no cooldown
+    started — the policy re-evaluates next tick with fresh signals,
+    by which time the budget usually freed)."""
+
+    def __init__(self, max_concurrent: int = 1):
+        self.max_concurrent = max(1, int(max_concurrent))
+        self.in_flight = 0
+        self.deferred = 0
+
+    def try_acquire(self) -> bool:
+        if self.in_flight >= self.max_concurrent:
+            self.deferred += 1
+            return False
+        self.in_flight += 1
+        return True
+
+    def release(self) -> None:
+        self.in_flight = max(0, self.in_flight - 1)
+
+    def snapshot(self) -> dict:
+        return {"max_concurrent": self.max_concurrent,
+                "in_flight": self.in_flight,
+                "deferred": self.deferred}
+
+
 class Autoscaler:
     """Owns the control loop; see module docstring."""
 
@@ -115,7 +151,9 @@ class Autoscaler:
                  metrics: Optional[AutoscalerMetrics] = None,
                  max_decisions: int = 4096,
                  alerts_fetch=None,
-                 remediator=None):
+                 remediator=None,
+                 pool: Optional[str] = None,
+                 budget: Optional[ActuationBudget] = None):
         self.policy = policy
         self.actuator = actuator
         self.collector = collector
@@ -138,6 +176,12 @@ class Autoscaler:
         # chat_availability_page was burning" is readable straight off
         # the decision log
         self._alerts_fetch = alerts_fetch
+        # named pool this loop owns (None = the whole fleet): stamped
+        # on every decision record so an N-pool deployment's shared
+        # decision log stays attributable per pool
+        self.pool = pool
+        # shared cross-loop actuation gate (None = unbudgeted)
+        self.budget = budget
         self._task: Optional[asyncio.Task] = None
 
     # -- lifecycle ------------------------------------------------------
@@ -183,6 +227,8 @@ class Autoscaler:
                   # without digging into the signal dict
                   "signal_source": sig.source,
                   **decision.to_json()}
+        if self.pool is not None:
+            record["pool"] = self.pool
         if self._alerts_fetch is not None:
             # annotation only: a dead router must never stall scaling
             try:
@@ -194,35 +240,50 @@ class Autoscaler:
                 record["alerts_firing"] = sorted(firing)
 
         if decision.direction != HOLD:
-            victims = None
-            if decision.direction == DOWN:
-                victims = self._pick_victims(
-                    decision.current - decision.target)
-                record["victims"] = victims
-            logger.info("autoscaler: %s %d -> %d (%s) signal=%s",
-                        decision.direction, decision.current,
-                        decision.target, decision.reason,
-                        decision.signal)
-            try:
-                await self.actuator.apply(decision.target,
-                                          victims=victims)
-            except Exception as e:
-                logger.exception("actuation %d -> %d failed",
-                                 decision.current, decision.target)
+            tag = f" [{self.pool}]" if self.pool else ""
+            if self.budget is not None and not self.budget.try_acquire():
+                # another pool's fleet change is in flight: defer, no
+                # cooldown — the policy re-decides next tick on fresh
+                # signals instead of silently queueing a stale target
                 record["applied"] = False
-                record["error"] = f"{type(e).__name__}: {e}"
+                record["deferred"] = "actuation_budget"
+                logger.info("autoscaler%s: %s %d -> %d deferred "
+                            "(shared actuation budget exhausted)", tag,
+                            decision.direction, decision.current,
+                            decision.target)
             else:
-                record["applied"] = True
-                # only a COMPLETED fleet change starts a cooldown (a
-                # failed actuation must stay immediately retryable),
-                # and it starts when the change finished: a 30 s drain
-                # must not have silently consumed the down cooldown.
-                # Expressed as tick-clock + elapsed wall time so
-                # injected-clock tests and production agree.
-                self.policy.note_scaled(
-                    decision.direction,
-                    now + (time.monotonic() - wall0))
-                self.scale_events.append(record)
+                victims = None
+                if decision.direction == DOWN:
+                    victims = self._pick_victims(
+                        decision.current - decision.target)
+                    record["victims"] = victims
+                logger.info("autoscaler%s: %s %d -> %d (%s) signal=%s",
+                            tag, decision.direction, decision.current,
+                            decision.target, decision.reason,
+                            decision.signal)
+                try:
+                    await self.actuator.apply(decision.target,
+                                              victims=victims)
+                except Exception as e:
+                    logger.exception("actuation %d -> %d failed",
+                                     decision.current, decision.target)
+                    record["applied"] = False
+                    record["error"] = f"{type(e).__name__}: {e}"
+                else:
+                    record["applied"] = True
+                    # only a COMPLETED fleet change starts a cooldown (a
+                    # failed actuation must stay immediately retryable),
+                    # and it starts when the change finished: a 30 s
+                    # drain must not have silently consumed the down
+                    # cooldown. Expressed as tick-clock + elapsed wall
+                    # time so injected-clock tests and production agree.
+                    self.policy.note_scaled(
+                        decision.direction,
+                        now + (time.monotonic() - wall0))
+                    self.scale_events.append(record)
+                finally:
+                    if self.budget is not None:
+                        self.budget.release()
 
         self._log(record, sig)
         if self.remediator is not None:

@@ -104,26 +104,25 @@ class LLMEngine:
         # adapter per request (reference surface: --enable-lora +
         # proposals/lora-k8s-support.md routing by served model name)
         self.lora_ids: Dict[str, int] = {}
+        # runtime adapter pool (load_adapter/evict_adapter): rows are
+        # APPEND-ONLY — adapter id == row index + 1 forever, so an
+        # evicted name can vanish from the catalog while in-flight
+        # sequences keep a valid row. The config is pinned at first
+        # use: every adapter in one engine shares rank/targets (the
+        # stacked-pytree contract).
+        self._lora_cfg = None
+        self._lora_rows: List = []
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
         lora_stacked, lora_scaling = None, 1.0
         if engine_cfg.lora_adapters:
-            import jax
             from production_stack_tpu.models import lora as lora_mod
-            lcfg = lora_mod.LoRAConfig(
-                rank=engine_cfg.lora_rank, alpha=engine_cfg.lora_alpha,
-                targets=tuple(engine_cfg.lora_targets))
-            adapters = []
+            lcfg = self._ensure_lora_cfg()
             for name, src in sorted(engine_cfg.lora_adapters.items()):
-                if src.startswith("random:"):
-                    ad = lora_mod.random_adapter(
-                        self.model_cfg, lcfg,
-                        jax.random.PRNGKey(int(src.split(":", 1)[1])))
-                else:
-                    ad = lora_mod.load_adapter_npz(self.model_cfg, lcfg,
-                                                   src)
-                adapters.append(ad)
-                self.lora_ids[name] = len(adapters)
+                self._lora_rows.append(self._build_adapter(name, src))
+                self.lora_ids[name] = len(self._lora_rows)
             lora_stacked = lora_mod.stack_adapters(self.model_cfg, lcfg,
-                                                   adapters)
+                                                   self._lora_rows)
             lora_scaling = lcfg.scaling
         self.served_models = [engine_cfg.model] + list(self.lora_ids)
         if mesh is None and (engine_cfg.tensor_parallel_size > 1
@@ -152,6 +151,7 @@ class LLMEngine:
                                    engine_cfg.max_model_len,
                                    engine_cfg.prefill_chunk)
         self.metrics = EngineMetrics(self.model_cfg.name)
+        self.metrics.adapters_loaded.set(len(self.lora_ids))
         # paged-KV block accounting (engine/block_manager.py): admission
         # allocates each prompt's blocks, decode windows extend tables
         # on demand, and prefix caching is refcounted block SHARING —
@@ -345,6 +345,73 @@ class LLMEngine:
             return self.lora_ids[model]
         raise ValueError(f"unknown model {model!r}; serving "
                          f"{self.served_models}")
+
+    # ------------------------------------------------- runtime adapters
+
+    def _ensure_lora_cfg(self):
+        if self._lora_cfg is None:
+            from production_stack_tpu.models import lora as lora_mod
+            self._lora_cfg = lora_mod.LoRAConfig(
+                rank=self.cfg.lora_rank, alpha=self.cfg.lora_alpha,
+                targets=tuple(self.cfg.lora_targets))
+        return self._lora_cfg
+
+    def _build_adapter(self, name: str, src: str):
+        lcfg = self._ensure_lora_cfg()
+        from production_stack_tpu.models import lora as lora_mod
+        if src.startswith("random:"):
+            import jax
+            return lora_mod.random_adapter(
+                self.model_cfg, lcfg,
+                jax.random.PRNGKey(int(src.split(":", 1)[1])))
+        return lora_mod.load_adapter_npz(self.model_cfg, lcfg, src)
+
+    def load_adapter(self, name: str, src: str) -> bool:
+        """Load a LoRA adapter at runtime and start serving it as model
+        ``name``. Returns False when the name is already serving
+        (idempotent); raises on any failure — the server answers a
+        load failure with a structured 503 + Retry-After (a SHED, per
+        the r9 shed!=sick contract: a failed weight fetch means "not
+        now", never a breaker signal against the engine)."""
+        with self._lock:
+            if name == self.cfg.model or name in self.lora_ids:
+                return False
+            new_row = self._build_adapter(name, src)
+            from production_stack_tpu.models import lora as lora_mod
+            lcfg = self._ensure_lora_cfg()
+            rows = self._lora_rows + [new_row]
+            stacked = lora_mod.stack_adapters(self.model_cfg, lcfg, rows)
+            # restack + device swap BEFORE publishing the id: a request
+            # racing in on the new name must never select a row the
+            # device pytree does not hold yet
+            self.runner.set_lora(stacked, lcfg.scaling)
+            self._lora_rows = rows
+            self.lora_ids[name] = len(rows)
+            self.served_models.append(name)
+            self.adapter_loads += 1
+            self.metrics.adapter_loads.inc()
+            self.metrics.adapters_loaded.set(len(self.lora_ids))
+            logger.info("adapter %s loaded from %s (id=%d, %d rows "
+                        "stacked)", name, src, len(rows), len(rows))
+            return True
+
+    def evict_adapter(self, name: str) -> None:
+        """Stop serving adapter ``name``. Raises KeyError when unknown
+        (the server answers 404). The stacked row is tombstoned, not
+        freed: in-flight sequences carry the adapter id in their device
+        sampling rows, and id stability is what keeps them valid —
+        only the NAME leaves the catalog, so new requests 404 at
+        resolve_model while old ones finish."""
+        with self._lock:
+            if name not in self.lora_ids:
+                raise KeyError(f"adapter {name!r} is not loaded; "
+                               f"serving {self.served_models}")
+            del self.lora_ids[name]
+            self.served_models.remove(name)
+            self.adapter_evictions += 1
+            self.metrics.adapter_evictions.inc()
+            self.metrics.adapters_loaded.set(len(self.lora_ids))
+            logger.info("adapter %s evicted (row tombstoned)", name)
 
     def add_request(self, prompt_tokens: List[int],
                     options: Optional[SamplingOptions] = None,
@@ -1742,6 +1809,11 @@ class LLMEngine:
             "kv_usage": round(self.block_mgr.usage, 4),
             "est_queue_delay_ms": round(
                 1e3 * self.estimated_queue_delay_s(), 1),
+            # live model catalog (base first, then loaded adapters):
+            # the router's /v1/models aggregation and pool resolution
+            # read it, so a runtime adapter load is fleet-visible one
+            # scrape later without a config push
+            "models": list(self.served_models),
             # engine-efficiency accounting (engine/efficiency.py):
             # token-step totals, recent effective-bandwidth/MBU rates,
             # and compile counters — including compile_in_flight, which

@@ -92,6 +92,19 @@ class EngineMetrics:
             "tpu:queue_delay_shed_total",
             "Requests shed while WAITING (max_queue_delay_ms exceeded, "
             "503)")
+        # runtime LoRA adapter pool (engine.load_adapter/evict_adapter;
+        # /admin/lora/load|evict): lifecycle counters + live catalog
+        # size, per pool on the router's dashboard row
+        self.adapter_loads = counter(
+            "tpu:engine_adapter_loads_total",
+            "LoRA adapters loaded at runtime (/admin/lora/load)")
+        self.adapter_evictions = counter(
+            "tpu:engine_adapter_evictions_total",
+            "LoRA adapters evicted at runtime (/admin/lora/evict)")
+        self.adapters_loaded = gauge(
+            "tpu:engine_adapters_loaded",
+            "LoRA adapters currently serving (served model catalog "
+            "minus the base model)")
         self.capacity = gauge(
             "tpu:engine_capacity_seqs",
             "Total sequences accepted before shedding (max_num_seqs + "

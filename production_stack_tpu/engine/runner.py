@@ -225,6 +225,33 @@ class ModelRunner:
         # prompt-logprobs (echo) path, cached per (batch, padded length)
         self._prompt_lp_fns = {}
 
+    def set_lora(self, lora_stacked, lora_scaling: float = None) -> None:
+        """Swap the stacked adapter pytree in place (runtime adapter
+        load, engine.load_adapter). Same layer_slice + replicate-under-
+        mesh treatment as construction; per-row selection still rides
+        sampling.adapter, so existing executables stay valid — the
+        stacked tensors only grew a row along the adapter axis, which
+        is a runtime input, not a compile-time shape for the rows in
+        use... but a NEW row count IS a new input shape, so touched
+        executables recompile once on next dispatch (expected, bounded:
+        one build per adapter-count change per bucket)."""
+        from production_stack_tpu.models import lora as lora_mod
+        lora = lora_mod.layer_slice(lora_stacked)
+        if lora is not None and self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            lora = jax.device_put(
+                lora, NamedSharding(self.mesh, PartitionSpec()))
+        self._lora = lora
+        if lora_scaling is not None:
+            self._lora_scaling = lora_scaling
+        # adapter-count change means new stacked shapes: drop the
+        # serving executables so the next dispatch builds against them
+        # instead of feeding mismatched shapes to a stale jit cache
+        # (the base-only paths — embed, prompt-logprobs, KV
+        # extract/inject — never see the stack and keep their caches)
+        self._decode_fns = {}
+        self._prefill_fns = {}
+
     # ------------------------------------------------------------------
     # jitted impls (pure)
     # ------------------------------------------------------------------

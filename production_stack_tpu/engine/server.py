@@ -1197,6 +1197,66 @@ async def admin_kvplane_warm(request: web.Request) -> web.Response:
     return web.json_response(result)
 
 
+async def admin_lora_load(request: web.Request) -> web.Response:
+    """Load a LoRA adapter at runtime and start serving it as its own
+    model id. Body: {"name": "sql-adapter", "src": "random:7"|"/path.npz"}.
+
+    Failure semantics are the r9 shed!=sick contract at the adapter
+    stage: a failed load (bad source, OOM during restack) answers a
+    structured 503 + Retry-After — "not now", NEVER a breaker signal —
+    because the engine itself is healthy and serving its other models.
+    The router's resilience layer already classifies exactly this shape
+    as shed. Idempotent re-loads answer 200 with loaded=false."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    name = str(body.get("name") or "").strip()
+    src = str(body.get("src") or "").strip()
+    if not name or not src:
+        return _error(400, "adapter load needs {'name': ..., 'src': "
+                           "'random:SEED' or '/path/to/adapter.npz'}")
+    try:
+        # restack + device swap holds the engine lock — keep it off
+        # the event loop like every other lock-taking admin verb
+        loaded = await asyncio.to_thread(
+            engine.engine.load_adapter, name, src)
+    except Exception as e:
+        logger.warning("adapter load %s from %s failed: %s", name, src, e)
+        resp = _error(503, f"adapter {name!r} failed to load: {e}; "
+                           f"the engine is healthy and still serving "
+                           f"its current models — retry later",
+                      err_type="overloaded_error")
+        resp.headers["Retry-After"] = "5"
+        return resp
+    return web.json_response({
+        "loaded": loaded, "name": name,
+        "models": list(engine.engine.served_models)})
+
+
+async def admin_lora_evict(request: web.Request) -> web.Response:
+    """Stop serving adapter ``name`` (body: {"name": ...}). Unknown
+    adapter answers 404; the stacked row is tombstoned so in-flight
+    requests on the adapter finish normally."""
+    engine = request.app[ENGINE_KEY]
+    try:
+        body = await request.json()
+    except Exception:
+        body = {}
+    name = str(body.get("name") or "").strip()
+    if not name:
+        return _error(400, "adapter evict needs {'name': ...}")
+    try:
+        await asyncio.to_thread(engine.engine.evict_adapter, name)
+    except KeyError as e:
+        return _error(404, str(e.args[0]) if e.args else
+                      f"adapter {name!r} is not loaded",
+                      err_type="not_found_error")
+    return web.json_response({
+        "evicted": name, "models": list(engine.engine.served_models)})
+
+
 async def tokenize(request: web.Request) -> web.Response:
     engine = request.app[ENGINE_KEY]
     body = await request.json()
@@ -1303,6 +1363,8 @@ def build_app(engine: AsyncLLMEngine,
     app.router.add_post("/admin/kvplane/migrate_out",
                         admin_kvplane_migrate_out)
     app.router.add_post("/admin/kvplane/warm", admin_kvplane_warm)
+    app.router.add_post("/admin/lora/load", admin_lora_load)
+    app.router.add_post("/admin/lora/evict", admin_lora_evict)
 
     async def on_startup(app):
         # warmup (if any) was done before the loop started

@@ -60,6 +60,16 @@ multirouter — launch N peered router replicas (breaker/drain gossip,
            a saturation sweep holds tier-0 goodput while tier-2
            sheds (MULTIROUTER_*.json; --no-shared-state must fail
            the affinity gate)
+multitenant — launch TWO named pools (model-a + runtime LoRA
+           adapters, model-b) behind one pooled router, each with its
+           own per-pool autoscaler sharing one actuation budget; exit
+           1 unless routing is 100%% model-correct against strict
+           engines, pool-b goodput holds through pool-a's adapter
+           churn + engine SIGKILL with zero errors, a bursting tenant
+           is shed >=50%% while same-tier peers hold >=95%% goodput,
+           and BOTH pool labels appear as applied scale-ups in the
+           decision log (TENANT_*.json; --no-tenant-buckets must fail
+           the peer-goodput gate)
 trace    — launch router + engines (optionally the disagg split),
            storm them, and join client x-trace-ids against the
            router's and engines' /debug/traces rings; exit 1 unless
@@ -134,6 +144,8 @@ from production_stack_tpu.loadgen.kvshare import (kvshare_violations,
                                                   run_kvshare)
 from production_stack_tpu.loadgen.multirouter import (
     multirouter_violations, run_multirouter)
+from production_stack_tpu.loadgen.multitenant import (
+    multitenant_violations, run_multitenant)
 from production_stack_tpu.loadgen.orchestrator import run_scaleout
 from production_stack_tpu.loadgen.overhead import run_overhead
 from production_stack_tpu.loadgen.overload import (overload_violations,
@@ -869,6 +881,51 @@ def cmd_multirouter(args) -> int:
                     f"{guard['overhead_ratio']:.2f}x vs baseline "
                     f"{guard['baseline_ratio']:.2f}x")
         print(msg)
+    return 1 if violations else 0
+
+
+def cmd_multitenant(args) -> int:
+    record = asyncio.run(run_multitenant(
+        baseline_s=args.baseline_duration,
+        churn_s=args.churn_duration,
+        noisy_s=args.noisy_duration,
+        surge_s=args.surge_duration,
+        adapter_cycles=args.adapter_cycles,
+        initial_a=args.pool_a_replicas, initial_b=args.pool_b_replicas,
+        max_a=args.pool_a_max, max_b=args.pool_b_max,
+        fake_capacity=args.fake_capacity,
+        num_tokens=args.num_tokens,
+        tenant_rate=args.tenant_rate,
+        tenant_buckets=not args.no_tenant_buckets,
+        max_inflight=args.max_inflight,
+        noisy_workers=args.noisy_workers,
+        tick_interval_s=args.tick_interval,
+        platform=args.platform, log_dir=args.log_dir,
+        startup_timeout_s=args.startup_timeout))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"TENANT_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = multitenant_violations(
+        record, interference_floor=args.interference_floor,
+        min_noisy_shed=args.min_noisy_shed,
+        peer_floor=args.peer_floor)
+    for v in violations:
+        print(f"MULTITENANT VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        noisy = d["noisy"]
+        routing = d["routing"]
+        print(f"multitenant PASSED: {routing['ok_checked']} responses "
+              f"100% model-correct across "
+              f"{len(d['pools'])} pools, pool-b held "
+              f"{record['value']}% of baseline through pool-a "
+              f"churn+kill, acme shed "
+              f"{noisy['acme_shed_fraction']:.0%} while peers held, "
+              f"pools scaled: "
+              f"{', '.join(d['autoscaling']['pools_scaled_up'])} "
+              f"({d['autoscaling']['budget_deferrals']} budget "
+              f"deferrals)")
     return 1 if violations else 0
 
 
@@ -1720,6 +1777,72 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write MULTIROUTER_*.json here (default: "
                          "timestamped)")
     sp.set_defaults(fn=cmd_multirouter)
+
+    sp = sub.add_parser("multitenant",
+                        help="two named pools (multi-model + runtime "
+                             "LoRA adapters) behind one router with "
+                             "per-tenant buckets and per-pool "
+                             "autoscalers on a shared actuation "
+                             "budget: routing must be 100%% model-"
+                             "correct, pool-a churn+kill must not "
+                             "touch pool-b, the noisy tenant must "
+                             "shed while tier peers hold, and both "
+                             "pools must log applied scale-ups")
+    sp.add_argument("--baseline-duration", type=parse_duration,
+                    default=6.0, help="reference-goodput window")
+    sp.add_argument("--churn-duration", type=parse_duration,
+                    default=14.0,
+                    help="pool-a adapter churn + fault + SIGKILL "
+                         "window")
+    sp.add_argument("--noisy-duration", type=parse_duration,
+                    default=8.0, help="noisy-tenant burst window")
+    sp.add_argument("--surge-duration", type=parse_duration,
+                    default=8.0, help="seconds per surge round (up "
+                                      "to 3 rounds until both pools "
+                                      "scale)")
+    sp.add_argument("--adapter-cycles", type=int, default=2,
+                    help="load->route->evict adapter cycles during "
+                         "churn")
+    sp.add_argument("--pool-a-replicas", type=int, default=2)
+    sp.add_argument("--pool-b-replicas", type=int, default=1)
+    sp.add_argument("--pool-a-max", type=int, default=3)
+    sp.add_argument("--pool-b-max", type=int, default=2)
+    sp.add_argument("--fake-capacity", type=int, default=4,
+                    help="per-engine bounded admission (the overload "
+                         "fault's capacity advertisement)")
+    sp.add_argument("--num-tokens", type=int, default=4)
+    sp.add_argument("--tenant-rate", type=float, default=5.0,
+                    help="router --qos-tenant-rate (req/s per "
+                         "x-tenant-id inside each tier)")
+    sp.add_argument("--no-tenant-buckets", action="store_true",
+                    help="launch the router WITHOUT per-tenant "
+                         "buckets: acme's burst then saturates "
+                         "pool-b and the peer-goodput gate must "
+                         "fail (exit 1) — the anti-vacuity check")
+    sp.add_argument("--max-inflight", type=int, default=40,
+                    help="router-wide admission gate (QoS tiers "
+                         "fraction it)")
+    sp.add_argument("--noisy-workers", type=int, default=8,
+                    help="closed-loop workers the bursting tenant "
+                         "runs")
+    sp.add_argument("--tick-interval", type=float, default=0.5,
+                    help="autoscaler decision tick (s)")
+    sp.add_argument("--interference-floor", type=float, default=0.95,
+                    help="pool-b churn-phase goodput as a fraction "
+                         "of baseline")
+    sp.add_argument("--min-noisy-shed", type=float, default=0.5,
+                    help="shed fraction the bursting tenant must "
+                         "reach")
+    sp.add_argument("--peer-floor", type=float, default=0.95,
+                    help="ok-fraction each tier peer must keep "
+                         "during the burst")
+    sp.add_argument("--platform", default="cpu")
+    sp.add_argument("--log-dir", default="loadgen-logs")
+    sp.add_argument("--startup-timeout", type=float, default=120.0)
+    sp.add_argument("--output", default=None,
+                    help="write TENANT_*.json here (default: "
+                         "timestamped)")
+    sp.set_defaults(fn=cmd_multitenant)
 
     sp = sub.add_parser("trace",
                         help="router + engines (optionally the disagg "

@@ -24,6 +24,7 @@ from production_stack_tpu import protocol as proto
 from production_stack_tpu.router.dynamic_config import DynamicConfigWatcher
 from production_stack_tpu.router.feature_gates import FeatureGates
 from production_stack_tpu.router.metrics import RouterMetrics
+from production_stack_tpu.router.pools import PoolManager, parse_pool_spec
 from production_stack_tpu.router.proxy import route_general_request
 from production_stack_tpu.router.resilience import (CLOSED,
                                                     HealthTracker,
@@ -68,6 +69,16 @@ async def list_models(request: web.Request) -> web.Response:
         for name in [ep.model] + ep.model_aliases:
             if name not in cards:
                 cards[name] = proto.ModelCard(id=name)
+    # the configured fleet is the floor, not the catalog: adapters
+    # loaded at runtime (/admin/lora/load) surface in each engine's
+    # scraped /load ``models`` list one scrape interval later — merge
+    # them so /v1/models reports what the fleet ACTUALLY serves
+    scraper = state.get("scraper")
+    if scraper is not None:
+        for es in scraper.get().values():
+            for name in getattr(es, "served_models", ()):
+                if name not in cards:
+                    cards[name] = proto.ModelCard(id=name)
     return web.json_response(
         proto.ModelList(data=list(cards.values())).model_dump())
 
@@ -111,6 +122,9 @@ async def health(request: web.Request) -> web.Response:
     disagg = state.get("disagg")
     if disagg is not None:
         body["prefill_pool"] = disagg.pool_snapshot()
+    pools = state.get("pools")
+    if pools is not None and pools.active:
+        body["pools"] = pools.snapshot()
     if peers is not None:
         body["peers"] = peers.snapshot()
     if state.get("qos") is not None:
@@ -298,6 +312,8 @@ async def metrics(request: web.Request) -> web.Response:
         state["metrics"].refresh_peers(state["peers"])
     if state.get("qos") is not None:
         state["metrics"].refresh_qos(state["qos"])
+    if state.get("pools") is not None:
+        state["metrics"].refresh_pools(state["pools"])
     return web.Response(body=state["metrics"].render(),
                         content_type="text/plain")
 
@@ -376,7 +392,8 @@ def build_app(args: argparse.Namespace) -> web.Application:
     if args.qos_tiers:
         state["qos"] = QosPolicy(
             args.qos_tiers, tier_rates=args.qos_tier_rates,
-            preempt_from=args.qos_preempt_from)
+            preempt_from=args.qos_preempt_from,
+            tenant_rate=args.qos_tenant_rate)
         state["qos_deadline_overlays"] = [
             {"x-request-deadline-ms":
              str(max(1000, int(args.request_timeout * 1000
@@ -482,6 +499,22 @@ def build_app(args: argparse.Namespace) -> web.Application:
     # scraped per-engine tier hit rate (routing.PrefixAwareRouter)
     if hasattr(state["router"], "attach_scraper"):
         state["router"].attach_scraper(state["scraper"].get)
+
+    # named pools (router/pools.py): model -> endpoints -> per-pool
+    # routing policy. The manager replaces service discovery — every
+    # fleet-wide consumer sees the union of pools. The startup static
+    # discovery (never started) is simply discarded; dynamic config
+    # can still swap/disable the table via its ``pools`` key.
+    if args.pools:
+        raw = args.pools
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        manager = PoolManager(state["router_kwargs"])
+        manager.attach_scraper(state["scraper"].get)
+        manager.apply(parse_pool_spec(raw))
+        state["pools"] = manager
+        state["discovery"] = manager
 
     if args.dynamic_config_json:
         state["config_watcher"] = DynamicConfigWatcher(
@@ -600,6 +633,16 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "adapters) become routable aliases")
     p.add_argument("--static-model-aliases", default="",
                    help="alias:model,... pairs")
+    p.add_argument("--pools", default="",
+                   help="named-pool fleet spec: JSON object (inline, "
+                        "or @/path/to/file) mapping pool name to "
+                        "{backends, models, routing_logic?, "
+                        "session_key?}. Requests route on their body's "
+                        "``model`` to the owning pool and ITS routing-"
+                        "policy instance; a model no pool serves is a "
+                        "structured 404. Replaces service discovery "
+                        "with the union of pools; hot-swappable via "
+                        "the dynamic-config ``pools`` key")
     p.add_argument("--k8s-namespace", default="default")
     p.add_argument("--k8s-label-selector", default="")
     p.add_argument("--k8s-engine-port", type=int, default=8100)
@@ -800,6 +843,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="optional per-tier token buckets: "
                         "name=requests_per_second pairs (absent = "
                         "uncapped rate)")
+    p.add_argument("--qos-tenant-rate", type=float, default=0.0,
+                   help="per-tenant token bucket nested inside every "
+                        "QoS tier (requests/second per distinct "
+                        "x-tenant-id value; 0 disables). A tenant over "
+                        "its bucket sheds 429 + Retry-After WITHOUT "
+                        "drawing from its tier's shared budget, so a "
+                        "noisy tenant cannot starve its tier peers")
     p.add_argument("--qos-preempt-from", type=int, default=None,
                    help="tiers at or past this index register as "
                         "preemptable while their backend dispatch is "
@@ -813,8 +863,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--file-storage-path", default="/tmp/pstpu_files")
     p.add_argument("--batch-db-path", default="/tmp/pstpu_batches.db")
     args = p.parse_args(argv)
-    if args.service_discovery == "static" and not args.static_backends:
-        p.error("--static-backends is required with static discovery")
+    if args.service_discovery == "static" and not args.static_backends \
+            and not args.pools:
+        p.error("--static-backends is required with static discovery "
+                "(or name the fleet via --pools)")
     if args.service_discovery == "k8s" and not args.k8s_label_selector:
         p.error("--k8s-label-selector is required with k8s discovery")
     return args

@@ -36,6 +36,14 @@ class DynamicRouterConfig:
     # disaggregation; non-empty = swap the pool in place.
     prefill_backends: Optional[List[str]] = None
     prefill_models: Optional[List[str]] = None
+    # named pools (router/pools.py). Same tri-state: None = key absent,
+    # leave the running pools table alone (an autoscaler actuating one
+    # pool writes only that pool's entry merged by its shared config
+    # writer — but an operator pushing an unrelated key must not wipe
+    # the table); {} = disable pooling (single-pool routing resumes
+    # from static_backends); non-empty = diff-and-swap pool by pool,
+    # preserving untouched pools' policy state.
+    pools: Optional[dict] = None
 
     @staticmethod
     def from_json(data: dict) -> "DynamicRouterConfig":
@@ -52,6 +60,8 @@ class DynamicRouterConfig:
                               if "prefill_backends" in data else None),
             prefill_models=(listify(data["prefill_models"])
                             if "prefill_models" in data else None),
+            pools=(dict(data["pools"] or {}) if "pools" in data
+                   else None),
         )
 
     def to_json(self) -> dict:
@@ -70,6 +80,8 @@ class DynamicRouterConfig:
             out["prefill_backends"] = self.prefill_backends
         if self.prefill_models is not None:
             out["prefill_models"] = self.prefill_models
+        if self.pools is not None:
+            out["pools"] = self.pools
         return out
 
 
@@ -151,6 +163,7 @@ class DynamicConfigWatcher:
             if scraper is not None and \
                     hasattr(self.state["router"], "attach_scraper"):
                 self.state["router"].attach_scraper(scraper.get)
+        await self._apply_pools(cfg)
         self._apply_prefill_pool(cfg)
         # decode-fleet membership may have changed above (static swap)
         # even when the prefill key was absent — the decode-only-
@@ -164,6 +177,60 @@ class DynamicConfigWatcher:
                 disagg.selector.evict_except(
                     ep.url for ep in discovery.all_endpoints())
         self.current = cfg
+
+    async def _apply_pools(self, cfg: DynamicRouterConfig) -> None:
+        """Create/swap/disable the named-pools table (router/pools.py).
+        The running PoolManager is mutated IN PLACE pool by pool, so a
+        swap that touches pool A never resets pool B's router-policy
+        state, and the manager's routed/unknown counters survive every
+        swap (the r11/r12 state-survival contract at the pool layer).
+        When pools are active the manager IS the service discovery —
+        every fleet-wide consumer reads the union of pools."""
+        if cfg.pools is None:
+            return                     # key absent: leave pools alone
+        manager = self.state.get("pools")
+        metrics = self.state.get("metrics")
+        if not cfg.pools:
+            # {} -> disable pooling. Discovery falls back to whatever
+            # the static swap above installed (an operator disabling
+            # pools ships static_backends in the same document); with
+            # no static list the fleet is legitimately empty.
+            if manager is not None and manager.active:
+                if metrics is not None:
+                    metrics.refresh_pools(manager)
+                manager.apply({})
+                logger.info("dynamic config: pools disabled")
+                if self.state.get("discovery") is manager and \
+                        not cfg.static_backends:
+                    logger.warning(
+                        "dynamic config: pools disabled with no "
+                        "static_backends — zero routable endpoints")
+            return
+        from production_stack_tpu.router.pools import (PoolManager,
+                                                       parse_pool_spec)
+        try:
+            spec = parse_pool_spec(cfg.pools)
+        except (ValueError, TypeError) as e:
+            # a malformed pools document must not kill the watcher or
+            # leave the apply half-done: keep the running table
+            logger.error("dynamic config: bad pools spec (%s) — pools "
+                         "left unchanged", e)
+            return
+        if manager is None:
+            manager = PoolManager(self.state.get("router_kwargs"))
+            scraper = self.state.get("scraper")
+            if scraper is not None:
+                manager.attach_scraper(scraper.get)
+            self.state["pools"] = manager
+        elif metrics is not None:
+            # fold counters before any pool drops out of the table
+            metrics.refresh_pools(manager)
+        manager.apply(spec)
+        old = self.state.get("discovery")
+        if old is not manager:
+            self.state["discovery"] = manager
+            if old is not None:
+                await old.close()
 
     def _apply_prefill_pool(self, cfg: DynamicRouterConfig) -> None:
         """Swap/create/disable the disagg prefill pool. The running

@@ -137,6 +137,40 @@ class RouterMetrics:
             "tpu:router_qos_inflight",
             "Currently proxied requests per priority tier",
             ["tier"], registry=self.registry)
+        # named-pools surface (router/pools.py): per-pool routed
+        # requests / endpoint counts / in-place swaps, unknown-model
+        # 404s, and per-(tenant, tier) sheds from the nested tenant
+        # buckets (qos.py). Counters delta-sync off the PoolManager's /
+        # QosPolicy's plain-int totals, which survive pool swaps by
+        # construction; the tenant label set is bounded by the policy's
+        # LRU (max_tenants).
+        self.pool_requests = Counter(
+            "tpu:router_pool_requests",
+            "Requests routed per named pool (model -> pool resolution)",
+            ["pool"], registry=self.registry)
+        self.pool_endpoints = Gauge(
+            "tpu:router_pool_endpoints",
+            "Configured endpoints per named pool",
+            ["pool"], registry=self.registry)
+        self.pool_swaps = Counter(
+            "tpu:router_pool_swaps",
+            "In-place pool spec swaps applied (membership or policy)",
+            ["pool"], registry=self.registry)
+        self.pool_unknown_models = Counter(
+            "tpu:router_pool_unknown_models",
+            "Requests 404ed because no pool serves the named model",
+            registry=self.registry)
+        self.tenant_sheds = Counter(
+            "tpu:router_tenant_sheds",
+            "Requests shed by a per-tenant token bucket nested in a "
+            "QoS tier (noisy-neighbor containment, "
+            "docs/multitenancy.md)",
+            ["tenant", "tier"], registry=self.registry)
+        self._pool_req_last: dict = {}
+        self._pool_swap_last: dict = {}
+        self._pool_unknown_last = 0
+        self._tenant_shed_last: dict = {}
+        self._seen_pools = set()
         self.affinity_moves = Counter(
             "tpu:router_affinity_moves",
             "Session/prefix keys routed away from their previous home "
@@ -342,6 +376,55 @@ class RouterMetrics:
             self._qos_preempt_last[t.name] = total
             self.qos_inflight.labels(tier=t.name).set(
                 qos.inflight[t.index])
+        # per-(tenant, tier) sheds from the nested tenant buckets; the
+        # policy's LRU evicts (tenant, tier) keys with their buckets,
+        # so the baseline dict is pruned with it — an evicted tenant
+        # that returns restarts its totals, which delta-sync treats as
+        # fresh increments (never negative)
+        tenant_sheds = getattr(qos, "tenant_sheds", None)
+        if tenant_sheds is not None:
+            for key in [k for k in self._tenant_shed_last
+                        if k not in tenant_sheds]:
+                del self._tenant_shed_last[key]
+            for (tenant, tier), total in tenant_sheds.items():
+                delta = total - self._tenant_shed_last.get(
+                    (tenant, tier), 0)
+                if delta > 0:
+                    self.tenant_sheds.labels(
+                        tenant=tenant, tier=tier).inc(delta)
+                self._tenant_shed_last[(tenant, tier)] = total
+
+    def refresh_pools(self, pools) -> None:
+        """Export the PoolManager's accounting. Requests/swaps are
+        delta-synced real counters off manager totals that survive
+        pool-object swaps; the endpoint gauge drops label series for
+        pools no longer in the table (dropped pools keep their counter
+        totals — counters are monotonic — but must not export a frozen
+        endpoint count)."""
+        snap = pools.snapshot()
+        for name in self._seen_pools - set(snap):
+            try:
+                self.pool_endpoints.remove(name)
+            except KeyError:
+                pass
+        self._seen_pools = set(snap)
+        for name, info in snap.items():
+            self.pool_endpoints.labels(pool=name).set(
+                len(info["backends"]))
+        for name, total in pools.routed.items():
+            delta = total - self._pool_req_last.get(name, 0)
+            if delta > 0:
+                self.pool_requests.labels(pool=name).inc(delta)
+            self._pool_req_last[name] = total
+        for name, total in pools.swaps.items():
+            delta = total - self._pool_swap_last.get(name, 0)
+            if delta > 0:
+                self.pool_swaps.labels(pool=name).inc(delta)
+            self._pool_swap_last[name] = total
+        delta = pools.unknown_models - self._pool_unknown_last
+        if delta > 0:
+            self.pool_unknown_models.inc(delta)
+        self._pool_unknown_last = pools.unknown_models
 
     def refresh_disagg(self, orch) -> None:
         """Export the disagg orchestrator's counters. Delta-synced like

@@ -337,15 +337,20 @@ async def route_general_request(request: web.Request,
         tier = qos.resolve(request.headers)
         if CLASS_HEADER not in request.headers:
             trace.attrs["class"] = tier.name
+        tenant = qos.resolve_tenant(request.headers)
         verdict, _victim = qos.admit(tier, state["proxied_inflight"],
-                                     max_inflight)
+                                     max_inflight, tenant=tenant)
         if verdict == "shed":
             state["shed_counts"]["admission"] += 1
             resp = _shed_response(
                 429, f"router overloaded: priority tier {tier.name} "
                      f"is past its admission bound "
                      f"({state['proxied_inflight']} in flight, "
-                     f"--max-inflight {max_inflight}); retry later")
+                     f"--max-inflight {max_inflight}); retry later"
+                if tenant is None else
+                f"tenant {tenant} is over its per-tenant rate in "
+                f"tier {tier.name}, or the tier is past its admission "
+                f"bound; retry later")
             resp.headers[TRACE_ID_HEADER] = trace.trace_id
             _slo_observe(state, endpoint_path, request, resp, trace,
                          tier=tier)
@@ -455,8 +460,28 @@ async def _proxy_request(request: web.Request,
         raw = json.dumps({k: v for k, v in body.items()
                           if k not in CACHE_CONTROL_FIELDS}).encode()
 
-    candidates = [ep for ep in state["discovery"].get_endpoints()
-                  if ep.serves(model)]
+    # named pools (router/pools.py): the model picks its pool — the
+    # pool's endpoints AND its own routing-policy instance. A model no
+    # pool serves is an authoritative 404 (the pools table is the
+    # fleet's model catalog), not the legacy 400. Without pools the
+    # single-pool path below is byte-identical to before (r7 band).
+    pools = state.get("pools")
+    pool_router = None
+    if pools is not None and pools.active:
+        model_pool = pools.resolve(model)
+        if model_pool is None:
+            pools.note_unknown_model()
+            return web.json_response(
+                {"error": {"message": f"model {model!r} is not served "
+                                      f"by any pool",
+                           "type": "not_found_error",
+                           "code": "model_not_found"}}, status=404)
+        pools.note_routed(model_pool.name)
+        pool_router = model_pool.router
+        candidates = list(model_pool.endpoints)
+    else:
+        candidates = [ep for ep in state["discovery"].get_endpoints()
+                      if ep.serves(model)]
     if not candidates:
         return web.json_response(
             {"error": {"message": f"no backend serves model {model!r}",
@@ -643,8 +668,13 @@ async def _proxy_request(request: web.Request,
                                             else "abstain"),
                                     attrs=explain)
             if url is None:
-                url = state["router"].route(pool, request_stats,
-                                            request.headers, body)
+                # the pool's own policy instance when pools are active
+                # (its ring/ramp state is pool-scoped); the app-wide
+                # router otherwise
+                router = pool_router if pool_router is not None \
+                    else state["router"]
+                url = router.route(pool, request_stats,
+                                   request.headers, body)
         if disagg_active:
             # the chosen decode engine will fetch-or-compute the
             # prompt chunks and hold them locally afterwards. Recorded

@@ -22,6 +22,17 @@ degrades *by tier* instead:
 - **Per-tier token buckets** (``--qos-tier-rates name=req_per_s``)
   bound a tier's *rate* outright, pressure or not — the lever for a
   contractual background-tier budget.
+- **Per-tenant token buckets inside each tier**
+  (``--qos-tenant-rate req_per_s``, ``x-tenant-id`` header): every
+  (tier, tenant) pair gets its own lazily created bucket, so a noisy
+  tenant's burst sheds against ITS budget — its tier peers' buckets,
+  and the tier's shared admission fraction, are untouched
+  (noisy-neighbor containment, docs/multitenancy.md). Tenant sheds
+  carry reason ``tenant`` and are counted per (tenant, tier) in
+  ``tpu:router_tenant_sheds_total``; untagged requests (no tenant
+  header) are never tenant-bucketed. The bucket table is a bounded
+  LRU (``max_tenants``), so label cardinality and memory stay fixed
+  no matter how many tenant ids clients invent.
 - **Deadline budgets, low-tier-first.** The downstream deadline the
   router injects when the client sent none (``--request-timeout``)
   scales by the tier's admit fraction, so under queueing the engine
@@ -55,11 +66,12 @@ from production_stack_tpu.utils import init_logger
 logger = init_logger(__name__)
 
 PRIORITY_HEADER = "x-priority-class"
+TENANT_HEADER = "x-tenant-id"
 
 # canonical three-tier spec (docs/router.md "QoS priority tiers")
 DEFAULT_TIER_SPEC = "tier0=1.0,tier1=0.85,tier2=0.7"
 
-SHED_REASONS = ("bucket", "pressure", "preempted")
+SHED_REASONS = ("bucket", "pressure", "preempted", "tenant")
 
 
 class _TokenBucket:
@@ -152,6 +164,8 @@ class QosPolicy:
     def __init__(self, spec: str = DEFAULT_TIER_SPEC,
                  tier_rates: str = "",
                  preempt_from: Optional[int] = None,
+                 tenant_rate: float = 0.0,
+                 max_tenants: int = 256,
                  now_fn=time.monotonic):
         rates: Dict[str, float] = {}
         for part in (tier_rates or "").split(","):
@@ -187,6 +201,18 @@ class QosPolicy:
         self.inflight = [0] * len(self.tiers)
         self.sheds: Dict[Tuple[str, str], int] = collections.defaultdict(int)
         self.preemptions = [0] * len(self.tiers)   # as victim
+        # per-tenant buckets nested inside tiers: (tier, tenant) ->
+        # bucket, bounded LRU. tenant_sheds keys (tenant, tier) —
+        # metrics label order — and is evicted WITH the bucket so the
+        # exported label set stays bounded by max_tenants too.
+        self.tenant_rate = float(tenant_rate)
+        self.max_tenants = max_tenants
+        self._now_fn = now_fn
+        self._tenant_buckets: \
+            "collections.OrderedDict[Tuple[str, str], _TokenBucket]" = \
+            collections.OrderedDict()
+        self.tenant_sheds: Dict[Tuple[str, str], int] = \
+            collections.defaultdict(int)
 
     # -- tier resolution ------------------------------------------------
 
@@ -209,10 +235,46 @@ class QosPolicy:
             return self.tiers[idx]
         return self.tiers[0]
 
+    def resolve_tenant(self, headers) -> Optional[str]:
+        """``x-tenant-id`` value, or None when absent or tenant
+        bucketing is off — None short-circuits every tenant check, so
+        untagged traffic (every client that predates tenancy) pays
+        nothing."""
+        if self.tenant_rate <= 0 or headers is None:
+            return None
+        raw = headers.get(TENANT_HEADER)
+        return raw.strip() if raw else None
+
     # -- admission ------------------------------------------------------
 
-    def admit(self, tier: QosTier, inflight: int,
-              max_inflight: int) -> Tuple[str, Optional[_PreemptSlot]]:
+    def _tenant_allows(self, tier: QosTier,
+                       tenant: Optional[str]) -> bool:
+        """One draw on the (tier, tenant) bucket — lazily created at
+        the flat per-tenant rate, LRU-bounded. An evicted tenant's
+        next request simply re-creates a full bucket: the LRU bound is
+        a memory cap, not a policy (an attacker cycling tenant ids is
+        the admission fraction's problem, not this table's)."""
+        if tenant is None:
+            return True
+        key = (tier.name, tenant)
+        bucket = self._tenant_buckets.get(key)
+        if bucket is None:
+            bucket = _TokenBucket(self.tenant_rate, now_fn=self._now_fn)
+            self._tenant_buckets[key] = bucket
+            while len(self._tenant_buckets) > self.max_tenants:
+                old_key, _ = self._tenant_buckets.popitem(last=False)
+                self.tenant_sheds.pop((old_key[1], old_key[0]), None)
+        else:
+            self._tenant_buckets.move_to_end(key)
+        return bucket.try_take()
+
+    def _shed_tenant(self, tier: QosTier, tenant: str) -> None:
+        self.sheds[(tier.name, "tenant")] += 1
+        self.tenant_sheds[(tenant, tier.name)] += 1
+
+    def admit(self, tier: QosTier, inflight: int, max_inflight: int,
+              tenant: Optional[str] = None
+              ) -> Tuple[str, Optional[_PreemptSlot]]:
         """One admission decision. Returns ``(verdict, victim)``:
         ``("admit", None)`` / ``("admit", slot)`` (slot preempted to
         make room — caller delivers the victim its 503) /
@@ -221,13 +283,25 @@ class QosPolicy:
         The pressure gate runs BEFORE the token bucket: a request that
         is going to be pressure-shed anyway must not drain the tier's
         contractual rate budget, or sustained pressure double-charges
-        the bucket and starves the tier after the pressure clears."""
+        the bucket and starves the tier after the pressure clears.
+        The TENANT bucket is drawn before the tier bucket for the same
+        reason in the other direction: a tenant-shed request must not
+        drain the tier's shared budget (one tenant's burst would spend
+        its peers' rate), and tenant-refused requests never preempt."""
         if max_inflight and inflight >= max_inflight * tier.admit_fraction:
             victim = None
             if tier.index < self.preempt_from:
                 victim = self._pick_victim(tier)
             if victim is None:
                 self.sheds[(tier.name, "pressure")] += 1
+                return "shed", None
+            if not self._tenant_allows(tier, tenant):
+                # over the TENANT's rate even with a victim available:
+                # put the victim back and shed — never burn a
+                # background dispatch for a request this tenant's own
+                # budget refuses anyway
+                self._preemptable[victim.tier.index][victim.key] = victim
+                self._shed_tenant(tier, tenant)
                 return "shed", None
             if tier.bucket is not None and not tier.bucket.try_take():
                 # over its rate even with a victim available: shed
@@ -241,6 +315,9 @@ class QosPolicy:
             self.sheds[(victim.tier.name, "preempted")] += 1
             self.admitted[tier.index] += 1
             return "admit", victim
+        if not self._tenant_allows(tier, tenant):
+            self._shed_tenant(tier, tenant)
+            return "shed", None
         if tier.bucket is not None and not tier.bucket.try_take():
             self.sheds[(tier.name, "bucket")] += 1
             return "shed", None
@@ -310,7 +387,14 @@ class QosPolicy:
                 "shed_total": sum(shed.values()),
                 "preempted": self.preemptions[t.index],
             })
-        return {"preempt_from": self.preempt_from, "tiers": tiers}
+        out = {"preempt_from": self.preempt_from, "tiers": tiers}
+        if self.tenant_rate > 0:
+            out["tenant_rate"] = self.tenant_rate
+            out["tenants_tracked"] = len(self._tenant_buckets)
+            out["tenant_sheds"] = {
+                f"{tenant}/{tier}": n
+                for (tenant, tier), n in sorted(self.tenant_sheds.items())}
+        return out
 
     def shed_totals(self) -> Dict[str, int]:
         out: Dict[str, int] = {t.name: 0 for t in self.tiers}

@@ -262,6 +262,12 @@ class EngineStats:
     decode_tokens_per_s: float = 0.0
     compiles_total: float = 0.0
     compile_in_flight: float = 0.0
+    # the engine's live model catalog from /load ("models": base first,
+    # then every currently-loaded LoRA adapter): feeds /v1/models
+    # aggregation and the pool-resolution scrape fallback — a
+    # just-loaded adapter becomes routable one scrape later with no
+    # config push (router/pools.py)
+    served_models: Tuple[str, ...] = ()
     scraped_at: float = field(default_factory=time.time)
 
 
@@ -330,6 +336,7 @@ class EngineStatsScraper(LoadPoller):
             decode_tokens_per_s=load.decode_tokens_per_s,
             compiles_total=load.compiles_total,
             compile_in_flight=load.compile_in_flight,
+            served_models=load.models,
         )
 
     async def _fetch_fallback(self, url: str) -> Optional[EngineStats]:

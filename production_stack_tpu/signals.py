@@ -27,7 +27,7 @@ router) are handled by the subclass fallback hook ``_fetch_fallback``
 import asyncio
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterable, Optional
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 import aiohttp
 
@@ -77,6 +77,12 @@ class EngineLoad:
     token_steps_dead: float = 0.0
     compiles_total: float = 0.0
     compile_in_flight: float = 0.0
+    # the engine's live model catalog (/load "models": base model
+    # first, then every currently-loaded LoRA adapter; () for engines
+    # predating the field): the router's /v1/models aggregation and
+    # pool resolution read it, so a runtime adapter load propagates
+    # fleet-wide one scrape later without a config push
+    models: Tuple[str, ...] = ()
     scraped_at: float = field(default_factory=time.time)
 
     @property
@@ -126,6 +132,7 @@ def parse_load_report(data: dict) -> EngineLoad:
         token_steps_dead=pnum(steps, "dead"),
         compiles_total=pnum(perf, "compiles_total"),
         compile_in_flight=pnum(perf, "compile_in_flight"),
+        models=tuple(str(m) for m in data.get("models") or ()),
     )
 
 

@@ -808,8 +808,33 @@ def compile_prometheus_rules(config: Optional[SLOConfig] = None) -> dict:
             "runbook": "docs/runbooks.md#auto-remediation",
         },
     }]
+    tenancy_rules = [{
+        # tenant-bucket sheds are the containment WORKING, not failing
+        # — the router refuses one tenant's overflow so its tier peers
+        # keep their goodput (router/qos.py). The alert exists because
+        # a tenant shedding for this long has outgrown its flat
+        # --qos-tenant-rate (or is misbehaving), and either way the
+        # conversation is with an account, not a pager storm: ticket.
+        "alert": "NoisyTenantShedding",
+        "expr": ('sum by (tenant, tier) (rate(\n'
+                 '  tpu:router_tenant_sheds_total[10m]\n)) > 1'),
+        "for": "600s",
+        "labels": {"severity": "ticket", "component": "router"},
+        "annotations": {
+            "summary": ("tenant {{ $labels.tenant }} shedding on its "
+                        "per-tenant budget in tier {{ $labels.tier }} "
+                        "for 10m+"),
+            "description": ("sustained tenant-bucket sheds: the noisy-"
+                            "neighbor containment is holding (peers "
+                            "are protected) but this tenant's traffic "
+                            "has outgrown its rate"),
+            "runbook": "docs/runbooks.md#noisy-neighbor",
+        },
+    }]
     return {"groups": [{"name": "tpu-stack-slo-burn", "rules": rules},
                        {"name": "tpu-stack-kvplane",
                         "rules": kvplane_rules},
                        {"name": "tpu-stack-autoscaler",
-                        "rules": autoscaler_rules}]}
+                        "rules": autoscaler_rules},
+                       {"name": "tpu-stack-tenancy",
+                        "rules": tenancy_rules}]}

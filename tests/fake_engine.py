@@ -137,7 +137,7 @@ from production_stack_tpu.tracing import TraceRecorder
 
 
 FAULT_MODES = ("reset", "error", "stall", "die_mid_stream", "slow_ttft",
-               "overload", "deadline", "wedge")
+               "overload", "deadline", "wedge", "adapter_load_error")
 
 
 class FakeEngine:
@@ -162,8 +162,29 @@ class FakeEngine:
                  prefill_decode_interference: float = 0.0,
                  kv_codec: Optional[str] = None,
                  kv_bytes_per_char: int = 256,
-                 trace_ring_entries: int = 4096):
+                 trace_ring_entries: int = 4096,
+                 adapters=None,
+                 strict_models: bool = False):
         self.model = model
+        # runtime LoRA adapter pool (mirror of the real engine's
+        # load_adapter/evict_adapter + /admin/lora/load|evict): name ->
+        # src. Served models = base + adapters, reported on /v1/models
+        # and in /load "models" so the router's aggregation and pool-
+        # resolution fallback are tier-1 testable engine-free.
+        self.adapters: dict = {name: "builtin" for name in
+                               (adapters or [])}
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
+        # strict_models: reject a body whose model this engine does not
+        # serve with a structured 404 — what a real engine's
+        # resolve_model does. OFF by default (legacy tests post
+        # arbitrary model names); the multitenant rig turns it on so a
+        # MISROUTE is an observable failure, not silently served.
+        self.strict_models = strict_models
+        # per-model inference counts, reported in /load
+        # ("model_requests"): the rig's per-adapter traffic census
+        import collections as _c
+        self.model_requests = _c.defaultdict(int)
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
         self.num_tokens = num_tokens
@@ -306,6 +327,8 @@ class FakeEngine:
         app.router.add_get("/metrics", self.metrics)
         app.router.add_post("/fault", self.set_fault)
         app.router.add_get("/fault", self.get_fault)
+        app.router.add_post("/admin/lora/load", self.admin_lora_load)
+        app.router.add_post("/admin/lora/evict", self.admin_lora_evict)
         app.router.add_post("/admin/kvplane/migrate_out",
                             self.admin_kvplane_migrate_out)
         app.router.add_post("/admin/kvplane/warm",
@@ -743,6 +766,12 @@ class FakeEngine:
         mode = f.get("mode")
         if mode not in FAULT_MODES:
             return None
+        # adapter_load_error targets EXACTLY the adapter-load verb: the
+        # engine keeps serving inference and probes normally (a failed
+        # weight fetch is a shed, never sickness — the r9 contract the
+        # rig asserts the router's breaker respects)
+        if (mode == "adapter_load_error") != (path == "/admin/lora/load"):
+            return None
         if path == "/v1/models":
             if f.get("scope", "inference") != "all" or \
                     mode in ("die_mid_stream", "slow_ttft", "overload",
@@ -798,6 +827,16 @@ class FakeEngine:
                 {"error": {"message": "injected deadline expiry",
                            "type": "timeout_error"}}, status=504)
             resp.headers["x-deadline-expired"] = "1"
+            return resp
+        if mode == "adapter_load_error":
+            # the real server's load-failure shape (engine/server.py
+            # admin_lora_load): structured 503 + Retry-After
+            resp = web.json_response(
+                {"error": {"message": "injected adapter load failure: "
+                                      "weight fetch failed; the engine "
+                                      "is healthy — retry later",
+                           "type": "overloaded_error"}}, status=503)
+            resp.headers["Retry-After"] = "5"
             return resp
         if mode == "stall":
             await asyncio.sleep(fault.get("arg") or 3600.0)
@@ -988,6 +1027,14 @@ class FakeEngine:
         self.requests_seen.append(
             ("/v1/chat/completions", request.headers.get("x-user-id"),
              body.get("model")))
+        misroute = self._check_model(body.get("model"))
+        if misroute is not None:
+            self._kv_pool_release(held)
+            misroute.headers["x-trace-id"] = trace.trace_id
+            misroute.headers["x-engine-id"] = self._engine_id(request)
+            self.tracer.finish(trace, "model_not_found")
+            return misroute
+        self.model_requests[body.get("model") or self.model] += 1
         self._in_flight += 1
         self.gauges["vllm:num_requests_running"] = float(self._in_flight)
         try:
@@ -1080,6 +1127,13 @@ class FakeEngine:
         self.requests_seen.append(
             ("/v1/completions", request.headers.get("x-user-id"),
              body.get("model")))
+        misroute = self._check_model(body.get("model"))
+        if misroute is not None:
+            self._kv_pool_release(held)
+            misroute.headers["x-engine-id"] = self._engine_id(request)
+            self.tracer.finish(trace, "model_not_found")
+            return misroute
+        self.model_requests[body.get("model") or self.model] += 1
         n = min(body.get("max_tokens") or self.num_tokens, self.num_tokens)
         self._kv_pool_release(held)
         self._note_served(n)
@@ -1097,6 +1151,72 @@ class FakeEngine:
         resp.headers["x-engine-id"] = self._engine_id(request)
         return resp
 
+    def served_models(self) -> list:
+        """Base model first, then loaded adapters (the real engine's
+        served_models ordering)."""
+        return [self.model] + list(self.adapters)
+
+    def _check_model(self, model) -> Optional[web.Response]:
+        """Strict-models gate: 404 for a model this engine does not
+        serve (what the real engine's resolve_model raises). None when
+        the gate is off, the body named no model, or the model is
+        served."""
+        if not self.strict_models or model is None \
+                or model in self.adapters or model == self.model:
+            return None
+        return web.json_response(
+            {"error": {"message": f"model {model!r} is not served by "
+                                  f"this engine; serving "
+                                  f"{self.served_models()}",
+                       "type": "not_found_error",
+                       "code": "model_not_found"}}, status=404)
+
+    async def admin_lora_load(self, request: web.Request) -> web.Response:
+        """Mirror of the real /admin/lora/load (engine/server.py):
+        body {"name": ..., "src": ...}; failure (the injectable
+        adapter_load_error fault) is a structured 503 + Retry-After."""
+        fault = self._take_fault("/admin/lora/load")
+        if fault is not None:
+            faulted = await self._apply_fault(request, fault)
+            if faulted is not None:
+                return faulted
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        name = str(body.get("name") or "").strip()
+        if not name:
+            return web.json_response(
+                {"error": {"message": "adapter load needs "
+                                      "{'name': ..., 'src': ...}",
+                           "type": "invalid_request_error"}}, status=400)
+        loaded = name != self.model and name not in self.adapters
+        if loaded:
+            self.adapters[name] = str(body.get("src") or "runtime")
+            self.adapter_loads += 1
+        return web.json_response({"loaded": loaded, "name": name,
+                                  "models": self.served_models()})
+
+    async def admin_lora_evict(self,
+                               request: web.Request) -> web.Response:
+        """Mirror of the real /admin/lora/evict: unknown adapter is a
+        404, never a 5xx."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        name = str(body.get("name") or "").strip()
+        if name not in self.adapters:
+            return web.json_response(
+                {"error": {"message": f"adapter {name!r} is not "
+                                      f"loaded; serving "
+                                      f"{self.served_models()}",
+                           "type": "not_found_error"}}, status=404)
+        del self.adapters[name]
+        self.adapter_evictions += 1
+        return web.json_response({"evicted": name,
+                                  "models": self.served_models()})
+
     def _engine_id(self, request: web.Request) -> str:
         """Replica identity stamped as x-engine-id on every inference
         response: the address the caller dialed (the Host header the
@@ -1112,8 +1232,8 @@ class FakeEngine:
             if faulted is not None:
                 return faulted
         return web.json_response(
-            {"object": "list", "data": [{"id": self.model,
-                                         "object": "model"}]})
+            {"object": "list", "data": [{"id": name, "object": "model"}
+                                        for name in self.served_models()]})
 
     async def health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -1143,6 +1263,11 @@ class FakeEngine:
             "kv_usage": self.gauges["vllm:gpu_cache_usage_perc"],
             "est_queue_delay_ms": self.gauges["tpu:est_queue_delay_ms"],
             "perf": self._perf_block(),
+            # live model catalog + per-model traffic census (the real
+            # engine reports "models" too; "model_requests" is the
+            # fake's extra ground truth the multitenant rig audits)
+            "models": self.served_models(),
+            "model_requests": dict(self.model_requests),
         }
         # the kvplane planner's poll surface: same block the real
         # engine's /load always carries (engine.load_report kv_pool);
@@ -1243,6 +1368,18 @@ class FakeEngine:
         lines.append(f'tpu:engine_compiles_total{{model_name='
                      f'"{self.model}",kind="decode",window="8",'
                      f'kv_bucket="512"}} {perf["compiles_total"]}')
+        # runtime adapter pool, mirroring the real engine's families
+        # (engine/metrics.py adapter_loads/adapter_evictions/
+        # adapters_loaded)
+        lines.append("# TYPE tpu_engine_adapter_loads counter")
+        lines.append(f'tpu:engine_adapter_loads_total{{model_name='
+                     f'"{self.model}"}} {self.adapter_loads}')
+        lines.append("# TYPE tpu_engine_adapter_evictions counter")
+        lines.append(f'tpu:engine_adapter_evictions_total{{model_name='
+                     f'"{self.model}"}} {self.adapter_evictions}')
+        lines.append("# TYPE tpu_engine_adapters_loaded gauge")
+        lines.append(f'tpu:engine_adapters_loaded{{model_name='
+                     f'"{self.model}"}} {len(self.adapters)}')
         if self.kv_pool is not None:
             # surface parity with the real engine's tpu:kvpool_* family
             # (engine/metrics.py sync_kvpool): /load and /metrics must
@@ -1298,6 +1435,15 @@ def main(argv=None) -> None:
     p.add_argument("--port", type=int, default=9100)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--model", default="fake-model")
+    p.add_argument("--adapters", default="",
+                   help="comma-separated LoRA adapter names served "
+                        "from startup (each is its own model id; "
+                        "runtime load/evict via /admin/lora/*)")
+    p.add_argument("--strict-models", action="store_true",
+                   help="404 inference bodies naming a model this "
+                        "engine does not serve (the real engine's "
+                        "resolve_model behavior; makes router "
+                        "misroutes observable)")
     p.add_argument("--ttft", type=float, default=0.0)
     p.add_argument("--tokens-per-s", type=float, default=0.0)
     p.add_argument("--num-tokens", type=int, default=8)
@@ -1367,7 +1513,9 @@ def main(argv=None) -> None:
                      kv_bytes_per_char=args.kv_bytes_per_char,
                      prefill_decode_interference=args.
                      prefill_decode_interference,
-                     trace_ring_entries=args.trace_ring_entries)
+                     trace_ring_entries=args.trace_ring_entries,
+                     adapters=[a for a in args.adapters.split(",") if a],
+                     strict_models=args.strict_models)
     if args.error_rate:
         eng.error_rate = min(1.0, max(0.0, args.error_rate))
     web.run_app(eng.build_app(), host=args.host, port=args.port,

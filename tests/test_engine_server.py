@@ -738,3 +738,33 @@ def test_debug_traces_requires_api_key(engine):
                 headers={"Authorization": "Bearer sekrit"})
             assert r.status == 200
     asyncio.run(runner())
+
+
+def test_admin_lora_load_and_evict(engine):
+    """Runtime adapter admin surface: load 200 + catalog, idempotent
+    reload, failed load = structured 503 + Retry-After (shed, never a
+    breaker signal), evict 200 then 404."""
+    async def body(client):
+        r = await client.post("/admin/lora/load",
+                              json={"name": "ad-srv", "src": "random:5"})
+        assert r.status == 200, await r.text()
+        data = await r.json()
+        assert data["loaded"] is True and "ad-srv" in data["models"]
+        r = await client.get("/v1/models")
+        assert "ad-srv" in {c["id"] for c in (await r.json())["data"]}
+        r = await client.post("/admin/lora/load",
+                              json={"name": "ad-srv", "src": "random:5"})
+        assert (await r.json())["loaded"] is False
+        r = await client.post("/admin/lora/load",
+                              json={"name": "ad-bad",
+                                    "src": "/no/such/adapter.npz"})
+        assert r.status == 503
+        assert "Retry-After" in r.headers
+        assert (await r.json())["error"]["type"] == "overloaded_error"
+        r = await client.post("/admin/lora/load", json={"name": "x"})
+        assert r.status == 400
+        r = await client.post("/admin/lora/evict", json={"name": "ad-srv"})
+        assert r.status == 200, await r.text()
+        r = await client.post("/admin/lora/evict", json={"name": "ad-srv"})
+        assert r.status == 404
+    _with_client(engine, body)

@@ -161,3 +161,29 @@ def test_lora_routing_through_router():
             assert r.status == 400  # router: no backend serves it
         await engine_server.close()
     asyncio.run(body())
+
+
+def test_runtime_adapter_load_and_evict(engine):
+    """Runtime adapter lifecycle on a live engine (multitenancy.md
+    "Runtime adapters"): load serves a new distinct model id, reload is
+    idempotent, evict tombstones the row — adapter ids are append-only
+    so in-flight sequences stay valid — and the catalog is restored."""
+    base_models = list(engine.served_models)
+    n_loads = engine.adapter_loads
+    assert engine.load_adapter("ad-rt", "random:33") is True
+    assert engine.load_adapter("ad-rt", "random:33") is False
+    assert engine.load_adapter("debug-tiny", "random:33") is False
+    assert engine.served_models == base_models + ["ad-rt"]
+    assert engine.adapter_loads == n_loads + 1
+    rt_id = engine.lora_ids["ad-rt"]
+    assert _gen(engine, "ad-rt") != _gen(engine, None)
+    engine.evict_adapter("ad-rt")
+    assert engine.served_models == base_models
+    with pytest.raises(ValueError, match="unknown model"):
+        engine.resolve_model("ad-rt")
+    with pytest.raises(KeyError):
+        engine.evict_adapter("ad-rt")
+    # append-only id space: a later load never reuses a tombstoned row
+    assert engine.load_adapter("ad-rt2", "random:44") is True
+    assert engine.lora_ids["ad-rt2"] == rt_id + 1
+    engine.evict_adapter("ad-rt2")

@@ -1,0 +1,375 @@
+"""Named pools (router/pools.py): spec parsing, model->pool resolution,
+the state-survival contract across dynamic-config swaps, decode-selector
+locality over the pool union, QoS per-tenant buckets, and an e2e tier
+routing a pooled router over strict FakeEngines.
+
+The load-bearing assertions are object-identity ones: a membership-only
+swap of pool A must keep pool A's router INSTANCE (its prefix/session
+ring state) and must not touch pool B at all — the r11/r12 state-survival
+contract at the pool layer.
+"""
+
+import asyncio
+import json
+import types
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from production_stack_tpu.router.app import build_app, parse_args
+from production_stack_tpu.router.dynamic_config import (DynamicConfigWatcher,
+                                                        DynamicRouterConfig)
+from production_stack_tpu.router.pools import PoolManager, parse_pool_spec
+from production_stack_tpu.router.qos import QosPolicy
+from tests.fake_engine import FakeEngine
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _spec(pool_a_backends=("http://a0:8100",),
+          pool_b_backends=("http://b0:8100",),
+          pool_a_logic="prefix", pool_b_logic="roundrobin"):
+    return {
+        "pool-a": {"backends": list(pool_a_backends),
+                   "models": ["model-a", "adapter-a"],
+                   "routing_logic": pool_a_logic},
+        "pool-b": {"backends": list(pool_b_backends),
+                   "models": ["model-b"],
+                   "routing_logic": pool_b_logic},
+    }
+
+
+# ------------------------------------------------------------- unit tier
+
+def test_parse_pool_spec_normalizes_and_defaults():
+    raw = json.dumps({"p": {"backends": ["http://x:1/"],
+                            "models": ["m"]}})
+    out = parse_pool_spec(raw)              # JSON text form (CLI path)
+    assert out["p"]["backends"] == ["http://x:1"]   # slash stripped
+    assert out["p"]["routing_logic"] == "roundrobin"
+    assert out["p"]["session_key"] == "x-user-id"
+
+
+@pytest.mark.parametrize("raw", [
+    ["not", "a", "dict"],
+    {"p": "not-a-spec"},
+    {"p": {"backends": [], "models": ["m"]}},
+    {"p": {"backends": ["http://x:1"], "models": []}},
+])
+def test_parse_pool_spec_rejects_malformed(raw):
+    with pytest.raises(ValueError):
+        parse_pool_spec(raw)
+
+
+def test_pool_manager_union_catalog_and_resolution():
+    mgr = PoolManager()
+    assert not mgr.active
+    mgr.apply(parse_pool_spec(_spec()))
+    assert mgr.active
+    # discovery union carries pool labels — the fleet-wide consumers'
+    # view (scraper, /health counts, proxy live-set re-read)
+    eps = mgr.get_endpoints()
+    assert {ep.url for ep in eps} == {"http://a0:8100", "http://b0:8100"}
+    assert {ep.pool for ep in eps} == {"pool-a", "pool-b"}
+    # catalog: pool order preserved, base + aliases, deduped
+    assert mgr.served_models() == ["model-a", "adapter-a", "model-b"]
+    assert mgr.resolve("model-a").name == "pool-a"
+    assert mgr.resolve("adapter-a").name == "pool-a"   # alias path
+    assert mgr.resolve("model-b").name == "pool-b"
+    assert mgr.resolve("nope") is None
+    mgr.note_unknown_model()
+    mgr.note_routed("pool-a")
+    assert mgr.unknown_models == 1
+    assert mgr.routed["pool-a"] == 1
+
+
+def test_membership_swap_keeps_router_instance_and_counters():
+    """Adding a backend to a pool (the autoscaler's move) must keep the
+    pool's router instance — the prefix ring inside it is the state the
+    r11/r12 contract protects — and the manager's counters."""
+    mgr = PoolManager()
+    mgr.apply(parse_pool_spec(_spec()))
+    router_a = mgr.resolve("model-a").router
+    router_b = mgr.resolve("model-b").router
+    mgr.note_routed("pool-a")
+    mgr.apply(parse_pool_spec(_spec(
+        pool_a_backends=("http://a0:8100", "http://a1:8100"))))
+    pool_a = mgr.resolve("model-a")
+    assert pool_a.router is router_a            # instance survives
+    assert len(pool_a.endpoints) == 2
+    assert mgr.resolve("model-b").router is router_b   # untouched pool
+    assert mgr.routed["pool-a"] == 1            # counters survive swaps
+    assert mgr.swaps["pool-a"] == 2             # create + membership
+    assert mgr.swaps["pool-b"] == 1             # create only
+
+
+def test_policy_change_rebuilds_only_that_pools_router():
+    mgr = PoolManager()
+    mgr.apply(parse_pool_spec(_spec()))
+    router_a = mgr.resolve("model-a").router
+    router_b = mgr.resolve("model-b").router
+    mgr.apply(parse_pool_spec(_spec(pool_a_logic="least_loaded")))
+    assert mgr.resolve("model-a").router is not router_a
+    assert mgr.resolve("model-a").router.name == "least_loaded"
+    assert mgr.resolve("model-b").router is router_b
+
+
+def test_dropped_pool_reported_and_unroutable():
+    mgr = PoolManager()
+    mgr.apply(parse_pool_spec(_spec()))
+    spec = parse_pool_spec(_spec())
+    del spec["pool-b"]
+    assert mgr.apply(spec) == ["pool-b"]
+    assert mgr.resolve("model-b") is None
+    assert {ep.pool for ep in mgr.get_endpoints()} == {"pool-a"}
+
+
+def test_resolve_falls_back_to_scraped_served_models():
+    """An adapter loaded at runtime (/admin/lora/load) is resolvable one
+    scrape later with NO config push: resolve() joins the scraped /load
+    ``models`` lists against pool membership by URL."""
+    mgr = PoolManager()
+    mgr.apply(parse_pool_spec(_spec()))
+    assert mgr.resolve("lora-hot") is None      # not scraped yet
+    mgr.attach_scraper(lambda: {
+        "http://a0:8100": types.SimpleNamespace(
+            served_models=("model-a", "lora-hot"))})
+    assert mgr.resolve("lora-hot").name == "pool-a"
+    assert mgr.resolve("nope") is None
+
+
+# ----------------------------------------------- dynamic-config lifecycle
+
+def _watcher(state):
+    w = DynamicConfigWatcher.__new__(DynamicConfigWatcher)
+    w.state = state
+    w.current = None
+    return w
+
+
+def _cfg(**kw):
+    return DynamicRouterConfig.from_json(
+        {"service_discovery": "static", "routing_logic": "roundrobin",
+         **kw})
+
+
+def test_dynamic_config_pools_tristate_lifecycle():
+    """absent = leave alone, non-empty = diff-and-swap preserving the
+    untouched pool's router instance, {} = disable. The manager IS the
+    service discovery while active."""
+    state = {"router_kwargs": {}}
+    w = _watcher(state)
+    asyncio.run(w._apply(_cfg(pools=_spec())))
+    mgr = state["pools"]
+    assert isinstance(mgr, PoolManager) and mgr.active
+    assert state["discovery"] is mgr            # manager IS discovery
+    router_b = mgr.resolve("model-b").router
+    mgr.note_routed("pool-b")
+
+    # key ABSENT: the running table is left alone entirely
+    asyncio.run(w._apply(_cfg()))
+    assert state["pools"] is mgr
+    assert mgr.resolve("model-b").router is router_b
+
+    # membership-only swap of pool-a: pool-b's router survives, the
+    # manager object survives, counters survive
+    asyncio.run(w._apply(_cfg(pools=_spec(
+        pool_a_backends=("http://a0:8100", "http://a1:8100")))))
+    assert state["pools"] is mgr
+    assert mgr.resolve("model-b").router is router_b
+    assert mgr.routed["pool-b"] == 1
+    assert len(mgr.resolve("model-a").endpoints) == 2
+
+    # malformed spec: logged and IGNORED — the running table persists
+    asyncio.run(w._apply(_cfg(pools={"bad": {"backends": [],
+                                             "models": []}})))
+    assert mgr.active and mgr.resolve("model-a") is not None
+
+    # {} disables pooling; with no static_backends the fleet is empty
+    asyncio.run(w._apply(_cfg(pools={})))
+    assert not mgr.active
+    assert state["discovery"].get_endpoints() == []
+
+
+def test_dynamic_config_pool_swap_feeds_decode_selector_union():
+    """The decode-locality eviction sweep after a config apply must see
+    the UNION of pools — evicting an untouched pool's endpoints from the
+    affinity ring would cold-score warm engines (r14 contract)."""
+    kept = []
+
+    class FakeSelector:
+        def evict_except(self, urls):
+            kept.append(sorted(urls))
+
+    state = {"router_kwargs": {},
+             "disagg": types.SimpleNamespace(selector=FakeSelector())}
+    w = _watcher(state)
+    asyncio.run(w._apply(_cfg(pools=_spec())))
+    assert kept[-1] == ["http://a0:8100", "http://b0:8100"]
+    # swap ONLY pool-a: pool-b's endpoint must still be in the kept set
+    asyncio.run(w._apply(_cfg(pools=_spec(
+        pool_a_backends=("http://a1:8100",)))))
+    assert kept[-1] == ["http://a1:8100", "http://b0:8100"]
+
+
+# -------------------------------------------------- QoS tenant buckets
+
+def test_tenant_bucket_sheds_noisy_tenant_only():
+    clock = Clock()
+    q = QosPolicy("tier0=1.0,tier1=0.9", tenant_rate=2.0, now_fn=clock)
+    tier = q.resolve({"x-priority-class": "tier1"})
+    assert q.resolve_tenant({"x-tenant-id": "acme"}) == "acme"
+    # burst = max(1, rate) = 2: two admits then tenant-shed
+    assert q.admit(tier, 0, 100, tenant="acme")[0] == "admit"
+    assert q.admit(tier, 0, 100, tenant="acme")[0] == "admit"
+    assert q.admit(tier, 0, 100, tenant="acme")[0] == "shed"
+    assert q.sheds[("tier1", "tenant")] == 1
+    assert q.tenant_sheds[("acme", "tier1")] == 1
+    # a tier PEER is untouched: its own bucket, its own budget
+    assert q.admit(tier, 0, 100, tenant="beta")[0] == "admit"
+    # untagged traffic is never tenant-bucketed
+    assert q.admit(tier, 0, 100, tenant=None)[0] == "admit"
+    # refill: the noisy tenant recovers at its rate
+    clock.t = 1.0
+    assert q.admit(tier, 0, 100, tenant="acme")[0] == "admit"
+
+
+def test_tenant_resolution_off_without_rate_or_header():
+    q = QosPolicy(tenant_rate=0.0)
+    assert q.resolve_tenant({"x-tenant-id": "acme"}) is None
+    q = QosPolicy(tenant_rate=1.0)
+    assert q.resolve_tenant({}) is None
+    assert q.resolve_tenant(None) is None
+
+
+def test_tenant_lru_bound_evicts_bucket_and_shed_labels():
+    """The bucket table is a bounded LRU and the exported tenant_sheds
+    label set is evicted WITH the bucket — label cardinality stays fixed
+    no matter how many tenant ids clients invent."""
+    clock = Clock()
+    q = QosPolicy("tier0=1.0", tenant_rate=0.5, max_tenants=2,
+                  now_fn=clock)
+    tier = q.tiers[0]
+    q.admit(tier, 0, 0, tenant="t1")            # burst=1: one admit
+    assert q.admit(tier, 0, 0, tenant="t1")[0] == "shed"
+    assert q.tenant_sheds[("t1", "tier0")] == 1
+    q.admit(tier, 0, 0, tenant="t2")
+    q.admit(tier, 0, 0, tenant="t3")            # evicts t1 (LRU)
+    assert len(q._tenant_buckets) == 2
+    assert ("t1", "tier0") not in q.tenant_sheds
+
+
+def test_tenant_refused_request_never_preempts():
+    """A tenant over its budget must not burn a background dispatch:
+    the picked victim goes BACK into the preemptable registry and the
+    request sheds with reason ``tenant``."""
+    clock = Clock()
+    q = QosPolicy("tier0=1.0,tier1=0.5", preempt_from=1,
+                  tenant_rate=1.0, now_fn=clock)
+    tier0, tier1 = q.tiers
+    event = asyncio.Event()
+    slot = q.register_preemptable(tier1, event)
+    assert slot is not None
+    q.admit(tier0, 0, 100, tenant="x")          # drain x's bucket
+    verdict, victim = q.admit(tier0, 100, 100, tenant="x")
+    assert (verdict, victim) == ("shed", None)
+    assert not event.is_set()                   # victim NOT cancelled
+    assert slot.key in q._preemptable[1]        # ...and still registered
+    assert q.sheds[("tier0", "tenant")] == 1
+    assert q.preemptions[1] == 0
+
+
+def test_pressure_shed_does_not_charge_tenant_bucket():
+    """The pressure gate runs BEFORE the tenant bucket: a request that
+    sheds on pressure anyway must not spend its tenant's rate budget."""
+    q = QosPolicy("tier0=1.0,tier1=0.5", tenant_rate=1.0,
+                  now_fn=Clock())
+    tier1 = q.tiers[1]
+    assert q.admit(tier1, 9, 10, tenant="x")[0] == "shed"
+    assert q.sheds[("tier1", "pressure")] == 1
+    assert len(q._tenant_buckets) == 0          # bucket never created
+
+
+# --------------------------------------------------------------- e2e tier
+
+async def _start_fakes(*fakes):
+    servers = []
+    for fake in fakes:
+        server = TestServer(fake.build_app())
+        await server.start_server()
+        servers.append(server)
+    return servers, [f"http://127.0.0.1:{s.port}" for s in servers]
+
+
+def _chat(model):
+    return {"model": model,
+            "messages": [{"role": "user", "content": "hi"}]}
+
+
+def test_pools_e2e_model_routing_404_and_adapter_catalog():
+    """Pooled router over two strict single-model FakeEngines: requests
+    land on the pool serving their model, an unknown model is an
+    authoritative 404, /health exposes the pools table, and an adapter
+    loaded at runtime surfaces in /v1/models AND becomes routable via
+    the scrape fallback — no config push."""
+    async def body():
+        a = FakeEngine(model="model-a", strict_models=True)
+        b = FakeEngine(model="model-b", strict_models=True)
+        servers, urls = await _start_fakes(a, b)
+        pools = json.dumps({
+            "pool-a": {"backends": [urls[0]], "models": ["model-a"]},
+            "pool-b": {"backends": [urls[1]], "models": ["model-b"]}})
+        app = build_app(parse_args(
+            ["--service-discovery", "static", "--pools", pools,
+             "--engine-stats-interval", "0.2"]))
+        async with TestClient(TestServer(app)) as client:
+            for _ in range(3):
+                r = await client.post("/v1/chat/completions",
+                                      json=_chat("model-a"))
+                assert r.status == 200, await r.text()
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat("model-b"))
+            assert r.status == 200, await r.text()
+            assert len(a.requests_seen) == 3    # strict engines: any
+            assert len(b.requests_seen) == 1    # misroute would be 404
+
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat("no-such-model"))
+            assert r.status == 404
+            err = await r.json()
+            assert err["error"]["code"] == "model_not_found"
+
+            r = await client.get("/health")
+            h = await r.json()
+            assert h["pools"]["pool-a"]["routed"] == 3
+            assert h["pools"]["pool-b"]["routed"] == 1
+
+            r = await client.get("/v1/models")
+            ids = {c["id"] for c in (await r.json())["data"]}
+            assert ids == {"model-a", "model-b"}
+
+            # runtime adapter load on engine-a: after one scrape
+            # interval it is listed fleet-wide and routable
+            async def _adapter_visible():
+                r = await client.get("/v1/models")
+                ids = {c["id"] for c in (await r.json())["data"]}
+                return "lora-hot" in ids
+            a.adapters["lora-hot"] = "runtime"
+            for _ in range(30):
+                if await _adapter_visible():
+                    break
+                await asyncio.sleep(0.1)
+            assert await _adapter_visible()
+            r = await client.post("/v1/chat/completions",
+                                  json=_chat("lora-hot"))
+            assert r.status == 200, await r.text()
+            assert len(a.requests_seen) == 4
+        for s in servers:
+            await s.close()
+    asyncio.run(body())
