@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Regenerate the committed demo traces under benchmarks/traces/.
+
+Every trace is fully determined by the specs below (seeded synthesis,
+no wall clock, no RNG outside the seeds), so running this script from
+a clean checkout reproduces the committed files byte for byte::
+
+    python benchmarks/gen_traces.py [--out-dir benchmarks/traces]
+
+Three production shapes:
+
+- ``diurnal_ramp``   — open-loop rate climbing 0.5 -> 3.0 qps and back
+  down (the diurnal curve autoscaling papers ramp against); chat on
+  model-a.
+- ``bursty_tenant``  — constant aggregate rate, but tenant "acme"
+  carries 8x the session weight of "beta"/"gamma": the noisy-neighbor
+  arrival shape the multitenant rig throttles.
+- ``mixed_classes``  — three superposed workload classes as one fleet
+  trace: interactive chat + runtime-LoRA traffic on model-a/lora-a,
+  RAG-shaped requests (large shared system prompt) on model-a, and a
+  secondary model-b stream — the heterogeneous traffic the r21
+  two-pool fleet serves. This is the distload capstone's input.
+
+The fake engines the distload rig launches serve chat-family endpoints
+only, so no trace uses the ``embeddings`` kind.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from production_stack_tpu.loadgen.distributed.tracefile import (  # noqa: E402
+    merge_traces, synthesize_trace, write_trace)
+from production_stack_tpu.loadgen.spec import (ArrivalSpec,  # noqa: E402
+                                               SessionSpec, TrafficMix,
+                                               WorkloadSpec)
+
+# small ShareGPT-ish sessions sized for the fake engines the distload
+# rig launches (and far under any real engine geometry)
+SESSION = SessionSpec(rounds_min=1, rounds_max=3,
+                      system_prompt_tokens=16,
+                      question_tokens_mean=12.0, question_tokens_sigma=0.4,
+                      question_tokens_max=24,
+                      answer_tokens_mean=16.0, answer_tokens_sigma=0.3,
+                      answer_tokens_max=16)
+
+RAG_SESSION = SessionSpec(rounds_min=1, rounds_max=2,
+                          system_prompt_tokens=64,   # the shared corpus
+                          question_tokens_mean=18.0,
+                          question_tokens_sigma=0.4,
+                          question_tokens_max=32,
+                          answer_tokens_mean=16.0,
+                          answer_tokens_sigma=0.3,
+                          answer_tokens_max=16)
+
+
+def _spec(name, model, seed, *, mix=None, session=SESSION, qps=1.0,
+          lora_model=None):
+    return WorkloadSpec(
+        name=name, model=model, seed=seed, lora_model=lora_model,
+        mix=mix or TrafficMix(chat=1.0), session=session,
+        arrival=ArrivalSpec(mode="open", qps_start=qps, qps_end=qps,
+                            qps_step=0.0, stage_duration_s=60.0),
+    ).validate()
+
+
+def gen_diurnal_ramp():
+    # one synthetic "day": night trough -> morning climb -> midday
+    # peak -> evening descent, 10s per phase
+    stages = [(0.5, 10.0), (1.5, 10.0), (3.0, 10.0), (1.5, 10.0),
+              (0.5, 10.0)]
+    spec = _spec("diurnal-ramp", "model-a", seed=101)
+    reqs = synthesize_trace(spec, duration_s=50.0, stages=stages)
+    return {"name": "diurnal_ramp", "seed": spec.seed,
+            "notes": "open-loop qps 0.5->3.0->0.5 diurnal curve, "
+                     "chat on model-a, 10s per phase"}, reqs
+
+
+def gen_bursty_tenant():
+    spec = _spec("bursty-tenant", "model-a", seed=202, qps=2.5)
+    reqs = synthesize_trace(spec, duration_s=40.0,
+                            tenants=[("acme", 8.0), ("beta", 1.0),
+                                     ("gamma", 1.0)])
+    return {"name": "bursty_tenant", "seed": spec.seed,
+            "notes": "constant 2.5 qps, tenant acme carries 8x the "
+                     "session weight of beta/gamma (noisy neighbor)"}, \
+        reqs
+
+
+def gen_mixed_classes():
+    chat_lora = _spec("mixed-chat-lora", "model-a", seed=303,
+                      mix=TrafficMix(chat=0.7, lora=0.3),
+                      qps=1.8, lora_model="lora-a")
+    rag = _spec("mixed-rag", "model-a", seed=404, session=RAG_SESSION,
+                qps=0.6)
+    model_b = _spec("mixed-model-b", "model-b", seed=505, qps=0.8)
+    parts = [
+        synthesize_trace(chat_lora, duration_s=40.0,
+                         tenants=[("acme", 2.0), ("beta", 1.0)]),
+        synthesize_trace(rag, duration_s=40.0,
+                         tenants=[("gamma", 1.0)]),
+        synthesize_trace(model_b, duration_s=40.0,
+                         tenants=[("batch", 1.0)]),
+    ]
+    return {"name": "mixed_classes", "seed": 303,
+            "notes": "three superposed classes: chat+LoRA on "
+                     "model-a/lora-a (1.8 qps), RAG-shaped on model-a "
+                     "(0.6 qps), secondary model-b stream (0.8 qps)"}, \
+        merge_traces(parts)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out-dir",
+                   default=os.path.join(REPO_ROOT, "benchmarks",
+                                        "traces"))
+    args = p.parse_args(argv)
+    os.makedirs(args.out_dir, exist_ok=True)
+    for gen in (gen_diurnal_ramp, gen_bursty_tenant, gen_mixed_classes):
+        header, reqs = gen()
+        path = os.path.join(args.out_dir,
+                            f"{header['name']}.trace.jsonl")
+        write_trace(path, header, reqs)
+        models = sorted({r.model for r in reqs})
+        tenants = sorted({r.tenant for r in reqs if r.tenant})
+        print(f"{path}: {len(reqs)} requests, "
+              f"{len({r.session_id for r in reqs})} sessions, "
+              f"models={models}, tenants={tenants}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

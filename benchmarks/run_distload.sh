@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Distributed-loadgen closed loop: 1-worker control vs N coordinator-
+# sharded worker processes at qps/N each (merged offered load and
+# merge-then-quantile percentiles must match the control), double
+# sharded replay of the committed bursty-tenant trace (identical
+# issued multisets), an embedded mismatched-rate run that must FAIL
+# the scaling gate, and the composed capstone: 2 peered pool-routers
+# + the two-pool fleet + obsplane under the replayed mixed trace
+# (>=95% complete stitched chains, zero raw 5xx). Committed record:
+# DISTLOAD_r22.json. See docs/benchmarks.md "Distributed load
+# generation".
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT="${OUT:-DISTLOAD_$(date +%Y%m%d_%H%M%S).json}"
+
+EXTRA=()
+if [ "${ANTI_VACUITY:-}" != "" ]; then
+  # anti-vacuity: this run MUST fail the scaling gate (exit 1).
+  # ANTI_VACUITY=mismatched-rate (workers at full global rate each)
+  # or ANTI_VACUITY=single-worker (a 1-worker "distributed" side).
+  EXTRA+=(--anti-vacuity "$ANTI_VACUITY" --no-capstone)
+fi
+
+JAX_PLATFORMS=cpu python -m production_stack_tpu.loadgen distload \
+  --workers "${WORKERS:-3}" \
+  --engines "${ENGINES:-2}" \
+  --qps "${QPS:-6}" \
+  --phase "${PHASE:-10}" \
+  --speedup "${SPEEDUP:-4}" \
+  --output "$OUT" "${EXTRA[@]}" "$@"
+
+echo "distload record: $OUT"

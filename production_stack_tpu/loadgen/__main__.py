@@ -98,6 +98,18 @@ fleetdrill — the r20 fleet-pilot closed loop: (1) the same latency
            injection with the kill-switch down must log
            suppressed_killswitch while the alert keeps burning
            (FLEETDRILL_*.json)
+distload — distributed load generation closed loop: launch router +
+           fake engines, drive the same open-loop workload as ONE
+           worker (control) and as N coordinator-sharded worker
+           processes at qps/N each; exit 1 unless the merged offered
+           load and merge-then-quantile percentiles match the control
+           within tolerance with zero errors, two sharded replays of
+           the committed trace issue identical request multisets, and
+           (unless --no-capstone) 2 peered pool-routers + the two-pool
+           fleet + obsplane under replayed mixed traffic stitch >=95%
+           complete chains with zero raw 5xx; the record embeds a
+           mismatched-rate sub-run that must FAIL the scaling gate
+           (DISTLOAD_*.json; --anti-vacuity must exit 1)
 kvmigrate — the kvplane closed loop: a fragmentation storm (one
            replica's pool injected into the fragmented-admission
            regime behind the router) run with and without the kvplane
@@ -125,6 +137,10 @@ from production_stack_tpu.loadgen.autoscale import (autoscale_violations,
 from production_stack_tpu.loadgen.chaos import chaos_violations, run_chaos
 from production_stack_tpu.loadgen.disagg import (disagg_violations,
                                                  run_disagg)
+from production_stack_tpu.loadgen.distributed.distload import (
+    add_cli_args as distload_cli_args, distload_violations, run_distload)
+from production_stack_tpu.loadgen.distributed.tracefile import (
+    trace_from_records, write_trace)
 from production_stack_tpu.loadgen.effwatch import (effwatch_ab_violations,
                                                    effwatch_violations,
                                                    run_effwatch,
@@ -187,6 +203,19 @@ def _print_report(result, out: dict) -> None:
             print(f"  - {v}", file=sys.stderr)
 
 
+def _record_trace(result, spec, path: str) -> None:
+    """The recorder leg of the distributed-loadgen loop: dump the run's
+    per-request schedule (measured arrival offsets + planned shapes) as
+    a replayable ``*.trace.jsonl``."""
+    reqs = trace_from_records(result.records, spec)
+    write_trace(path, {"name": spec.name, "seed": spec.seed,
+                       "notes": f"recorded from a live {spec.name} run "
+                                f"({spec.arrival.mode}-loop)"}, reqs)
+    print(f"recorded {len(reqs)} requests to {path} (replay: loadgen "
+          f"distload --trace {path}, or distributed.worker in replay "
+          f"mode)", file=sys.stderr)
+
+
 def cmd_run(args) -> int:
     spec = _load_spec(args)
     result = asyncio.run(run_workload(
@@ -200,6 +229,8 @@ def cmd_run(args) -> int:
                 "model": spec.model, "arrival_mode": spec.arrival.mode})
     if args.output:
         report_mod.write_json(args.output, out)
+    if args.record_trace:
+        _record_trace(result, spec, args.record_trace)
     _print_report(result, out)
     return 0 if result.ok else 1
 
@@ -218,6 +249,8 @@ def cmd_soak(args) -> int:
         p99_ttft_bound_s=args.p99_ttft_bound,
         checkpoint_interval_s=args.checkpoint_interval,
         checkpoint_path=args.checkpoint_file))
+    if args.record_trace:
+        _record_trace(result, spec, args.record_trace)
     out = report_mod.bench_schema(
         f"loadgen soak {spec.name} ({duration:.0f}s)",
         result.summary,
@@ -233,6 +266,58 @@ def cmd_soak(args) -> int:
         print(f"soak PASSED: {result.summary['finished']} requests, "
               f"zero invariant violations")
     return 0 if result.ok else 1
+
+
+def cmd_distload(args) -> int:
+    record = asyncio.run(run_distload(
+        engines=args.engines, workers=args.workers, qps=args.qps,
+        phase_s=args.phase, trace_path=args.trace,
+        capstone_trace=args.capstone_trace, speedup=args.speedup,
+        capstone=not args.no_capstone,
+        capstone_routers=args.capstone_routers,
+        capstone_engines_per_pool=args.capstone_engines_per_pool,
+        anti_vacuity=args.anti_vacuity,
+        skip_embedded_anti_vacuity=args.skip_embedded_anti_vacuity,
+        service_jitter=args.service_jitter,
+        qps_rel_tol=args.qps_rel_tol, pct_rel_tol=args.pct_rel_tol,
+        pct_abs_tol_s=args.pct_abs_tol,
+        min_chain_fraction=args.min_chain_fraction,
+        worker_timeout_s=args.worker_timeout,
+        startup_timeout_s=args.startup_timeout,
+        log_dir=args.log_dir, work_dir=args.work_dir,
+        platform=args.platform))
+    print(json.dumps(record, indent=2))
+    output = args.output or \
+        f"DISTLOAD_{time.strftime('%Y%m%d_%H%M%S')}.json"
+    report_mod.write_json(output, record)
+    violations = distload_violations(
+        record, min_chain_fraction=args.min_chain_fraction)
+    for v in violations:
+        print(f"DISTLOAD VIOLATION: {v}", file=sys.stderr)
+    if not violations:
+        d = record["detail"]
+        dist, ctrl = d["dist"]["summary"], d["control"]["summary"]
+        av = d.get("anti_vacuity") or {}
+        msg = (f"distload PASSED: {d['workers']} workers offered "
+               f"{dist['offered_qps']:.2f} qps (control "
+               f"{ctrl['offered_qps']:.2f}, target {d['target_qps']}), "
+               f"merged ttft p50 {dist['ttft_s']['p50']*1000:.1f}ms vs "
+               f"control {ctrl['ttft_s']['p50']*1000:.1f}ms, replay "
+               f"digest stable over "
+               f"{len(d['replay']['runs'])} runs")
+        if av:
+            msg += (f"; embedded mismatched-rate run failed the gate "
+                    f"as required ({len(av['violations'])} violations "
+                    f"at {av.get('offered_qps', 0):.2f} qps offered)")
+        cap = d.get("capstone")
+        if cap:
+            msg += (f"; capstone stitched "
+                    f"{cap['stitch'].get('chains_complete', 0)} chains "
+                    f"({cap['stitch'].get('complete_fraction', 0):.0%} "
+                    f"complete) across {cap['routers']} routers / 2 "
+                    f"pools with 0 raw 5xx")
+        print(msg)
+    return 1 if violations else 0
 
 
 def cmd_scaleout(args) -> int:
@@ -959,6 +1044,10 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.add_argument("--duration", type=parse_duration, default=None)
     sp.add_argument("--max-sessions", type=int, default=None)
+    sp.add_argument("--record-trace", default=None,
+                    help="dump this run's per-request schedule as a "
+                         "replayable *.trace.jsonl (measured arrival "
+                         "offsets + planned shapes)")
     sp.set_defaults(fn=cmd_run)
 
     sp = sub.add_parser("soak", help="duration-bounded invariant-checked "
@@ -974,8 +1063,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="seconds; invariant I4 when set")
     sp.add_argument("--checkpoint-file", default=None,
                     help="append checkpoint JSON lines here")
+    sp.add_argument("--record-trace", default=None,
+                    help="dump this run's per-request schedule as a "
+                         "replayable *.trace.jsonl")
     # the soak's whole point is mixed traffic
     sp.set_defaults(fn=cmd_soak, workload="mixed")
+
+    sp = sub.add_parser(
+        "distload",
+        help="coordinator/worker sharded loadgen closed loop: "
+             "N-worker merged percentiles must match the 1-worker "
+             "control, trace replay must be deterministic, and the "
+             "composed routers/pools/obsplane capstone must stitch "
+             "complete chains with zero 5xx")
+    distload_cli_args(sp)
+    sp.set_defaults(fn=cmd_distload)
 
     sp = sub.add_parser("scaleout",
                         help="launch router+N engines, measure the "

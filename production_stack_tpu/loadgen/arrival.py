@@ -11,7 +11,7 @@ QPS ramp concatenates stages, each its own Poisson segment.
 """
 
 import random
-from typing import Iterator, List, Sequence, Tuple
+from typing import Iterable, Iterator, List, Sequence, Tuple
 
 
 def poisson_times(rng: random.Random, qps: float,
@@ -62,3 +62,26 @@ def arrival_stream(rng: random.Random,
         base += duration
         if repeat_last and not stages:
             stages = [(qps, duration)]
+
+
+def replay_stream(offsets: Iterable[float],
+                  speedup: float = 1.0) -> Iterator[Tuple[float, float]]:
+    """Recorded arrival offsets as an arrival source: yields
+    (absolute_offset, instantaneous_qps_estimate) in the same shape as
+    ``arrival_stream`` so drivers consume traces and synthetic ramps
+    identically. ``speedup`` > 1 compresses the recorded timeline
+    (replay an hour of production in minutes); the qps estimate is the
+    reciprocal of the (scaled) gap to the previous arrival — good
+    enough for checkpoint lines, never used for pacing."""
+    if speedup <= 0:
+        raise ValueError(f"speedup must be positive, got {speedup}")
+    prev = None
+    for off in offsets:
+        t = off / speedup
+        if prev is not None and t < prev:
+            raise ValueError(
+                f"replay offsets must be non-decreasing, got {t:.6f} "
+                f"after {prev:.6f}")
+        gap = (t - prev) if prev is not None else t
+        yield (t, round(1.0 / gap, 6) if gap > 0 else 0.0)
+        prev = t

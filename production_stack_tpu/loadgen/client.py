@@ -83,6 +83,14 @@ class LoadClient:
                             turn_index=plan.turn_index, kind=plan.kind,
                             launch_time=time.time())
         headers = {"Content-Type": "application/json", **plan.headers}
+        # stable request identity: a function of the PLANNED position
+        # (session, turn), not the launch-order request_id — so the
+        # same logical request carries the same id no matter which
+        # worker fires it or when. The fake engine keys per-request
+        # service-time/error seeding off this header, which is what
+        # makes multi-worker replays reproducible run-to-run.
+        headers.setdefault(
+            "x-request-id", f"lg-{plan.session_id}.{plan.turn_index}")
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
         t0 = time.monotonic()

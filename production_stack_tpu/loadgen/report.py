@@ -12,7 +12,7 @@ Two committed shapes:
 import json
 import platform as _platform
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence
 
 from production_stack_tpu.loadgen.client import RequestRecord
 
@@ -24,6 +24,97 @@ def percentile(values: Sequence[float], p: float) -> float:
     s = sorted(values)
     idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
     return s[idx]
+
+
+class LatencyRecordSet:
+    """Mergeable raw-sample latency set: merge-then-quantile.
+
+    The one legal way to combine latency measurements from multiple
+    phases or workers is to merge the RAW samples and take quantiles of
+    the union — averaging per-worker percentiles is statistically
+    meaningless (the mean of two p99s is not the p99 of anything).
+    This class is the enforcement point: workers ship their samples
+    (``to_dict``/``from_dict`` round-trip through worker JSONL),
+    coordinators ``merge`` and only then read ``quantiles``.
+
+    Samples accumulate via ``add``/``add_samples`` (streaming: a
+    coordinator can fold worker record files in one pass without
+    holding RequestRecords), and quantiles are computed on demand with
+    the same nearest-rank ``percentile`` every committed record uses.
+    """
+
+    def __init__(self) -> None:
+        self.ttft_s: List[float] = []
+        self.itl_s: List[float] = []
+        self.e2e_s: List[float] = []
+        self.count = 0                   # ok records folded in
+
+    @classmethod
+    def from_records(cls, records: Iterable[RequestRecord]
+                     ) -> "LatencyRecordSet":
+        s = cls()
+        for r in records:
+            s.add(r)
+        return s
+
+    def add(self, rec: RequestRecord) -> None:
+        """Fold one OK record's raw samples in (errors/aborts carry no
+        latency truth and are counted elsewhere)."""
+        if not rec.ok:
+            return
+        self.count += 1
+        self.ttft_s.append(rec.ttft_s)
+        self.e2e_s.append(rec.e2e_s)
+        self.itl_s.extend(rec.itl_s)
+
+    def add_samples(self, *, ttft_s: Sequence[float] = (),
+                    itl_s: Sequence[float] = (),
+                    e2e_s: Sequence[float] = (), count: int = 0) -> None:
+        self.ttft_s.extend(ttft_s)
+        self.itl_s.extend(itl_s)
+        self.e2e_s.extend(e2e_s)
+        self.count += count
+
+    def merge(self, other: "LatencyRecordSet") -> "LatencyRecordSet":
+        """Fold another worker/phase's raw samples in (in place)."""
+        self.add_samples(ttft_s=other.ttft_s, itl_s=other.itl_s,
+                         e2e_s=other.e2e_s, count=other.count)
+        return self
+
+    def quantiles(self) -> Dict:
+        """The percentile sub-dicts every summary/record shape carries —
+        computed from the merged raw samples, never from per-shard
+        percentiles."""
+        ttfts, itls, e2es = self.ttft_s, self.itl_s, self.e2e_s
+        return {
+            "ttft_s": {"mean": round(sum(ttfts) / len(ttfts), 4)
+                       if ttfts else 0.0,
+                       "p50": round(percentile(ttfts, 50), 4),
+                       "p90": round(percentile(ttfts, 90), 4),
+                       "p99": round(percentile(ttfts, 99), 4)},
+            "itl_s": {"mean": round(sum(itls) / len(itls), 4)
+                      if itls else 0.0,
+                      "p99": round(percentile(itls, 99), 4)},
+            "e2e_s": {"p50": round(percentile(e2es, 50), 4),
+                      "p99": round(percentile(e2es, 99), 4)},
+        }
+
+    def to_dict(self) -> Dict:
+        """Raw-sample transport shape (worker -> coordinator). Ships
+        samples, not summaries, so the receiver can merge-then-quantile."""
+        return {"count": self.count,
+                "ttft_s": [round(v, 6) for v in self.ttft_s],
+                "itl_s": [round(v, 6) for v in self.itl_s],
+                "e2e_s": [round(v, 6) for v in self.e2e_s]}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "LatencyRecordSet":
+        s = cls()
+        s.add_samples(ttft_s=d.get("ttft_s", ()),
+                      itl_s=d.get("itl_s", ()),
+                      e2e_s=d.get("e2e_s", ()),
+                      count=int(d.get("count", 0)))
+        return s
 
 
 def aggregate(records: List[RequestRecord],
@@ -45,9 +136,7 @@ def aggregate(records: List[RequestRecord],
     aborted = [r for r in in_window if r.aborted]
     cancelled = [r for r in in_window if r.cancelled]
     duration = max(window_end - window_start, 1e-9)
-    ttfts = [r.ttft_s for r in ok]
-    e2es = [r.e2e_s for r in ok]
-    itls = [g for r in ok for g in r.itl_s]
+    latencies = LatencyRecordSet.from_records(ok)
     kinds: Dict[str, int] = {}
     for r in in_window:
         kinds[r.kind] = kinds.get(r.kind, 0) + 1
@@ -77,16 +166,7 @@ def aggregate(records: List[RequestRecord],
         "output_tokens_per_s": round(
             sum(r.output_tokens for r in ok) / duration, 2),
         "total_output_tokens": sum(r.output_tokens for r in ok),
-        "ttft_s": {"mean": round(sum(ttfts) / len(ttfts), 4) if ttfts
-                   else 0.0,
-                   "p50": round(percentile(ttfts, 50), 4),
-                   "p90": round(percentile(ttfts, 90), 4),
-                   "p99": round(percentile(ttfts, 99), 4)},
-        "itl_s": {"mean": round(sum(itls) / len(itls), 4) if itls
-                  else 0.0,
-                  "p99": round(percentile(itls, 99), 4)},
-        "e2e_s": {"p50": round(percentile(e2es, 50), 4),
-                  "p99": round(percentile(e2es, 99), 4)},
+        **latencies.quantiles(),
         "requests_by_kind": kinds,
         "error_samples": error_samples,
     }

@@ -151,7 +151,8 @@ class _Run:
     """Shared machinery between the two loop modes."""
 
     def __init__(self, spec: WorkloadSpec, client: LoadClient,
-                 tracker: InvariantTracker, abort_fraction: float):
+                 tracker: InvariantTracker, abort_fraction: float,
+                 first_session_id: int = 0):
         self.spec = spec
         self.client = client
         self.tracker = tracker
@@ -161,7 +162,12 @@ class _Run:
         # does not perturb the planned workload
         self._abort_rng = random.Random((spec.seed << 8) ^ 0x5eed)
         self.abort_fraction = abort_fraction
-        self._next_session = 0
+        # sharding hook (loadgen/distributed): a worker owning session
+        # range [first, first+k) plans the SAME sessions the whole
+        # schedule would have planned at those ids — plan_sessions is
+        # resumable, so shards concatenate to the unsharded schedule
+        self._first_session = first_session_id
+        self._next_session = first_session_id
 
     def new_session(self) -> SessionState:
         plan = plan_sessions(self.spec, 1, first_id=self._next_session)[0]
@@ -170,7 +176,7 @@ class _Run:
 
     @property
     def sessions_started(self) -> int:
-        return self._next_session
+        return self._next_session - self._first_session
 
     async def fire(self, state: SessionState) -> RequestRecord:
         plan = state.next_request()
@@ -238,9 +244,17 @@ async def _closed_loop(run: _Run, deadline: Optional[float],
 
 
 async def _open_loop(run: _Run, deadline: Optional[float],
-                     max_sessions: Optional[int]) -> None:
+                     max_sessions: Optional[int],
+                     arrival_seed: Optional[int] = None) -> None:
     spec = run.spec
-    rng = random.Random((spec.seed << 8) ^ 0xa441)
+    # arrival randomness is decoupled from spec.seed on request: N
+    # distributed workers plan sessions off the SAME spec.seed (shared
+    # schedule, disjoint id ranges) but need INDEPENDENT Poisson
+    # streams — identical streams would synchronize arrivals into
+    # N-request bursts instead of superposing to one Poisson process
+    seed = arrival_seed if arrival_seed is not None \
+        else (spec.seed << 8) ^ 0xa441
+    rng = random.Random(seed)
     ready: List[SessionState] = []
     in_flight: set = set()
     t0 = time.monotonic()
@@ -327,10 +341,15 @@ async def run_workload(spec: WorkloadSpec, base_url: str, *,
                        p99_ttft_bound_s: Optional[float] = None,
                        checkpoint_interval_s: float = 30.0,
                        checkpoint_path: Optional[str] = None,
-                       warmup_requests: int = 0) -> RunResult:
+                       warmup_requests: int = 0,
+                       first_session_id: int = 0,
+                       arrival_seed: Optional[int] = None) -> RunResult:
     """Drive ``spec`` against ``base_url``; returns records + summary +
     invariant verdicts. ``duration_s``/``max_sessions`` override the
-    spec's own bounds when given."""
+    spec's own bounds when given. ``first_session_id`` starts the
+    session schedule mid-stream (distributed worker shard
+    [first, first+max_sessions)); ``max_sessions`` counts sessions
+    started by THIS run, not absolute ids."""
     spec.validate()
     duration_s = duration_s if duration_s is not None else spec.duration_s
     max_sessions = max_sessions if max_sessions is not None \
@@ -341,7 +360,8 @@ async def run_workload(spec: WorkloadSpec, base_url: str, *,
                         request_timeout_s=spec.request_timeout_s)
     await client.start()
     tracker = InvariantTracker(p99_ttft_bound_s=p99_ttft_bound_s)
-    run = _Run(spec, client, tracker, abort_fraction)
+    run = _Run(spec, client, tracker, abort_fraction,
+               first_session_id=first_session_id)
     checkpoints: List[Dict] = []
     try:
         if warmup_requests > 0:
@@ -378,7 +398,8 @@ async def run_workload(spec: WorkloadSpec, base_url: str, *,
             if spec.arrival.mode == "closed":
                 await _closed_loop(run, deadline, max_sessions)
             else:
-                await _open_loop(run, deadline, max_sessions)
+                await _open_loop(run, deadline, max_sessions,
+                                 arrival_seed=arrival_seed)
         finally:
             ck_task.cancel()
             try:

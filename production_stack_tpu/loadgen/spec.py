@@ -80,14 +80,23 @@ class ArrivalSpec:
     qps_end: float = 4.1
     qps_step: float = 1.0
     stage_duration_s: float = 30.0
+    # every stage's qps multiplied by this AFTER the ramp is built —
+    # the distributed coordinator hands worker i the shared ramp with
+    # qps_scale = 1/N (N Poisson streams at rate/N superpose to the
+    # target rate), without perturbing how many stages the ramp has
+    qps_scale: float = 1.0
 
     def stages(self) -> List[Tuple[float, float]]:
         """Open-loop (qps, duration_s) stages."""
+        if self.qps_scale <= 0:
+            raise ValueError(f"qps_scale {self.qps_scale} must be "
+                             f"positive")
         if self.qps_step <= 0:
             # a non-advancing step would loop this builder forever;
             # constant-rate (start == end) is the one sensible reading
             if self.qps_start == self.qps_end:
-                return [(round(self.qps_start, 6), self.stage_duration_s)]
+                return [(round(self.qps_start * self.qps_scale, 6),
+                         self.stage_duration_s)]
             raise ValueError(
                 f"qps_step {self.qps_step} must be positive to ramp "
                 f"{self.qps_start} -> {self.qps_end}")
@@ -95,7 +104,8 @@ class ArrivalSpec:
         q = self.qps_start
         # tolerance so 0.1 + 4 * 1.0 == 4.1 lands despite float drift
         while q <= self.qps_end + 1e-9:
-            out.append((round(q, 6), self.stage_duration_s))
+            out.append((round(q * self.qps_scale, 6),
+                        self.stage_duration_s))
             q += self.qps_step
         if not out:
             raise ValueError("open-loop ramp has no stages")

@@ -169,3 +169,64 @@ class SessionState:
         if self.plan.kind != "embeddings":
             self.messages.append({"role": "assistant",
                                   "content": text or "(no answer)"})
+
+
+def replay_request_plan(*, session_id: int, turn_index: int, kind: str,
+                        model: str, question_tokens: int,
+                        answer_tokens: int,
+                        system_prompt_tokens: int = 0,
+                        prior_turns: Optional[List[Dict]] = None,
+                        tenant: Optional[str] = None,
+                        stream: bool = True) -> RequestPlan:
+    """A wire-ready RequestPlan reconstructed from a trace line.
+
+    Replay rebuilds the conversation history DETERMINISTICALLY from the
+    recorded shape — prior questions are the same ``filler`` text the
+    original planner produced for (session, turn), prior answers are
+    filler of the recorded answer length — so the prompt grows exactly
+    like the original session's did (same prefix-reuse pressure, same
+    session-affinity key) without needing the original responses.
+    ``prior_turns`` is the trace's earlier lines for this session, each
+    ``{"question_tokens": int, "answer_tokens": int}``.
+    """
+    headers = {"x-user-id": f"lg-user-{session_id}"}
+    if tenant:
+        headers["x-tenant-id"] = tenant
+    if kind == "embeddings":
+        return RequestPlan(
+            path="/v1/embeddings",
+            body={"model": model,
+                  "input": filler(question_tokens, salt=session_id)},
+            headers=headers, stream=False, kind=kind,
+            session_id=session_id, turn_index=turn_index, max_tokens=0)
+    messages: List[Dict] = []
+    if system_prompt_tokens > 0:
+        messages.append({"role": "system",
+                         "content": "Shared context: "
+                         + filler(system_prompt_tokens, salt=session_id)})
+    for j, t in enumerate(prior_turns or []):
+        messages.append({
+            "role": "user",
+            "content": f"Question {j + 1}: "
+            + filler(int(t["question_tokens"]), salt=session_id + j)})
+        messages.append({
+            "role": "assistant",
+            "content": filler(int(t["answer_tokens"]),
+                              salt=session_id + j + 13)})
+    messages.append({
+        "role": "user",
+        "content": f"Question {turn_index + 1}: "
+        + filler(question_tokens, salt=session_id + turn_index)})
+    body: Dict = {
+        "model": model,
+        "messages": messages,
+        "max_tokens": max(1, answer_tokens),
+        "stream": bool(stream),
+        "temperature": 0.0,
+    }
+    if stream:
+        body["stream_options"] = {"include_usage": True}
+    return RequestPlan(path="/v1/chat/completions", body=body,
+                       headers=headers, stream=bool(stream), kind=kind,
+                       session_id=session_id, turn_index=turn_index,
+                       max_tokens=body["max_tokens"])

@@ -164,7 +164,8 @@ class FakeEngine:
                  kv_bytes_per_char: int = 256,
                  trace_ring_entries: int = 4096,
                  adapters=None,
-                 strict_models: bool = False):
+                 strict_models: bool = False,
+                 service_jitter: float = 0.0):
         self.model = model
         # runtime LoRA adapter pool (mirror of the real engine's
         # load_adapter/evict_adapter + /admin/lora/load|evict): name ->
@@ -188,6 +189,13 @@ class FakeEngine:
         self.ttft_s = ttft_s
         self.tokens_per_s = tokens_per_s
         self.num_tokens = num_tokens
+        # per-request service-time jitter, seeded off the REQUEST's own
+        # identity (x-request-id), never off shared RNG state: request
+        # "lg-7.2" draws the same factor whichever worker fires it, in
+        # whatever order it lands — the property that makes
+        # multi-worker replays against fakes reproducible run-to-run.
+        # factor in [1 - jitter, 1 + jitter] stretches ttft + decode.
+        self.service_jitter = max(0.0, service_jitter)
         self.kv_chunk_chars = max(1, kv_chunk_chars)
         self.prefill_s_per_char = prefill_s_per_char
         # disagg role simulation (docs/disagg.md): a kv_producer paces
@@ -339,10 +347,10 @@ class FakeEngine:
         app.router.add_get("/debug/perf", self.debug_perf)
         return app
 
-    async def _tick(self):
+    async def _tick(self, factor: float = 1.0):
         if self.tokens_per_s > 0:
-            stretch = 1.0 + (self.prefill_decode_interference
-                             * self._n_prefilling)
+            stretch = factor * (1.0 + (self.prefill_decode_interference
+                                       * self._n_prefilling))
             await asyncio.sleep(stretch / self.tokens_per_s)
 
     async def _paced_sleep(self, seconds: float):
@@ -980,10 +988,50 @@ class FakeEngine:
                                   "error_rate": self.error_rate,
                                   "errors_injected": self.errors_injected})
 
-    def _draw_partial_error(self) -> Optional[web.Response]:
-        """One RNG draw against the partial error_rate override."""
-        if self.error_rate <= 0 or \
-                self._error_rng.random() >= self.error_rate:
+    @staticmethod
+    def _request_key(request: web.Request) -> Optional[str]:
+        """The request's stable identity for seeded decisions: the
+        caller's x-request-id (the loadgen client derives it from the
+        planned (session, turn) position, and the router forwards it).
+        None = anonymous traffic, which falls back to the legacy
+        shared-RNG path."""
+        return request.headers.get("x-request-id") or None
+
+    @staticmethod
+    def _keyed_rng(key: str, salt: int) -> "_random.Random":
+        """A Random seeded from a cryptographic hash of ``key`` —
+        stable across processes and runs (python's hash() is salted
+        per-process, so it must not leak in here)."""
+        import hashlib
+        import random as _random
+        h = hashlib.sha256(f"{salt}:{key}".encode()).digest()
+        return _random.Random(int.from_bytes(h[:8], "big"))
+
+    def _service_factor(self, key: Optional[str]) -> float:
+        """Per-request pacing multiplier in [1 - j, 1 + j]: a function
+        of the request id alone when one is present, so the same
+        logical request is served at the same speed in every replay."""
+        if self.service_jitter <= 0:
+            return 1.0
+        if key is None:
+            u = self._error_rng.random()      # legacy: shared stream
+        else:
+            u = self._keyed_rng(key, 0x7177).random()
+        return 1.0 + self.service_jitter * (2.0 * u - 1.0)
+
+    def _draw_partial_error(self, key: Optional[str] = None
+                            ) -> Optional[web.Response]:
+        """One draw against the partial error_rate override. With a
+        request key the draw is a pure function of (key, rate) —
+        request "lg-7.2" either always fails or never fails at a given
+        rate, regardless of worker count or arrival order; across
+        distinct keys the failure fraction still converges to the
+        rate. Anonymous requests keep the legacy shared-RNG draw."""
+        if self.error_rate <= 0:
+            return None
+        draw = (self._keyed_rng(key, 0xE44).random() if key is not None
+                else self._error_rng.random())
+        if draw >= self.error_rate:
             return None
         self.errors_injected += 1
         return web.json_response(
@@ -1006,11 +1054,13 @@ class FakeEngine:
                     faulted.headers["x-trace-id"] = trace.trace_id
                 self.tracer.finish(trace, f"fault:{fault['mode']}")
                 return faulted
-        injected = self._draw_partial_error()
+        req_key = self._request_key(request)
+        injected = self._draw_partial_error(req_key)
         if injected is not None:
             injected.headers["x-trace-id"] = trace.trace_id
             self.tracer.finish(trace, "fault:error_rate")
             return injected
+        service_factor = self._service_factor(req_key)
         # injected KV-pool admission (kvplane storm rig): claim
         # blocks_per_request allocatable blocks or 503 like a real
         # engine whose paged pool cannot seat the request
@@ -1047,7 +1097,7 @@ class FakeEngine:
             # as unattributed time no stitcher can pin to a phase
             t_pf = trace.t0
             if self.ttft_s:
-                await asyncio.sleep(self.ttft_s)
+                await asyncio.sleep(self.ttft_s * service_factor)
             prompt_text = ""
             if self._kv_store is not None:
                 # shared-KV simulation: TTFT paced by the uncached
@@ -1071,7 +1121,7 @@ class FakeEngine:
                              "x-engine-id": self._engine_id(request)})
                 await resp.prepare(request)
                 for i in range(n):
-                    await self._tick()
+                    await self._tick(service_factor)
                     chunk = {"id": rid, "object": "chat.completion.chunk",
                              "model": self.model,
                              "choices": [{"index": 0,
@@ -1113,7 +1163,7 @@ class FakeEngine:
             faulted = await self._apply_fault(request, fault)
             if faulted is not None:
                 return faulted
-        injected = self._draw_partial_error()
+        injected = self._draw_partial_error(self._request_key(request))
         if injected is not None:
             return injected
         held, denied = self._kv_pool_try_alloc()
@@ -1447,6 +1497,13 @@ def main(argv=None) -> None:
     p.add_argument("--ttft", type=float, default=0.0)
     p.add_argument("--tokens-per-s", type=float, default=0.0)
     p.add_argument("--num-tokens", type=int, default=8)
+    p.add_argument("--service-jitter", type=float, default=0.0,
+                   help="per-request pacing multiplier spread: each "
+                        "request's ttft/decode pacing scales by a "
+                        "factor in [1-j, 1+j] seeded from its "
+                        "x-request-id (NOT shared RNG state), so "
+                        "multi-worker replays reproduce per-request "
+                        "service times run-to-run")
     p.add_argument("--fault", default=None, choices=FAULT_MODES,
                    help="start with a fault mode active (also settable "
                         "at runtime via POST /fault)")
@@ -1515,7 +1572,8 @@ def main(argv=None) -> None:
                      prefill_decode_interference,
                      trace_ring_entries=args.trace_ring_entries,
                      adapters=[a for a in args.adapters.split(",") if a],
-                     strict_models=args.strict_models)
+                     strict_models=args.strict_models,
+                     service_jitter=args.service_jitter)
     if args.error_rate:
         eng.error_rate = min(1.0, max(0.0, args.error_rate))
     web.run_app(eng.build_app(), host=args.host, port=args.port,

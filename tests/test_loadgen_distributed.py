@@ -1,0 +1,461 @@
+"""Distributed load generation (loadgen distload): sharding laws,
+merge-then-quantile, trace record/replay round-trip, contract units,
+and the fake-fleet rig smoke.
+
+Tiers:
+- units — schedule sharding ([0,k) + [k,n) ≡ [0,n)), rate partition
+  (qps_scale splits the ramp without changing its stage structure),
+  ``LatencyRecordSet`` merge equivalence (and the explicit guard that
+  AVERAGING per-worker percentiles is not merging), trace synth/write/
+  read round-trips, deterministic replay-plan reconstruction, the fake
+  engine's request-keyed service seeding;
+- contract units — distload_violations over synthetic records, each
+  gate tripping independently;
+- rig — tier-1 fake-fleet smoke (control vs 3 sharded workers +
+  double replay, no capstone). The composed capstone and the
+  real-engine coordinated run stay behind ``slow`` (the committed
+  DISTLOAD_r22.json is produced by benchmarks/run_distload.sh).
+"""
+
+import asyncio
+import copy
+import dataclasses
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from production_stack_tpu.loadgen.distributed.distload import (
+    BURSTY_TRACE, distload_spec, distload_violations, run_distload)
+from production_stack_tpu.loadgen.distributed.shard import (
+    WorkerAssignment, shard_sessions, worker_arrival_seed)
+from production_stack_tpu.loadgen.distributed.tracefile import (
+    TraceRequest, issued_key, merge_traces, multiset_digest, read_trace,
+    synthesize_trace, trace_from_records, write_trace)
+from production_stack_tpu.loadgen.client import RequestRecord
+from production_stack_tpu.loadgen.report import (LatencyRecordSet,
+                                                 percentile)
+from production_stack_tpu.loadgen.spec import (ArrivalSpec, SessionSpec,
+                                               TrafficMix, WorkloadSpec)
+from production_stack_tpu.loadgen.workload import (plan_sessions,
+                                                   replay_request_plan)
+
+TRACES_DIR = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                          "traces")
+
+
+def _spec(seed=5, **arrival):
+    return WorkloadSpec(
+        name="t", model="m", seed=seed,
+        session=SessionSpec(rounds_min=1, rounds_max=3,
+                            system_prompt_tokens=8,
+                            question_tokens_mean=10.0,
+                            question_tokens_max=16,
+                            answer_tokens_mean=12.0,
+                            answer_tokens_max=16),
+        arrival=ArrivalSpec(**arrival) if arrival else ArrivalSpec(),
+    ).validate()
+
+
+# --------------------------------------------------- sharding laws
+
+def test_shard_sessions_partitions_contiguously():
+    for total, workers in [(10, 3), (7, 7), (2, 5), (100, 4), (0, 3)]:
+        ranges = shard_sessions(total, workers)
+        assert len(ranges) == workers
+        # contiguous and covering: concatenated ranges are [0, total)
+        cursor = 0
+        for start, end in ranges:
+            assert start == cursor
+            assert end >= start
+            cursor = end
+        assert cursor == total
+        # fair: sizes differ by at most 1
+        sizes = [e - s for s, e in ranges]
+        assert max(sizes) - min(sizes) <= 1
+
+
+def test_sharded_schedule_equals_unsharded():
+    """The tentpole's law: planning sessions [0,k) and [k,n) separately
+    yields exactly the unsharded [0,n) schedule."""
+    spec = _spec()
+    whole = plan_sessions(spec, 9)
+    k = 4
+    sharded = plan_sessions(spec, k, first_id=0) + \
+        plan_sessions(spec, 9 - k, first_id=k)
+    assert [p.session_id for p in sharded] == \
+        [p.session_id for p in whole]
+    for a, b in zip(sharded, whole):
+        assert a.user_id == b.user_id
+        assert [dataclasses.asdict(t) for t in a.turns] == \
+            [dataclasses.asdict(t) for t in b.turns]
+
+
+def test_qps_scale_partitions_rate_not_ramp_shape():
+    ramp = dict(mode="open", qps_start=0.5, qps_end=2.5, qps_step=0.5,
+                stage_duration_s=10.0)
+    base = ArrivalSpec(**ramp).stages()
+    workers = 4
+    scaled = ArrivalSpec(**ramp, qps_scale=1.0 / workers).stages()
+    # same stage structure, rates summing back to the target
+    assert len(scaled) == len(base)
+    for (q, d), (sq, sd) in zip(base, scaled):
+        assert sd == d
+        assert sq * workers == pytest.approx(q, rel=1e-6)
+
+
+def test_worker_arrival_seeds_differ_from_planning_and_each_other():
+    seeds = {worker_arrival_seed(5, i) for i in range(8)}
+    assert len(seeds) == 8           # identical streams would sync
+    assert 5 not in seeds            # decoupled from the planning seed
+
+
+def test_worker_assignment_roundtrip(tmp_path):
+    asn = WorkerAssignment(worker_index=1, num_workers=3,
+                           base_url="http://x", mode="replay",
+                           trace_path="/t.jsonl", speedup=2.0)
+    path = tmp_path / "a.json"
+    path.write_text(asn.to_json())
+    assert WorkerAssignment.from_file(str(path)) == asn
+
+
+# ------------------------------------------- merge-then-quantile
+
+def _rec(i, ttft, e2e, itl=()):
+    return RequestRecord(request_id=i, session_id=i, turn_index=0,
+                         kind="chat", ttft_s=ttft, e2e_s=e2e,
+                         itl_s=list(itl), launch_time=float(i),
+                         finish_time=float(i) + e2e, status=200)
+
+
+def test_merged_quantiles_equal_single_pass():
+    """Folding per-worker sets must equal one pass over the union."""
+    w1 = [_rec(i, 0.01 * i, 0.1 * i, [0.001 * i]) for i in range(1, 40)]
+    w2 = [_rec(i, 0.5 + 0.01 * i, 1.0 + 0.1 * i, [0.01 * i])
+          for i in range(1, 25)]
+    merged = LatencyRecordSet.from_records(w1)
+    merged.merge(LatencyRecordSet.from_records(w2))
+    single = LatencyRecordSet.from_records(w1 + w2)
+    assert merged.quantiles() == single.quantiles()
+    assert merged.count == single.count == len(w1) + len(w2)
+
+
+def test_quantile_averaging_is_not_merging():
+    """The bug the refactor exists to prevent: on skewed workers, the
+    mean of per-worker p99s is NOT the p99 of the union."""
+    fast = [_rec(i, 0.01, 0.01) for i in range(99)]
+    slow = [_rec(i, 1.0, 1.0) for i in range(5)]
+    merged_p99 = LatencyRecordSet.from_records(fast + slow) \
+        .quantiles()["ttft_s"]["p99"]
+    avg_of_p99 = (percentile([r.ttft_s for r in fast], 99)
+                  + percentile([r.ttft_s for r in slow], 99)) / 2
+    assert merged_p99 != pytest.approx(avg_of_p99, rel=0.2)
+    assert merged_p99 == pytest.approx(1.0)   # tail survives the merge
+
+
+def test_latency_recordset_transport_roundtrip():
+    s = LatencyRecordSet.from_records(
+        [_rec(i, 0.01 * i, 0.1 * i, [0.002]) for i in range(1, 20)])
+    back = LatencyRecordSet.from_dict(s.to_dict())
+    assert back.quantiles() == s.quantiles()
+    assert back.count == s.count
+
+
+def test_error_records_carry_no_latency():
+    bad = RequestRecord(request_id=1, session_id=1, turn_index=0,
+                        kind="chat", error="boom", status=500)
+    s = LatencyRecordSet.from_records([bad, _rec(2, 0.1, 0.2)])
+    assert s.count == 1
+    assert s.ttft_s == [0.1]
+
+
+# ---------------------------------------------- trace round-trips
+
+def test_trace_synth_write_read_roundtrip(tmp_path):
+    spec = _spec(seed=9, mode="open", qps_start=2.0, qps_end=2.0,
+                 qps_step=0.0, stage_duration_s=20.0)
+    reqs = synthesize_trace(spec, duration_s=20.0,
+                            tenants=[("a", 3.0), ("b", 1.0)])
+    assert reqs and reqs == synthesize_trace(
+        spec, duration_s=20.0, tenants=[("a", 3.0), ("b", 1.0)])
+    path = str(tmp_path / "t.trace.jsonl")
+    write_trace(path, {"name": "t", "seed": spec.seed}, reqs)
+    header, back = read_trace(path)
+    assert back == sorted(reqs, key=lambda r: (r.offset_s, r.session_id,
+                                               r.turn_index))
+    assert header["requests"] == len(reqs)
+    # byte determinism: rewriting yields the identical file
+    path2 = str(tmp_path / "t2.trace.jsonl")
+    write_trace(path2, {"name": "t", "seed": spec.seed}, back)
+    assert open(path).read() == open(path2).read()
+
+
+def test_read_trace_rejects_malformed(tmp_path):
+    good = ('{"schema": "tpu-loadgen-trace/v1", "requests": 1}\n'
+            '{"offset_s": 0.5, "session_id": 0, "turn_index": 0, '
+            '"kind": "chat", "model": "m", "question_tokens": 4, '
+            '"answer_tokens": 4}\n')
+    p = tmp_path / "x.trace.jsonl"
+    p.write_text(good)
+    read_trace(str(p))
+    for mutation, msg in [
+            (good.replace("/v1", "/v9"), "schema"),
+            (good.replace('"turn_index": 0', '"turn_index": 1'),
+             "contiguous"),
+            (good.replace('"requests": 1', '"requests": 3'), "claims"),
+            (good.replace('"question_tokens": 4, ', ""), "missing")]:
+        p.write_text(mutation)
+        with pytest.raises(ValueError, match=msg):
+            read_trace(str(p))
+
+
+def test_trace_from_records_recovers_schedule():
+    """The recorder: records of a run -> the replayable schedule, with
+    shapes re-derived from the plan and offsets from launch times."""
+    spec = _spec(seed=3)
+    plans = plan_sessions(spec, 3)
+    records, t0 = [], 100.0
+    for i, plan in enumerate(plans):
+        for j, turn in enumerate(plan.turns):
+            records.append(RequestRecord(
+                request_id=len(records), session_id=plan.session_id,
+                turn_index=j, kind=turn.kind,
+                launch_time=t0 + i + 0.1 * j, status=200))
+    trace = trace_from_records(records, spec)
+    assert len(trace) == len(records)
+    assert trace[0].offset_s == 0.0     # rebased to the first launch
+    by_key = {(r.session_id, r.turn_index): r for r in trace}
+    for plan in plans:
+        for j, turn in enumerate(plan.turns):
+            t = by_key[(plan.session_id, j)]
+            assert (t.question_tokens, t.answer_tokens) == \
+                (turn.question_tokens, turn.answer_tokens)
+
+
+def test_merge_traces_rebases_sessions():
+    a = [TraceRequest(0.0, 0, 0, "chat", "m1", 4, 4)]
+    b = [TraceRequest(0.5, 0, 0, "chat", "m2", 4, 4)]
+    merged = merge_traces([a, b], session_stride=1000)
+    assert [r.session_id for r in merged] == [0, 1000]
+    assert {r.model for r in merged} == {"m1", "m2"}
+
+
+def test_replay_plan_reconstruction_deterministic():
+    kwargs = dict(session_id=7, turn_index=2, kind="chat", model="m",
+                  question_tokens=8, answer_tokens=8,
+                  system_prompt_tokens=8,
+                  prior_turns=[{"question_tokens": 6,
+                                "answer_tokens": 6}] * 2,
+                  tenant="acme")
+    p1, p2 = replay_request_plan(**kwargs), replay_request_plan(**kwargs)
+    assert p1.body == p2.body
+    assert p1.headers == p2.headers
+    assert p1.headers["x-tenant-id"] == "acme"
+    assert p1.headers["x-user-id"] == "lg-user-7"
+    # history: system + 2 prior (question, answer) pairs + the question
+    assert len(p1.body["messages"]) == 6
+    # a different turn of the same session produces a different prompt
+    p3 = replay_request_plan(**{**kwargs, "turn_index": 1,
+                                "prior_turns": kwargs["prior_turns"][:1]})
+    assert p3.body != p1.body
+
+
+def test_issued_digest_is_order_independent():
+    reqs = [TraceRequest(0.1 * i, i, 0, "chat", "m", 4, 4)
+            for i in range(10)]
+    keys = [issued_key(r) for r in reqs]
+    assert multiset_digest(keys) == multiset_digest(list(reversed(keys)))
+    assert multiset_digest(keys) != multiset_digest(keys[:-1])
+
+
+def test_committed_traces_are_valid_and_fleet_shaped():
+    """The committed demo traces must parse, and mixed_classes must
+    carry all three fleet streams (model-a, lora-a, model-b) the
+    capstone's two pools serve."""
+    models = set()
+    for name in ("diurnal_ramp", "bursty_tenant", "mixed_classes"):
+        header, reqs = read_trace(
+            os.path.join(TRACES_DIR, f"{name}.trace.jsonl"))
+        assert header["requests"] == len(reqs) > 0
+        if name == "mixed_classes":
+            models = {r.model for r in reqs}
+        if name == "bursty_tenant":
+            tenants = [r.tenant for r in reqs]
+            assert tenants.count("acme") > len(reqs) / 2   # the burst
+    assert models == {"model-a", "lora-a", "model-b"}
+
+
+# ------------------------------- fake-engine request-keyed seeding
+
+def test_fake_engine_service_factor_keyed_by_request_id():
+    from tests.fake_engine import FakeEngine
+    eng = FakeEngine(service_jitter=0.3)
+    req = SimpleNamespace(headers={"x-request-id": "lg-5.0"})
+    key = eng._request_key(req)
+    f1 = eng._service_factor(key)
+    # deterministic per key, independent of call order / other draws
+    eng._service_factor(eng._request_key(
+        SimpleNamespace(headers={"x-request-id": "lg-9.1"})))
+    assert eng._service_factor(key) == f1
+    # a fresh engine (fresh process) agrees: no global-RNG coupling
+    assert FakeEngine(service_jitter=0.3)._service_factor(key) == f1
+    # different requests draw different factors, all inside the band
+    factors = {FakeEngine(service_jitter=0.3)._service_factor(f"lg-{i}.0")
+               for i in range(16)}
+    assert len(factors) > 8
+    assert all(0.7 <= f <= 1.3 for f in factors)
+    # jitter off -> unity, whatever the key
+    assert FakeEngine()._service_factor(key) == 1.0
+
+
+# ------------------------------------------------- contract units
+
+def _clean_record():
+    q = {"ttft_s": {"mean": 0.05, "p50": 0.05, "p90": 0.06,
+                    "p99": 0.07},
+         "itl_s": {"mean": 0.01, "p99": 0.02},
+         "e2e_s": {"p50": 0.2, "p99": 0.3}}
+    summary = {"offered_qps": 6.1, "errors": 0, "http_5xx": 0,
+               "launched": 61, **copy.deepcopy(q)}
+    block = {"summary": copy.deepcopy(summary), "violations": [],
+             "per_worker": [], "skew": {}}
+    return {"detail": {
+        "workers": 3, "declared_workers": 3, "target_qps": 6.0,
+        "min_workers": 3,
+        "tolerances": {"qps_rel_tol": 0.25, "pct_rel_tol": 0.35,
+                       "pct_abs_tol_s": 0.05,
+                       "min_chain_fraction": 0.95},
+        "control": copy.deepcopy(block),
+        "dist": copy.deepcopy(block),
+        "anti_vacuity": {"mode": "mismatched-rate",
+                         "offered_qps": 18.2,
+                         "violations": ["SCALE dist offered 18.2"]},
+        "replay": {"trace": "bursty_tenant.trace.jsonl",
+                   "trace_requests": 113, "speedup": 4.0,
+                   "runs": [{"summary": {"errors": 0, "launched": 113},
+                             "violations": [], "issued_digest": "d1"},
+                            {"summary": {"errors": 0, "launched": 113},
+                             "violations": [], "issued_digest": "d1"}]},
+        "capstone": {"summary": {"errors": 0, "http_5xx": 0},
+                     "violations": [],
+                     "stitch": {"chains_complete": 120,
+                                "complete_fraction": 0.99},
+                     "pools_served": {"model-a": 80, "lora-a": 20,
+                                      "model-b": 26},
+                     "routers": 2},
+        "control_errors": [],
+    }}
+
+
+def test_distload_violations_clean_record_passes():
+    assert distload_violations(_clean_record()) == []
+
+
+def test_distload_violations_catch_each_gate():
+    r = _clean_record()
+    r["detail"]["dist"]["summary"]["offered_qps"] = 18.0
+    assert any("superposing" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["dist"]["summary"]["ttft_s"]["p50"] = 0.4
+    assert any("sharding changed the measurement" in v
+               for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["dist"]["summary"]["errors"] = 3
+    assert any("request errors" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["workers"] = 1
+    assert any("requires >= 3" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["replay"]["runs"][1]["issued_digest"] = "d2"
+    assert any("not deterministic" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["replay"]["runs"][0]["summary"]["launched"] = 90
+    assert any("launched 90" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["anti_vacuity"]["violations"] = []
+    assert any("too loose" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["capstone"]["stitch"]["complete_fraction"] = 0.5
+    assert any("completeness" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["capstone"]["stitch"] = {}
+    assert any("vacuous" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["capstone"]["summary"]["http_5xx"] = 2
+    assert any("raw 5xx" in v for v in distload_violations(r))
+
+    r = _clean_record()
+    r["detail"]["capstone"]["pools_served"].pop("model-b")
+    assert any("pool-b saw no traffic" in v
+               for v in distload_violations(r))
+
+
+# ------------------------------------------------------------- rig
+
+def test_distload_smoke_fake_fleet(tmp_path):
+    """Tier-1: control vs 3 sharded workers + double sharded replay of
+    the committed bursty trace against one router + 2 jittered fake
+    engines; every gate green, and the embedded mismatched-rate run
+    must fail the scaling gate."""
+    record = asyncio.run(run_distload(
+        engines=2, workers=3, qps=6.0, phase_s=5.0,
+        trace_path=BURSTY_TRACE, speedup=10.0, capstone=False,
+        worker_timeout_s=120.0,
+        log_dir=str(tmp_path / "logs"),
+        work_dir=str(tmp_path / "wd")))
+    assert distload_violations(record) == []
+    d = record["detail"]
+    assert d["dist"]["summary"]["errors"] == 0
+    assert d["anti_vacuity"]["violations"]          # self-test failed
+    assert d["replay"]["runs"][0]["issued_digest"] == \
+        d["replay"]["runs"][1]["issued_digest"]
+
+
+@pytest.mark.slow
+def test_distload_capstone_fake_fleet(tmp_path):
+    """The committed-record shape: everything in the smoke PLUS the
+    2-router/2-pool/obsplane capstone under the mixed trace."""
+    record = asyncio.run(run_distload(
+        engines=2, workers=3, qps=6.0, phase_s=8.0, speedup=4.0,
+        capstone=True, log_dir=str(tmp_path / "logs"),
+        work_dir=str(tmp_path / "wd")))
+    assert distload_violations(record) == []
+    cap = record["detail"]["capstone"]
+    assert cap["stitch"]["complete_fraction"] >= 0.95
+    assert cap["pools_served"].get("model-b", 0) > 0
+
+
+@pytest.mark.slow
+def test_coordinated_run_real_engine(tmp_path):
+    """Sharded loadgen against a REAL debug-tiny engine stack: two
+    workers' merged records must carry zero errors and real latency."""
+    from production_stack_tpu.loadgen.distributed.coordinator import (
+        run_coordinated, synthetic_assignments)
+    from production_stack_tpu.loadgen.orchestrator import LocalStack
+
+    async def go():
+        async with LocalStack(1, "debug-tiny",
+                              log_dir=str(tmp_path / "logs")) as stack:
+            spec = distload_spec(2.0, 10.0)
+            spec.model = "debug-tiny"
+            asns = synthetic_assignments(spec, stack.url, workers=2,
+                                         duration_s=10.0,
+                                         warmup_requests=2)
+            return await asyncio.to_thread(
+                run_coordinated, asns,
+                work_dir=str(tmp_path / "wd"), timeout_s=300.0)
+
+    res = asyncio.run(go())
+    assert res.violations == []
+    assert res.merged_summary["errors"] == 0
+    assert res.merged_summary["finished"] > 0
+    assert res.merged_summary["ttft_s"]["p50"] > 0

@@ -32,6 +32,13 @@ SURFACES = {
     / "__main__.py",
     "obsplane": REPO / "production_stack_tpu" / "obsplane" / "app.py",
     "kvplane": REPO / "production_stack_tpu" / "kvplane" / "app.py",
+    # the distributed-loadgen surfaces: the distload rig's flags (a
+    # closed-loop gate operators reproduce records with) and the worker
+    # subprocess a multi-host run drives by hand
+    "loadgen-distload": REPO / "production_stack_tpu" / "loadgen"
+    / "distributed" / "distload.py",
+    "loadgen-worker": REPO / "production_stack_tpu" / "loadgen"
+    / "distributed" / "worker.py",
 }
 
 FLAG_RE = re.compile(r'add_argument\(\s*"(--[a-z0-9][a-z0-9-]*)"')

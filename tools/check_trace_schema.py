@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""Lint: committed loadgen traces must be valid and replayable.
+
+Validates every ``benchmarks/traces/*.trace.jsonl`` against the
+``tpu-loadgen-trace/v1`` format (documented in docs/benchmarks.md):
+header line with the schema tag and accurate request/session counts,
+every request line carrying the required fields with sane values,
+offsets non-decreasing across the file, and each session's turn
+indexes contiguous from 0. A committed trace that fails any of these
+would replay as a different workload than its name claims — the
+distload determinism gate downstream would chase a corrupt fixture.
+
+Deliberately stdlib-only and independent of
+``production_stack_tpu.loadgen.distributed.tracefile`` (same
+scan-don't-import pattern as the other doc/metrics linters, and a
+cross-check: the committed files must satisfy the SPEC, not merely
+whatever the current reader tolerates).
+
+Exit 1 lists every violation with file:line.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+TRACES_DIR = REPO / "benchmarks" / "traces"
+
+SCHEMA = "tpu-loadgen-trace/v1"
+REQUIRED = ("offset_s", "session_id", "turn_index", "kind", "model",
+            "question_tokens", "answer_tokens")
+KINDS = {"chat", "guided", "shaped", "embeddings", "lora"}
+
+
+def check_trace(path: Path) -> list:
+    errs = []
+    lines = [ln for ln in path.read_text(encoding="utf-8").splitlines()
+             if ln.strip()]
+    if not lines:
+        return [f"{path.name}:1: empty trace"]
+    try:
+        header = json.loads(lines[0])
+    except json.JSONDecodeError as e:
+        return [f"{path.name}:1: header is not JSON ({e})"]
+    if header.get("schema") != SCHEMA:
+        errs.append(f"{path.name}:1: schema {header.get('schema')!r}, "
+                    f"expected {SCHEMA!r}")
+    prev_off = 0.0
+    turn_seen = {}
+    n = 0
+    for i, ln in enumerate(lines[1:], start=2):
+        try:
+            d = json.loads(ln)
+        except json.JSONDecodeError as e:
+            errs.append(f"{path.name}:{i}: not JSON ({e})")
+            continue
+        n += 1
+        missing = [k for k in REQUIRED if k not in d]
+        if missing:
+            errs.append(f"{path.name}:{i}: missing {missing}")
+            continue
+        if d["kind"] not in KINDS:
+            errs.append(f"{path.name}:{i}: unknown kind {d['kind']!r}")
+        if d["question_tokens"] <= 0 or d["answer_tokens"] <= 0:
+            errs.append(f"{path.name}:{i}: non-positive token counts")
+        off = d["offset_s"]
+        if off < prev_off - 1e-9:
+            errs.append(f"{path.name}:{i}: offset {off} before "
+                        f"previous {prev_off} (must be non-decreasing)")
+        prev_off = max(prev_off, off)
+        sid, turn = d["session_id"], d["turn_index"]
+        expect = turn_seen.get(sid, 0)
+        if turn != expect:
+            errs.append(f"{path.name}:{i}: session {sid} turn {turn}, "
+                        f"expected {expect} (contiguous from 0)")
+        turn_seen[sid] = expect + 1
+    for field, got in (("requests", n), ("sessions", len(turn_seen))):
+        declared = header.get(field)
+        if declared is not None and declared != got:
+            errs.append(f"{path.name}:1: header claims {declared} "
+                        f"{field}, file has {got}")
+    return errs
+
+
+def main() -> int:
+    traces = sorted(TRACES_DIR.glob("*.trace.jsonl"))
+    if not traces:
+        print(f"no traces under {TRACES_DIR} — the distload rig's "
+              f"committed fixtures are missing", file=sys.stderr)
+        return 1
+    errs = []
+    for path in traces:
+        errs.extend(check_trace(path))
+    if errs:
+        print(f"{len(errs)} trace schema violations:", file=sys.stderr)
+        for e in errs:
+            print(f"  - {e}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(traces)} committed traces valid "
+          f"({', '.join(p.name for p in traces)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
